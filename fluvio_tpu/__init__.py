@@ -1,0 +1,15 @@
+"""fluvio-tpu: a TPU-native data-streaming framework.
+
+A ground-up, TPU-first rebuild of the capabilities of Fluvio (a Kafka-class
+distributed log with WASM stream transforms). The layering mirrors the
+reference system (wire protocol -> transport -> storage -> broker/controller
+-> client -> CLI), while the SmartModule transform engine — the hot path —
+executes filter/map/filter_map/array_map/aggregate chains as fused JAX/XLA
+programs over an HBM-resident batched-record buffer.
+
+Reference capability map: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+from fluvio_tpu.types import Offset, PartitionId, SpuId  # noqa: F401

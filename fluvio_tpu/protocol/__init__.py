@@ -1,0 +1,34 @@
+"""Wire protocol: versioned binary codec, records, batches, request framing.
+
+Capability parity with the reference's `fluvio-protocol` crate (versioned
+Encoder/Decoder, Record/Batch/RecordSet, api-key request framing, error
+codes) and `fluvio-compression`. The wire format is our own spec — a
+Kafka-style layout documented in `record.py` — since the framework defines
+both ends of every connection.
+"""
+
+from fluvio_tpu.protocol.codec import ByteReader, ByteWriter, DecodeError
+from fluvio_tpu.protocol.varint import varint_decode, varint_encode, varint_size
+from fluvio_tpu.protocol.record import (
+    Batch,
+    BatchHeader,
+    Record,
+    RecordSet,
+    COMPRESSION_NONE,
+)
+from fluvio_tpu.protocol.error import ErrorCode
+
+__all__ = [
+    "ByteReader",
+    "ByteWriter",
+    "DecodeError",
+    "varint_decode",
+    "varint_encode",
+    "varint_size",
+    "Record",
+    "Batch",
+    "BatchHeader",
+    "RecordSet",
+    "ErrorCode",
+    "COMPRESSION_NONE",
+]

@@ -1,0 +1,91 @@
+"""Record-batch compression codecs.
+
+Capability parity: the `fluvio-compression` crate (gzip/snappy/lz4/zstd,
+fluvio-compression/src/lib.rs). Codec ids live in the low 3 bits of the
+batch attributes word. gzip (zlib) and zstd are always available in this
+environment; lz4/snappy are gated — attempting to use a missing codec raises
+``UnsupportedCompression`` at call time, never at import time.
+"""
+
+from __future__ import annotations
+
+import enum
+import gzip as _gzip
+
+
+class UnsupportedCompression(Exception):
+    pass
+
+
+class Compression(enum.IntEnum):
+    NONE = 0
+    GZIP = 1
+    SNAPPY = 2
+    LZ4 = 3
+    ZSTD = 4
+
+    @classmethod
+    def parse(cls, name: str) -> "Compression":
+        try:
+            return cls[name.strip().upper()]
+        except KeyError:
+            raise ValueError(f"unknown compression: {name!r}") from None
+
+
+try:
+    import zstandard as _zstd
+
+    _ZSTD_C = _zstd.ZstdCompressor(level=3)
+    _ZSTD_D = _zstd.ZstdDecompressor()
+except ImportError:  # pragma: no cover
+    _zstd = None
+
+try:
+    import lz4.frame as _lz4  # type: ignore
+except ImportError:
+    _lz4 = None
+
+try:
+    import snappy as _snappy  # type: ignore
+except ImportError:
+    _snappy = None
+
+
+def compress(codec: Compression, data: bytes) -> bytes:
+    if codec == Compression.NONE:
+        return data
+    if codec == Compression.GZIP:
+        return _gzip.compress(data, compresslevel=6)
+    if codec == Compression.ZSTD:
+        if _zstd is None:
+            raise UnsupportedCompression("zstd not available")
+        return _ZSTD_C.compress(data)
+    if codec == Compression.LZ4:
+        if _lz4 is None:
+            raise UnsupportedCompression("lz4 not available in this environment")
+        return _lz4.compress(data)
+    if codec == Compression.SNAPPY:
+        if _snappy is None:
+            raise UnsupportedCompression("snappy not available in this environment")
+        return _snappy.compress(data)
+    raise UnsupportedCompression(f"unknown codec {codec}")
+
+
+def decompress(codec: Compression, data: bytes) -> bytes:
+    if codec == Compression.NONE:
+        return data
+    if codec == Compression.GZIP:
+        return _gzip.decompress(data)
+    if codec == Compression.ZSTD:
+        if _zstd is None:
+            raise UnsupportedCompression("zstd not available")
+        return _ZSTD_D.decompress(data)
+    if codec == Compression.LZ4:
+        if _lz4 is None:
+            raise UnsupportedCompression("lz4 not available in this environment")
+        return _lz4.decompress(data)
+    if codec == Compression.SNAPPY:
+        if _snappy is None:
+            raise UnsupportedCompression("snappy not available in this environment")
+        return _snappy.decompress(data)
+    raise UnsupportedCompression(f"unknown codec {codec}")

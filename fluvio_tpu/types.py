@@ -1,0 +1,152 @@
+"""Primitive ids, defaults, and in-process event primitives.
+
+Capability parity: the reference's `fluvio-types` crate — id aliases and
+defaults (fluvio-types/src/lib.rs), `StickyEvent` (fluvio-types/src/event.rs:13)
+and `OffsetPublisher`/`OffsetChangeListener` (fluvio-types/src/event.rs:70).
+Here the event primitives are asyncio-native instead of async-rust: a
+`StickyEvent` is a latchable `asyncio.Event`, and `OffsetPublisher` is a
+monotonic value with per-listener change wakeups (the in-process bus that
+wakes consumer streams when the leader's HW/LEO advances).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+# ---------------------------------------------------------------------------
+# Aliases & defaults
+# ---------------------------------------------------------------------------
+
+SpuId = int
+PartitionId = int
+Offset = int
+Timestamp = int  # milliseconds since epoch; NO_TIMESTAMP = -1
+
+NO_TIMESTAMP: Timestamp = -1
+
+SPU_PUBLIC_PORT = 9010
+SPU_PRIVATE_PORT = 9011
+SC_PUBLIC_PORT = 9003
+SC_PRIVATE_PORT = 9004
+
+DEFAULT_REPLICATION_FACTOR = 1
+DEFAULT_PARTITIONS = 1
+
+PRODUCER_ID_NO_PRODUCER = -1
+
+
+def partition_replica_key(topic: str, partition: PartitionId) -> str:
+    """Canonical replica id, e.g. ``my-topic-0``."""
+    return f"{topic}-{partition}"
+
+
+# ---------------------------------------------------------------------------
+# Event primitives
+# ---------------------------------------------------------------------------
+
+
+class StickyEvent:
+    """One-way latch: once notified, stays set forever.
+
+    Used for end-of-life signalling (server shutdown, stream close) exactly
+    like the reference's StickyEvent.
+    """
+
+    def __init__(self) -> None:
+        self._event = asyncio.Event()
+
+    def notify(self) -> None:
+        self._event.set()
+
+    def is_set(self) -> bool:
+        return self._event.is_set()
+
+    async def wait(self) -> None:
+        await self._event.wait()
+
+
+class OffsetChangeListener:
+    """Listener handle on an :class:`OffsetPublisher`.
+
+    ``listen()`` returns as soon as the published value differs from the last
+    value this listener observed (immediately, if it already differs).
+    """
+
+    def __init__(self, publisher: "OffsetPublisher") -> None:
+        self._publisher = publisher
+        self._last_seen: Offset = publisher.current_value()
+        self._cond = publisher._cond
+
+    def last_seen(self) -> Offset:
+        return self._last_seen
+
+    async def listen(self) -> Offset:
+        async with self._cond:
+            while self._publisher.current_value() == self._last_seen:
+                await self._cond.wait()
+            self._last_seen = self._publisher.current_value()
+            return self._last_seen
+
+    def sync(self) -> Offset:
+        """Mark the current value as seen and return it (non-blocking)."""
+        self._last_seen = self._publisher.current_value()
+        return self._last_seen
+
+
+class OffsetPublisher:
+    """Monotonic offset bus: publishes a value, wakes all listeners on change.
+
+    The in-process signal path between replica state (LEO/HW advances) and
+    the per-stream select loops that push records to consumers.
+    """
+
+    def __init__(self, initial: Offset = -1) -> None:
+        self._value: Offset = initial
+        self._cond = asyncio.Condition()
+        self._pending: set = set()  # keep notify tasks alive until done
+
+    def current_value(self) -> Offset:
+        return self._value
+
+    def update(self, value: Offset) -> None:
+        if value == self._value:
+            return
+        self._value = value
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # No loop running -> nothing can be blocked in wait(); the new
+            # value is visible to any listener created later.
+            return
+        task = loop.create_task(self._notify())
+        self._pending.add(task)
+        task.add_done_callback(self._pending.discard)
+
+    async def update_async(self, value: Offset) -> None:
+        if value == self._value:
+            return
+        async with self._cond:
+            self._value = value
+            self._cond.notify_all()
+
+    async def _notify(self) -> None:
+        async with self._cond:
+            self._cond.notify_all()
+
+    def change_listener(self) -> OffsetChangeListener:
+        return OffsetChangeListener(self)
+
+
+class SimpleEvent:
+    """Re-armable notification used by follower sync controllers."""
+
+    def __init__(self) -> None:
+        self._event = asyncio.Event()
+
+    def notify(self) -> None:
+        self._event.set()
+
+    async def listen(self) -> None:
+        await self._event.wait()
+        self._event.clear()

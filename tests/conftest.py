@@ -1,0 +1,14 @@
+"""Test harness config: force an 8-device virtual CPU mesh for JAX tests.
+
+Must set env before jax is imported anywhere in the test process, so this
+lives in conftest.py which pytest imports first.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

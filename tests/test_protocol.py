@@ -1,0 +1,199 @@
+"""Protocol round-trip tests.
+
+Mirrors the reference's fluvio-protocol unit tests: varint edge cases,
+record/batch/recordset encode-decode round trips, compression variants,
+raw (shallow) batch decode, and request framing.
+"""
+
+import numpy as np
+import pytest
+
+from fluvio_tpu.protocol.api import (
+    ApiVersionKey,
+    ApiVersionsRequest,
+    ApiVersionsResponse,
+    RequestMessage,
+    decode_request_header,
+)
+from fluvio_tpu.protocol.codec import ByteReader, ByteWriter, DecodeError
+from fluvio_tpu.protocol.compression import Compression
+from fluvio_tpu.protocol.error import ApiError, ErrorCode
+from fluvio_tpu.protocol.record import Batch, Record, RecordSet
+from fluvio_tpu.protocol.varint import (
+    varint_decode,
+    varint_decode_array,
+    varint_encode,
+    varint_encode_array,
+    varint_encoded_sizes,
+    varint_size,
+)
+
+
+class TestVarint:
+    @pytest.mark.parametrize(
+        "value", [0, 1, -1, 63, 64, -64, -65, 127, 128, 300, -300, 2**31, -(2**31), 2**62, -(2**62)]
+    )
+    def test_roundtrip(self, value):
+        buf = bytearray()
+        varint_encode(buf, value)
+        assert len(buf) == varint_size(value)
+        decoded, pos = varint_decode(buf, 0)
+        assert decoded == value
+        assert pos == len(buf)
+
+    def test_truncated(self):
+        buf = bytearray()
+        varint_encode(buf, 10**12)
+        with pytest.raises(ValueError):
+            varint_decode(buf[:-1], 0)
+
+    def test_vectorized_roundtrip(self):
+        rng = np.random.default_rng(0)
+        values = np.concatenate(
+            [
+                rng.integers(-(2**31), 2**31, size=1000),
+                np.array([0, 1, -1, 2**62, -(2**62), 127, -128]),
+            ]
+        ).astype(np.int64)
+        sizes = varint_encoded_sizes(values)
+        # scalar sizes agree
+        for v, s in zip(values.tolist()[:50], sizes.tolist()[:50]):
+            assert varint_size(v) == s
+        starts = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+        out = np.zeros(int(sizes.sum()), dtype=np.uint8)
+        ends = varint_encode_array(values, out, starts)
+        assert (ends == starts + sizes).all()
+        # scalar decode agrees
+        for i in [0, 1, 5, 500, len(values) - 1]:
+            v, pos = varint_decode(out, int(starts[i]))
+            assert v == values[i]
+            assert pos == ends[i]
+        # vector decode agrees
+        decoded, new_pos = varint_decode_array(out, starts)
+        np.testing.assert_array_equal(decoded, values)
+        np.testing.assert_array_equal(new_pos, ends)
+
+
+class TestRecord:
+    def roundtrip(self, rec: Record) -> Record:
+        w = ByteWriter()
+        rec.encode(w)
+        return Record.decode(ByteReader(w.bytes()))
+
+    def test_value_only(self):
+        out = self.roundtrip(Record(value=b"hello fluvio"))
+        assert out.value == b"hello fluvio"
+        assert out.key is None
+
+    def test_key_value(self):
+        out = self.roundtrip(Record(value=b"v" * 1000, key=b"k1", offset_delta=7, timestamp_delta=-5))
+        assert out.value == b"v" * 1000
+        assert out.key == b"k1"
+        assert out.offset_delta == 7
+        assert out.timestamp_delta == -5
+
+    def test_empty(self):
+        out = self.roundtrip(Record())
+        assert out.value == b""
+        assert out.key is None
+
+
+class TestBatch:
+    def test_roundtrip(self):
+        records = [Record(value=f"rec-{i}".encode(), key=b"k") for i in range(10)]
+        batch = Batch.from_records(records, base_offset=100, first_timestamp=1234)
+        w = ByteWriter()
+        batch.encode(w)
+        out = Batch.decode(ByteReader(w.bytes()))
+        assert out.base_offset == 100
+        assert out.header.last_offset_delta == 9
+        assert out.header.first_timestamp == 1234
+        assert out.computed_last_offset() == 110
+        assert [r.value for r in out.records] == [f"rec-{i}".encode() for i in range(10)]
+        assert [r.offset_delta for r in out.records] == list(range(10))
+
+    @pytest.mark.parametrize("codec", [Compression.NONE, Compression.GZIP, Compression.ZSTD])
+    def test_compression_roundtrip(self, codec):
+        records = [Record(value=b"x" * 500) for _ in range(50)]
+        batch = Batch.from_records(records, compression=codec)
+        w = ByteWriter()
+        batch.encode(w)
+        out = Batch.decode(ByteReader(w.bytes()))
+        assert out.header.compression() == codec
+        assert len(out.records) == 50
+        assert all(r.value == b"x" * 500 for r in out.records)
+        if codec != Compression.NONE:
+            raw = Batch.decode(ByteReader(w.bytes()), parse_records=False)
+            assert raw.raw_record_count == 50
+            assert len(raw.raw_records) < 50 * 500  # actually compressed
+
+    def test_shallow_decode_then_materialize(self):
+        records = [Record(value=f"{i}".encode()) for i in range(5)]
+        batch = Batch.from_records(records, base_offset=3)
+        w = ByteWriter()
+        batch.encode(w)
+        shallow = Batch.decode(ByteReader(w.bytes()), parse_records=False)
+        assert shallow.records_len() == 5
+        assert shallow.raw_records is not None
+        mats = shallow.memory_records()
+        assert [r.value for r in mats] == [b"0", b"1", b"2", b"3", b"4"]
+
+    def test_corrupt_truncated(self):
+        batch = Batch.from_records([Record(value=b"abc")])
+        w = ByteWriter()
+        batch.encode(w)
+        with pytest.raises(DecodeError):
+            Batch.decode(ByteReader(w.bytes()[: len(w.bytes()) - 3]))
+
+
+class TestRecordSet:
+    def test_multi_batch_roundtrip(self):
+        rs = RecordSet()
+        rs.add(Batch.from_records([Record(value=b"a"), Record(value=b"b")], base_offset=0))
+        rs.add(Batch.from_records([Record(value=b"c")], base_offset=2))
+        w = ByteWriter()
+        rs.encode(w)
+        out = RecordSet.decode(ByteReader(w.bytes()))
+        assert len(out.batches) == 2
+        assert out.total_records() == 3
+        assert out.base_offset() == 0
+        assert out.last_offset() == 3
+
+    def test_empty(self):
+        w = ByteWriter()
+        RecordSet().encode(w)
+        out = RecordSet.decode(ByteReader(w.bytes()))
+        assert out.batches == []
+        assert out.last_offset() is None
+
+
+class TestApiFraming:
+    def test_request_roundtrip(self):
+        req = ApiVersionsRequest(client_version="9.9.9")
+        msg = RequestMessage.new_request(req)
+        frame = msg.to_frame()
+        r = ByteReader(frame)
+        payload_len = r.read_i32()
+        payload = r.read_raw(payload_len)
+        header, body = decode_request_header(payload)
+        assert header.api_key == ApiVersionsRequest.API_KEY
+        decoded = ApiVersionsRequest.decode(body, header.api_version)
+        assert decoded.client_version == "9.9.9"
+
+    def test_api_versions_response(self):
+        resp = ApiVersionsResponse(
+            api_keys=[ApiVersionKey(0, 0, 3), ApiVersionKey(1003, 0, 5)]
+        )
+        w = ByteWriter()
+        resp.encode(w, 0)
+        out = ApiVersionsResponse.decode(ByteReader(w.bytes()), 0)
+        assert out.lookup_version(1003) == 5
+        assert out.lookup_version(42) is None
+
+    def test_api_error(self):
+        for err in [ApiError.ok(), ApiError(ErrorCode.TOPIC_NOT_FOUND, "no such topic")]:
+            w = ByteWriter()
+            err.encode(w)
+            out = ApiError.decode(ByteReader(w.bytes()))
+            assert out.code == err.code
+            assert out.message == err.message
