@@ -1,0 +1,32 @@
+"""aggregate-sum / aggregate-count / word-count (baseline config #3).
+
+Stateful reductions with the reference's aggregate semantics (derive
+generator aggregate.rs: the running accumulator is emitted as each output
+record's value). DSL-only — on the TPU backend these lower to `lax.scan`
+with a device-resident carry.
+"""
+
+from __future__ import annotations
+
+from fluvio_tpu.models import register
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef
+from fluvio_tpu.smartmodule.types import SmartModuleKind
+
+
+def _make(kind: str):
+    def factory() -> SmartModuleDef:
+        m = SmartModuleDef(name=f"aggregate-{kind}")
+        m.dsl[SmartModuleKind.AGGREGATE] = dsl.AggregateProgram(kind=kind)
+        return m
+
+    return factory
+
+
+module = _make("sum_int")
+
+register("aggregate-sum", _make("sum_int"))
+register("aggregate-count", _make("count"))
+register("word-count", _make("word_count"))
+register("aggregate-max", _make("max_int"))
+register("aggregate-min", _make("min_int"))

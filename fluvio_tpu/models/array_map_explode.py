@@ -1,0 +1,30 @@
+"""array-map JSON-array explode (baseline config #4).
+
+Each record's value must be a top-level JSON array; one output record is
+emitted per element (strings unquoted, key preserved from the input
+record). Non-array input is a transform runtime error at that record, like
+the reference's array-map example returning ``Err``.
+"""
+
+from __future__ import annotations
+
+from fluvio_tpu.models import register
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef
+from fluvio_tpu.smartmodule.types import SmartModuleKind
+
+
+def module() -> SmartModuleDef:
+    m = SmartModuleDef(name="array-map-json")
+    m.dsl[SmartModuleKind.ARRAY_MAP] = dsl.ArrayMapProgram(mode="json_array")
+    return m
+
+
+def lines_module() -> SmartModuleDef:
+    m = SmartModuleDef(name="array-map-lines")
+    m.dsl[SmartModuleKind.ARRAY_MAP] = dsl.ArrayMapProgram(mode="split", sep=b"\n")
+    return m
+
+
+register("array-map-json", module)
+register("array-map-lines", lines_module)

@@ -1,0 +1,37 @@
+"""json-map — JSON field extraction map (baseline config #2 chain tail).
+
+Maps each record's value to the (ASCII-uppercased) bytes of a top-level
+JSON field, selected by the ``field`` param (default ``name``); key
+preserved. Byte-level field-extraction semantics are pinned by
+`dsl.json_get_bytes` so the Python hook, the DSL interpreter, and the TPU
+structural-scan kernel agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from fluvio_tpu.models import register
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef
+from fluvio_tpu.smartmodule.types import SmartModuleKind
+
+
+def module(with_hooks: bool = True) -> SmartModuleDef:
+    m = SmartModuleDef(name="json-map")
+    m.dsl[SmartModuleKind.MAP] = dsl.MapProgram(
+        value=dsl.Upper(arg=dsl.JsonGet(arg=dsl.Value(), key="@param:field=name"))
+    )
+    if with_hooks:
+        state = {"field": "name"}
+
+        def init(params: dict) -> None:
+            state["field"] = params.get("field", "name")
+
+        def map_fn(record) -> bytes:
+            return dsl.ascii_upper(dsl.json_get_bytes(record.value, state["field"]))
+
+        m.hooks[SmartModuleKind.INIT] = init
+        m.hooks[SmartModuleKind.MAP] = map_fn
+    return m
+
+
+register("json-map", module)

@@ -1,0 +1,40 @@
+"""regex-filter — the canonical SmartModule (baseline config #1).
+
+Capability parity: smartmodule/regex-filter/src/lib.rs:13-28 in the
+reference — ``#[smartmodule(init)]`` compiles a regex from the ``regex``
+param, ``#[smartmodule(filter)]`` keeps records whose *value* matches
+(unanchored search). Ships both a Python hook implementation (init + filter,
+like the reference) and the DSL program the TPU backend lowers to a DFA
+byte-scan kernel.
+"""
+
+from __future__ import annotations
+
+import re
+
+from fluvio_tpu.models import register
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef
+from fluvio_tpu.smartmodule.types import SmartModuleKind
+
+
+def module(with_hooks: bool = True) -> SmartModuleDef:
+    m = SmartModuleDef(name="regex-filter")
+    m.dsl[SmartModuleKind.FILTER] = dsl.FilterProgram(
+        predicate=dsl.RegexMatch(arg=dsl.Value(), pattern="@param:regex")
+    )
+    if with_hooks:
+        state = {}
+
+        def init(params: dict) -> None:
+            state["re"] = re.compile(params["regex"].encode("utf-8"))
+
+        def fil(record) -> bool:
+            return state["re"].search(record.value) is not None
+
+        m.hooks[SmartModuleKind.INIT] = init
+        m.hooks[SmartModuleKind.FILTER] = fil
+    return m
+
+
+register("regex-filter", module)

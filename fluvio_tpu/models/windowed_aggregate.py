@@ -1,0 +1,25 @@
+"""Stateful windowed aggregate — materialized-view style (baseline #5).
+
+The forward-looking design from the reference's rfc/materialize_view.md:
+a time-windowed running aggregate. Accumulator resets at each
+``window_ms`` timestamp bucket; each output record's key is the window
+start (ASCII ms) and its value the running in-window accumulator.
+"""
+
+from __future__ import annotations
+
+from fluvio_tpu.models import register
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef
+from fluvio_tpu.smartmodule.types import SmartModuleKind
+
+
+def module() -> SmartModuleDef:
+    m = SmartModuleDef(name="windowed-sum")
+    m.dsl[SmartModuleKind.AGGREGATE] = dsl.AggregateProgram(
+        kind="@param:kind=sum_int", window_ms="@param:window_ms=1000"
+    )
+    return m
+
+
+register("windowed-sum", module)

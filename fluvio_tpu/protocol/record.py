@@ -79,22 +79,33 @@ class Record:
     timestamp_delta: Timestamp = 0
     offset_delta: Offset = 0
 
+    def _inner_size(self) -> int:
+        from fluvio_tpu.protocol.varint import varint_size
+
+        inner = 1  # attributes
+        inner += varint_size(self.timestamp_delta)
+        inner += varint_size(self.offset_delta)
+        inner += 1  # key tag
+        if self.key is not None:
+            inner += varint_size(len(self.key)) + len(self.key)
+        inner += varint_size(len(self.value)) + len(self.value)
+        inner += varint_size(0)  # header count
+        return inner
+
     def encode(self, w: ByteWriter, version: Version = 0) -> None:
-        inner = ByteWriter()
-        inner.write_i8(self.attributes)
-        inner.write_varint(self.timestamp_delta)
-        inner.write_varint(self.offset_delta)
+        w.write_varint(self._inner_size())
+        w.write_i8(self.attributes)
+        w.write_varint(self.timestamp_delta)
+        w.write_varint(self.offset_delta)
         if self.key is None:
-            inner.write_u8(0)
+            w.write_u8(0)
         else:
-            inner.write_u8(1)
-            inner.write_varint(len(self.key))
-            inner.write_raw(self.key)
-        inner.write_varint(len(self.value))
-        inner.write_raw(self.value)
-        inner.write_varint(0)  # record headers: none
-        w.write_varint(len(inner))
-        w.write_raw(inner.buf)
+            w.write_u8(1)
+            w.write_varint(len(self.key))
+            w.write_raw(self.key)
+        w.write_varint(len(self.value))
+        w.write_raw(self.value)
+        w.write_varint(0)  # record headers: none
 
     @classmethod
     def decode(cls, r: ByteReader, version: Version = 0) -> "Record":
@@ -126,14 +137,7 @@ class Record:
     def write_size(self, version: Version = 0) -> int:
         from fluvio_tpu.protocol.varint import varint_size
 
-        inner = 1  # attributes
-        inner += varint_size(self.timestamp_delta)
-        inner += varint_size(self.offset_delta)
-        inner += 1  # key tag
-        if self.key is not None:
-            inner += varint_size(len(self.key)) + len(self.key)
-        inner += varint_size(len(self.value)) + len(self.value)
-        inner += varint_size(0)  # header count
+        inner = self._inner_size()
         return varint_size(inner) + inner
 
 
@@ -264,8 +268,7 @@ class Batch:
         after_crc.write_i32(count)
         after_crc.write_raw(record_section)
 
-        crc = zlib.crc32(after_crc.bytes()) & 0xFFFFFFFF
-        self.header.crc = crc
+        crc = zlib.crc32(after_crc.buf) & 0xFFFFFFFF
 
         batch_len = 4 + 1 + 4 + len(after_crc)  # epoch + magic + crc + rest
         w.write_i64(self.base_offset)
@@ -273,7 +276,7 @@ class Batch:
         w.write_i32(self.header.partition_leader_epoch)
         w.write_i8(self.header.magic)
         w.write_u32(crc)
-        w.write_raw(after_crc.bytes())
+        w.write_raw(after_crc.buf)
 
     @classmethod
     def decode(
@@ -318,9 +321,15 @@ class Batch:
         return b
 
     def write_size(self, version: Version = 0) -> int:
-        w = ByteWriter()
-        self.encode(w, version)
-        return len(w)
+        """Encoded size. Exact for uncompressed/raw batches; for a batch
+        that still needs compressing this is the uncompressed upper bound
+        (callers budget with it; encode() may write less)."""
+        if self.raw_records is not None:
+            section = len(self.raw_records)
+        else:
+            section = sum(r.write_size(version) for r in self.records)
+        schema = 4 if self.header.attributes & ATTR_SCHEMA_PRESENT else 0
+        return BATCH_PREAMBLE_SIZE + BATCH_HEADER_SIZE + schema + 4 + section
 
 
 @dataclass

@@ -17,9 +17,13 @@ from typing import Tuple
 import numpy as np
 
 _MASK64 = (1 << 64) - 1
+INT64_MIN = -(1 << 63)
+INT64_MAX = (1 << 63) - 1
 
 
 def zigzag(n: int) -> int:
+    if not INT64_MIN <= n <= INT64_MAX:
+        raise ValueError(f"varint: value {n} outside int64 range")
     return ((n << 1) ^ (n >> 63)) & _MASK64
 
 
@@ -64,7 +68,9 @@ def varint_decode(buf, pos: int) -> Tuple[int, int]:
         shift += 7
         if shift > 63:
             raise ValueError("varint: too many continuation bytes")
-    return unzigzag(result), pos
+    # Mask to 64 bits so a 10-byte varint's high bits wrap exactly like the
+    # vectorized (uint64) decoder — both ends must agree on every byte string.
+    return unzigzag(result & _MASK64), pos
 
 
 # ---------------------------------------------------------------------------

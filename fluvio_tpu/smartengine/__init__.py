@@ -1,0 +1,40 @@
+"""SmartEngine — the transform execution engine (host side).
+
+Capability parity: the `fluvio-smartengine` crate. `SmartEngine` +
+`SmartModuleChainBuilder` + `SmartModuleChainInstance::process`
+(engine/wasmtime/engine.rs:27,49,114,135) with identical chain semantics:
+per-instance transform, first-error short-circuit with partial output,
+base offset/timestamp preserved across the chain, aggregate accumulator
+state held per instance, optional init/look_back hooks, metered execution.
+
+Two backends:
+
+- ``python``: per-record interpreter — the semantics reference (the analog
+  of the wasmtime engine in the reference architecture).
+- ``tpu``: DSL chains lowered to fused JAX/XLA kernels over a padded,
+  HBM-resident record buffer (the north-star backend).
+"""
+
+from fluvio_tpu.smartengine.config import (
+    Lookback,
+    SmartModuleConfig,
+    TransformationConfig,
+)
+from fluvio_tpu.smartengine.engine import (
+    EngineError,
+    SmartEngine,
+    SmartModuleChainBuilder,
+    SmartModuleChainInstance,
+)
+from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
+
+__all__ = [
+    "SmartEngine",
+    "SmartModuleChainBuilder",
+    "SmartModuleChainInstance",
+    "SmartModuleConfig",
+    "SmartModuleChainMetrics",
+    "TransformationConfig",
+    "Lookback",
+    "EngineError",
+]

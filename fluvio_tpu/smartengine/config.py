@@ -1,0 +1,84 @@
+"""SmartModule chain configuration.
+
+Capability parity: fluvio-smartengine/src/engine/config.rs
+(`SmartModuleConfig{initial_data, params, version, lookback}`,
+`Lookback::Last(u64) | Age{age, last}`) and src/transformation.rs
+(`TransformationConfig` YAML: ``transforms: [{uses, lookback, with}]``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from fluvio_tpu.smartmodule.types import DEFAULT_SMARTENGINE_VERSION
+
+
+@dataclass
+class Lookback:
+    """How much history to feed a module's look_back hook at (re)start."""
+
+    last: int = 0
+    age_ms: Optional[int] = None  # Age{age, last} when set
+
+    @classmethod
+    def last_n(cls, n: int) -> "Lookback":
+        return cls(last=n)
+
+    @classmethod
+    def age(cls, age_ms: int, last: int = 0) -> "Lookback":
+        return cls(last=last, age_ms=age_ms)
+
+
+@dataclass
+class SmartModuleConfig:
+    """Per-module invocation config within a chain."""
+
+    params: Dict[str, str] = field(default_factory=dict)
+    version: int = DEFAULT_SMARTENGINE_VERSION
+    lookback: Optional[Lookback] = None
+    initial_data: bytes = b""  # aggregate accumulator seed
+
+
+@dataclass
+class TransformStep:
+    """One step of a TransformationConfig: module name + params."""
+
+    uses: str
+    with_params: Dict[str, str] = field(default_factory=dict)
+    lookback: Optional[Lookback] = None
+
+    def to_config(self) -> SmartModuleConfig:
+        return SmartModuleConfig(params=dict(self.with_params), lookback=self.lookback)
+
+
+@dataclass
+class TransformationConfig:
+    """Parsed ``transforms:`` YAML (client/CLI surface for chains)."""
+
+    transforms: List[TransformStep] = field(default_factory=list)
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "TransformationConfig":
+        import yaml
+
+        doc = yaml.safe_load(text) or {}
+        steps = []
+        for entry in doc.get("transforms", []):
+            if isinstance(entry, str):
+                steps.append(TransformStep(uses=entry))
+                continue
+            lookback = None
+            lb = entry.get("lookback")
+            if lb:
+                lookback = Lookback(
+                    last=int(lb.get("last", 0)),
+                    age_ms=int(lb["age"]) if "age" in lb else None,
+                )
+            params = {k: str(v) for k, v in (entry.get("with") or {}).items()}
+            steps.append(
+                TransformStep(
+                    uses=entry["uses"], with_params=params, lookback=lookback
+                )
+            )
+        return cls(transforms=steps)

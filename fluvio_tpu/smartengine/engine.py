@@ -1,0 +1,205 @@
+"""SmartEngine, chain builder, and chain instance.
+
+Capability parity: fluvio-smartengine/src/engine/wasmtime/engine.rs —
+`SmartEngine::new` (engine.rs:31), `SmartModuleChainBuilder::initialize`
+(engine.rs:65-91: compile each module, detect transform kind, run init),
+`SmartModuleChainInstance::process` (engine.rs:135-185: pipe input through
+instances, preserve base offset/timestamp, short-circuit on first error,
+meter each call) and `look_back` (engine.rs:187-218).
+
+Backend selection replaces the reference's single wasmtime runtime:
+
+- ``python``  — per-record interpreter (semantics reference)
+- ``tpu``     — fused JAX/XLA chain over the batched record buffer;
+                requires every module in the chain to carry a DSL program
+- ``auto``    — tpu when the whole chain is lowerable, else python
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, List, Optional
+
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef, load_source
+from fluvio_tpu.smartmodule.types import (
+    SmartModuleInput,
+    SmartModuleKind,
+    SmartModuleOutput,
+    SmartModuleRecord,
+)
+from fluvio_tpu.smartengine.config import Lookback, SmartModuleConfig
+from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
+from fluvio_tpu.smartengine.python_backend import PythonInstance
+
+DEFAULT_STORE_MAX_MEMORY = 1 << 30  # 1 GB input bound, parity: engine.rs:24
+
+
+class EngineError(Exception):
+    pass
+
+
+class StoreMemoryExceeded(EngineError):
+    """Input slab exceeds the engine memory bound (parity: limiter.rs)."""
+
+    def __init__(self, requested: int, maximum: int):
+        super().__init__(
+            f"SmartModule input of {requested} bytes exceeds engine memory "
+            f"limit of {maximum} bytes"
+        )
+        self.requested = requested
+        self.maximum = maximum
+
+
+class SmartModuleChainInitError(EngineError):
+    """A module's init hook failed during chain build (parity: engine.rs)."""
+
+
+@dataclass
+class SmartEngine:
+    """Engine factory/config. Cheap to clone; owns no per-chain state."""
+
+    backend: str = "python"  # python | tpu | auto
+    store_max_memory: int = DEFAULT_STORE_MAX_MEMORY
+
+    def builder(self) -> "SmartModuleChainBuilder":
+        return SmartModuleChainBuilder(engine=self)
+
+
+@dataclass
+class _ChainEntry:
+    module: SmartModuleDef
+    config: SmartModuleConfig
+
+
+@dataclass
+class SmartModuleChainBuilder:
+    engine: SmartEngine = field(default_factory=SmartEngine)
+    entries: List[_ChainEntry] = field(default_factory=list)
+
+    def add_smart_module(
+        self,
+        config: SmartModuleConfig,
+        module: SmartModuleDef | str | bytes,
+        name: str = "adhoc",
+    ) -> "SmartModuleChainBuilder":
+        if not isinstance(module, SmartModuleDef):
+            module = load_source(module, name=name)
+        self.entries.append(_ChainEntry(module=module, config=config))
+        return self
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def initialize(self, engine: Optional[SmartEngine] = None) -> "SmartModuleChainInstance":
+        engine = engine or self.engine
+        instances = []
+        for entry in self.entries:
+            inst = PythonInstance(entry.module, entry.config)
+            try:
+                inst.call_init()
+            except Exception as e:  # noqa: BLE001 — user code boundary
+                raise SmartModuleChainInitError(
+                    f"init failed for SmartModule {entry.module.name!r}: {e}"
+                ) from e
+            instances.append(inst)
+
+        backend = engine.backend
+        tpu_chain = None
+        if backend in ("tpu", "auto"):
+            try:
+                from fluvio_tpu.smartengine.tpu.executor import TpuChainExecutor
+
+                tpu_chain = TpuChainExecutor.try_build(
+                    [(e.module, e.config) for e in self.entries]
+                )
+            except ImportError:
+                tpu_chain = None
+            if tpu_chain is None and backend == "tpu":
+                raise EngineError(
+                    "backend='tpu' requires every module in the chain to "
+                    "carry a DSL program (or jax is unavailable)"
+                )
+        return SmartModuleChainInstance(
+            engine=engine, instances=instances, tpu_chain=tpu_chain
+        )
+
+
+class SmartModuleChainInstance:
+    """An initialized chain; processes inputs one slab at a time."""
+
+    def __init__(
+        self,
+        engine: SmartEngine,
+        instances: List[PythonInstance],
+        tpu_chain=None,
+    ):
+        self.engine = engine
+        self.instances = instances
+        self.tpu_chain = tpu_chain
+
+    def __len__(self) -> int:
+        return len(self.instances)
+
+    @property
+    def backend_in_use(self) -> str:
+        return "tpu" if self.tpu_chain is not None else "python"
+
+    def process(
+        self,
+        inp: SmartModuleInput,
+        metrics: Optional[SmartModuleChainMetrics] = None,
+    ) -> SmartModuleOutput:
+        metrics = metrics if metrics is not None else SmartModuleChainMetrics()
+        raw_len = inp.byte_size()
+        if raw_len > self.engine.store_max_memory:
+            raise StoreMemoryExceeded(raw_len, self.engine.store_max_memory)
+        metrics.add_bytes_in(raw_len)
+
+        if self.tpu_chain is not None:
+            output = self.tpu_chain.process(inp, metrics)
+            metrics.add_records_out(len(output.successes))
+            return output
+
+        if not self.instances:
+            # Empty chain: decode-and-passthrough (parity: engine.rs:180-184)
+            return SmartModuleOutput.new(inp.into_records())
+
+        base_offset = inp.base_offset
+        base_timestamp = inp.base_timestamp
+        next_input = inp
+        output = SmartModuleOutput()
+        for i, instance in enumerate(self.instances):
+            output = instance.process(next_input, metrics)
+            if output.error is not None:
+                # stop processing, return partial output (engine.rs:159-161)
+                return output
+            if i + 1 < len(self.instances):
+                next_input = SmartModuleInput.from_records(
+                    output.successes,
+                    base_offset=base_offset,
+                    base_timestamp=base_timestamp,
+                )
+        metrics.add_records_out(len(output.successes))
+        return output
+
+    async def look_back(
+        self,
+        read_fn: Callable[[Lookback], Awaitable[List[SmartModuleRecord]]],
+        metrics: Optional[SmartModuleChainMetrics] = None,
+    ) -> None:
+        """Feed recent records to each module exporting look_back.
+
+        ``read_fn`` receives the module's Lookback config and returns the
+        records to replay (parity: engine.rs:187-218).
+        """
+        for instance in self.instances:
+            if not instance.module.has_look_back():
+                continue
+            lookback = instance.config.lookback or Lookback.last_n(0)
+            records = await read_fn(lookback)
+            if metrics is not None:
+                metrics.add_bytes_in(sum(len(r.value) for r in records))
+            instance.call_look_back(records)
+            # keep any TPU-side state in sync after host-side replay
+            if self.tpu_chain is not None:
+                self.tpu_chain.sync_state_from(self.instances)

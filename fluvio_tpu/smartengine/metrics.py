@@ -1,0 +1,47 @@
+"""Chain execution metrics.
+
+Capability parity: fluvio-smartengine/src/engine/metrics.rs
+(`SmartModuleChainMetrics{bytes_in, records_out, invocation_count,
+fuel_used}`). The reference meters cost in wasmtime fuel; the analog here is
+user-transform invocations (python backend: one unit per record per
+instance) or device kernel records processed (tpu backend).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SmartModuleChainMetrics:
+    bytes_in: int = 0
+    records_out: int = 0
+    invocation_count: int = 0
+    fuel_used: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def add_bytes_in(self, n: int) -> None:
+        with self._lock:
+            self.bytes_in += n
+            self.invocation_count += 1
+
+    def add_records_out(self, n: int) -> None:
+        with self._lock:
+            self.records_out += n
+
+    def add_fuel_used(self, n: int) -> None:
+        with self._lock:
+            self.fuel_used += n
+
+    def to_dict(self) -> dict:
+        return {
+            "bytes_in": self.bytes_in,
+            "records_out": self.records_out,
+            "invocation_count": self.invocation_count,
+            "fuel_used": self.fuel_used,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())

@@ -1,0 +1,270 @@
+"""Python (per-record) engine backend — the semantics reference.
+
+This is the architectural slot of the reference's wasmtime engine: each
+module instance processes one `SmartModuleInput` at a time, record by
+record, with the exact per-kind semantics of the generated WASM guest loops
+(fluvio-smartmodule-derive/src/generator/{filter,map,filter_map,array_map,
+aggregate}.rs):
+
+- filter:      keep the record unchanged when the predicate holds
+- map:         mutate value (and key, when provided) in place; preamble
+               (offset/timestamp deltas) preserved
+- filter_map:  None drops; otherwise as map
+- array_map:   emits fresh records (zero deltas) per output element
+- aggregate:   acc = f(acc, record); the output record's value is the new
+               accumulator (running value emitted per input record)
+- any user exception -> SmartModuleTransformRuntimeError at that record,
+  stop, return successes so far (partial output)
+
+DSL programs (modules without Python hooks) are interpreted here with the
+same per-record loop via `fluvio_tpu.smartmodule.dsl.eval_expr`, which
+pins the byte-level semantics the TPU backend must reproduce.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef
+from fluvio_tpu.smartmodule.types import (
+    SmartModuleInput,
+    SmartModuleKind,
+    SmartModuleLookbackError,
+    SmartModuleOutput,
+    SmartModuleRecord,
+    SmartModuleTransformRuntimeError,
+)
+from fluvio_tpu.smartengine.config import SmartModuleConfig
+from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
+
+
+def _normalize_map_result(result, record: Record) -> Tuple[Optional[bytes], bytes]:
+    """User map result -> (key, value). Bare bytes preserves the input key."""
+    if isinstance(result, tuple):
+        key, value = result
+        key = key if key is None else bytes(key)
+        return key, bytes(value)
+    return record.key, bytes(result)
+
+
+class PythonInstance:
+    """One module instance: config + hooks + per-instance aggregate state."""
+
+    def __init__(self, module: SmartModuleDef, config: SmartModuleConfig):
+        self.module = module
+        self.config = config
+        self.kind = module.transform_kind()
+        self.accumulator: bytes = config.initial_data
+        self._dsl_programs = {
+            k: dsl.resolve_params(p, config.params) for k, p in module.dsl.items()
+        }
+        # windowed aggregate state
+        self._window_start: Optional[int] = None
+
+    # -- init / look_back ---------------------------------------------------
+
+    def call_init(self) -> None:
+        hook = self.module.hook(SmartModuleKind.INIT)
+        if hook is not None:
+            hook(dict(self.config.params))
+
+    def call_look_back(self, records: List[SmartModuleRecord]) -> None:
+        hook = self.module.hook(SmartModuleKind.LOOK_BACK)
+        if hook is None:
+            return
+        for rec in records:
+            try:
+                hook(rec)
+            except Exception as e:  # noqa: BLE001 — user code boundary
+                raise SmartModuleLookbackError(str(e), rec.offset) from e
+
+    # -- transform ----------------------------------------------------------
+
+    def process(
+        self, inp: SmartModuleInput, metrics: Optional[SmartModuleChainMetrics] = None
+    ) -> SmartModuleOutput:
+        records = inp.into_records(self.config.version)
+        sm_records = [
+            SmartModuleRecord(r, inp.base_offset, inp.base_timestamp) for r in records
+        ]
+        hook = self.module.hook(self.kind)
+        if hook is not None:
+            out = self._run_hook(hook, sm_records, inp)
+        else:
+            out = self._run_dsl(sm_records, inp)
+        if metrics is not None:
+            metrics.add_fuel_used(len(sm_records))
+        return out
+
+    def _error(
+        self, exc: Exception, rec: SmartModuleRecord
+    ) -> SmartModuleTransformRuntimeError:
+        return SmartModuleTransformRuntimeError(
+            hint=str(exc),
+            offset=rec.offset,
+            kind=self.kind,
+            record_key=rec.key,
+            record_value=rec.value,
+        )
+
+    def _run_hook(
+        self,
+        hook: Callable,
+        sm_records: List[SmartModuleRecord],
+        inp: SmartModuleInput,
+    ) -> SmartModuleOutput:
+        out = SmartModuleOutput()
+        kind = self.kind
+        if kind == SmartModuleKind.FILTER:
+            for rec in sm_records:
+                try:
+                    keep = hook(rec)
+                except Exception as e:  # noqa: BLE001
+                    out.error = self._error(e, rec)
+                    break
+                if keep:
+                    out.successes.append(rec.record)
+        elif kind == SmartModuleKind.MAP:
+            for rec in sm_records:
+                try:
+                    key, value = _normalize_map_result(hook(rec), rec.record)
+                except Exception as e:  # noqa: BLE001
+                    out.error = self._error(e, rec)
+                    break
+                rec.record.key = key
+                rec.record.value = value
+                out.successes.append(rec.record)
+        elif kind == SmartModuleKind.FILTER_MAP:
+            for rec in sm_records:
+                try:
+                    result = hook(rec)
+                except Exception as e:  # noqa: BLE001
+                    out.error = self._error(e, rec)
+                    break
+                if result is None:
+                    continue
+                key, value = _normalize_map_result(result, rec.record)
+                rec.record.key = key
+                rec.record.value = value
+                out.successes.append(rec.record)
+        elif kind == SmartModuleKind.ARRAY_MAP:
+            for rec in sm_records:
+                try:
+                    results = hook(rec)
+                except Exception as e:  # noqa: BLE001
+                    out.error = self._error(e, rec)
+                    break
+                for item in results:
+                    if isinstance(item, tuple):
+                        k, v = item
+                        k = k if k is None else bytes(k)
+                    else:
+                        k, v = None, item
+                    out.successes.append(Record(value=bytes(v), key=k))
+        elif kind == SmartModuleKind.AGGREGATE:
+            acc = self.accumulator
+            for rec in sm_records:
+                try:
+                    acc = bytes(hook(acc, rec))
+                except Exception as e:  # noqa: BLE001
+                    out.error = self._error(e, rec)
+                    break
+                rec.record.value = acc
+                out.successes.append(rec.record)
+            self.accumulator = acc
+        else:  # pragma: no cover
+            raise TypeError(f"not a transform kind: {kind}")
+        return out
+
+    # -- DSL interpretation --------------------------------------------------
+
+    def _run_dsl(
+        self, sm_records: List[SmartModuleRecord], inp: SmartModuleInput
+    ) -> SmartModuleOutput:
+        program = self._dsl_programs[self.kind]
+        out = SmartModuleOutput()
+        ev = dsl.eval_expr
+        if isinstance(program, dsl.FilterProgram):
+            for rec in sm_records:
+                if ev(program.predicate, rec.value, rec.key):
+                    out.successes.append(rec.record)
+        elif isinstance(program, dsl.MapProgram):
+            for rec in sm_records:
+                value = ev(program.value, rec.value, rec.key)
+                if program.key is not None:
+                    rec.record.key = ev(program.key, rec.value, rec.key)
+                rec.record.value = value
+                out.successes.append(rec.record)
+        elif isinstance(program, dsl.FilterMapProgram):
+            for rec in sm_records:
+                if not ev(program.predicate, rec.value, rec.key):
+                    continue
+                value = ev(program.value, rec.value, rec.key)
+                if program.key is not None:
+                    rec.record.key = ev(program.key, rec.value, rec.key)
+                rec.record.value = value
+                out.successes.append(rec.record)
+        elif isinstance(program, dsl.ArrayMapProgram):
+            for rec in sm_records:
+                if program.mode == "json_array":
+                    elements = dsl.json_array_elements(rec.value)
+                    if elements is None:
+                        out.error = self._error(
+                            ValueError("input record is not a JSON array"), rec
+                        )
+                        break
+                else:  # split
+                    elements = [s for s in rec.value.split(program.sep) if s]
+                for el in elements:
+                    out.successes.append(Record(value=el, key=rec.key))
+        elif isinstance(program, dsl.AggregateProgram):
+            self._run_dsl_aggregate(program, sm_records, out)
+        else:
+            raise TypeError(f"unknown DSL program {type(program).__name__}")
+        return out
+
+    def _run_dsl_aggregate(
+        self,
+        program: dsl.AggregateProgram,
+        sm_records: List[SmartModuleRecord],
+        out: SmartModuleOutput,
+    ) -> None:
+        kind = program.kind
+
+        def init_acc() -> int:
+            if kind == "max_int":
+                return -(2**63)
+            if kind == "min_int":
+                return 2**63 - 1
+            return 0
+
+        def step(acc: int, rec: SmartModuleRecord) -> int:
+            if kind == "sum_int":
+                return acc + dsl.parse_int_prefix(rec.value)
+            if kind == "count":
+                return acc + 1
+            if kind == "word_count":
+                return acc + dsl.count_words(rec.value)
+            if kind == "max_int":
+                return max(acc, dsl.parse_int_prefix(rec.value))
+            if kind == "min_int":
+                return min(acc, dsl.parse_int_prefix(rec.value))
+            raise ValueError(f"unknown aggregate kind {kind!r}")
+
+        acc = dsl.parse_int_prefix(self.accumulator) if self.accumulator else init_acc()
+        for rec in sm_records:
+            if program.window_ms:
+                ts = rec.timestamp
+                window = 0 if ts < 0 else ts - (ts % program.window_ms)
+                if self._window_start is None or window != self._window_start:
+                    self._window_start = window
+                    acc = init_acc()
+                acc = step(acc, rec)
+                rec.record.key = str(window).encode("ascii")
+            else:
+                acc = step(acc, rec)
+            rec.record.value = str(acc).encode("ascii")
+            out.successes.append(rec.record)
+        self.accumulator = str(acc).encode("ascii")

@@ -1,0 +1,39 @@
+"""SmartModule SDK — authoring surface for stream transforms.
+
+Capability parity: the `fluvio-smartmodule` crate (guest SDK + dataplane
+types, fluvio-smartmodule/src/lib.rs:11) and `fluvio-smartmodule-derive`
+(the `#[smartmodule(...)]` macros). A SmartModule here is a Python module (or
+inline source artifact) using the decorators below; transforms may also carry
+a declarative DSL spec (`fluvio_tpu.smartmodule.dsl`) which is what the TPU
+engine backend lowers to fused JAX kernels.
+"""
+
+from fluvio_tpu.smartmodule.types import (
+    SmartModuleInput,
+    SmartModuleOutput,
+    SmartModuleAggregateInput,
+    SmartModuleAggregateOutput,
+    SmartModuleRecord,
+    SmartModuleKind,
+    SmartModuleTransformRuntimeError,
+)
+from fluvio_tpu.smartmodule.sdk import (
+    SmartModuleDef,
+    smartmodule,
+    load_source,
+    current_module,
+)
+
+__all__ = [
+    "SmartModuleInput",
+    "SmartModuleOutput",
+    "SmartModuleAggregateInput",
+    "SmartModuleAggregateOutput",
+    "SmartModuleRecord",
+    "SmartModuleKind",
+    "SmartModuleTransformRuntimeError",
+    "SmartModuleDef",
+    "smartmodule",
+    "load_source",
+    "current_module",
+]

@@ -82,6 +82,7 @@ class OffsetChangeListener:
         return self._last_seen
 
     async def listen(self) -> Offset:
+        self._publisher._loop = asyncio.get_running_loop()
         async with self._cond:
             while self._publisher.current_value() == self._last_seen:
                 await self._cond.wait()
@@ -105,9 +106,15 @@ class OffsetPublisher:
         self._value: Offset = initial
         self._cond = asyncio.Condition()
         self._pending: set = set()  # keep notify tasks alive until done
+        self._loop: Optional[asyncio.AbstractEventLoop] = None  # listeners' loop
 
     def current_value(self) -> Offset:
         return self._value
+
+    def _schedule_notify(self, loop: asyncio.AbstractEventLoop) -> None:
+        task = loop.create_task(self._notify())
+        self._pending.add(task)
+        task.add_done_callback(self._pending.discard)
 
     def update(self, value: Offset) -> None:
         if value == self._value:
@@ -116,16 +123,20 @@ class OffsetPublisher:
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
-            # No loop running -> nothing can be blocked in wait(); the new
-            # value is visible to any listener created later.
+            # Called from a non-loop thread (e.g. a storage flush callback):
+            # wake listeners on the loop they are blocked in, if known.
+            loop = self._loop
+            if loop is None or loop.is_closed():
+                return
+            loop.call_soon_threadsafe(self._schedule_notify, loop)
             return
-        task = loop.create_task(self._notify())
-        self._pending.add(task)
-        task.add_done_callback(self._pending.discard)
+        self._loop = loop
+        self._schedule_notify(loop)
 
     async def update_async(self, value: Offset) -> None:
         if value == self._value:
             return
+        self._loop = asyncio.get_running_loop()
         async with self._cond:
             self._value = value
             self._cond.notify_all()

@@ -1,0 +1,115 @@
+"""DSL primitive semantics — the contract the TPU kernels must match."""
+
+import pytest
+
+from fluvio_tpu.smartmodule import dsl
+
+
+class TestJsonGet:
+    @pytest.mark.parametrize(
+        "doc,key,expected",
+        [
+            (b'{"name":"fluvio"}', "name", b"fluvio"),
+            (b'{"a":1,"name":"x"}', "name", b"x"),
+            (b'{"name": "spaced" }', "name", b"spaced"),
+            (b'{"name":42}', "name", b"42"),
+            (b'{"name":-3.5,"z":1}', "name", b"-3.5"),
+            (b'{"name":true}', "name", b"true"),
+            (b'{"name":null}', "name", b"null"),
+            (b'{"name":{"inner":1}}', "name", b'{"inner":1}'),
+            (b'{"name":[1,2]}', "name", b"[1,2]"),
+            (b'{"other":"x"}', "name", b""),  # missing -> empty
+            (b"not json", "name", b""),
+            (b"", "name", b""),
+            (b'{"nested":{"name":"inner"},"name":"outer"}', "name", b"outer"),
+            (b'{"val":"name","name":"real"}', "name", b"real"),  # key in a value string
+            (b'{"namer":"no","name":"yes"}', "name", b"yes"),  # prefix key
+        ],
+    )
+    def test_cases(self, doc, key, expected):
+        assert dsl.json_get_bytes(doc, key) == expected
+
+    def test_nested_object_does_not_leak(self):
+        # "name" at depth 2 must not match
+        assert dsl.json_get_bytes(b'{"outer":{"name":"inner"}}', "name") == b""
+
+
+class TestJsonArray:
+    def test_strings(self):
+        assert dsl.json_array_elements(b'["a","b"]') == [b"a", b"b"]
+
+    def test_numbers_and_nested(self):
+        assert dsl.json_array_elements(b'[1, 2.5, {"a":1}, [3,4]]') == [
+            b"1",
+            b"2.5",
+            b'{"a":1}',
+            b"[3,4]",
+        ]
+
+    def test_not_array(self):
+        assert dsl.json_array_elements(b'{"a":1}') is None
+        assert dsl.json_array_elements(b"plain") is None
+
+    def test_empty_array(self):
+        assert dsl.json_array_elements(b"[]") == []
+
+    def test_comma_inside_string(self):
+        assert dsl.json_array_elements(b'["a,b","c"]') == [b"a,b", b"c"]
+
+
+class TestParseInt:
+    @pytest.mark.parametrize(
+        "data,expected",
+        [
+            (b"42", 42),
+            (b"-7", -7),
+            (b"  13x", 13),
+            (b"+5", 5),
+            (b"abc", 0),
+            (b"", 0),
+            (b"12.9", 12),
+            (b"-", 0),
+        ],
+    )
+    def test_cases(self, data, expected):
+        assert dsl.parse_int_prefix(data) == expected
+
+
+class TestCase:
+    def test_upper_ascii_only(self):
+        assert dsl.ascii_upper(b"aZ3{}\xff") == b"AZ3{}\xff"
+
+    def test_lower(self):
+        assert dsl.ascii_lower(b"AbC") == b"abc"
+
+
+class TestSerde:
+    def test_roundtrip(self):
+        prog = dsl.FilterMapProgram(
+            predicate=dsl.And(
+                args=[
+                    dsl.RegexMatch(arg=dsl.Value(), pattern="^a+b"),
+                    dsl.Not(arg=dsl.Contains(arg=dsl.Key(), literal=b"\x00bin")),
+                ]
+            ),
+            value=dsl.Concat(args=[dsl.Const(data=b"v:"), dsl.JsonGet(arg=dsl.Value(), key="f")]),
+        )
+        j = prog.to_json()
+        back = dsl.Expr.from_json(j)
+        assert back == prog
+
+    def test_param_resolution(self):
+        prog = dsl.FilterProgram(
+            predicate=dsl.RegexMatch(arg=dsl.Value(), pattern="@param:regex")
+        )
+        resolved = dsl.resolve_params(prog, {"regex": "xyz"})
+        assert resolved.predicate.pattern == "xyz"
+
+    def test_param_default_and_missing(self):
+        prog = dsl.MapProgram(value=dsl.JsonGet(arg=dsl.Value(), key="@param:field=name"))
+        assert dsl.resolve_params(prog, {}).value.key == "name"
+        prog2 = dsl.FilterProgram(
+            predicate=dsl.RegexMatch(arg=dsl.Value(), pattern="@param:regex")
+        )
+        with pytest.raises(KeyError):
+            dsl.resolve_params(prog2, {})

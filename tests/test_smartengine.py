@@ -1,0 +1,350 @@
+"""SmartEngine chain tests (python backend — the semantics reference).
+
+Mirrors fluvio-smartengine's engine tests (engine/wasmtime/engine.rs:237-627,
+transforms/filter.rs, transforms/aggregate.rs): filter, filter+map chain,
+aggregate with accumulator, error short-circuit with partial output,
+lookback happy/error paths, memory-limit enforcement, plus our SDK/DSL
+surfaces (source-artifact loading, hook-vs-DSL equivalence,
+TransformationConfig YAML).
+"""
+
+import asyncio
+
+import pytest
+
+from fluvio_tpu.models import lookup
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartengine import (
+    Lookback,
+    SmartEngine,
+    SmartModuleChainMetrics,
+    SmartModuleConfig,
+    TransformationConfig,
+)
+from fluvio_tpu.smartengine.engine import (
+    EngineError,
+    SmartModuleChainInitError,
+    StoreMemoryExceeded,
+)
+from fluvio_tpu.smartmodule import SmartModuleInput, SmartModuleKind, load_source
+from fluvio_tpu.smartmodule.types import SmartModuleLookbackError
+
+
+def recs(*values: bytes):
+    records = [Record(value=v) for v in values]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+    return records
+
+
+def make_input(*values: bytes, base_offset=0, base_timestamp=-1):
+    return SmartModuleInput.from_records(
+        recs(*values), base_offset=base_offset, base_timestamp=base_timestamp
+    )
+
+
+def build_chain(*mods, engine=None):
+    engine = engine or SmartEngine(backend="python")
+    b = engine.builder()
+    for module, config in mods:
+        b.add_smart_module(config, module)
+    return b.initialize()
+
+
+class TestFilter:
+    def test_regex_filter(self):
+        chain = build_chain(
+            (lookup("regex-filter"), SmartModuleConfig(params={"regex": "^a"}))
+        )
+        out = chain.process(make_input(b"apple", b"banana", b"avocado"))
+        assert out.error is None
+        assert [r.value for r in out.successes] == [b"apple", b"avocado"]
+
+    def test_empty_chain_passthrough(self):
+        chain = build_chain()
+        out = chain.process(make_input(b"x", b"y"))
+        assert [r.value for r in out.successes] == [b"x", b"y"]
+
+    def test_filter_preserves_offsets(self):
+        chain = build_chain(
+            (lookup("regex-filter"), SmartModuleConfig(params={"regex": "keep"}))
+        )
+        out = chain.process(make_input(b"keep-0", b"drop", b"keep-2", base_offset=50))
+        assert [r.offset_delta for r in out.successes] == [0, 2]
+
+
+class TestChain:
+    def test_filter_then_map(self):
+        chain = build_chain(
+            (lookup("regex-filter"), SmartModuleConfig(params={"regex": "fluvio"})),
+            (lookup("json-map"), SmartModuleConfig(params={"field": "name"})),
+        )
+        out = chain.process(
+            make_input(
+                b'{"name":"fluvio","v":1}',
+                b'{"name":"kafka","v":2}',
+                b'{"name":"fluvio-tpu","v":3}',
+            )
+        )
+        assert out.error is None
+        assert [r.value for r in out.successes] == [b"FLUVIO", b"FLUVIO-TPU"]
+
+    def test_metrics(self):
+        chain = build_chain(
+            (lookup("regex-filter"), SmartModuleConfig(params={"regex": "a"}))
+        )
+        metrics = SmartModuleChainMetrics()
+        chain.process(make_input(b"abc", b"xyz"), metrics)
+        assert metrics.records_out == 1
+        assert metrics.fuel_used == 2  # two records through one instance
+        assert metrics.bytes_in > 0
+
+
+class TestAggregate:
+    def test_running_sum_emitted_per_record(self):
+        chain = build_chain((lookup("aggregate-sum"), SmartModuleConfig()))
+        out = chain.process(make_input(b"1", b"2", b"3"))
+        # reference semantics: running accumulator is each output's value
+        assert [r.value for r in out.successes] == [b"1", b"3", b"6"]
+
+    def test_accumulator_persists_across_process_calls(self):
+        chain = build_chain((lookup("aggregate-sum"), SmartModuleConfig()))
+        chain.process(make_input(b"10"))
+        out = chain.process(make_input(b"5"))
+        assert out.successes[0].value == b"15"
+
+    def test_initial_accumulator_seed(self):
+        chain = build_chain(
+            (lookup("aggregate-sum"), SmartModuleConfig(initial_data=b"100"))
+        )
+        out = chain.process(make_input(b"1"))
+        assert out.successes[0].value == b"101"
+
+    def test_word_count(self):
+        chain = build_chain((lookup("word-count"), SmartModuleConfig()))
+        out = chain.process(make_input(b"hello world", b"one two  three"))
+        assert [r.value for r in out.successes] == [b"2", b"5"]
+
+    def test_windowed_sum(self):
+        chain = build_chain(
+            (lookup("windowed-sum"), SmartModuleConfig(params={"window_ms": "1000"}))
+        )
+        records = recs(b"1", b"2", b"3", b"4")
+        # timestamps: two in window 0, two in window 1000
+        records[0].timestamp_delta = 0
+        records[1].timestamp_delta = 500
+        records[2].timestamp_delta = 1000
+        records[3].timestamp_delta = 1500
+        inp = SmartModuleInput.from_records(records, base_offset=0, base_timestamp=0)
+        out = chain.process(inp)
+        assert [(r.key, r.value) for r in out.successes] == [
+            (b"0", b"1"),
+            (b"0", b"3"),
+            (b"1000", b"3"),
+            (b"1000", b"7"),
+        ]
+
+
+class TestArrayMap:
+    def test_json_array_explode(self):
+        chain = build_chain((lookup("array-map-json"), SmartModuleConfig()))
+        out = chain.process(make_input(b'["a","b","c"]', b"[1,2]"))
+        assert out.error is None
+        assert [r.value for r in out.successes] == [b"a", b"b", b"c", b"1", b"2"]
+
+    def test_non_array_is_error_with_partial_output(self):
+        chain = build_chain((lookup("array-map-json"), SmartModuleConfig()))
+        out = chain.process(make_input(b"[1]", b"not-an-array", b"[2]"))
+        assert out.error is not None
+        assert out.error.kind == SmartModuleKind.ARRAY_MAP
+        assert out.error.offset == 1
+        assert [r.value for r in out.successes] == [b"1"]  # partial output kept
+
+
+class TestErrorSemantics:
+    FAILING_FILTER = """
+@smartmodule.filter
+def fil(record):
+    if record.value == b"boom":
+        raise ValueError("exploded")
+    return True
+"""
+
+    def test_error_short_circuits_with_partial_output(self):
+        chain = build_chain(
+            (self.FAILING_FILTER, SmartModuleConfig()),
+        )
+        out = chain.process(make_input(b"ok-1", b"boom", b"ok-2", base_offset=10))
+        assert [r.value for r in out.successes] == [b"ok-1"]
+        assert out.error is not None
+        assert out.error.offset == 11  # absolute offset of the failing record
+        assert out.error.record_value == b"boom"
+        assert "exploded" in out.error.hint
+
+    def test_error_stops_chain(self):
+        chain = build_chain(
+            (self.FAILING_FILTER, SmartModuleConfig()),
+            (lookup("json-map"), SmartModuleConfig()),
+        )
+        out = chain.process(make_input(b"boom"))
+        assert out.error is not None
+        assert out.error.kind == SmartModuleKind.FILTER  # map never ran
+
+    def test_init_failure_raises_chain_init_error(self):
+        src = """
+@smartmodule.init
+def init(params):
+    raise RuntimeError("bad init")
+
+@smartmodule.filter
+def fil(record):
+    return True
+"""
+        with pytest.raises(SmartModuleChainInitError):
+            build_chain((src, SmartModuleConfig()))
+
+    def test_memory_limit(self):
+        engine = SmartEngine(backend="python", store_max_memory=10)
+        chain = build_chain(
+            (lookup("regex-filter"), SmartModuleConfig(params={"regex": "x"})),
+            engine=engine,
+        )
+        with pytest.raises(StoreMemoryExceeded):
+            chain.process(make_input(b"x" * 100))
+
+
+class TestLookback:
+    COUNTER_SRC = """
+state = {"seen": 0}
+
+@smartmodule.look_back
+def lb(record):
+    if record.value == b"bad":
+        raise ValueError("lookback hates this record")
+    state["seen"] += 1
+
+@smartmodule.filter
+def fil(record):
+    return state["seen"] > 0
+"""
+
+    def run(self, coro):
+        return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+    def test_lookback_happy_path(self):
+        chain = build_chain(
+            (self.COUNTER_SRC, SmartModuleConfig(lookback=Lookback.last_n(2))),
+        )
+        seen_configs = []
+
+        async def read_fn(lookback):
+            seen_configs.append(lookback)
+            from fluvio_tpu.smartmodule.types import SmartModuleRecord
+
+            return [SmartModuleRecord(Record(value=b"old"), 0, -1)]
+
+        self.run(chain.look_back(read_fn))
+        assert seen_configs[0].last == 2
+        out = chain.process(make_input(b"now"))
+        assert len(out.successes) == 1  # state hydrated from lookback
+
+    def test_lookback_error(self):
+        chain = build_chain(
+            (self.COUNTER_SRC, SmartModuleConfig(lookback=Lookback.last_n(1))),
+        )
+
+        async def read_fn(lookback):
+            from fluvio_tpu.smartmodule.types import SmartModuleRecord
+
+            return [SmartModuleRecord(Record(value=b"bad"), 7, -1)]
+
+        with pytest.raises(SmartModuleLookbackError) as ei:
+            self.run(chain.look_back(read_fn))
+        assert ei.value.offset == 7
+
+
+class TestSdkSurface:
+    def test_load_source_map_with_key(self):
+        src = """
+@smartmodule.map
+def m(record):
+    return (b"k", record.value.upper())
+"""
+        chain = build_chain((src, SmartModuleConfig()))
+        out = chain.process(make_input(b"abc"))
+        assert out.successes[0].key == b"k"
+        assert out.successes[0].value == b"ABC"
+
+    def test_load_source_requires_transform(self):
+        with pytest.raises(ValueError):
+            load_source("x = 1")
+
+    def test_filter_map(self):
+        src = """
+@smartmodule.filter_map
+def fm(record):
+    n = int(record.value)
+    if n % 2 == 0:
+        return str(n // 2).encode()
+    return None
+"""
+        chain = build_chain((src, SmartModuleConfig()))
+        out = chain.process(make_input(b"2", b"3", b"8"))
+        assert [r.value for r in out.successes] == [b"1", b"4"]
+
+    def test_hook_vs_dsl_equivalence(self):
+        """The Python-hook and DSL forms of built-ins must agree."""
+        values = [
+            b'{"name":"alpha","n":1}',
+            b'{"n":2}',
+            b'{"name":"Beta-2"}',
+            b"not json",
+        ]
+        for name, params in [
+            ("regex-filter", {"regex": "a"}),
+            ("json-map", {"field": "name"}),
+        ]:
+            hook_mod = lookup(name)
+            import fluvio_tpu.models.regex_filter as rf
+            import fluvio_tpu.models.json_map as jm
+
+            dsl_mod = (rf if name == "regex-filter" else jm).module(with_hooks=False)
+            out_hook = build_chain((hook_mod, SmartModuleConfig(params=params))).process(
+                make_input(*values)
+            )
+            out_dsl = build_chain((dsl_mod, SmartModuleConfig(params=params))).process(
+                make_input(*values)
+            )
+            assert [(r.key, r.value) for r in out_hook.successes] == [
+                (r.key, r.value) for r in out_dsl.successes
+            ], name
+
+
+class TestTransformationConfig:
+    def test_yaml_parse(self):
+        cfg = TransformationConfig.from_yaml(
+            """
+transforms:
+  - uses: regex-filter
+    with:
+      regex: "^a"
+  - uses: json-map
+    lookback:
+      last: 10
+      age: 60000
+"""
+        )
+        assert len(cfg.transforms) == 2
+        assert cfg.transforms[0].uses == "regex-filter"
+        assert cfg.transforms[0].with_params == {"regex": "^a"}
+        assert cfg.transforms[1].lookback.last == 10
+        assert cfg.transforms[1].lookback.age_ms == 60000
+
+    def test_yaml_to_chain(self):
+        cfg = TransformationConfig.from_yaml(
+            "transforms:\n  - uses: regex-filter\n    with: {regex: b}\n"
+        )
+        step = cfg.transforms[0]
+        chain = build_chain((lookup(step.uses), step.to_config()))
+        out = chain.process(make_input(b"abc", b"xyz"))
+        assert [r.value for r in out.successes] == [b"abc"]
