@@ -1,0 +1,170 @@
+"""North-star benchmark: regex-filter + json-map chain records/sec.
+
+Runs the fused TPU SmartModule chain (BASELINE.md config #1+#2: regex
+filter then JSON field map) over 1M-record batches on the real chip and
+prints ONE JSON line:
+
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+``vs_baseline`` is measured against this repo's per-record reference
+engine (the wasmtime-equivalent semantics backend) executing the same
+chain on the host CPU — the reference's own engine cannot run here (no
+Rust toolchain in the image; see BASELINE.md). Environment knobs:
+``BENCH_SMOKE=1`` shrinks shapes for a fast correctness pass;
+``BENCH_RECORDS=<n>`` overrides the batch size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_chain(backend: str):
+    from fluvio_tpu.models import lookup
+    from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+
+    b = SmartEngine(backend=backend).builder()
+    b.add_smart_module(
+        SmartModuleConfig(params={"regex": "fluvio"}), lookup("regex-filter")
+    )
+    b.add_smart_module(SmartModuleConfig(params={"field": "name"}), lookup("json-map"))
+    return b.initialize()
+
+
+def generate(n: int):
+    """1M-record corpus: ~half the names match the regex."""
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+
+    rng = np.random.default_rng(2024)
+    names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "redpanda", "flink"]
+    picks = rng.integers(0, len(names), size=n)
+    nums = rng.integers(0, 100000, size=n)
+    log(f"generating {n} records ...")
+    values = [
+        f'{{"name":"{names[picks[i]]}-{i & 1023}","n":{nums[i]}}}'.encode()
+        for i in range(n)
+    ]
+    widths = max(len(v) for v in values)
+    width = 32
+    while width < widths:
+        width *= 2
+    rows = 8
+    while rows < n:
+        rows *= 2
+    arr = np.zeros((rows, width), dtype=np.uint8)
+    lengths = np.zeros(rows, dtype=np.int32)
+    flat = np.frombuffer(b"".join(values), dtype=np.uint8)
+    lens = np.array([len(v) for v in values], dtype=np.int32)
+    starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+    # ragged copy: one fancy-index assignment
+    dst_rows = np.repeat(np.arange(n), lens)
+    dst_cols = np.arange(flat.size) - np.repeat(starts, lens)
+    arr[dst_rows, dst_cols] = flat
+    lengths[:n] = lens
+    buf = RecordBuffer.from_arrays(arr, lengths, count=n)
+    buf.offset_deltas = np.arange(rows, dtype=np.int32)
+    return buf, values
+
+
+def bench_tpu(buf, runs: int) -> tuple:
+    chain = build_chain("tpu")
+    assert chain.backend_in_use == "tpu"
+    executor = chain.tpu_chain
+    log("compiling + warmup ...")
+    t0 = time.time()
+    out = executor.process_buffer(buf)
+    log(f"first call (compile): {time.time()-t0:.2f}s; {out.count} records out")
+    # single-batch latency
+    t0 = time.time()
+    out = executor.process_buffer(buf)
+    single = time.time() - t0
+    # sustained pipelined throughput (the consume-stream shape)
+    t0 = time.time()
+    for out in executor.process_stream(iter([buf] * runs)):
+        pass
+    sustained = (time.time() - t0) / runs
+    log(f"single-batch: {single*1000:.0f}ms; pipelined: {sustained*1000:.0f}ms/batch")
+    return out, [sustained]
+
+
+def bench_python_baseline(values, base_n: int) -> float:
+    """Per-record reference engine on a subset; returns records/sec."""
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    chain = build_chain("python")
+    records = [Record(value=v) for v in values[:base_n]]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+    inp = SmartModuleInput.from_records(records)
+    t0 = time.time()
+    out = chain.process(inp)
+    dt = time.time() - t0
+    assert out.error is None
+    return base_n / dt
+
+
+def verify_outputs(out_buf, values, check_n: int) -> None:
+    """Spot-check TPU outputs equal the reference engine's."""
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    chain = build_chain("python")
+    records = [Record(value=v) for v in values[:check_n]]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+    ref = chain.process(SmartModuleInput.from_records(records))
+    ref_values = [r.value for r in ref.successes]
+    got_values = []
+    i = 0
+    while len(got_values) < len(ref_values) and i < out_buf.count:
+        if out_buf.offset_deltas[i] < check_n:
+            got_values.append(
+                out_buf.values[i, : out_buf.lengths[i]].tobytes()
+            )
+        i += 1
+    assert got_values == ref_values, "TPU output diverged from reference engine"
+    log(f"verified first {len(ref_values)} outputs byte-equal to reference")
+
+
+def main() -> None:
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    n = int(os.environ.get("BENCH_RECORDS", "20000" if smoke else "1000000"))
+    runs = 3 if smoke else 5
+    base_n = min(n, 2000 if smoke else 20000)
+
+    buf, values = generate(n)
+    out, times = bench_tpu(buf, runs)
+    verify_outputs(out, values, min(n, 512))
+
+    t_med = statistics.median(times)
+    tpu_rps = n / t_med
+    log(f"tpu: {[f'{t*1000:.1f}ms' for t in times]} -> {tpu_rps:,.0f} records/s")
+
+    base_rps = bench_python_baseline(values, base_n)
+    log(f"reference engine baseline: {base_rps:,.0f} records/s ({base_n} records)")
+
+    print(
+        json.dumps(
+            {
+                "metric": "smartmodule_chain_records_per_sec",
+                "value": round(tpu_rps),
+                "unit": "records/s",
+                "vs_baseline": round(tpu_rps / base_rps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""TPU-facing byte-level ops: regex DFA compilation, ragged array helpers.
+
+These are the building blocks the SmartEngine TPU backend lowers DSL
+programs onto. They are engine-independent and individually tested.
+"""

@@ -1,0 +1,580 @@
+"""Regex -> byte-class DFA compiler for TPU scan execution.
+
+The reference executes user regexes (Rust `regex` crate) inside WASM; a TPU
+cannot run arbitrary code, so supported patterns compile to a dense DFA
+transition table executed as a `lax.scan` over record bytes — O(L) steps of
+N-lane table gathers, the shape XLA tiles well.
+
+Pipeline: parse (supported subset) -> Thompson NFA over byte-sets ->
+subset-construction DFA -> byte-class compression. Search (unanchored)
+semantics match Python ``re.search`` on bytes for the supported subset,
+which tests enforce by fuzzing against ``re``.
+
+Supported: literals, escapes (\\d \\D \\w \\W \\s \\S \\n \\t \\r \\xhh and
+escaped metachars), ``.``, character classes ``[...]`` (ranges, negation),
+``*`` ``+`` ``?`` ``{m}`` ``{m,n}`` ``{m,}`` (n bounded), alternation ``|``,
+groups ``(...)`` (incl. ``(?:...)``), anchors ``^`` (pattern start) and
+``$`` (pattern end). Unsupported constructs raise
+:class:`UnsupportedRegex` — callers fall back to host-side execution.
+
+Execution alphabet: 256 byte symbols + EOS (scanned once at end-of-record)
++ PAD (scanned beyond end-of-record; dead for every non-absorbing state).
+Accept states are made absorbing so "matched anywhere" reduces to "final
+state accepts" after scanning len(record)+1 symbols.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+import numpy as np
+
+EOS = 256
+PAD = 257
+N_SYMBOLS = 258
+
+MAX_DFA_STATES = 255  # table stays int16-narrow and VMEM-resident
+MAX_REP_BOUND = 16  # {m,n} expansion bound
+
+
+class UnsupportedRegex(ValueError):
+    """Pattern outside the compilable subset (caller should fall back)."""
+
+
+# ---------------------------------------------------------------------------
+# Parsing to AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Node:
+    pass
+
+
+@dataclass
+class _Lit(_Node):
+    bytes_set: FrozenSet[int] = frozenset()
+
+
+@dataclass
+class _Concat(_Node):
+    parts: List[_Node] = field(default_factory=list)
+
+
+@dataclass
+class _Alt(_Node):
+    options: List[_Node] = field(default_factory=list)
+
+
+@dataclass
+class _Star(_Node):
+    inner: _Node = None
+
+
+@dataclass
+class _Plus(_Node):
+    inner: _Node = None
+
+
+@dataclass
+class _Opt(_Node):
+    inner: _Node = None
+
+
+@dataclass
+class _Rep(_Node):
+    inner: _Node = None
+    lo: int = 0
+    hi: Optional[int] = None  # None = unbounded
+
+
+@dataclass
+class _End(_Node):  # '$'
+    pass
+
+
+_DIGITS = frozenset(range(0x30, 0x3A))
+_WORD = frozenset(
+    list(range(0x30, 0x3A)) + list(range(0x41, 0x5B)) + list(range(0x61, 0x7B)) + [0x5F]
+)
+_SPACE = frozenset(b" \t\n\r\x0b\x0c")
+_ALL = frozenset(range(256))
+_DOT = frozenset(i for i in range(256) if i != 0x0A)  # '.' excludes newline (re default)
+
+
+class _Parser:
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.anchored_start = False
+
+    def error(self, msg: str) -> UnsupportedRegex:
+        return UnsupportedRegex(f"{msg} at position {self.i} in {self.p!r}")
+
+    def peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def next(self) -> str:
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def parse(self) -> _Node:
+        if self.peek() == "^":
+            self.next()
+            self.anchored_start = True
+        node = self.parse_alt()
+        if self.i < len(self.p):
+            raise self.error(f"unexpected {self.p[self.i]!r}")
+        return node
+
+    def parse_alt(self) -> _Node:
+        options = [self.parse_concat()]
+        while self.peek() == "|":
+            self.next()
+            options.append(self.parse_concat())
+        if len(options) == 1:
+            return options[0]
+        return _Alt(options=options)
+
+    def parse_concat(self) -> _Node:
+        parts: List[_Node] = []
+        while True:
+            c = self.peek()
+            if c is None or c in "|)":
+                break
+            parts.append(self.parse_repeat())
+        if len(parts) == 1:
+            return parts[0]
+        return _Concat(parts=parts)
+
+    def parse_repeat(self) -> _Node:
+        atom = self.parse_atom()
+        while True:
+            c = self.peek()
+            if c == "*":
+                self.next()
+                atom = _Star(inner=atom)
+            elif c == "+":
+                self.next()
+                atom = _Plus(inner=atom)
+            elif c == "?":
+                self.next()
+                atom = _Opt(inner=atom)
+            elif c == "{":
+                atom = self.parse_braces(atom)
+            else:
+                break
+            # non-greedy suffix: irrelevant for match-existence; consume it
+            if self.peek() == "?":
+                self.next()
+        return atom
+
+    def parse_braces(self, atom: _Node) -> _Node:
+        save = self.i
+        self.next()  # '{'
+        digits1 = ""
+        while self.peek() is not None and self.peek().isdigit():
+            digits1 += self.next()
+        if self.peek() == "}" and digits1:
+            self.next()
+            return _Rep(inner=atom, lo=int(digits1), hi=int(digits1))
+        if self.peek() == "," and digits1:
+            self.next()
+            digits2 = ""
+            while self.peek() is not None and self.peek().isdigit():
+                digits2 += self.next()
+            if self.peek() == "}":
+                self.next()
+                hi = int(digits2) if digits2 else None
+                return _Rep(inner=atom, lo=int(digits1), hi=hi)
+        # not a repetition -> literal '{' (re treats it literally)
+        self.i = save
+        self.next()
+        return _Concat(parts=[atom, _Lit(bytes_set=frozenset([0x7B]))])
+
+    def parse_atom(self) -> _Node:
+        c = self.next()
+        if c == "(":
+            if self.peek() == "?":
+                self.next()
+                k = self.peek()
+                if k == ":":
+                    self.next()
+                else:
+                    raise self.error(f"unsupported group (?{k}")
+            inner = self.parse_alt()
+            if self.peek() != ")":
+                raise self.error("unbalanced group")
+            self.next()
+            return inner
+        if c == "[":
+            return _Lit(bytes_set=self.parse_class())
+        if c == ".":
+            return _Lit(bytes_set=_DOT)
+        if c == "$":
+            if self.i != len(self.p):
+                raise self.error("'$' supported only at pattern end")
+            return _End()
+        if c == "^":
+            raise self.error("'^' supported only at pattern start")
+        if c == "\\":
+            return _Lit(bytes_set=self.parse_escape())
+        if c in "*+?":
+            raise self.error(f"dangling quantifier {c!r}")
+        return _Lit(bytes_set=frozenset([ord(c)]))
+
+    def parse_escape(self) -> FrozenSet[int]:
+        if self.peek() is None:
+            raise self.error("trailing backslash")
+        c = self.next()
+        table = {
+            "d": _DIGITS,
+            "D": _ALL - _DIGITS,
+            "w": _WORD,
+            "W": _ALL - _WORD,
+            "s": _SPACE,
+            "S": _ALL - _SPACE,
+            "n": frozenset([0x0A]),
+            "t": frozenset([0x09]),
+            "r": frozenset([0x0D]),
+            "f": frozenset([0x0C]),
+            "v": frozenset([0x0B]),
+            "0": frozenset([0x00]),
+        }
+        if c in table:
+            return table[c]
+        if c == "x":
+            hex_digits = self.p[self.i : self.i + 2]
+            if len(hex_digits) == 2:
+                self.i += 2
+                return frozenset([int(hex_digits, 16)])
+            raise self.error("bad \\x escape")
+        if c.isalnum():
+            raise self.error(f"unsupported escape \\{c}")
+        return frozenset([ord(c)])
+
+    def parse_class(self) -> FrozenSet[int]:
+        negate = False
+        if self.peek() == "^":
+            self.next()
+            negate = True
+        members: Set[int] = set()
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                raise self.error("unterminated character class")
+            if c == "]" and not first:
+                self.next()
+                break
+            first = False
+            if c == "\\":
+                self.next()
+                members |= self.parse_escape()
+                continue
+            self.next()
+            lo = ord(c)
+            if self.peek() == "-" and self.i + 1 < len(self.p) and self.p[self.i + 1] != "]":
+                self.next()  # '-'
+                hi_ch = self.next()
+                if hi_ch == "\\":
+                    raise self.error("escape as range endpoint")
+                hi = ord(hi_ch)
+                if hi < lo:
+                    raise self.error("inverted class range")
+                members |= set(range(lo, hi + 1))
+            else:
+                members.add(lo)
+        if negate:
+            return frozenset(_ALL - members)
+        return frozenset(members)
+
+
+# ---------------------------------------------------------------------------
+# Thompson NFA
+# ---------------------------------------------------------------------------
+
+
+class _NFA:
+    def __init__(self) -> None:
+        self.eps: List[Set[int]] = []
+        self.trans: List[List[Tuple[FrozenSet[int], int]]] = []  # (byteset, target)
+        self.eos_trans: List[Set[int]] = []
+
+    def new_state(self) -> int:
+        self.eps.append(set())
+        self.trans.append([])
+        self.eos_trans.append(set())
+        return len(self.eps) - 1
+
+    def add_eps(self, a: int, b: int) -> None:
+        self.eps[a].add(b)
+
+    def add_sym(self, a: int, byteset: FrozenSet[int], b: int) -> None:
+        self.trans[a].append((byteset, b))
+
+    def add_eos(self, a: int, b: int) -> None:
+        self.eos_trans[a].add(b)
+
+    def build(self, node: _Node) -> Tuple[int, int]:
+        """Build fragment, return (start, end)."""
+        if isinstance(node, _Lit):
+            s, e = self.new_state(), self.new_state()
+            self.add_sym(s, node.bytes_set, e)
+            return s, e
+        if isinstance(node, _End):
+            s, e = self.new_state(), self.new_state()
+            self.add_eos(s, e)
+            return s, e
+        if isinstance(node, _Concat):
+            if not node.parts:
+                s = self.new_state()
+                return s, s
+            s, e = self.build(node.parts[0])
+            for part in node.parts[1:]:
+                s2, e2 = self.build(part)
+                self.add_eps(e, s2)
+                e = e2
+            return s, e
+        if isinstance(node, _Alt):
+            s, e = self.new_state(), self.new_state()
+            for opt in node.options:
+                s2, e2 = self.build(opt)
+                self.add_eps(s, s2)
+                self.add_eps(e2, e)
+            return s, e
+        if isinstance(node, _Star):
+            s, e = self.new_state(), self.new_state()
+            s2, e2 = self.build(node.inner)
+            self.add_eps(s, s2)
+            self.add_eps(s, e)
+            self.add_eps(e2, s2)
+            self.add_eps(e2, e)
+            return s, e
+        if isinstance(node, _Plus):
+            s2, e2 = self.build(node.inner)
+            e = self.new_state()
+            self.add_eps(e2, e)
+            self.add_eps(e2, s2)
+            return s2, e
+        if isinstance(node, _Opt):
+            s, e = self.new_state(), self.new_state()
+            s2, e2 = self.build(node.inner)
+            self.add_eps(s, s2)
+            self.add_eps(e2, e)
+            self.add_eps(s, e)
+            return s, e
+        if isinstance(node, _Rep):
+            lo, hi = node.lo, node.hi
+            if hi is not None and hi > MAX_REP_BOUND:
+                raise UnsupportedRegex(f"repetition bound {hi} > {MAX_REP_BOUND}")
+            if lo > MAX_REP_BOUND:
+                raise UnsupportedRegex(f"repetition bound {lo} > {MAX_REP_BOUND}")
+            parts: List[_Node] = [node.inner] * lo
+            if hi is None:
+                parts.append(_Star(inner=node.inner))
+            else:
+                parts.extend([_Opt(inner=node.inner)] * (hi - lo))
+            return self.build(_Concat(parts=parts))
+        raise UnsupportedRegex(f"unsupported node {type(node).__name__}")
+
+    def eps_closure(self, states: Set[int]) -> FrozenSet[int]:
+        stack = list(states)
+        seen = set(states)
+        while stack:
+            s = stack.pop()
+            for t in self.eps[s]:
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        return frozenset(seen)
+
+
+# ---------------------------------------------------------------------------
+# Compiled DFA
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CompiledDfa:
+    """Dense DFA over compressed byte classes.
+
+    - ``table[s, c]`` -> next state (int16), ``c`` a byte class
+    - ``byte_class[b]`` for bytes 0..255; ``eos_class``/``pad_class`` for the
+      end-of-record sentinel and padding
+    - ``accept[s]`` final-state acceptance after len+1 scanned symbols
+    - ``start`` initial state
+    """
+
+    table: np.ndarray  # int16 [S, C]
+    byte_class: np.ndarray  # int16 [256]
+    eos_class: int
+    pad_class: int
+    accept: np.ndarray  # bool [S]
+    start: int
+    pattern: str = ""
+
+    @property
+    def n_states(self) -> int:
+        return self.table.shape[0]
+
+    @property
+    def n_classes(self) -> int:
+        return self.table.shape[1]
+
+    def match_numpy(self, values: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """Reference batch matcher (numpy): values u8 [N, L], lengths [N]."""
+        n, max_len = values.shape
+        state = np.full(n, self.start, dtype=np.int16)
+        idx = np.arange(n)
+        for t in range(max_len + 1):
+            if t < max_len:
+                cls = self.byte_class[values[:, t]]
+                cls = np.where(t < lengths, cls, np.where(t == lengths, self.eos_class, self.pad_class))
+            else:
+                cls = np.where(lengths == max_len, self.eos_class, self.pad_class)
+            state = self.table[state, cls]
+        return self.accept[state.astype(np.int64)]
+
+    def match_bytes(self, data: bytes) -> bool:
+        arr = np.frombuffer(data, dtype=np.uint8).reshape(1, -1)
+        if len(data) == 0:
+            arr = np.zeros((1, 1), dtype=np.uint8)
+            return bool(self.match_numpy(arr, np.array([0]))[0])
+        return bool(self.match_numpy(arr, np.array([len(data)]))[0])
+
+
+def compile_regex(pattern: str) -> CompiledDfa:
+    """Compile a pattern (search semantics) to a byte-class DFA."""
+    parser = _Parser(pattern)
+    ast = parser.parse()
+
+    nfa = _NFA()
+    start_frag, end_frag = nfa.build(ast)
+    start = nfa.new_state()
+    accept_state = nfa.new_state()
+    nfa.add_eps(start, start_frag)
+    nfa.add_eps(end_frag, accept_state)
+    if not parser.anchored_start:
+        # unanchored search: start state may consume any byte and retry
+        nfa.add_sym(start, _ALL, start)
+
+    # ---- subset construction over symbols: bytes x EOS ----
+    start_set = nfa.eps_closure({start})
+    dfa_states: Dict[FrozenSet[int], int] = {start_set: 0}
+    worklist = [start_set]
+    trans_rows: List[Dict[int, int]] = []  # symbol (0..256) -> dfa state
+    accepts: List[bool] = []
+
+    def is_accepting(sset: FrozenSet[int]) -> bool:
+        return accept_state in sset
+
+    while worklist:
+        sset = worklist.pop()
+        sid = dfa_states[sset]
+        while len(trans_rows) <= sid:
+            trans_rows.append({})
+            accepts.append(False)
+        accepts[sid] = is_accepting(sset)
+
+        if accepts[sid]:
+            # absorbing accept: all symbols loop
+            trans_rows[sid] = {sym: sid for sym in range(257)}
+            continue
+
+        # group target NFA-state-sets per byte
+        byte_targets: List[Set[int]] = [set() for _ in range(256)]
+        for s in sset:
+            for byteset, tgt in nfa.trans[s]:
+                for b in byteset:
+                    byte_targets[b].add(tgt)
+        eos_target: Set[int] = set()
+        for s in sset:
+            eos_target |= nfa.eos_trans[s]
+
+        row: Dict[int, int] = {}
+        cache: Dict[FrozenSet[int], int] = {}
+        for sym in range(257):
+            tgt = frozenset(byte_targets[sym]) if sym < 256 else frozenset(eos_target)
+            if not tgt:
+                row[sym] = -1  # dead
+                continue
+            closed = nfa.eps_closure(tgt)
+            tid = dfa_states.get(closed)
+            if tid is None:
+                tid = len(dfa_states)
+                if tid > MAX_DFA_STATES:
+                    raise UnsupportedRegex(
+                        f"DFA exceeds {MAX_DFA_STATES} states for {pattern!r}"
+                    )
+                dfa_states[closed] = tid
+                worklist.append(closed)
+            row[sym] = tid
+        trans_rows[sid] = row
+
+    n_states = len(dfa_states) + 1  # + dead state
+    dead = n_states - 1
+    full = np.full((n_states, N_SYMBOLS), dead, dtype=np.int16)
+    accept_arr = np.zeros(n_states, dtype=bool)
+    for sid, row in enumerate(trans_rows):
+        accept_arr[sid] = accepts[sid]
+        for sym, tgt in row.items():
+            full[sid, sym] = dead if tgt == -1 else tgt
+        full[sid, PAD] = sid if accepts[sid] else dead
+    # EOS column: for accepting states, stay (absorbing covers via row loop)
+    # PAD for dead stays dead (default).
+
+    # ---- byte-class compression: identical columns merge ----
+    col_keys: Dict[bytes, int] = {}
+    class_of_symbol = np.zeros(N_SYMBOLS, dtype=np.int16)
+    for sym in range(N_SYMBOLS):
+        key = full[:, sym].tobytes()
+        cid = col_keys.setdefault(key, len(col_keys))
+        class_of_symbol[sym] = cid
+    n_classes = len(col_keys)
+    table = np.zeros((n_states, n_classes), dtype=np.int16)
+    for sym in range(N_SYMBOLS):
+        table[:, class_of_symbol[sym]] = full[:, sym]
+
+    return CompiledDfa(
+        table=table,
+        byte_class=class_of_symbol[:256].copy(),
+        eos_class=int(class_of_symbol[EOS]),
+        pad_class=int(class_of_symbol[PAD]),
+        accept=accept_arr,
+        start=0,
+        pattern=pattern,
+    )
+
+
+def literal_of(pattern: str):
+    """Detect pure-literal patterns (optionally ^/$-anchored).
+
+    Returns ``(literal_bytes, anchored_start, anchored_end)`` or ``None``
+    if the pattern uses any non-literal construct. Lets the engine replace
+    the DFA scan with windowed-compare substring search for the common
+    case.
+    """
+    parser = _Parser(pattern)
+    try:
+        ast = parser.parse()
+    except UnsupportedRegex:
+        return None
+
+    anchored_end = False
+    parts: List[_Node]
+    if isinstance(ast, _Concat):
+        parts = list(ast.parts)
+    else:
+        parts = [ast]
+    if parts and isinstance(parts[-1], _End):
+        anchored_end = True
+        parts = parts[:-1]
+    out = bytearray()
+    for node in parts:
+        if not isinstance(node, _Lit) or len(node.bytes_set) != 1:
+            return None
+        out.append(next(iter(node.bytes_set)))
+    return bytes(out), parser.anchored_start, anchored_end

@@ -1,0 +1,17 @@
+"""Multi-chip execution: record-axis sharding of the engine over a Mesh.
+
+The reference scales horizontally by assigning topic partitions to SPUs
+(SURVEY.md §2.5); inside one TPU-backed SPU the analogous axis is the
+record axis of the batched buffer. Chains shard over a
+`jax.sharding.Mesh` ``records`` axis: filters/maps are embarrassingly
+parallel, aggregate prefix scans cross shards via XLA collectives over
+ICI (GSPMD partitions `associative_scan`/`cumsum` automatically).
+"""
+
+from fluvio_tpu.parallel.mesh import (
+    make_record_mesh,
+    shard_buffer_arrays,
+    sharded_chain_step,
+)
+
+__all__ = ["make_record_mesh", "shard_buffer_arrays", "sharded_chain_step"]

@@ -1,0 +1,63 @@
+"""Mesh construction and sharded chain execution."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+RECORD_AXIS = "records"
+
+
+def make_record_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (RECORD_AXIS,))
+
+
+def shard_buffer_arrays(arrays: Dict[str, jnp.ndarray], mesh: Mesh) -> Dict[str, jnp.ndarray]:
+    """Place buffer columns row-sharded across the record axis."""
+    out = {}
+    for name, arr in arrays.items():
+        spec = P(RECORD_AXIS) if arr.ndim == 1 else P(RECORD_AXIS, None)
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out
+
+
+def sharded_chain_step(executor, mesh: Mesh):
+    """Jit the fused chain step with record-axis input shardings.
+
+    GSPMD inserts the ICI collectives: the aggregate `associative_scan`
+    and the compaction `cumsum` become cross-shard prefix ops; everything
+    else stays local to its shard.
+    """
+    row_spec = NamedSharding(mesh, P(RECORD_AXIS))
+    mat_spec = NamedSharding(mesh, P(RECORD_AXIS, None))
+    rep = NamedSharding(mesh, P())
+
+    def spec_for(arr):
+        return mat_spec if getattr(arr, "ndim", 1) == 2 else row_spec
+
+    def in_shardings(arrays, count, base_ts, carries):
+        return (
+            {k: spec_for(v) for k, v in arrays.items()},
+            rep,
+            rep,
+            jax.tree_util.tree_map(lambda _: rep, carries),
+        )
+
+    def step(arrays, count, base_ts, carries):
+        return executor._chain_fn(arrays, count, base_ts, carries)
+
+    # shardings bound at call time (array pytree structure varies per chain)
+    def run(arrays, count, base_ts, carries):
+        jitted = jax.jit(
+            step, in_shardings=in_shardings(arrays, count, base_ts, carries)
+        )
+        return jitted(arrays, count, base_ts, carries)
+
+    return run

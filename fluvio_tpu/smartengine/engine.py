@@ -105,7 +105,9 @@ class SmartModuleChainBuilder:
 
         backend = engine.backend
         tpu_chain = None
-        if backend in ("tpu", "auto"):
+        # an empty chain is decode-and-passthrough on every backend
+        # (parity: engine.rs:180-184); nothing to lower
+        if backend in ("tpu", "auto") and self.entries:
             try:
                 from fluvio_tpu.smartengine.tpu.executor import TpuChainExecutor
 
@@ -114,6 +116,8 @@ class SmartModuleChainBuilder:
                 )
             except ImportError:
                 tpu_chain = None
+            if tpu_chain is not None:
+                tpu_chain.attach(instances)
             if tpu_chain is None and backend == "tpu":
                 raise EngineError(
                     "backend='tpu' requires every module in the chain to "

@@ -1,0 +1,21 @@
+"""TPU engine backend: DSL chains lowered to fused JAX/XLA programs.
+
+Architecture (the north star; see SURVEY.md §7 step 2):
+
+- records stage into a padded, bucketed `RecordBuffer` (uint8[N, L] values
+  + lengths + key/offset/timestamp columns) that lives in HBM,
+- each DSL transform lowers to vectorized kernels over that buffer
+  (regex -> DFA byte-class scan, JSON field access -> structural-scan
+  state machine, aggregate -> segmented prefix scans with a
+  device-resident carry),
+- a whole chain compiles into ONE jitted function (filters become lazy
+  validity masks — no mid-chain compaction or host round-trips),
+- aggregate accumulator/window state crosses `process()` calls on device.
+
+int64 is enabled process-wide here: offsets/timestamps/aggregates are
+64-bit in the protocol and must not silently truncate.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

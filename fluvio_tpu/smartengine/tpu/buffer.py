@@ -1,0 +1,168 @@
+"""RecordBuffer — the HBM-resident batched-record layout.
+
+The TPU-native replacement for the reference's per-record WASM ABI round
+trip (fluvio-smartengine .../instance.rs:164-191): instead of
+encode -> guest alloc -> memcpy -> call -> decode per module per batch,
+records are staged once into padded columnar arrays and every transform in
+the chain operates on those arrays in place on device.
+
+Shape discipline: widths and row counts are bucketed to powers of two so
+XLA compiles one program per bucket, not per batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartmodule.types import SmartModuleInput
+from fluvio_tpu.types import NO_TIMESTAMP
+
+MIN_ROWS = 8
+MIN_WIDTH = 32
+MAX_WIDTH = 1 << 16
+
+
+def _next_pow2(n: int, floor: int) -> int:
+    v = floor
+    while v < n:
+        v <<= 1
+    return v
+
+
+@dataclass
+class RecordBuffer:
+    """Padded columnar record batch (numpy on host; device puts are cheap).
+
+    - ``values``: uint8 [N, L]; row i holds record i's value bytes, zero-pad
+    - ``lengths``: int32 [N]
+    - ``keys``: uint8 [N, LK]; ``key_lengths`` int32 [N], -1 = null key
+    - ``offset_deltas``: int32 [N]; ``timestamp_deltas``: int64 [N]
+    - ``count``: live rows (rows >= count are padding)
+    """
+
+    values: np.ndarray
+    lengths: np.ndarray
+    keys: np.ndarray
+    key_lengths: np.ndarray
+    offset_deltas: np.ndarray
+    timestamp_deltas: np.ndarray
+    count: int
+    base_offset: int = 0
+    base_timestamp: int = NO_TIMESTAMP
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_records(
+        cls,
+        records: List[Record],
+        base_offset: int = 0,
+        base_timestamp: int = NO_TIMESTAMP,
+    ) -> "RecordBuffer":
+        n = len(records)
+        rows = _next_pow2(max(n, 1), MIN_ROWS)
+        max_v = max((len(r.value) for r in records), default=0)
+        max_k = max((len(r.key) for r in records if r.key is not None), default=0)
+        width = _next_pow2(max(max_v, 1), MIN_WIDTH)
+        kwidth = _next_pow2(max_k, MIN_WIDTH) if max_k else MIN_WIDTH
+        if width > MAX_WIDTH:
+            raise ValueError(f"record value of {max_v} bytes exceeds {MAX_WIDTH}")
+
+        values = np.zeros((rows, width), dtype=np.uint8)
+        lengths = np.zeros(rows, dtype=np.int32)
+        keys = np.zeros((rows, kwidth), dtype=np.uint8)
+        key_lengths = np.full(rows, -1, dtype=np.int32)
+        offset_deltas = np.zeros(rows, dtype=np.int32)
+        timestamp_deltas = np.zeros(rows, dtype=np.int64)
+        for i, rec in enumerate(records):
+            v = rec.value
+            values[i, : len(v)] = np.frombuffer(v, dtype=np.uint8)
+            lengths[i] = len(v)
+            if rec.key is not None:
+                k = rec.key
+                keys[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
+                key_lengths[i] = len(k)
+            offset_deltas[i] = rec.offset_delta
+            timestamp_deltas[i] = rec.timestamp_delta
+        return cls(
+            values=values,
+            lengths=lengths,
+            keys=keys,
+            key_lengths=key_lengths,
+            offset_deltas=offset_deltas,
+            timestamp_deltas=timestamp_deltas,
+            count=n,
+            base_offset=base_offset,
+            base_timestamp=base_timestamp,
+        )
+
+    @classmethod
+    def from_smartmodule_input(cls, inp: SmartModuleInput) -> "RecordBuffer":
+        return cls.from_records(
+            inp.into_records(),
+            base_offset=inp.base_offset,
+            base_timestamp=inp.base_timestamp,
+        )
+
+    @classmethod
+    def from_arrays(
+        cls,
+        values: np.ndarray,
+        lengths: np.ndarray,
+        count: Optional[int] = None,
+        keys: Optional[np.ndarray] = None,
+        key_lengths: Optional[np.ndarray] = None,
+        offset_deltas: Optional[np.ndarray] = None,
+        timestamp_deltas: Optional[np.ndarray] = None,
+        base_offset: int = 0,
+        base_timestamp: int = NO_TIMESTAMP,
+    ) -> "RecordBuffer":
+        """Adopt pre-staged arrays (bench/broker fast path). Rows must
+        already be bucketed; ``count`` defaults to all rows."""
+        rows = values.shape[0]
+        n = rows if count is None else count
+        if keys is None:
+            keys = np.zeros((rows, MIN_WIDTH), dtype=np.uint8)
+            key_lengths = np.full(rows, -1, dtype=np.int32)
+        if offset_deltas is None:
+            offset_deltas = np.arange(rows, dtype=np.int32)
+        if timestamp_deltas is None:
+            timestamp_deltas = np.zeros(rows, dtype=np.int64)
+        return cls(
+            values=values,
+            lengths=lengths.astype(np.int32),
+            keys=keys,
+            key_lengths=key_lengths.astype(np.int32),
+            offset_deltas=offset_deltas,
+            timestamp_deltas=timestamp_deltas,
+            count=n,
+            base_offset=base_offset,
+            base_timestamp=base_timestamp,
+        )
+
+    # -- materialization ----------------------------------------------------
+
+    def to_records(self) -> List[Record]:
+        out: List[Record] = []
+        values = self.values
+        keys = self.keys
+        for i in range(self.count):
+            vlen = int(self.lengths[i])
+            klen = int(self.key_lengths[i])
+            out.append(
+                Record(
+                    value=values[i, :vlen].tobytes(),
+                    key=None if klen < 0 else keys[i, :klen].tobytes(),
+                    offset_delta=int(self.offset_deltas[i]),
+                    timestamp_delta=int(self.timestamp_deltas[i]),
+                )
+            )
+        return out
+
+    def shape_key(self) -> Tuple[int, int, int]:
+        """(rows, value width, key width) — the jit-cache bucket."""
+        return (self.values.shape[0], self.values.shape[1], self.keys.shape[1])

@@ -1,0 +1,618 @@
+"""JAX kernels over the RecordBuffer columns.
+
+Every kernel is a pure function over padded arrays, vectorized across the
+record axis (N lanes) with any per-byte iteration expressed as `lax.scan`
+fixed-trip loops — no data-dependent Python control flow, so whole chains
+fuse under one jit. Byte-level semantics are pinned by
+`fluvio_tpu.smartmodule.dsl` (json_get_bytes / parse_int_prefix / ...);
+tests assert bit-equality against those references.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from fluvio_tpu.ops.regex_dfa import CompiledDfa
+
+INT64_MIN = -(2**63)
+INT64_MAX = 2**63 - 1
+
+
+# ---------------------------------------------------------------------------
+# Regex DFA scan
+# ---------------------------------------------------------------------------
+
+
+def dfa_match(values: jnp.ndarray, lengths: jnp.ndarray, dfa: CompiledDfa) -> jnp.ndarray:
+    """Run a compiled DFA over each record; True where the regex matches.
+
+    O(L) scan steps of N-lane gathers from a VMEM-resident flat table.
+    Padding uses the PAD class (dead unless absorbed), end-of-record feeds
+    one EOS symbol so ``$`` anchors work.
+    """
+    n, width = values.shape
+    n_classes = dfa.n_classes
+    table_flat = jnp.asarray(dfa.table.reshape(-1).astype(np.int32))
+    byte_class = jnp.asarray(dfa.byte_class.astype(np.int32))
+    accept = jnp.asarray(dfa.accept)
+    lengths = lengths.astype(jnp.int32)
+
+    def step(state, xs):
+        col, t = xs
+        cls = jnp.take(byte_class, col.astype(jnp.int32))
+        cls = jnp.where(
+            t < lengths,
+            cls,
+            jnp.where(t == lengths, dfa.eos_class, dfa.pad_class),
+        )
+        state = jnp.take(table_flat, state * n_classes + cls)
+        return state, None
+
+    state0 = jnp.full((n,), dfa.start, dtype=jnp.int32)
+    final, _ = lax.scan(step, state0, (values.T, jnp.arange(width, dtype=jnp.int32)))
+    # one trailing symbol for records exactly `width` long (EOS) / shorter (PAD)
+    cls = jnp.where(lengths == width, dfa.eos_class, dfa.pad_class)
+    final = jnp.take(table_flat, final * n_classes + cls)
+    return jnp.take(accept, final)
+
+
+# ---------------------------------------------------------------------------
+# JSON top-level field extraction (structural scan)
+# ---------------------------------------------------------------------------
+
+_P_SCAN, _P_COLON, _P_WS, _P_STR, _P_RAW, _P_DONE = range(6)
+
+
+def json_get(
+    values: jnp.ndarray, lengths: jnp.ndarray, key: str
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-record top-level JSON field extraction.
+
+    Bit-identical to `dsl.json_get_bytes`: a byte state machine tracking
+    (in-string, escape, brace depth, progressive needle match, value phase)
+    as N-lane vectors, scanned over the L byte columns. Returns
+    ``(out_values u8[N, L], out_lengths i32[N])`` — missing/malformed
+    yields length 0.
+    """
+    needle = b'"' + key.encode("utf-8") + b'"'
+    klen = len(needle)
+    needle_arr = jnp.asarray(np.frombuffer(needle, dtype=np.uint8).astype(np.int32))
+    n, width = values.shape
+    lengths = lengths.astype(jnp.int32)
+
+    def step(carry, xs):
+        (phase, kmatch, in_str, esc, depth, d2, vesc, start, end, lastnw) = carry
+        col, t = xs
+        c = col.astype(jnp.int32)
+        active = t < lengths
+        is_ws = (c == 32) | (c == 9) | (c == 13) | (c == 10)
+        is_quote = c == 0x22
+        is_bslash = c == 0x5C
+
+        # ---- phase COLON: ws -> stay; ':' -> WS phase; else abort+reprocess
+        colon_here = (phase == _P_COLON) & (c == 0x3A)
+        colon_stay = (phase == _P_COLON) & is_ws
+        colon_abort = (phase == _P_COLON) & ~is_ws & (c != 0x3A)
+
+        # ---- general scan applies in SCAN phase or on COLON abort
+        g = (phase == _P_SCAN) | colon_abort
+
+        # inside string
+        gs = g & in_str
+        s_esc_consume = gs & esc
+        s_set_esc = gs & ~esc & is_bslash
+        s_close = gs & ~esc & is_quote
+        s_key_done = s_close & (kmatch == klen - 1)
+        # progressive needle match on ordinary string bytes
+        s_ordinary = gs & ~esc & ~is_bslash & ~is_quote
+        expected = jnp.take(needle_arr, jnp.clip(kmatch, 0, klen - 1))
+        k_next = jnp.where(
+            (kmatch > 0) & (kmatch < klen - 1) & (c == expected), kmatch + 1, 0
+        )
+
+        # outside string
+        go = g & ~in_str
+        o_open = go & is_quote
+        o_depth_up = go & (c == 0x7B)
+        o_depth_dn = go & (c == 0x7D)
+
+        new_in_str = jnp.where(
+            active & s_close, False, jnp.where(active & o_open, True, in_str)
+        )
+        new_esc = jnp.where(active & gs, s_set_esc, esc)
+        new_depth = (
+            depth
+            + jnp.where(active & o_depth_up, 1, 0)
+            - jnp.where(active & o_depth_dn, 1, 0)
+        )
+        new_kmatch = kmatch
+        new_kmatch = jnp.where(active & s_ordinary, k_next, new_kmatch)
+        new_kmatch = jnp.where(
+            active & (s_set_esc | s_esc_consume | s_close), 0, new_kmatch
+        )
+        new_kmatch = jnp.where(
+            active & o_open, jnp.where(depth == 1, 1, 0), new_kmatch
+        )
+
+        # ---- phase WS (after colon): skip ws, classify value start
+        w = (phase == _P_WS) & active
+        w_go = w & ~is_ws
+        w_str = w_go & is_quote
+        is_closer = (c == 0x5D) | (c == 0x7D) | (c == 0x2C)  # ] } ,
+        w_empty = w_go & ~is_quote & is_closer
+        w_raw = w_go & ~is_quote & ~is_closer
+        w_raw_open = w_raw & ((c == 0x5B) | (c == 0x7B))
+
+        # ---- phase STR (string value)
+        s3 = (phase == _P_STR) & active
+        s3_esc_consume = s3 & vesc
+        s3_set_esc = s3 & ~vesc & is_bslash
+        s3_close = s3 & ~vesc & is_quote
+
+        # ---- phase RAW (scalar / nested value)
+        s4 = (phase == _P_RAW) & active
+        r_open = s4 & ((c == 0x5B) | (c == 0x7B))
+        r_close = s4 & ((c == 0x5D) | (c == 0x7D))
+        r_comma = s4 & (c == 0x2C)
+        r_end = (r_close & (d2 == 0)) | (r_comma & (d2 == 0))
+        r_dec = r_close & (d2 > 0)
+
+        # ---- transitions
+        new_phase = phase
+        new_phase = jnp.where(active & s_key_done, _P_COLON, new_phase)
+        new_phase = jnp.where(active & colon_here, _P_WS, new_phase)
+        new_phase = jnp.where(active & colon_abort, _P_SCAN, new_phase)
+        new_phase = jnp.where(w_str, _P_STR, new_phase)
+        new_phase = jnp.where(w_empty, _P_DONE, new_phase)
+        new_phase = jnp.where(w_raw, _P_RAW, new_phase)
+        new_phase = jnp.where(s3_close, _P_DONE, new_phase)
+        new_phase = jnp.where(r_end, _P_DONE, new_phase)
+
+        new_vesc = jnp.where(s3, ~vesc & is_bslash, vesc)
+        new_d2 = d2 + jnp.where(w_raw_open, 1, 0) + jnp.where(r_open, 1, 0) - jnp.where(r_dec, 1, 0)
+        new_start = jnp.where(w_str, t + 1, jnp.where(w_raw | w_empty, t, start))
+        new_end = jnp.where(s3_close, t, jnp.where(r_end, lastnw + 1, jnp.where(w_empty, t, end)))
+        new_lastnw = jnp.where((w_raw & ~is_ws) | (s4 & ~r_end & ~is_ws), t, lastnw)
+
+        return (
+            new_phase,
+            new_kmatch,
+            new_in_str,
+            new_esc,
+            new_depth,
+            new_d2,
+            new_vesc,
+            new_start,
+            new_end,
+            new_lastnw,
+        ), None
+
+    zeros_i = jnp.zeros((n,), dtype=jnp.int32)
+    zeros_b = jnp.zeros((n,), dtype=bool)
+    carry0 = (
+        jnp.full((n,), _P_SCAN, dtype=jnp.int32),  # phase
+        zeros_i,  # kmatch
+        zeros_b,  # in_str
+        zeros_b,  # esc
+        zeros_i,  # depth
+        zeros_i,  # d2
+        zeros_b,  # vesc
+        zeros_i,  # start
+        zeros_i,  # end
+        jnp.full((n,), -1, dtype=jnp.int32),  # lastnw
+    )
+    final, _ = lax.scan(
+        step, carry0, (values.T, jnp.arange(width, dtype=jnp.int32))
+    )
+    phase, _, _, _, _, _, _, start, end, lastnw = final
+
+    # end-of-record fixups (unterminated values run to the end)
+    end = jnp.where(phase == _P_STR, lengths, end)
+    end = jnp.where(phase == _P_RAW, lastnw + 1, end)
+    found = (phase == _P_DONE) | (phase == _P_STR) | (phase == _P_RAW)
+
+    out_lengths = jnp.where(found, jnp.maximum(end - start, 0), 0).astype(jnp.int32)
+    idx = start[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    gathered = jnp.take_along_axis(values, jnp.clip(idx, 0, width - 1), axis=1)
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < out_lengths[:, None]
+    out_values = jnp.where(mask, gathered, 0).astype(jnp.uint8)
+    return out_values, out_lengths
+
+
+# ---------------------------------------------------------------------------
+# Case folding, int parse/render, word count
+# ---------------------------------------------------------------------------
+
+
+def ascii_upper(values: jnp.ndarray) -> jnp.ndarray:
+    lower = (values >= 0x61) & (values <= 0x7A)
+    return jnp.where(lower, values - 32, values).astype(jnp.uint8)
+
+
+def ascii_lower(values: jnp.ndarray) -> jnp.ndarray:
+    upper = (values >= 0x41) & (values <= 0x5A)
+    return jnp.where(upper, values + 32, values).astype(jnp.uint8)
+
+
+def parse_int(values: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Leading ASCII integer per record (parity: dsl.parse_int_prefix)."""
+    n, width = values.shape
+    # scan the full width: leading whitespace is unbounded in the reference
+    # semantics, so a fixed window would silently misparse padded values
+    steps = width
+    lengths = lengths.astype(jnp.int32)
+
+    def step(carry, xs):
+        phase, neg, num, seen, done = carry
+        col, t = xs
+        c = col.astype(jnp.int32)
+        active = (t < lengths) & ~done
+        is_ws = (c == 32) | (c == 9) | (c == 13) | (c == 10)
+        is_digit = (c >= 0x30) & (c <= 0x39)
+        is_sign = (c == 0x2B) | (c == 0x2D)
+
+        p0 = active & (phase == 0)
+        p1 = active & (phase == 1)
+
+        start_digit = p0 & is_digit
+        start_sign = p0 & is_sign
+        cont_digit = p1 & is_digit
+
+        new_num = jnp.where(
+            start_digit,
+            (c - 0x30).astype(jnp.int64),
+            jnp.where(cont_digit, num * 10 + (c - 0x30).astype(jnp.int64), num),
+        )
+        new_seen = seen | start_digit | cont_digit
+        new_neg = jnp.where(start_sign, c == 0x2D, neg)
+        new_phase = jnp.where(start_digit | start_sign, 1, phase)
+        new_done = done | (p0 & ~is_ws & ~is_digit & ~is_sign) | (p1 & ~is_digit)
+        return (new_phase, new_neg, new_num, new_seen, new_done), None
+
+    zeros_b = jnp.zeros((n,), dtype=bool)
+    carry0 = (
+        jnp.zeros((n,), dtype=jnp.int32),
+        zeros_b,
+        jnp.zeros((n,), dtype=jnp.int64),
+        zeros_b,
+        zeros_b,
+    )
+    cols = values[:, :steps].T
+    (phase, neg, num, seen, done), _ = lax.scan(
+        step, carry0, (cols, jnp.arange(steps, dtype=jnp.int32))
+    )
+    return jnp.where(seen, jnp.where(neg, -num, num), 0)
+
+
+_POW10 = np.ones(20, dtype=np.uint64)
+for _i in range(1, 20):
+    _POW10[_i] = _POW10[_i - 1] * np.uint64(10)
+
+INT_ASCII_WIDTH = 20  # sign + 19 digits covers all of int64
+
+
+def int_to_ascii(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Render int64 -> ASCII decimal. Returns (u8[N, 20], lengths[N])."""
+    n = x.shape[0]
+    neg = x < 0
+    xu = x.astype(jnp.uint64)
+    mag = jnp.where(neg, (~xu) + jnp.uint64(1), xu)  # |x| exact incl. INT64_MIN
+    pow10 = jnp.asarray(_POW10)
+    ndigits = 1 + jnp.sum(
+        mag[:, None] >= pow10[None, 1:20], axis=1
+    ).astype(jnp.int32)
+    length = ndigits + neg.astype(jnp.int32)
+
+    j = jnp.arange(INT_ASCII_WIDTH, dtype=jnp.int32)[None, :]
+    digit_idx = j - neg[:, None].astype(jnp.int32)
+    pos = ndigits[:, None] - 1 - digit_idx
+    pos_c = jnp.clip(pos, 0, 19)
+    digit = (mag[:, None] // jnp.take(pow10, pos_c)) % jnp.uint64(10)
+    ch = (digit.astype(jnp.int32) + 0x30).astype(jnp.uint8)
+    out = jnp.where((j == 0) & neg[:, None], jnp.uint8(0x2D), ch)
+    in_range = (digit_idx >= 0) & (digit_idx < ndigits[:, None])
+    sign_pos = (j == 0) & neg[:, None]
+    out = jnp.where(in_range | sign_pos, out, 0).astype(jnp.uint8)
+    return out, length
+
+
+def count_words(values: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Whitespace-separated token count per record (parity: bytes.split())."""
+    n, width = values.shape
+    c = values.astype(jnp.int32)
+    is_ws = (c == 32) | (c == 9) | (c == 13) | (c == 10) | (c == 11) | (c == 12)
+    in_rec = jnp.arange(width, dtype=jnp.int32)[None, :] < lengths[:, None].astype(jnp.int32)
+    nonws = (~is_ws) & in_rec
+    prev_ws = jnp.concatenate(
+        [jnp.ones((n, 1), dtype=bool), ~nonws[:, :-1]], axis=1
+    )
+    starts = nonws & prev_ws
+    return jnp.sum(starts, axis=1).astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# Segmented prefix scans (aggregate engine)
+# ---------------------------------------------------------------------------
+
+# neutrals stay plain ints — creating jax arrays at import time would
+# force backend initialization as an import side effect
+_AGG_OPS = {
+    "add": (0, lambda a, b: a + b),
+    "max": (INT64_MIN, jnp.maximum),
+    "min": (INT64_MAX, jnp.minimum),
+}
+
+
+def segmented_scan(
+    x: jnp.ndarray, reset: jnp.ndarray, op_name: str
+) -> jnp.ndarray:
+    """Inclusive segmented scan: resets start a new running value."""
+    _, op = _AGG_OPS[op_name]
+
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, op(va, vb))
+
+    _, out = lax.associative_scan(combine, (reset, x))
+    return out
+
+
+def last_true_value(
+    flags: jnp.ndarray, values: jnp.ndarray, fallback: jnp.ndarray
+) -> jnp.ndarray:
+    """Value at the last True flag, else fallback (scalar)."""
+    n = flags.shape[0]
+    idxs = jnp.where(flags, jnp.arange(n, dtype=jnp.int32), -1)
+    li = jnp.max(idxs)
+    return jnp.where(li >= 0, values[jnp.clip(li, 0, n - 1)], fallback)
+
+
+def propagate_last_valid(
+    values: jnp.ndarray, valid: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Inclusive forward-fill of the last valid value; (filled, has_any)."""
+
+    def combine(a, b):
+        ha, va = a
+        hb, vb = b
+        return ha | hb, jnp.where(hb, vb, va)
+
+    has, filled = lax.associative_scan(combine, (valid, values))
+    return filled, has
+
+
+def compact_rows(mask: jnp.ndarray, *arrays: jnp.ndarray):
+    """Scatter surviving rows to the front; returns (count, packed arrays).
+
+    Rows past the survivor count keep zeros. Used for on-device output
+    compaction before D2H.
+    """
+    n = mask.shape[0]
+    dest = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    dest = jnp.where(mask, dest, n)  # out-of-bounds -> dropped
+    out = []
+    for arr in arrays:
+        zeros = jnp.zeros_like(arr)
+        out.append(zeros.at[dest].set(arr, mode="drop"))
+    return jnp.sum(mask.astype(jnp.int32)), tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Parallel (scan-free) fast paths
+# ---------------------------------------------------------------------------
+
+
+def literal_search(values: jnp.ndarray, lengths: jnp.ndarray, literal: bytes) -> jnp.ndarray:
+    """Substring search via windowed equality — no sequential scan.
+
+    K shifted compares over the byte matrix; the whole thing is a handful
+    of fused VPU ops. Used when a regex reduces to a literal (the common
+    chain pattern) instead of the DFA scan.
+    """
+    n, width = values.shape
+    k = len(literal)
+    if k == 0:
+        return jnp.ones((n,), dtype=bool)
+    if k > width:
+        return jnp.zeros((n,), dtype=bool)
+    span = width - k + 1
+    acc = jnp.ones((n, span), dtype=bool)
+    for i, b in enumerate(literal):
+        acc = acc & (values[:, i : i + span] == b)
+    pos_ok = (
+        jnp.arange(span, dtype=jnp.int32)[None, :] + k
+        <= lengths[:, None].astype(jnp.int32)
+    )
+    return jnp.any(acc & pos_ok, axis=1)
+
+
+def literal_startswith(values: jnp.ndarray, lengths: jnp.ndarray, literal: bytes) -> jnp.ndarray:
+    n, width = values.shape
+    k = len(literal)
+    if k == 0:
+        return jnp.ones((n,), dtype=bool)
+    if k > width:
+        return jnp.zeros((n,), dtype=bool)
+    lit = jnp.asarray(np.frombuffer(literal, dtype=np.uint8))
+    ok = jnp.all(values[:, :k] == lit[None, :], axis=1)
+    return ok & (lengths >= k)
+
+
+def literal_endswith(values: jnp.ndarray, lengths: jnp.ndarray, literal: bytes) -> jnp.ndarray:
+    n, width = values.shape
+    k = len(literal)
+    if k == 0:
+        return jnp.ones((n,), dtype=bool)
+    if k > width:
+        return jnp.zeros((n,), dtype=bool)
+    start = lengths.astype(jnp.int32) - k
+    idx = start[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    tail = jnp.take_along_axis(values, jnp.clip(idx, 0, width - 1), axis=1)
+    lit = jnp.asarray(np.frombuffer(literal, dtype=np.uint8))
+    return jnp.all(tail == lit[None, :], axis=1) & (lengths >= k)
+
+
+def _excl_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.cumsum(x, axis=1) - x
+
+
+def _next_index_ge(cond: jnp.ndarray, width: int) -> jnp.ndarray:
+    """next_idx[:, j] = smallest j' >= j with cond[:, j'], else width.
+
+    Native reverse running-minimum along the byte axis.
+    """
+    jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
+    cand = jnp.where(cond, jidx, width)
+    return lax.cummin(cand, axis=1, reverse=True)
+
+
+def _prev_index_le(cond: jnp.ndarray, width: int) -> jnp.ndarray:
+    """prev_idx[:, j] = largest j' <= j with cond[:, j'], else -1."""
+    jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
+    cand = jnp.where(cond, jidx, -1)
+    return lax.cummax(cand, axis=1)
+
+
+def _bwd_fill_flag(cond: jnp.ndarray, flag: jnp.ndarray, width: int) -> jnp.ndarray:
+    """For each j: the ``flag`` at the NEXT position j' >= j where ``cond``.
+
+    Gather-free: encode (position, flag) as an integer and take a native
+    reverse cumulative max; positions closer to j dominate. False where no
+    such j' exists.
+    """
+    jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
+    enc = jnp.where(cond, (width - jidx) * 2 + flag.astype(jnp.int32), -1)
+    filled = lax.cummax(enc, axis=1, reverse=True)
+    return (filled >= 0) & ((filled & 1) == 1)
+
+
+def json_get_parallel(
+    values: jnp.ndarray, lengths: jnp.ndarray, key: str
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Structural-index JSON field extraction — scan-free.
+
+    simdjson-style: build per-byte structural masks with parallel
+    prefixes (escape parity, in-string parity, brace depth), find the
+    first colon-confirmed ``"key"`` occurrence at depth 1 by windowed
+    compare, then resolve the value span with next/prev index fills.
+
+    Matches `dsl.json_get_bytes` on well-formed input (and on the garbage
+    in our corpora). Known deviation: a quote immediately preceded by
+    backslashes *outside* any string (malformed JSON) is treated as
+    escaped, where the sequential reference treats it as a string opener.
+    The scan kernel (`json_get`) remains the exact-semantics fallback.
+    """
+    needle = b'"' + key.encode("utf-8") + b'"'
+    klen = len(needle)
+    n, width = values.shape
+    lengths = lengths.astype(jnp.int32)
+    c = values.astype(jnp.int32)
+    jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
+    inrec = jidx < lengths[:, None]
+
+    is_bs = (c == 0x5C) & inrec
+    is_q = (c == 0x22) & inrec
+    is_ws = ((c == 32) | (c == 9) | (c == 13) | (c == 10)) & inrec
+
+    # escape parity: odd run of backslashes immediately before j
+    last_non_bs = _prev_index_le(~is_bs, width)  # index of last non-backslash <= j
+    # backslashes strictly before j: run length = (j-1) - last_non_bs[j-1]
+    lnb_shift = jnp.concatenate(
+        [jnp.full((n, 1), -1, dtype=jnp.int32), last_non_bs[:, :-1]], axis=1
+    )
+    run_before = (jidx - 1) - lnb_shift
+    escaped = (run_before % 2) == 1
+
+    q_real = is_q & ~escaped
+    q_before = _excl_cumsum(q_real.astype(jnp.int32))
+    outside = (q_before % 2) == 0  # true at opening quotes and between strings
+
+    brace_open = (c == 0x7B) & outside & inrec
+    brace_close = (c == 0x7D) & outside & inrec
+    depth_excl = _excl_cumsum(brace_open.astype(jnp.int32) - brace_close.astype(jnp.int32))
+
+    # windowed needle compare at candidate opening quotes
+    span = width - klen + 1
+    if span <= 0:
+        return jnp.zeros_like(values), jnp.zeros((n,), dtype=jnp.int32)
+    wc = jnp.ones((n, span), dtype=bool)
+    for i, b in enumerate(needle):
+        wc = wc & (c[:, i : i + span] == b)
+    fits = jidx[:, :span] + klen <= lengths[:, None]
+    cand = (
+        wc
+        & fits
+        & q_real[:, :span]
+        & outside[:, :span]
+        & (depth_excl[:, :span] == 1)
+    )
+
+    nonws_in = ~is_ws & inrec
+    next_nonws = _next_index_ge(nonws_in, width)
+    # colon confirmation per candidate, gather-free: colon_reach[j] is true
+    # when the next non-ws byte at >= j is ':'; shift left by klen aligns
+    # it with candidate starts
+    colon_reach = _bwd_fill_flag(nonws_in, (c == 0x3A), width)
+    pad_f = jnp.zeros((n, klen), dtype=bool)
+    colon_after = jnp.concatenate([colon_reach[:, klen:], pad_f], axis=1)[:, :span]
+    ok = cand & colon_after
+    big = jnp.int32(width + 1)
+    p = jnp.min(jnp.where(ok, jidx[:, :span], big), axis=1)
+    found = p <= width
+
+    p_c = jnp.clip(p, 0, width - 1)
+    # colon position for the winning candidate, then value start
+    jcol_win = jnp.take_along_axis(
+        next_nonws, jnp.clip(p_c + klen, 0, width - 1)[:, None], axis=1
+    )[:, 0]
+    j2 = jnp.take_along_axis(
+        next_nonws, jnp.clip(jcol_win + 1, 0, width - 1)[:, None], axis=1
+    )[:, 0]
+    j2_in = j2 < lengths
+    vchar = jnp.take_along_axis(c, jnp.clip(j2, 0, width - 1)[:, None], axis=1)[:, 0]
+    is_strval = j2_in & (vchar == 0x22)
+
+    # string value: [j2+1, next real quote)
+    next_q = _next_index_ge(q_real, width)
+    sstart = jnp.clip(j2 + 1, 0, width)
+    q_end = jnp.take_along_axis(
+        next_q, jnp.clip(sstart, 0, width - 1)[:, None], axis=1
+    )[:, 0]
+    s_end = jnp.minimum(jnp.where(q_end < width, q_end, lengths), lengths)
+
+    # raw value: first , ] } at relative bracket depth 0 from j2
+    br = ((c == 0x5B) | (c == 0x7B)).astype(jnp.int32) - (
+        (c == 0x5D) | (c == 0x7D)
+    ).astype(jnp.int32)
+    br = jnp.where(inrec, br, 0)
+    br_excl = _excl_cumsum(br)
+    base = jnp.take_along_axis(br_excl, jnp.clip(j2, 0, width - 1)[:, None], axis=1)
+    rel = br_excl - base
+    is_term = ((c == 0x2C) | (c == 0x5D) | (c == 0x7D)) & (rel == 0) & inrec
+    term_from = jnp.where(jidx >= j2[:, None], is_term, False)
+    r_end_raw = jnp.min(jnp.where(term_from, jidx, big), axis=1)
+    r_end_raw = jnp.minimum(r_end_raw, lengths)
+    # strip trailing ws: last non-ws in [j2, r_end_raw)
+    prev_nonws = _prev_index_le(~is_ws & inrec, width)
+    r_last = jnp.take_along_axis(
+        prev_nonws, jnp.clip(r_end_raw - 1, 0, width - 1)[:, None], axis=1
+    )[:, 0]
+    r_end = jnp.maximum(r_last + 1, j2)
+
+    start = jnp.where(is_strval, sstart, j2)
+    end = jnp.where(is_strval, s_end, r_end)
+    out_lengths = jnp.where(found & j2_in, jnp.maximum(end - start, 0), 0)
+    # found but value beyond record end (e.g. colon then EOF) -> empty
+    out_lengths = jnp.where(found & ~j2_in, 0, out_lengths).astype(jnp.int32)
+
+    idx = start[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    gathered = jnp.take_along_axis(values, jnp.clip(idx, 0, width - 1), axis=1)
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < out_lengths[:, None]
+    out_values = jnp.where(mask, gathered, 0).astype(jnp.uint8)
+    return out_values, out_lengths

@@ -1,0 +1,225 @@
+"""DSL expression -> JAX kernel lowering.
+
+Compiles resolved (param-substituted) DSL expression trees into functions
+over the chain state (values/lengths/keys/key_lengths arrays). Types are
+inferred: ``bytes`` results are (values u8[N, W], lengths i32[N]) pairs,
+``int`` is i64[N], ``bool`` is bool[N]. Regex-family predicates compile to
+DFA tables at lowering time; an unsupported pattern raises
+:class:`Unlowerable` and the builder falls back to the python backend.
+"""
+
+from __future__ import annotations
+
+import os
+import re as _re
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+from fluvio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex, literal_of
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartengine.tpu import kernels
+
+
+class Unlowerable(Exception):
+    """Expression/program outside the TPU-compilable subset."""
+
+
+# state dict keys: values, lengths, keys, key_lengths
+BytesVal = Tuple[jnp.ndarray, jnp.ndarray]
+
+
+def infer_type(expr: dsl.Expr) -> str:
+    if isinstance(expr, (dsl.Value, dsl.Key, dsl.Const, dsl.Upper, dsl.Lower,
+                         dsl.Concat, dsl.JsonGet, dsl.IntToBytes)):
+        return "bytes"
+    if isinstance(expr, (dsl.Len, dsl.ParseInt)):
+        return "int"
+    if isinstance(expr, (dsl.RegexMatch, dsl.Contains, dsl.StartsWith,
+                         dsl.EndsWith, dsl.Cmp, dsl.And, dsl.Or, dsl.Not)):
+        return "bool"
+    raise Unlowerable(f"cannot type {type(expr).__name__}")
+
+
+def lower_expr(expr: dsl.Expr) -> Callable[[Dict[str, jnp.ndarray]], object]:
+    """Lower one expression; returns fn(state) -> typed result."""
+
+    if isinstance(expr, dsl.Value):
+        return lambda s: (s["values"], s["lengths"])
+
+    if isinstance(expr, dsl.Key):
+        # null key reads as b"" (parity with the interpreter)
+        return lambda s: (s["keys"], jnp.maximum(s["key_lengths"], 0))
+
+    if isinstance(expr, dsl.Const):
+        import numpy as np
+
+        data = np.frombuffer(expr.data, dtype=np.uint8)
+        width = max(len(data), 1)
+
+        def const_fn(s):
+            n = s["values"].shape[0]
+            vals = jnp.broadcast_to(jnp.asarray(data), (n, len(data))) if len(data) else jnp.zeros((n, width), dtype=jnp.uint8)
+            lens = jnp.full((n,), len(data), dtype=jnp.int32)
+            return vals, lens
+
+        return const_fn
+
+    if isinstance(expr, (dsl.Upper, dsl.Lower)):
+        inner = lower_expr(expr.arg)
+        op = kernels.ascii_upper if isinstance(expr, dsl.Upper) else kernels.ascii_lower
+
+        def case_fn(s):
+            v, l = inner(s)
+            return op(v), l
+
+        return case_fn
+
+    if isinstance(expr, dsl.JsonGet):
+        inner = lower_expr(expr.arg)
+        key = expr.key
+        # the sequential scan kernel is exact on all inputs (incl. the
+        # malformed-JSON corner json_get_parallel documents); opt in when
+        # exactness on garbage matters more than speed
+        exact = os.environ.get("FLUVIO_TPU_EXACT_JSON") == "1"
+        json_kernel = kernels.json_get if exact else kernels.json_get_parallel
+
+        def json_fn(s):
+            v, l = inner(s)
+            return json_kernel(v, l, key)
+
+        return json_fn
+
+    if isinstance(expr, (dsl.RegexMatch, dsl.Contains, dsl.StartsWith, dsl.EndsWith)):
+        inner = lower_expr(expr.arg)
+
+        def _literal_fn(lit: bytes, anchor_start: bool, anchor_end: bool):
+            def fn(s):
+                v, l = inner(s)
+                if anchor_start and anchor_end:
+                    return kernels.literal_startswith(v, l, lit) & (l == len(lit))
+                if anchor_start:
+                    return kernels.literal_startswith(v, l, lit)
+                if anchor_end:
+                    return kernels.literal_endswith(v, l, lit)
+                return kernels.literal_search(v, l, lit)
+
+            return fn
+
+        if isinstance(expr, dsl.Contains):
+            return _literal_fn(expr.literal, False, False)
+        if isinstance(expr, dsl.StartsWith):
+            return _literal_fn(expr.literal, True, False)
+        if isinstance(expr, dsl.EndsWith):
+            return _literal_fn(expr.literal, False, True)
+
+        # RegexMatch: windowed-compare fast path for pure literals,
+        # DFA byte-class scan otherwise
+        lit_info = literal_of(expr.pattern)
+        if lit_info is not None:
+            return _literal_fn(*lit_info)
+        try:
+            dfa = compile_regex(expr.pattern)
+        except UnsupportedRegex as e:
+            raise Unlowerable(str(e)) from e
+
+        def regex_fn(s):
+            v, l = inner(s)
+            return kernels.dfa_match(v, l, dfa)
+
+        return regex_fn
+
+    if isinstance(expr, dsl.Len):
+        inner = lower_expr(expr.arg)
+
+        def len_fn(s):
+            _, l = inner(s)
+            return l.astype(jnp.int64)
+
+        return len_fn
+
+    if isinstance(expr, dsl.ParseInt):
+        inner = lower_expr(expr.arg)
+
+        def parse_fn(s):
+            v, l = inner(s)
+            return kernels.parse_int(v, l)
+
+        return parse_fn
+
+    if isinstance(expr, dsl.IntToBytes):
+        inner = lower_expr(expr.arg)
+        if infer_type(expr.arg) != "int":
+            raise Unlowerable("IntToBytes needs an int argument")
+
+        def render_fn(s):
+            return kernels.int_to_ascii(inner(s))
+
+        return render_fn
+
+    if isinstance(expr, dsl.Cmp):
+        lt, rt = infer_type(expr.left), infer_type(expr.right)
+        if lt != "int" or rt != "int":
+            raise Unlowerable("Cmp lowers only for int operands")
+        lf, rf = lower_expr(expr.left), lower_expr(expr.right)
+        ops = {
+            "eq": jnp.equal, "ne": jnp.not_equal, "lt": jnp.less,
+            "le": jnp.less_equal, "gt": jnp.greater, "ge": jnp.greater_equal,
+        }
+        op = ops[expr.cmp]
+        return lambda s: op(lf(s), rf(s))
+
+    if isinstance(expr, dsl.And):
+        fns = [lower_expr(a) for a in expr.args]
+
+        def and_fn(s):
+            out = fns[0](s)
+            for f in fns[1:]:
+                out = out & f(s)
+            return out
+
+        return and_fn
+
+    if isinstance(expr, dsl.Or):
+        fns = [lower_expr(a) for a in expr.args]
+
+        def or_fn(s):
+            out = fns[0](s)
+            for f in fns[1:]:
+                out = out | f(s)
+            return out
+
+        return or_fn
+
+    if isinstance(expr, dsl.Not):
+        inner = lower_expr(expr.arg)
+        return lambda s: ~inner(s)
+
+    if isinstance(expr, dsl.Concat):
+        fns = [lower_expr(a) for a in expr.args]
+
+        def concat_fn(s):
+            parts = [f(s) for f in fns]
+            widths = [p[0].shape[1] for p in parts]
+            total_w = sum(widths)
+            n = parts[0][0].shape[0]
+            out_len = sum(p[1] for p in parts).astype(jnp.int32)
+            out = jnp.zeros((n, total_w), dtype=jnp.uint8)
+            # write each part at its running start offset via scatter-free
+            # gather: out[:, j] selects from the part covering position j
+            j = jnp.arange(total_w, dtype=jnp.int32)[None, :]
+            starts = jnp.zeros((n,), dtype=jnp.int32)
+            for (pv, pl) in parts:
+                pl = pl.astype(jnp.int32)
+                rel = j - starts[:, None]
+                in_part = (rel >= 0) & (rel < pl[:, None])
+                gathered = jnp.take_along_axis(
+                    pv, jnp.clip(rel, 0, pv.shape[1] - 1), axis=1
+                )
+                out = jnp.where(in_part, gathered, out)
+                starts = starts + pl
+            return out, out_len
+
+        return concat_fn
+
+    raise Unlowerable(f"no lowering for {type(expr).__name__}")

@@ -1,14 +1,19 @@
 """Test harness config: force an 8-device virtual CPU mesh for JAX tests.
 
-Must set env before jax is imported anywhere in the test process, so this
-lives in conftest.py which pytest imports first.
+The axon TPU tunnel's sitecustomize registers its backend and pins
+``jax_platforms`` before pytest starts, so plain env vars are not enough —
+override the jax config directly before any backend initializes. Tests
+must be hermetic on CPU; only bench.py targets the real chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,138 @@
+"""Regex DFA compiler: fuzz equivalence against Python `re` (search semantics)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from fluvio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex
+
+PATTERNS = [
+    "a",
+    "abc",
+    "^abc",
+    "abc$",
+    "^abc$",
+    "a.c",
+    "a*",
+    "ab*c",
+    "ab+c",
+    "ab?c",
+    "a|b",
+    "abc|xyz",
+    "(ab)+",
+    "(?:ab|cd)*e",
+    "[abc]",
+    "[a-z]+",
+    "[^0-9]",
+    "[a-zA-Z_][a-zA-Z0-9_]*",
+    r"\d+",
+    r"\w+@\w+",
+    r"\s",
+    r"\S+",
+    "a{3}",
+    "a{2,4}",
+    "(ab){1,2}c",
+    "fluvio",
+    "^\\{",
+    r"\d{2,4}-\d{2}",
+    "colou?r",
+    "(a|b)*abb",
+    "x.*y",
+    "x.*y$",
+    "a+b+c+",
+    r"[\d]+\.[\d]+",
+    "",
+]
+
+CORPUS = [
+    b"",
+    b"a",
+    b"abc",
+    b"xabcx",
+    b"aaaa",
+    b"ab",
+    b"abab",
+    b"xyz",
+    b"cde",
+    b"a c",
+    b"123",
+    b"12-34",
+    b"1234-56",
+    b"user@host",
+    b"fluvio rocks",
+    b"color",
+    b"colour",
+    b"aabb",
+    b"babb",
+    b"x123y",
+    b"x\ny",
+    b"3.14",
+    b'{"name":"x"}',
+    b"hello world",
+    b"\x00\xff\x80",
+]
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_matches_re_search(pattern):
+    dfa = compile_regex(pattern)
+    rx = re.compile(pattern.encode())
+    for data in CORPUS:
+        expected = rx.search(data) is not None
+        got = dfa.match_bytes(data)
+        assert got == expected, f"{pattern!r} on {data!r}: dfa={got} re={expected}"
+
+
+def test_fuzz_random_corpus():
+    rng = np.random.default_rng(42)
+    alphabet = b"abcxyz019 .-@"
+    corpus = [
+        bytes(rng.choice(list(alphabet), size=rng.integers(0, 30)))
+        for _ in range(300)
+    ]
+    for pattern in PATTERNS:
+        dfa = compile_regex(pattern)
+        rx = re.compile(pattern.encode())
+        for data in corpus:
+            assert dfa.match_bytes(data) == (rx.search(data) is not None), (
+                pattern,
+                data,
+            )
+
+
+def test_batch_match_numpy():
+    dfa = compile_regex("ab+c$")
+    values = np.zeros((4, 8), dtype=np.uint8)
+    lengths = np.zeros(4, dtype=np.int32)
+    for i, data in enumerate([b"abc", b"abbbc", b"abcx", b"ab"]):
+        values[i, : len(data)] = np.frombuffer(data, dtype=np.uint8)
+        lengths[i] = len(data)
+    got = dfa.match_numpy(values, lengths)
+    np.testing.assert_array_equal(got, [True, True, False, False])
+
+
+def test_padding_cannot_complete_match():
+    # '.' must not match padding bytes: "a." on record "xa" (padded) is False
+    dfa = compile_regex("a.")
+    values = np.zeros((1, 8), dtype=np.uint8)
+    values[0, :2] = np.frombuffer(b"xa", dtype=np.uint8)
+    assert not dfa.match_numpy(values, np.array([2]))[0]
+    # but a real following byte does match
+    values[0, :3] = np.frombuffer(b"xaz", dtype=np.uint8)
+    assert dfa.match_numpy(values, np.array([3]))[0]
+
+
+@pytest.mark.parametrize(
+    "pattern",
+    [r"(a)\1", "a(?=b)", "a(?!b)", "(?P<x>a)", "a{99}", "(?i)abc"],
+)
+def test_unsupported_raise(pattern):
+    with pytest.raises(UnsupportedRegex):
+        compile_regex(pattern)
+
+
+def test_byte_class_compression_is_small():
+    dfa = compile_regex("[a-z]+@[a-z]+")
+    assert dfa.n_classes <= 8  # lowercase, '@', other, eos, pad, ...
+    assert dfa.n_states <= 8

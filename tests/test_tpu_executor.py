@@ -1,0 +1,233 @@
+"""TPU backend vs python backend: golden-output equivalence.
+
+The §4(b)-style gate from SURVEY.md: the same chain on both engines must
+produce byte-identical outputs on the baseline configs.
+"""
+
+import numpy as np
+import pytest
+
+from fluvio_tpu.models import lookup
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+from fluvio_tpu.smartengine.engine import EngineError
+from fluvio_tpu.smartmodule import SmartModuleInput
+
+
+def build(backend, *mods):
+    b = SmartEngine(backend=backend).builder()
+    for module, config in mods:
+        b.add_smart_module(config, module)
+    return b.initialize()
+
+
+def run_both(mods, records_fn):
+    """Build both backends fresh and feed identical inputs; compare."""
+    py = build("python", *mods)
+    tpu = build("tpu", *mods)
+    assert tpu.backend_in_use == "tpu"
+    outs = []
+    for records, base_offset, base_ts in records_fn():
+        inp1 = SmartModuleInput.from_records(records, base_offset, base_ts)
+        records2 = [
+            Record(
+                value=r.value, key=r.key,
+                offset_delta=r.offset_delta, timestamp_delta=r.timestamp_delta,
+            )
+            for r in records
+        ]
+        inp2 = SmartModuleInput.from_records(records2, base_offset, base_ts)
+        out_py = py.process(inp1)
+        out_tpu = tpu.process(inp2)
+        assert out_py.error is None and out_tpu.error is None
+        got_py = [
+            (r.key, r.value, r.offset_delta, r.timestamp_delta)
+            for r in out_py.successes
+        ]
+        got_tpu = [
+            (r.key, r.value, r.offset_delta, r.timestamp_delta)
+            for r in out_tpu.successes
+        ]
+        assert got_py == got_tpu
+        outs.append(got_py)
+    return outs
+
+
+def recs(*values, deltas=None):
+    records = [Record(value=v) for v in values]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+        if deltas:
+            r.timestamp_delta = deltas[i]
+    return records
+
+
+class TestEquivalence:
+    def test_regex_filter(self):
+        def gen():
+            yield recs(b"apple", b"banana", b"avocado", b"cherry"), 0, -1
+
+        outs = run_both(
+            [(lookup("regex-filter"), SmartModuleConfig(params={"regex": "^a"}))], gen
+        )
+        assert [v for (_, v, _, _) in outs[0]] == [b"apple", b"avocado"]
+
+    def test_regex_filter_json_map_chain(self):
+        """The north-star chain (baseline config #1+#2)."""
+
+        def gen():
+            yield recs(
+                b'{"name":"fluvio","n":1}',
+                b'{"name":"kafka","n":2}',
+                b'{"name":"fluvio-tpu","n":3}',
+                b"not json at all",
+            ), 100, 5000
+
+        outs = run_both(
+            [
+                (lookup("regex-filter"), SmartModuleConfig(params={"regex": "fluvio"})),
+                (lookup("json-map"), SmartModuleConfig(params={"field": "name"})),
+            ],
+            gen,
+        )
+        assert [v for (_, v, _, _) in outs[0]] == [b"FLUVIO", b"FLUVIO-TPU"]
+        assert [d for (_, _, d, _) in outs[0]] == [0, 2]  # offsets preserved
+
+    def test_aggregate_sum_across_calls(self):
+        def gen():
+            yield recs(b"1", b"2", b"3"), 0, -1
+            yield recs(b"10", b"-4"), 3, -1
+
+        outs = run_both([(lookup("aggregate-sum"), SmartModuleConfig())], gen)
+        assert [v for (_, v, _, _) in outs[0]] == [b"1", b"3", b"6"]
+        assert [v for (_, v, _, _) in outs[1]] == [b"16", b"12"]
+
+    def test_aggregate_with_seed(self):
+        def gen():
+            yield recs(b"5"), 0, -1
+
+        outs = run_both(
+            [(lookup("aggregate-sum"), SmartModuleConfig(initial_data=b"100"))], gen
+        )
+        assert [v for (_, v, _, _) in outs[0]] == [b"105"]
+
+    def test_filter_then_aggregate(self):
+        def gen():
+            yield recs(b"keep 1", b"drop 2", b"keep 3"), 0, -1
+
+        outs = run_both(
+            [
+                (lookup("regex-filter"), SmartModuleConfig(params={"regex": "keep"})),
+                (lookup("aggregate-count"), SmartModuleConfig()),
+            ],
+            gen,
+        )
+        assert [v for (_, v, _, _) in outs[0]] == [b"1", b"2"]
+
+    def test_word_count(self):
+        def gen():
+            yield recs(b"hello world", b"", b"a b  c"), 0, -1
+
+        outs = run_both([(lookup("word-count"), SmartModuleConfig())], gen)
+        assert [v for (_, v, _, _) in outs[0]] == [b"2", b"2", b"5"]
+
+    def test_windowed_sum(self):
+        def gen():
+            yield recs(
+                b"1", b"2", b"3", b"4", deltas=[0, 500, 1000, 1500]
+            ), 0, 10_000
+            # second slab continues the last window then opens a new one
+            yield recs(b"5", b"6", deltas=[1600, 2100]), 4, 10_000
+
+        outs = run_both(
+            [(lookup("windowed-sum"), SmartModuleConfig(params={"window_ms": "1000"}))],
+            gen,
+        )
+        assert [(k, v) for (k, v, _, _) in outs[0]] == [
+            (b"10000", b"1"),
+            (b"10000", b"3"),
+            (b"11000", b"3"),
+            (b"11000", b"7"),
+        ]
+        assert [(k, v) for (k, v, _, _) in outs[1]] == [
+            (b"11000", b"12"),
+            (b"12000", b"6"),
+        ]
+
+    def test_aggregate_max_min(self):
+        def gen():
+            yield recs(b"5", b"3", b"9", b"7"), 0, -1
+
+        outs = run_both([(lookup("aggregate-max"), SmartModuleConfig())], gen)
+        assert [v for (_, v, _, _) in outs[0]] == [b"5", b"5", b"9", b"9"]
+
+    def test_keys_preserved_through_filter(self):
+        def gen():
+            records = [
+                Record(value=b"al", key=b"k0"),
+                Record(value=b"bx", key=None),
+                Record(value=b"ay", key=b"k2"),
+            ]
+            for i, r in enumerate(records):
+                r.offset_delta = i
+            yield records, 0, -1
+
+        outs = run_both(
+            [(lookup("regex-filter"), SmartModuleConfig(params={"regex": "^a"}))], gen
+        )
+        assert [(k, v) for (k, v, _, _) in outs[0]] == [(b"k0", b"al"), (b"k2", b"ay")]
+
+    def test_fuzz_northstar_chain(self):
+        rng = np.random.default_rng(3)
+        names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "x"]
+
+        def gen():
+            for base in (0, 1000):
+                records = []
+                for i in range(rng.integers(5, 40)):
+                    name = names[rng.integers(0, len(names))]
+                    n = rng.integers(0, 100)
+                    records.append(Record(value=f'{{"name":"{name}","n":{n}}}'.encode()))
+                for i, r in enumerate(records):
+                    r.offset_delta = i
+                yield records, base, -1
+
+        run_both(
+            [
+                (lookup("regex-filter"), SmartModuleConfig(params={"regex": "fluvio"})),
+                (lookup("json-map"), SmartModuleConfig(params={"field": "n"})),
+            ],
+            gen,
+        )
+
+
+class TestBackendSelection:
+    def test_tpu_refuses_hook_only_module(self):
+        src = "@smartmodule.filter\ndef f(record):\n    return True\n"
+        b = SmartEngine(backend="tpu").builder()
+        b.add_smart_module(SmartModuleConfig(), src)
+        with pytest.raises(EngineError):
+            b.initialize()
+
+    def test_auto_falls_back_to_python(self):
+        src = "@smartmodule.filter\ndef f(record):\n    return True\n"
+        b = SmartEngine(backend="auto").builder()
+        b.add_smart_module(SmartModuleConfig(), src)
+        chain = b.initialize()
+        assert chain.backend_in_use == "python"
+
+    def test_auto_uses_tpu_for_dsl_chain(self):
+        b = SmartEngine(backend="auto").builder()
+        b.add_smart_module(
+            SmartModuleConfig(params={"regex": "x"}), lookup("regex-filter")
+        )
+        chain = b.initialize()
+        assert chain.backend_in_use == "tpu"
+
+    def test_unsupported_regex_falls_back(self):
+        b = SmartEngine(backend="auto").builder()
+        b.add_smart_module(
+            SmartModuleConfig(params={"regex": r"(a)\1"}), lookup("regex-filter")
+        )
+        chain = b.initialize()
+        assert chain.backend_in_use == "python"

@@ -1,0 +1,189 @@
+"""TPU kernel equivalence vs the pinned DSL byte semantics (CPU jax)."""
+
+import numpy as np
+import pytest
+
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartengine.tpu import kernels
+from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+from fluvio_tpu.ops.regex_dfa import compile_regex
+from fluvio_tpu.protocol.record import Record
+
+
+def stage(values_list):
+    buf = RecordBuffer.from_records([Record(value=v) for v in values_list])
+    return buf
+
+
+JSON_DOCS = [
+    b'{"name":"fluvio"}',
+    b'{"a":1,"name":"x"}',
+    b'{"name": "spaced" }',
+    b'{"name":42}',
+    b'{"name":-3.5,"z":1}',
+    b'{"name":true}',
+    b'{"name":null}',
+    b'{"name":{"inner":1}}',
+    b'{"name":[1,2]}',
+    b'{"other":"x"}',
+    b"not json",
+    b"",
+    b'{"nested":{"name":"inner"},"name":"outer"}',
+    b'{"val":"name","name":"real"}',
+    b'{"namer":"no","name":"yes"}',
+    b'{"outer":{"name":"inner"}}',
+    b'{"name":""}',
+    b'{"x":[{"name":"in-array"}],"name":"top"}',
+    b'{"name":"unterminated',
+    b'{"name":  12345  ,"q":2}',
+    b'{ "padded" : 1, "name" : "v" }',
+    b'{"name":"with \\"escape\\""}',
+]
+
+
+class TestJsonGet:
+    @pytest.mark.parametrize("key", ["name", "q", ""])
+    @pytest.mark.parametrize("fn", [kernels.json_get, kernels.json_get_parallel])
+    def test_matches_reference(self, key, fn):
+        buf = stage(JSON_DOCS)
+        out_v, out_l = fn(buf.values, buf.lengths, key)
+        out_v = np.asarray(out_v)
+        out_l = np.asarray(out_l)
+        for i, doc in enumerate(JSON_DOCS):
+            expected = dsl.json_get_bytes(doc, key)
+            got = out_v[i, : out_l[i]].tobytes()
+            assert got == expected, f"doc={doc!r} key={key!r}: {got!r} != {expected!r}"
+
+    def test_fuzz_random_json(self):
+        rng = np.random.default_rng(7)
+        keys = ["a", "bb", "name"]
+        docs = []
+        for _ in range(200):
+            parts = []
+            for k in rng.choice(keys, size=rng.integers(1, 4), replace=False):
+                kind = rng.integers(0, 4)
+                if kind == 0:
+                    v = f'"{rng.integers(0, 999)}"'
+                elif kind == 1:
+                    v = str(rng.integers(-5000, 5000))
+                elif kind == 2:
+                    v = '{"in":' + str(rng.integers(0, 9)) + "}"
+                else:
+                    v = "[1,2,3]"
+                parts.append(f'"{k}":{v}')
+            docs.append(("{" + ",".join(parts) + "}").encode())
+        buf = stage(docs)
+        for fn in (kernels.json_get, kernels.json_get_parallel):
+          for key in keys:
+            out_v, out_l = fn(buf.values, buf.lengths, key)
+            out_v, out_l = np.asarray(out_v), np.asarray(out_l)
+            for i, doc in enumerate(docs):
+                expected = dsl.json_get_bytes(doc, key)
+                assert out_v[i, : out_l[i]].tobytes() == expected, (doc, key)
+
+
+class TestParseInt:
+    def test_matches_reference(self):
+        cases = [b"42", b"-7", b"  13x", b"+5", b"abc", b"", b"12.9", b"-",
+                 b"9223372036854775807", b"  -00042  ", b"1e5", b"0"]
+        buf = stage(cases)
+        got = np.asarray(kernels.parse_int(buf.values, buf.lengths))
+        for i, c in enumerate(cases):
+            assert got[i] == dsl.parse_int_prefix(c), c
+
+
+class TestIntToAscii:
+    def test_matches_str(self):
+        xs = np.array(
+            [0, 1, -1, 9, 10, -10, 12345, -987654321,
+             2**62, -(2**62), 2**63 - 1, -(2**63)],
+            dtype=np.int64,
+        )
+        import jax.numpy as jnp
+
+        out_v, out_l = kernels.int_to_ascii(jnp.asarray(xs))
+        out_v, out_l = np.asarray(out_v), np.asarray(out_l)
+        for i, x in enumerate(xs.tolist()):
+            assert out_v[i, : out_l[i]].tobytes() == str(x).encode(), x
+
+
+class TestCase:
+    def test_upper_lower(self):
+        buf = stage([b"aZ3{}", b"Hello World!"])
+        up = np.asarray(kernels.ascii_upper(buf.values))
+        lo = np.asarray(kernels.ascii_lower(buf.values))
+        assert up[0, :5].tobytes() == b"AZ3{}"
+        assert lo[1, :12].tobytes() == b"hello world!"
+
+
+class TestCountWords:
+    def test_matches_split(self):
+        cases = [b"hello world", b"", b"  a  ", b"one two  three", b"\tx\ny z\r"]
+        buf = stage(cases)
+        got = np.asarray(kernels.count_words(buf.values, buf.lengths))
+        for i, c in enumerate(cases):
+            assert got[i] == len(c.split()), c
+
+
+class TestDfaMatchJax:
+    def test_matches_numpy_matcher(self):
+        import re
+
+        corpus = [b"abc", b"xabcx", b"", b"ab", b"zzzabczzz", b"a" * 31, b"xyz"]
+        for pattern in ["abc", "^abc", "abc$", "a+b", "[a-y]+$", r"\d"]:
+            dfa = compile_regex(pattern)
+            buf = stage(corpus)
+            got = np.asarray(kernels.dfa_match(buf.values, buf.lengths, dfa))
+            rx = re.compile(pattern.encode())
+            for i, data in enumerate(corpus):
+                assert got[i] == (rx.search(data) is not None), (pattern, data)
+
+
+class TestSegmentedScan:
+    def test_sum_with_resets(self):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.array([1, 2, 3, 4, 5], dtype=np.int64))
+        reset = jnp.asarray(np.array([True, False, True, False, False]))
+        out = np.asarray(kernels.segmented_scan(x, reset, "add"))
+        np.testing.assert_array_equal(out, [1, 3, 3, 7, 12])
+
+    def test_max(self):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.array([3, 1, 5, 2], dtype=np.int64))
+        reset = jnp.asarray(np.array([True, False, True, False]))
+        out = np.asarray(kernels.segmented_scan(x, reset, "max"))
+        np.testing.assert_array_equal(out, [3, 3, 5, 5])
+
+    def test_propagate_last_valid(self):
+        import jax.numpy as jnp
+
+        vals = jnp.asarray(np.array([10, 20, 30, 40], dtype=np.int64))
+        valid = jnp.asarray(np.array([False, True, False, True]))
+        filled, has = kernels.propagate_last_valid(vals, valid)
+        np.testing.assert_array_equal(np.asarray(filled)[1:], [20, 20, 40])
+        np.testing.assert_array_equal(np.asarray(has), [False, True, True, True])
+
+    def test_compact_rows(self):
+        import jax.numpy as jnp
+
+        mask = jnp.asarray(np.array([True, False, True, False]))
+        vals = jnp.asarray(np.arange(8, dtype=np.int64).reshape(4, 2))
+        count, (packed,) = kernels.compact_rows(mask, vals)
+        assert int(count) == 2
+        np.testing.assert_array_equal(np.asarray(packed)[:2], [[0, 1], [4, 5]])
+
+
+class TestLiteralSearch:
+    def test_matches_python(self):
+        corpus = [b"", b"abc", b"xabcx", b"ab", b"aabbcc", b"abcabc", b"zzabc"]
+        buf = stage(corpus)
+        for lit in [b"abc", b"", b"z", b"abcd", b"aa"]:
+            got = np.asarray(kernels.literal_search(buf.values, buf.lengths, lit))
+            starts = np.asarray(kernels.literal_startswith(buf.values, buf.lengths, lit))
+            ends = np.asarray(kernels.literal_endswith(buf.values, buf.lengths, lit))
+            for i, data in enumerate(corpus):
+                assert got[i] == (lit in data), (lit, data)
+                assert starts[i] == data.startswith(lit), (lit, data)
+                assert ends[i] == data.endswith(lit), (lit, data)
