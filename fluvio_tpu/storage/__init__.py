@@ -1,0 +1,22 @@
+"""Per-partition append-only log storage.
+
+Capability parity: the `fluvio-storage` crate — `FileReplica`
+(replica.rs:31) over rolling segments (`.log` batch stream + sparse mmap'd
+`.index`), high-watermark checkpoint (`replication.chk`), crash validation
+(validator.rs / segment.rs:353), time/size retention cleaning
+(cleaner.rs), and file-slice reads that feed the zero-copy consume path
+(records.rs, `ReplicaSlice`).
+"""
+
+from fluvio_tpu.storage.config import ReplicaConfig
+from fluvio_tpu.storage.replica import FileReplica, FileSlice, ReplicaSlice, OffsetInfo
+from fluvio_tpu.storage.cleaner import Cleaner
+
+__all__ = [
+    "FileReplica",
+    "FileSlice",
+    "ReplicaSlice",
+    "OffsetInfo",
+    "ReplicaConfig",
+    "Cleaner",
+]

@@ -1,0 +1,51 @@
+"""Retention cleaner (parity: fluvio-storage/src/cleaner.rs).
+
+Removes read-only segments whose newest record exceeds the retention age,
+and (when ``max_partition_size`` is set) oldest-first until the partition
+fits. Never touches the active segment.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from fluvio_tpu.storage.replica import FileReplica
+
+
+class Cleaner:
+    def __init__(self, replica: FileReplica):
+        self.replica = replica
+
+    def clean(self, now_ms: int | None = None) -> List[int]:
+        """Run one cleaning pass; returns removed segment base offsets."""
+        config = self.replica.config
+        now = int(time.time() * 1000) if now_ms is None else now_ms
+        removed: List[int] = []
+
+        # age-based
+        cutoff = now - config.retention_seconds * 1000
+        for base in sorted(self.replica.prev_segments):
+            seg = self.replica.prev_segments[base]
+            newest = seg.newest_timestamp()
+            if newest != -1 and newest < cutoff:
+                seg.remove_files()
+                del self.replica.prev_segments[base]
+                removed.append(base)
+            else:
+                break  # segments are time-ordered
+
+        # size-based
+        if config.max_partition_size is not None:
+            def total_size() -> int:
+                return self.replica.active_segment.size + sum(
+                    s.size for s in self.replica.prev_segments.values()
+                )
+
+            for base in sorted(self.replica.prev_segments):
+                if total_size() <= config.max_partition_size:
+                    break
+                seg = self.replica.prev_segments.pop(base)
+                seg.remove_files()
+                removed.append(base)
+        return removed

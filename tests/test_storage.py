@@ -1,0 +1,244 @@
+"""Storage tests against temp dirs (mirrors fluvio-storage replica tests)."""
+
+import os
+
+import pytest
+
+from fluvio_tpu.protocol.error import FluvioError
+from fluvio_tpu.protocol.record import Batch, Record, RecordSet
+from fluvio_tpu.storage import Cleaner, FileReplica, ReplicaConfig
+from fluvio_tpu.storage.replica import (
+    ISOLATION_READ_COMMITTED,
+    ISOLATION_READ_UNCOMMITTED,
+)
+
+
+def make_config(tmp_path, **kw) -> ReplicaConfig:
+    return ReplicaConfig(base_dir=str(tmp_path), **kw)
+
+
+def rs(*values, first_timestamp=None):
+    return RecordSet().add(
+        Batch.from_records(
+            [Record(value=v) for v in values], first_timestamp=first_timestamp
+        )
+    )
+
+
+class TestWriteRead:
+    def test_roundtrip(self, tmp_path):
+        replica = FileReplica("topic", 0, 0, make_config(tmp_path))
+        replica.write_recordset(rs(b"a", b"b", b"c"))
+        assert replica.get_leo() == 3
+        assert replica.get_hw() == 0
+        batches = replica.read_records(0, 1 << 20)
+        assert [r.value for r in batches[0].memory_records()] == [b"a", b"b", b"c"]
+        replica.close()
+
+    def test_offsets_assigned_across_batches(self, tmp_path):
+        replica = FileReplica("topic", 0, 0, make_config(tmp_path))
+        replica.write_recordset(rs(b"a", b"b"))
+        replica.write_recordset(rs(b"c"))
+        assert replica.get_leo() == 3
+        batches = replica.read_records(0, 1 << 20)
+        assert batches[0].base_offset == 0
+        assert batches[1].base_offset == 2
+        replica.close()
+
+    def test_read_from_mid_offset(self, tmp_path):
+        replica = FileReplica("t", 0, 0, make_config(tmp_path))
+        for i in range(5):
+            replica.write_recordset(rs(f"rec-{i}".encode()))
+        batches = replica.read_records(3, 1 << 20)
+        assert batches[0].base_offset == 3
+        replica.close()
+
+    def test_max_bytes_bounds_slice(self, tmp_path):
+        replica = FileReplica("t", 0, 0, make_config(tmp_path))
+        for i in range(10):
+            replica.write_recordset(rs(b"x" * 200))
+        one_batch = replica.read_records(0, 300)
+        assert len(one_batch) == 1
+        replica.close()
+
+    def test_offset_out_of_range(self, tmp_path):
+        replica = FileReplica("t", 0, 0, make_config(tmp_path))
+        replica.write_recordset(rs(b"a"))
+        with pytest.raises(FluvioError):
+            replica.read_partition_slice(99, 1 << 20)
+        replica.close()
+
+
+class TestIsolation:
+    def test_read_committed_bounded_by_hw(self, tmp_path):
+        replica = FileReplica("t", 0, 0, make_config(tmp_path))
+        replica.write_recordset(rs(b"a", b"b"))
+        # hw still 0: committed read sees nothing
+        sl = replica.read_partition_slice(0, 1 << 20, ISOLATION_READ_COMMITTED)
+        assert sl.file_slice is None
+        replica.update_high_watermark(2)
+        batches = replica.read_records(0, 1 << 20, ISOLATION_READ_COMMITTED)
+        assert batches and batches[0].records_len() == 2
+        replica.close()
+
+    def test_hw_cannot_exceed_leo(self, tmp_path):
+        replica = FileReplica("t", 0, 0, make_config(tmp_path))
+        with pytest.raises(FluvioError):
+            replica.update_high_watermark(5)
+        replica.close()
+
+
+class TestReload:
+    def test_reload_preserves_log_and_hw(self, tmp_path):
+        config = make_config(tmp_path)
+        replica = FileReplica("t", 0, 0, config)
+        replica.write_recordset(rs(b"a", b"b"), update_highwatermark=True)
+        replica.close()
+
+        again = FileReplica("t", 0, 0, config)
+        assert again.get_leo() == 2
+        assert again.get_hw() == 2
+        batches = again.read_records(0, 1 << 20)
+        assert [r.value for r in batches[0].memory_records()] == [b"a", b"b"]
+        again.write_recordset(rs(b"c"))
+        assert again.get_leo() == 3
+        again.close()
+
+    def test_torn_tail_truncated(self, tmp_path):
+        config = make_config(tmp_path)
+        replica = FileReplica("t", 0, 0, config)
+        replica.write_recordset(rs(b"a"))
+        replica.write_recordset(rs(b"b"))
+        log_path = replica.active_segment.log_path
+        replica.close()
+        # corrupt: append garbage partial batch
+        with open(log_path, "ab") as f:
+            f.write(b"\x00\x00\x00\x00\x00\x00\x00\x09\x00\x00\x01\x00garbage")
+        again = FileReplica("t", 0, 0, config)
+        assert again.get_leo() == 2
+        # the log is usable after repair
+        again.write_recordset(rs(b"c"))
+        assert [b.base_offset for b in again.read_records(0, 1 << 20)] == [0, 1, 2]
+        again.close()
+
+
+class TestSegmentRolling:
+    def test_rolls_and_reads_across_segments(self, tmp_path):
+        config = make_config(tmp_path, segment_max_bytes=500)
+        replica = FileReplica("t", 0, 0, config)
+        for i in range(10):
+            replica.write_recordset(rs(f"value-{i:04d}".encode() * 10))
+        assert len(replica.prev_segments) > 0
+        # every offset readable
+        for off in range(10):
+            batches = replica.read_records(off, 1 << 20)
+            assert batches[0].base_offset == off
+        replica.close()
+
+    def test_reload_multi_segment(self, tmp_path):
+        config = make_config(tmp_path, segment_max_bytes=400)
+        replica = FileReplica("t", 0, 0, config)
+        for i in range(8):
+            replica.write_recordset(rs(b"z" * 100))
+        n_prev = len(replica.prev_segments)
+        leo = replica.get_leo()
+        replica.close()
+        again = FileReplica("t", 0, 0, config)
+        assert again.get_leo() == leo
+        assert len(again.prev_segments) == n_prev
+        assert again.read_records(0, 1 << 20)[0].base_offset == 0
+        again.close()
+
+
+class TestLookback:
+    def test_read_last_records(self, tmp_path):
+        replica = FileReplica("t", 0, 0, make_config(tmp_path))
+        for i in range(6):
+            replica.write_recordset(rs(f"{i}".encode()), update_highwatermark=True)
+        last = replica.read_last_records(3)
+        assert [r.value for r in last] == [b"3", b"4", b"5"]
+        replica.close()
+
+
+class TestCleaner:
+    def test_age_retention(self, tmp_path):
+        config = make_config(tmp_path, segment_max_bytes=300, retention_seconds=10)
+        replica = FileReplica("t", 0, 0, config)
+        old_ts = 1_000_000
+        for i in range(6):
+            replica.write_recordset(rs(b"x" * 100, first_timestamp=old_ts))
+        assert replica.prev_segments
+        removed = Cleaner(replica).clean(now_ms=old_ts + 60_000)
+        assert removed
+        assert replica.get_log_start_offset() > 0
+        replica.close()
+
+    def test_size_retention(self, tmp_path):
+        config = make_config(
+            tmp_path, segment_max_bytes=300, max_partition_size=600,
+            retention_seconds=10**9,
+        )
+        replica = FileReplica("t", 0, 0, config)
+        for i in range(8):
+            replica.write_recordset(rs(b"y" * 100))
+        removed = Cleaner(replica).clean()
+        assert removed
+        replica.close()
+
+    def test_start_offset_errors_after_clean(self, tmp_path):
+        config = make_config(tmp_path, segment_max_bytes=300, retention_seconds=10)
+        replica = FileReplica("t", 0, 0, config)
+        for i in range(6):
+            replica.write_recordset(rs(b"x" * 100, first_timestamp=1000))
+        Cleaner(replica).clean(now_ms=10_000_000)
+        start = replica.get_log_start_offset()
+        with pytest.raises(FluvioError):
+            replica.read_partition_slice(0, 1 << 20)
+        assert replica.read_records(start, 1 << 20)
+        replica.close()
+
+
+class TestRemove:
+    def test_remove_deletes_directory(self, tmp_path):
+        replica = FileReplica("t", 1, 0, make_config(tmp_path))
+        replica.write_recordset(rs(b"a"))
+        d = replica.directory
+        assert os.path.exists(d)
+        replica.remove()
+        assert not os.path.exists(d)
+
+
+class TestIndexReload:
+    def test_index_survives_reload_and_stays_monotonic(self, tmp_path):
+        # regression: entry 0 indexes log position 0; reload must neither
+        # wipe the index nor resurrect stale non-monotonic entries
+        config = make_config(tmp_path, index_max_interval_bytes=1)
+        replica = FileReplica("t", 0, 0, config)
+        for i in range(5):
+            replica.write_recordset(rs(f"{i}".encode()))
+        n = len(replica.active_segment.index)
+        assert n >= 5
+        replica.close()
+        again = FileReplica("t", 0, 0, config)
+        assert len(again.active_segment.index) == n
+        again.write_recordset(rs(b"5"))
+        again.close()
+        final = FileReplica("t", 0, 0, config)
+        for off in range(6):
+            assert final.read_records(off, 1 << 20)[0].base_offset == off
+        final.close()
+
+
+class TestLookbackAcrossSegments:
+    def test_read_last_records_spans_segments(self, tmp_path):
+        config = make_config(tmp_path, segment_max_bytes=300)
+        replica = FileReplica("t", 0, 0, config)
+        for i in range(10):
+            replica.write_recordset(
+                rs(f"v-{i:03d}".encode() * 5), update_highwatermark=True
+            )
+        assert replica.prev_segments  # must actually have rolled
+        last = replica.read_last_records(8)
+        assert len(last) == 8
+        assert last[-1].value.startswith(b"v-009")
+        replica.close()
