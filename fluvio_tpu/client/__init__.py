@@ -1,0 +1,19 @@
+"""Client library (parity: the `fluvio` crate, L7).
+
+`Fluvio.connect` -> producer / consumer / (admin once the SC lands).
+Until the control plane exists, `connect` points at an SPU directly and
+partition routing uses a static single-SPU pool.
+"""
+
+from fluvio_tpu.client.fluvio import Fluvio  # noqa: F401
+from fluvio_tpu.client.offset import Offset  # noqa: F401
+from fluvio_tpu.client.producer import (  # noqa: F401
+    ProducerConfig,
+    RecordMetadata,
+    TopicProducer,
+)
+from fluvio_tpu.client.consumer import (  # noqa: F401
+    ConsumerConfig,
+    ConsumerRecord,
+    PartitionConsumer,
+)

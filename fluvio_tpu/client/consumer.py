@@ -1,0 +1,129 @@
+"""Partition consumer: push-stream with auto offset acks.
+
+Capability parity: fluvio/src/consumer.rs — `PartitionConsumer.
+stream_with_config` (:119-223) opens a StreamFetchRequest over the
+multiplexer, decodes pushed batches into `ConsumerRecord`s, and
+auto-sends `UpdateOffsetsRequest` acks so the server keeps pushing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import AsyncIterator, List, Optional
+
+from fluvio_tpu.protocol.api import MAX_BYTES
+from fluvio_tpu.protocol.error import ErrorCode, FluvioError
+from fluvio_tpu.client.offset import Offset
+from fluvio_tpu.schema.smartmodule import SmartModuleInvocation
+from fluvio_tpu.schema.spu import (
+    FetchOffsetsRequest,
+    Isolation,
+    OffsetUpdate,
+    StreamFetchRequest,
+    UpdateOffsetsRequest,
+)
+from fluvio_tpu.types import Timestamp
+
+
+@dataclass
+class ConsumerConfig:
+    max_bytes: int = MAX_BYTES
+    isolation: Isolation = Isolation.READ_UNCOMMITTED
+    smartmodules: List[SmartModuleInvocation] = field(default_factory=list)
+    # stop the stream once the log end at stream-start is reached
+    # (parity: `fluvio consume -d`)
+    disable_continuous: bool = False
+
+
+@dataclass
+class ConsumerRecord:
+    partition: int
+    offset: int
+    timestamp: Timestamp
+    key: Optional[bytes]
+    value: bytes
+
+
+class PartitionConsumer:
+    """Consumer for one topic-partition (parity: consumer.rs:77)."""
+
+    def __init__(self, topic: str, partition: int, socket):
+        self.topic = topic
+        self.partition = partition
+        self._socket = socket  # VersionedSerialSocket to the leader SPU
+
+    async def fetch_offsets(self):
+        resp = await self._socket.send_receive(
+            FetchOffsetsRequest(topic=self.topic, partition=self.partition)
+        )
+        if resp.error_code != ErrorCode.NONE:
+            raise FluvioError(resp.error_code)
+        return resp
+
+    async def stream(
+        self,
+        offset: Offset,
+        config: Optional[ConsumerConfig] = None,
+    ) -> AsyncIterator[ConsumerRecord]:
+        """Yield records from ``offset`` onward, acking as it goes."""
+        config = config or ConsumerConfig()
+        offsets = await self.fetch_offsets()
+        start = offset.resolve(offsets, config.isolation)
+        end_at = None
+        if config.disable_continuous:
+            end_at = offsets.hw if config.isolation == Isolation.READ_COMMITTED else offsets.leo
+            if start >= end_at:
+                return
+
+        request = StreamFetchRequest(
+            topic=self.topic,
+            partition=self.partition,
+            fetch_offset=start,
+            max_bytes=config.max_bytes,
+            isolation=config.isolation,
+            smartmodules=list(config.smartmodules),
+        )
+        stream = await self._socket.create_stream(request)
+        try:
+            async for response in stream:
+                part = response.partition
+                if part.error_code != ErrorCode.NONE:
+                    raise FluvioError(part.error_code, part.error_message)
+                last_seen = start - 1
+                for batch in part.records.batches:
+                    base = batch.base_offset
+                    ts = batch.header.first_timestamp
+                    for rec in batch.memory_records():
+                        abs_offset = base + rec.offset_delta
+                        if abs_offset < start:
+                            continue  # skip records before the requested offset
+                        yield ConsumerRecord(
+                            partition=self.partition,
+                            offset=abs_offset,
+                            timestamp=(
+                                ts + rec.timestamp_delta if ts >= 0 else -1
+                            ),
+                            key=rec.key,
+                            value=rec.value,
+                        )
+                    last_seen = max(last_seen, batch.computed_last_offset() - 1)
+                # next offset to continue from: the engine's filter cursor
+                # when present, else the last stored offset we decoded
+                next_offset = (
+                    part.next_filter_offset
+                    if part.next_filter_offset >= 0
+                    else last_seen + 1
+                )
+                await self._socket.send_async(
+                    UpdateOffsetsRequest(
+                        offsets=[
+                            OffsetUpdate(
+                                offset=next_offset, session_id=response.stream_id
+                            )
+                        ]
+                    )
+                )
+                if end_at is not None and next_offset >= end_at:
+                    return
+        finally:
+            await stream.close()

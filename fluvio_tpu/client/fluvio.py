@@ -1,0 +1,70 @@
+"""Client entry point (parity: fluvio/src/fluvio.rs `Fluvio::connect`).
+
+Until the SC/control-plane lands, `connect` dials an SPU's public endpoint
+directly and the "pool" is that single connection; the SpuPool interface
+is kept so SC-backed leader routing can slot in.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from fluvio_tpu.client.consumer import PartitionConsumer
+from fluvio_tpu.client.producer import ProducerConfig, TopicProducer
+from fluvio_tpu.transport.versioned import VersionedSerialSocket
+
+
+class SpuPool:
+    """Leader-routed socket cache (parity: fluvio/src/spu.rs:97,152)."""
+
+    def __init__(self, default_addr: str):
+        self._default_addr = default_addr
+        self._sockets: Dict[str, VersionedSerialSocket] = {}
+
+    def addr_for(self, topic: str, partition: int) -> str:
+        # SC metadata will map partition -> leader SPU; single-SPU for now
+        return self._default_addr
+
+    async def socket_for(self, topic: str, partition: int) -> VersionedSerialSocket:
+        addr = self.addr_for(topic, partition)
+        sock = self._sockets.get(addr)
+        if sock is None or sock.is_stale:
+            sock = await VersionedSerialSocket.connect(addr)
+            self._sockets[addr] = sock
+        return sock
+
+    async def close(self) -> None:
+        for sock in self._sockets.values():
+            await sock.close()
+        self._sockets.clear()
+
+
+class Fluvio:
+    def __init__(self, pool: SpuPool):
+        self._pool = pool
+
+    @classmethod
+    async def connect(cls, addr: str) -> "Fluvio":
+        """Connect to a cluster (currently: one SPU's public address)."""
+        pool = SpuPool(addr)
+        # eagerly validate connectivity + negotiate versions
+        await pool.socket_for("", 0)
+        return cls(pool)
+
+    async def topic_producer(
+        self,
+        topic: str,
+        num_partitions: int = 1,
+        config: Optional[ProducerConfig] = None,
+    ) -> TopicProducer:
+        async def socket_factory(partition: int = 0):
+            return await self._pool.socket_for(topic, partition)
+
+        return TopicProducer(topic, num_partitions, socket_factory, config)
+
+    async def partition_consumer(self, topic: str, partition: int = 0) -> PartitionConsumer:
+        socket = await self._pool.socket_for(topic, partition)
+        return PartitionConsumer(topic, partition, socket)
+
+    async def close(self) -> None:
+        await self._pool.close()

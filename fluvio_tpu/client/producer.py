@@ -1,0 +1,259 @@
+"""Topic producer: batching accumulator + background flush.
+
+Capability parity: fluvio/src/producer/ — `TopicProducer.send` routes
+through a partitioner (partitioning.rs:16,39: key-hash or round-robin)
+into per-partition `RecordAccumulator` batches (accumulator.rs:63-143);
+a background `PartitionProducer` flushes on linger expiry or batch-full
+(partition_producer.rs:26,181); callers get `FutureRecordMetadata`
+(output.rs) resolving to the record's (partition, offset).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import zlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+from fluvio_tpu.protocol.compression import Compression
+from fluvio_tpu.protocol.error import ErrorCode, FluvioError
+from fluvio_tpu.protocol.record import Batch, Record, RecordSet
+from fluvio_tpu.schema.smartmodule import SmartModuleInvocation
+from fluvio_tpu.schema.spu import (
+    Isolation,
+    PartitionProduceData,
+    ProduceRequest,
+    TopicProduceData,
+)
+
+DEFAULT_BATCH_SIZE = 16_384
+DEFAULT_LINGER_MS = 100
+
+
+@dataclass
+class ProducerConfig:
+    batch_size: int = DEFAULT_BATCH_SIZE
+    linger_ms: int = DEFAULT_LINGER_MS
+    compression: Compression = Compression.NONE
+    isolation: Isolation = Isolation.READ_UNCOMMITTED
+    timeout_ms: int = 1500
+    max_request_size: int = 1 << 20
+    smartmodules: List[SmartModuleInvocation] = field(default_factory=list)
+
+
+@dataclass
+class RecordMetadata:
+    partition: int
+    offset: int
+
+
+class FutureRecordMetadata:
+    """Resolves when the record's batch is acked by the leader."""
+
+    def __init__(self, future: "asyncio.Future[Tuple[int, int]]", index: int):
+        self._future = future
+        self._index = index
+
+    async def wait(self) -> RecordMetadata:
+        partition, base_offset = await self._future
+        return RecordMetadata(partition=partition, offset=base_offset + self._index)
+
+
+class Partitioner:
+    """Key-hash (stable) or round-robin routing (partitioning.rs:39)."""
+
+    def __init__(self) -> None:
+        self._round_robin = 0
+
+    def partition(self, key: Optional[bytes], num_partitions: int) -> int:
+        if num_partitions <= 1:
+            return 0
+        if key is None:
+            p = self._round_robin % num_partitions
+            self._round_robin += 1
+            return p
+        return zlib.crc32(key) % num_partitions
+
+
+class _PendingBatch:
+    """One in-flight MemoryBatch + its ack future (accumulator.rs:220)."""
+
+    def __init__(self, partition: int, capacity: int):
+        self.partition = partition
+        self.capacity = capacity
+        self.records: List[Record] = []
+        self.size = 0
+        self.future: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.created = asyncio.get_event_loop().time()
+
+    def try_push(self, record: Record) -> Optional[FutureRecordMetadata]:
+        rsize = record.write_size()
+        if self.records and self.size + rsize > self.capacity:
+            return None
+        self.records.append(record)
+        self.size += rsize
+        return FutureRecordMetadata(self.future, len(self.records) - 1)
+
+
+class PartitionProducer:
+    """Background flusher for one partition (partition_producer.rs:26)."""
+
+    def __init__(self, topic: str, partition: int, socket_factory, config: ProducerConfig):
+        self.topic = topic
+        self.partition = partition
+        self._socket_factory = socket_factory
+        self.config = config
+        self._current: Optional[_PendingBatch] = None
+        self._queue: List[_PendingBatch] = []
+        self._wake = asyncio.Event()
+        self._closed = False
+        self._task = asyncio.ensure_future(self._run())
+
+    def push_record(self, record: Record) -> FutureRecordMetadata:
+        if self._current is None:
+            self._current = _PendingBatch(self.partition, self.config.batch_size)
+        fut = self._current.try_push(record)
+        if fut is None:
+            self._seal_current()
+            self._current = _PendingBatch(self.partition, self.config.batch_size)
+            fut = self._current.try_push(record)
+            assert fut is not None, "record exceeds batch capacity"
+        if self._current.size >= self.config.batch_size:
+            self._seal_current()
+        return fut
+
+    def _seal_current(self) -> None:
+        if self._current is not None and self._current.records:
+            self._queue.append(self._current)
+            self._current = None
+            self._wake.set()
+
+    async def flush(self) -> None:
+        self._seal_current()
+        pending = list(self._queue)
+        self._wake.set()
+        for batch in pending:
+            try:
+                await asyncio.shield(batch.future)
+            except FluvioError:
+                pass
+
+    async def _run(self) -> None:
+        linger = self.config.linger_ms / 1000
+        while not self._closed:
+            if not self._queue:
+                if self._current is not None and self._current.records:
+                    # linger: seal the open batch when it gets old enough
+                    age = asyncio.get_event_loop().time() - self._current.created
+                    timeout = max(linger - age, 0)
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), timeout=timeout)
+                    except asyncio.TimeoutError:
+                        self._seal_current()
+                else:
+                    await self._wake.wait()
+                self._wake.clear()
+                continue
+            batches, self._queue = self._queue, []
+            await self._send(batches)
+
+    async def _send(self, pending: List[_PendingBatch]) -> None:
+        record_set = RecordSet()
+        for p in pending:
+            record_set.add(
+                Batch.from_records(p.records, compression=self.config.compression)
+            )
+        request = ProduceRequest(
+            isolation=self.config.isolation,
+            timeout_ms=self.config.timeout_ms,
+            topics=[
+                TopicProduceData(
+                    name=self.topic,
+                    partitions=[
+                        PartitionProduceData(
+                            partition_index=self.partition, records=record_set
+                        )
+                    ],
+                )
+            ],
+            smartmodules=list(self.config.smartmodules),
+        )
+        try:
+            socket = await self._socket_factory()
+            response = await socket.send_receive(request)
+            presp = response.find_partition(self.topic, self.partition)
+        except Exception as e:  # noqa: BLE001 — propagate via futures
+            err = e if isinstance(e, FluvioError) else FluvioError(ErrorCode.OTHER, str(e))
+            for p in pending:
+                if not p.future.done():
+                    p.future.set_exception(err)
+            return
+        if presp.error_code != ErrorCode.NONE:
+            err = FluvioError(presp.error_code, presp.error_message)
+            for p in pending:
+                if not p.future.done():
+                    p.future.set_exception(err)
+            return
+        # offsets are contiguous across the batches of one request
+        offset = presp.base_offset
+        for p in pending:
+            if not p.future.done():
+                p.future.set_result((self.partition, offset))
+            offset += len(p.records)
+
+    async def close(self) -> None:
+        await self.flush()
+        self._closed = True
+        self._task.cancel()
+        try:
+            await self._task
+        except asyncio.CancelledError:
+            pass
+
+
+class TopicProducer:
+    """Public producer handle (parity: fluvio/src/producer/mod.rs)."""
+
+    def __init__(
+        self,
+        topic: str,
+        num_partitions: int,
+        socket_factory,
+        config: Optional[ProducerConfig] = None,
+    ):
+        self.topic = topic
+        self.num_partitions = num_partitions
+        self.config = config or ProducerConfig()
+        self._socket_factory = socket_factory
+        self._partitioner = Partitioner()
+        self._producers: dict[int, PartitionProducer] = {}
+
+    def _producer_for(self, partition: int) -> PartitionProducer:
+        if partition not in self._producers:
+            # bind the partition so the flusher dials that partition's leader
+            factory = lambda p=partition: self._socket_factory(p)  # noqa: E731
+            self._producers[partition] = PartitionProducer(
+                self.topic, partition, factory, self.config
+            )
+        return self._producers[partition]
+
+    async def send(
+        self,
+        key: Union[bytes, str, None],
+        value: Union[bytes, str],
+    ) -> FutureRecordMetadata:
+        kb = key.encode() if isinstance(key, str) else key
+        vb = value.encode() if isinstance(value, str) else value
+        partition = self._partitioner.partition(kb, self.num_partitions)
+        record = Record(key=kb, value=vb)
+        return self._producer_for(partition).push_record(record)
+
+    async def send_all(self, items) -> List[FutureRecordMetadata]:
+        return [await self.send(k, v) for k, v in items]
+
+    async def flush(self) -> None:
+        await asyncio.gather(*(p.flush() for p in self._producers.values()))
+
+    async def close(self) -> None:
+        await asyncio.gather(*(p.close() for p in self._producers.values()))
+        self._producers.clear()

@@ -1,0 +1,5 @@
+"""Wire schemas for the public/internal server APIs.
+
+Capability parity: `fluvio-spu-schema` (data-plane requests) and, later,
+`fluvio-sc-schema` (admin) / `fluvio-controlplane` (SC<->SPU internal).
+"""

@@ -1,0 +1,13 @@
+"""SPU — the Streaming Processing Unit (broker).
+
+Capability parity: `fluvio-spu` — public server (produce / fetch /
+stream-fetch / offsets), per-partition leader state over FileReplica
+storage, SmartModule chain execution on both produce and consume paths,
+and metrics. Replication (follower sync) and the SC dispatcher layer on
+top of this core.
+"""
+
+from fluvio_tpu.spu.config import SpuConfig  # noqa: F401
+from fluvio_tpu.spu.context import GlobalContext  # noqa: F401
+from fluvio_tpu.spu.replica import LeaderReplicaState  # noqa: F401
+from fluvio_tpu.spu.server import SpuServer  # noqa: F401

@@ -1,0 +1,31 @@
+"""SPU configuration (parity: fluvio-spu/src/config/spu_config.rs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from fluvio_tpu.smartengine.engine import DEFAULT_STORE_MAX_MEMORY
+from fluvio_tpu.storage.config import ReplicaConfig
+from fluvio_tpu.types import SPU_PUBLIC_PORT, SpuId
+
+
+@dataclass
+class SmartEngineConfig:
+    backend: str = "auto"  # python | tpu | auto
+    store_max_memory: int = DEFAULT_STORE_MAX_MEMORY
+
+
+@dataclass
+class SpuConfig:
+    id: SpuId = 0
+    public_addr: str = f"0.0.0.0:{SPU_PUBLIC_PORT}"
+    private_addr: str = ""
+    log_base_dir: str = "/tmp/fluvio-tpu"
+    replication: ReplicaConfig = field(default_factory=ReplicaConfig)
+    smart_engine: SmartEngineConfig = field(default_factory=SmartEngineConfig)
+    # produce-side flush guarantees: rf=1 means HW advances on local write
+    in_sync_replica: int = 1
+
+    def __post_init__(self) -> None:
+        if self.replication.base_dir in (".", ""):
+            self.replication.base_dir = self.log_base_dir

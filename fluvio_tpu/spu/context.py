@@ -1,0 +1,69 @@
+"""SPU global context (parity: fluvio-spu/src/core/global_context.rs:36-80).
+
+Holds the config, the leader-replica store, the local SmartModule store,
+the SmartEngine instance, and metrics. Created once per broker process and
+shared (by reference) with every service handler.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from fluvio_tpu.smartengine.engine import SmartEngine
+from fluvio_tpu.spu.config import SpuConfig
+from fluvio_tpu.spu.metrics import SpuMetrics
+from fluvio_tpu.spu.replica import LeaderReplicaState
+from fluvio_tpu.types import partition_replica_key
+
+
+class SmartModuleLocalStore:
+    """Named SmartModule artifacts pushed by the SC (or loaded directly).
+
+    Parity: the SPU's SmartModule local store that `resolve_invocation`
+    reads Predefined modules from (fluvio-spu/src/smartengine/context.rs:95).
+    Payloads are artifact source bytes.
+    """
+
+    def __init__(self) -> None:
+        self._modules: Dict[str, bytes] = {}
+
+    def insert(self, name: str, payload: bytes) -> None:
+        self._modules[name] = payload
+
+    def get(self, name: str) -> Optional[bytes]:
+        return self._modules.get(name)
+
+    def remove(self, name: str) -> None:
+        self._modules.pop(name, None)
+
+    def names(self) -> list[str]:
+        return sorted(self._modules)
+
+
+class GlobalContext:
+    def __init__(self, config: SpuConfig):
+        self.config = config
+        self.leaders: Dict[str, LeaderReplicaState] = {}
+        self.smartmodules = SmartModuleLocalStore()
+        self.engine = SmartEngine(
+            backend=config.smart_engine.backend,
+            store_max_memory=config.smart_engine.store_max_memory,
+        )
+        self.metrics = SpuMetrics()
+
+    def create_replica(self, topic: str, partition: int = 0) -> LeaderReplicaState:
+        """Create-or-load a leader replica (control-plane `ReplicaChange::Add`)."""
+        key = partition_replica_key(topic, partition)
+        if key not in self.leaders:
+            self.leaders[key] = LeaderReplicaState(
+                topic, partition, self.config.replication, self.config.in_sync_replica
+            )
+        return self.leaders[key]
+
+    def leader_for(self, topic: str, partition: int) -> Optional[LeaderReplicaState]:
+        return self.leaders.get(partition_replica_key(topic, partition))
+
+    def close(self) -> None:
+        for leader in self.leaders.values():
+            leader.close()
+        self.leaders.clear()

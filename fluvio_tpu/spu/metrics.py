@@ -1,0 +1,41 @@
+"""Broker metrics (parity: fluvio-spu/src/core/metrics.rs)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
+
+
+@dataclass
+class RecordCounter:
+    records: int = 0
+    bytes: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def add(self, records: int, nbytes: int) -> None:
+        with self._lock:
+            self.records += records
+            self.bytes += nbytes
+
+    def to_dict(self) -> dict:
+        return {"records": self.records, "bytes": self.bytes}
+
+
+@dataclass
+class SpuMetrics:
+    inbound: RecordCounter = field(default_factory=RecordCounter)
+    outbound: RecordCounter = field(default_factory=RecordCounter)
+    smartmodule: SmartModuleChainMetrics = field(default_factory=SmartModuleChainMetrics)
+
+    def to_dict(self) -> dict:
+        return {
+            "inbound": self.inbound.to_dict(),
+            "outbound": self.outbound.to_dict(),
+            "smartmodule": self.smartmodule.to_dict(),
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())

@@ -1,0 +1,542 @@
+"""SPU public API service: produce / fetch / stream-fetch / offsets.
+
+Capability parity: fluvio-spu/src/services/public/ — the per-connection
+dispatch loop, `handle_produce_request` (produce_handler.rs:56,87,159),
+`StreamFetchHandler` with its select loop and `send_back_records`
+(stream_fetch.rs:39,229-326,340; zero-copy branch :443), offset fetch
+(offset_request.rs) and consumer acks (offset_update.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from fluvio_tpu.protocol.api import (
+    ApiVersionKey,
+    ApiVersionsRequest,
+    ApiVersionsResponse,
+    ResponseMessage,
+    decode_request_header,
+)
+from fluvio_tpu.protocol.codec import ByteWriter
+from fluvio_tpu.protocol.error import ErrorCode, FluvioError
+from fluvio_tpu.protocol.record import Batch, RecordSet
+from fluvio_tpu.schema.spu import (
+    FetchablePartitionResponse,
+    FetchOffsetsRequest,
+    FetchOffsetsResponse,
+    FetchRequest,
+    FetchResponse,
+    Isolation,
+    OffsetUpdateStatus,
+    PartitionProduceResponse,
+    ProduceRequest,
+    ProduceResponse,
+    SpuServerApiKey,
+    StreamFetchRequest,
+    StreamFetchResponse,
+    TopicProduceResponse,
+    UpdateOffsetsRequest,
+    UpdateOffsetsResponse,
+)
+from fluvio_tpu.spu.context import GlobalContext
+from fluvio_tpu.spu.replica import LeaderReplicaState
+from fluvio_tpu.spu.smart_chain import (
+    BatchProcessResult,
+    SmartModuleResolutionError,
+    build_chain,
+    chain_look_back,
+    process_batches,
+)
+from fluvio_tpu.smartengine.engine import EngineError, SmartModuleChainInitError
+from fluvio_tpu.smartmodule.types import SmartModuleInput
+from fluvio_tpu.transport.service import FluvioService
+from fluvio_tpu.transport.sink import ExclusiveSink, FluvioSink
+from fluvio_tpu.transport.socket import FluvioSocket, SocketClosed
+from fluvio_tpu.types import OffsetPublisher, StickyEvent
+
+logger = logging.getLogger(__name__)
+
+SPU_API_KEYS = [
+    ApiVersionKey(SpuServerApiKey.API_VERSION, 0, 0),
+    ApiVersionKey(SpuServerApiKey.PRODUCE, 0, ProduceRequest.MAX_API_VERSION),
+    ApiVersionKey(SpuServerApiKey.FETCH, 0, FetchRequest.MAX_API_VERSION),
+    ApiVersionKey(SpuServerApiKey.FETCH_OFFSETS, 0, 0),
+    ApiVersionKey(SpuServerApiKey.STREAM_FETCH, 0, StreamFetchRequest.MAX_API_VERSION),
+    ApiVersionKey(SpuServerApiKey.UPDATE_OFFSETS, 0, 0),
+]
+
+
+class ConnectionContext:
+    """Per-connection state: push streams + their consumer-ack buses."""
+
+    def __init__(self) -> None:
+        self.next_stream_id = 1
+        self.ack_publishers: Dict[int, OffsetPublisher] = {}
+        self.stream_tasks: Dict[int, asyncio.Task] = {}
+        self.end = StickyEvent()
+
+    def allocate_stream(self) -> tuple[int, OffsetPublisher]:
+        sid = self.next_stream_id
+        self.next_stream_id += 1
+        pub = OffsetPublisher(-1)
+        self.ack_publishers[sid] = pub
+        return sid, pub
+
+    async def shutdown(self) -> None:
+        self.end.notify()
+        for task in self.stream_tasks.values():
+            task.cancel()
+        if self.stream_tasks:
+            await asyncio.gather(*self.stream_tasks.values(), return_exceptions=True)
+        self.stream_tasks.clear()
+
+
+class SpuPublicService(FluvioService[GlobalContext]):
+    async def respond(self, ctx: GlobalContext, socket: FluvioSocket) -> None:
+        sink = ExclusiveSink(FluvioSink(socket.writer))
+        conn = ConnectionContext()
+        try:
+            while True:
+                try:
+                    frame = await socket.read_frame()
+                except SocketClosed:
+                    break
+                header, reader = decode_request_header(frame)
+                key = header.api_key
+                version = header.api_version
+                cid = header.correlation_id
+
+                if key == SpuServerApiKey.API_VERSION:
+                    ApiVersionsRequest.decode(reader, version)
+                    resp = ApiVersionsResponse(api_keys=list(SPU_API_KEYS))
+                elif key == SpuServerApiKey.PRODUCE:
+                    req = ProduceRequest.decode(reader, version)
+                    resp = await handle_produce(ctx, req)
+                elif key == SpuServerApiKey.FETCH:
+                    req = FetchRequest.decode(reader, version)
+                    resp = handle_fetch(ctx, req)
+                elif key == SpuServerApiKey.FETCH_OFFSETS:
+                    req = FetchOffsetsRequest.decode(reader, version)
+                    resp = handle_fetch_offsets(ctx, req)
+                elif key == SpuServerApiKey.UPDATE_OFFSETS:
+                    req = UpdateOffsetsRequest.decode(reader, version)
+                    resp = handle_update_offsets(conn, req)
+                elif key == SpuServerApiKey.STREAM_FETCH:
+                    req = StreamFetchRequest.decode(reader, version)
+                    start_stream_fetch(ctx, conn, req, version, cid, sink)
+                    continue  # responses are pushed by the stream task
+                else:
+                    logger.warning("unknown api key %s", key)
+                    break
+
+                await sink.send_response(ResponseMessage(cid, resp), version)
+        finally:
+            await conn.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Produce
+# ---------------------------------------------------------------------------
+
+
+async def handle_produce(ctx: GlobalContext, req: ProduceRequest) -> ProduceResponse:
+    chain = None
+    if req.smartmodules:
+        try:
+            chain = build_chain(req.smartmodules, ctx)
+        except (SmartModuleResolutionError, SmartModuleChainInitError, EngineError) as e:
+            return _produce_error_response(req, _smartmodule_error_code(e), str(e))
+
+    response = ProduceResponse()
+    for topic_data in req.topics:
+        topic_resp = TopicProduceResponse(name=topic_data.name)
+        response.responses.append(topic_resp)
+        for pdata in topic_data.partitions:
+            presp = PartitionProduceResponse(partition_index=pdata.partition_index)
+            topic_resp.partitions.append(presp)
+            leader = ctx.leader_for(topic_data.name, pdata.partition_index)
+            if leader is None:
+                presp.error_code = ErrorCode.NOT_LEADER_FOR_PARTITION
+                presp.error_message = (
+                    f"{topic_data.name}-{pdata.partition_index} has no leader here"
+                )
+                continue
+            records = pdata.records
+            if chain is not None:
+                records, err = _apply_produce_chain(chain, records)
+                if err is not None:
+                    presp.error_code = ErrorCode.SMARTMODULE_RUNTIME_ERROR
+                    presp.error_message = str(err)
+                    continue
+            try:
+                nbytes = sum(b.write_size() for b in records.batches)
+                base = await leader.write_record_set(records)
+            except FluvioError as e:
+                presp.error_code = e.code
+                presp.error_message = str(e)
+                continue
+            presp.base_offset = base
+            ctx.metrics.inbound.add(records.total_records(), nbytes)
+            if req.isolation == Isolation.READ_COMMITTED:
+                await _wait_for_hw(leader, leader.leo(), req.timeout_ms)
+    return response
+
+
+def _apply_produce_chain(chain, records: RecordSet):
+    """Producer-side transform (parity: produce_handler.rs:215)."""
+    out = RecordSet()
+    for batch in records.batches:
+        inp = SmartModuleInput.from_records(
+            batch.memory_records(),
+            base_offset=0,  # offsets not assigned until the log write
+            base_timestamp=batch.header.first_timestamp,
+        )
+        output = chain.process(inp)
+        if output.error is not None:
+            return out, output.error
+        if output.successes:
+            out.add(
+                Batch.from_records(
+                    output.successes,
+                    first_timestamp=batch.header.first_timestamp or None,
+                )
+            )
+    return out, None
+
+
+async def _wait_for_hw(leader: LeaderReplicaState, target: int, timeout_ms: int) -> None:
+    """Block until HW reaches ``target`` (read-committed produce acks)."""
+    if leader.hw() >= target:
+        return
+    listener = leader.hw_publisher.change_listener()
+    deadline = asyncio.get_running_loop().time() + timeout_ms / 1000
+    while leader.hw() < target:
+        remaining = deadline - asyncio.get_running_loop().time()
+        if remaining <= 0:
+            return
+        try:
+            await asyncio.wait_for(listener.listen(), timeout=remaining)
+        except asyncio.TimeoutError:
+            return
+
+
+def _smartmodule_error_code(e: Exception) -> ErrorCode:
+    if isinstance(e, SmartModuleResolutionError):
+        return e.code
+    if isinstance(e, SmartModuleChainInitError):
+        return ErrorCode.SMARTMODULE_CHAIN_INIT_ERROR
+    return ErrorCode.SMARTMODULE_ERROR
+
+
+def _produce_error_response(
+    req: ProduceRequest, code: ErrorCode, message: str
+) -> ProduceResponse:
+    response = ProduceResponse()
+    for topic_data in req.topics:
+        topic_resp = TopicProduceResponse(name=topic_data.name)
+        for pdata in topic_data.partitions:
+            topic_resp.partitions.append(
+                PartitionProduceResponse(
+                    partition_index=pdata.partition_index,
+                    error_code=code,
+                    error_message=message,
+                )
+            )
+        response.responses.append(topic_resp)
+    return response
+
+
+# ---------------------------------------------------------------------------
+# Fetch / FetchOffsets / UpdateOffsets
+# ---------------------------------------------------------------------------
+
+
+def handle_fetch(ctx: GlobalContext, req: FetchRequest) -> FetchResponse:
+    resp = FetchResponse(
+        topic=req.topic,
+        partition=FetchablePartitionResponse(partition_index=req.partition),
+    )
+    leader = ctx.leader_for(req.topic, req.partition)
+    if leader is None:
+        resp.partition.error_code = ErrorCode.NOT_LEADER_FOR_PARTITION
+        return resp
+    info = leader.offsets()
+    resp.partition.high_watermark = info.hw
+    resp.partition.log_start_offset = info.start_offset
+    try:
+        rslice = leader.read_records(req.fetch_offset, req.max_bytes, req.isolation)
+    except FluvioError as e:
+        resp.partition.error_code = e.code
+        return resp
+    if rslice.file_slice is not None:
+        for batch in rslice.decode_batches(parse_records=False):
+            resp.partition.records.add(batch)
+        ctx.metrics.outbound.add(
+            resp.partition.records.total_records(), rslice.file_slice.length
+        )
+    return resp
+
+
+def handle_fetch_offsets(ctx: GlobalContext, req: FetchOffsetsRequest) -> FetchOffsetsResponse:
+    leader = ctx.leader_for(req.topic, req.partition)
+    if leader is None:
+        return FetchOffsetsResponse(error_code=ErrorCode.NOT_LEADER_FOR_PARTITION)
+    info = leader.offsets()
+    return FetchOffsetsResponse(
+        start_offset=info.start_offset, hw=info.hw, leo=info.leo
+    )
+
+
+def handle_update_offsets(
+    conn: ConnectionContext, req: UpdateOffsetsRequest
+) -> UpdateOffsetsResponse:
+    resp = UpdateOffsetsResponse()
+    for upd in req.offsets:
+        pub = conn.ack_publishers.get(upd.session_id)
+        if pub is None:
+            resp.offsets.append(
+                OffsetUpdateStatus(
+                    session_id=upd.session_id,
+                    error_code=ErrorCode.FETCH_SESSION_NOT_FOUND,
+                )
+            )
+            continue
+        pub.update(upd.offset)
+        resp.offsets.append(OffsetUpdateStatus(session_id=upd.session_id))
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# StreamFetch
+# ---------------------------------------------------------------------------
+
+
+def start_stream_fetch(
+    ctx: GlobalContext,
+    conn: ConnectionContext,
+    req: StreamFetchRequest,
+    version: int,
+    correlation_id: int,
+    sink: ExclusiveSink,
+) -> None:
+    stream_id, ack_publisher = conn.allocate_stream()
+    handler = StreamFetchHandler(
+        ctx, conn, req, version, correlation_id, stream_id, sink, ack_publisher
+    )
+    task = asyncio.ensure_future(handler.run())
+    conn.stream_tasks[stream_id] = task
+
+    def _cleanup(_t, sid=stream_id) -> None:
+        conn.stream_tasks.pop(sid, None)
+        conn.ack_publishers.pop(sid, None)  # dead stream ids stop acking
+
+    task.add_done_callback(_cleanup)
+
+
+class StreamFetchHandler:
+    """One push stream: select loop over data / acks / end.
+
+    Parity: fluvio-spu/src/services/public/stream_fetch.rs:39 — the handler
+    compiles the chain once per stream (`:138`), runs lookback (`:140`),
+    then loops: read a bounded slice, push it (zero-copy when no chain,
+    engine-processed otherwise, `send_back_records` `:340`), wait for the
+    consumer's offset ack, wait for the leader's offsets to advance.
+    """
+
+    def __init__(
+        self,
+        ctx: GlobalContext,
+        conn: ConnectionContext,
+        req: StreamFetchRequest,
+        version: int,
+        correlation_id: int,
+        stream_id: int,
+        sink: ExclusiveSink,
+        ack_publisher: OffsetPublisher,
+    ):
+        self.ctx = ctx
+        self.conn = conn
+        self.req = req
+        self.version = version
+        self.correlation_id = correlation_id
+        self.stream_id = stream_id
+        self.sink = sink
+        self.ack_publisher = ack_publisher
+        self.metrics = ctx.metrics.smartmodule
+        self._ended = False  # terminal error pushed; stop the stream
+
+    async def run(self) -> None:
+        try:
+            await self._run()
+        except (SocketClosed, ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            logger.exception(
+                "stream fetch failed (%s-%s)", self.req.topic, self.req.partition
+            )
+
+    async def _run(self) -> None:
+        req = self.req
+        leader = self.ctx.leader_for(req.topic, req.partition)
+        if leader is None:
+            await self._send_error(
+                ErrorCode.NOT_LEADER_FOR_PARTITION, hw=-1, log_start=-1
+            )
+            return
+
+        chain = None
+        if req.smartmodules:
+            try:
+                chain = build_chain(req.smartmodules, self.ctx, version=self.version)
+                await chain_look_back(chain, leader)
+            except (
+                SmartModuleResolutionError,
+                SmartModuleChainInitError,
+                EngineError,
+            ) as e:
+                info = leader.offsets()
+                await self._send_error(
+                    _smartmodule_error_code(e),
+                    hw=info.hw,
+                    log_start=info.start_offset,
+                    message=str(e),
+                )
+                return
+
+        # clamp the starting offset into the valid window (stream_fetch.rs
+        # resolves the requested offset against [start, bound])
+        info = leader.offsets()
+        bound = leader.read_bound(req.isolation)
+        current = max(info.start_offset, min(req.fetch_offset, bound))
+
+        end_wait = asyncio.ensure_future(self.conn.end.wait())
+        try:
+            while not self.conn.end.is_set() and not self._ended:
+                bound = leader.read_bound(req.isolation)
+                if current < bound:
+                    sent_next = await self._send_back_records(leader, chain, current)
+                    if self._ended:
+                        return
+                    if sent_next > current:
+                        await self._wait_for_ack(sent_next, end_wait)
+                        current = sent_next
+                        continue
+                # no data (or empty slice): wait for the log to advance
+                listener = leader.offset_publisher(req.isolation).change_listener()
+                if leader.read_bound(req.isolation) > current:
+                    continue
+                listen = asyncio.ensure_future(listener.listen())
+                done, _ = await asyncio.wait(
+                    [listen, end_wait], return_when=asyncio.FIRST_COMPLETED
+                )
+                if end_wait in done:
+                    listen.cancel()
+                    return
+        finally:
+            end_wait.cancel()
+
+    async def _wait_for_ack(self, target: int, end_wait: asyncio.Future) -> None:
+        """Backpressure: hold the next push until the consumer acks."""
+        listener = self.ack_publisher.change_listener()
+        while (
+            self.ack_publisher.current_value() < target
+            and not self.conn.end.is_set()
+        ):
+            listen = asyncio.ensure_future(listener.listen())
+            done, _ = await asyncio.wait(
+                [listen, end_wait], return_when=asyncio.FIRST_COMPLETED
+            )
+            if end_wait in done:
+                listen.cancel()
+                return
+
+    async def _send_back_records(self, leader, chain, offset: int) -> int:
+        """Push one chunk; returns the next offset (== offset if nothing sent)."""
+        req = self.req
+        try:
+            rslice = leader.read_records(offset, req.max_bytes, req.isolation)
+        except FluvioError as e:
+            info = leader.offsets()
+            await self._send_error(e.code, hw=info.hw, log_start=info.start_offset)
+            self._ended = True
+            return offset
+        if rslice.file_slice is None or rslice.next_offset is None:
+            return offset
+
+        info = rslice.start
+        if chain is None:
+            # zero-copy: stored batches are wire-encoded; sendfile them as
+            # the RecordSet body (stream_fetch.rs:443 / sink.rs:123)
+            header = ByteWriter()
+            header.write_i32(self.correlation_id)
+            header.write_string(req.topic)
+            header.write_i32(req.partition)
+            header.write_i32(self.stream_id)
+            header.write_i32(req.partition)  # partition.partition_index
+            header.write_u16(int(ErrorCode.NONE))
+            header.write_string("")  # error_message
+            header.write_i64(info.hw)
+            header.write_i64(info.start_offset)
+            header.write_i64(rslice.next_offset)
+            header.write_i32(rslice.file_slice.length)  # RecordSet byte len
+            await self.sink.send_response_with_file_slices(
+                header.bytes(), [rslice.file_slice]
+            )
+            self.ctx.metrics.outbound.add(0, rslice.file_slice.length)
+            return rslice.next_offset
+
+        # SmartModule path: decode -> chain -> re-batch -> push
+        batches = rslice.decode_batches()
+        result: BatchProcessResult = process_batches(
+            chain, batches, req.max_bytes, self.metrics
+        )
+        partition = FetchablePartitionResponse(
+            partition_index=req.partition,
+            high_watermark=info.hw,
+            log_start_offset=info.start_offset,
+            next_filter_offset=result.next_offset,
+            records=result.records,
+        )
+        if result.error is not None:
+            partition.error_code = ErrorCode.SMARTMODULE_RUNTIME_ERROR
+            partition.error_message = str(result.error)
+            self._ended = True  # reference ends the stream on transform error
+        resp = StreamFetchResponse(
+            topic=req.topic,
+            partition_index=req.partition,
+            stream_id=self.stream_id,
+            partition=partition,
+        )
+        await self.sink.send_response(
+            ResponseMessage(self.correlation_id, resp), self.version
+        )
+        nbytes = sum(b.write_size() for b in result.records.batches)
+        self.ctx.metrics.outbound.add(result.records.total_records(), nbytes)
+        return max(result.next_offset, offset)
+
+    async def _send_error(
+        self,
+        code: ErrorCode,
+        hw: int,
+        log_start: int,
+        message: str = "",
+    ) -> None:
+        partition = FetchablePartitionResponse(
+            partition_index=self.req.partition,
+            error_code=code,
+            error_message=message,
+            high_watermark=hw,
+            log_start_offset=log_start,
+        )
+        resp = StreamFetchResponse(
+            topic=self.req.topic,
+            partition_index=self.req.partition,
+            stream_id=self.stream_id,
+            partition=partition,
+        )
+        await self.sink.send_response(
+            ResponseMessage(self.correlation_id, resp), self.version
+        )

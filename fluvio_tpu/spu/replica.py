@@ -1,0 +1,107 @@
+"""Leader replica state: storage + offset event publishing.
+
+Capability parity: fluvio-spu/src/replication/leader/replica_state.rs —
+`LeaderReplicaState` (`:41`): owns the FileReplica, serializes writes
+(`write_record_set` `:323`), advances HW (immediately when
+in_sync_replica == 1), and publishes LEO/HW changes on OffsetPublishers so
+stream-fetch select loops wake up. Follower-offset tracking
+(`update_states_from_followers` `:172`) arrives with the replication layer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List
+
+from fluvio_tpu.protocol.record import Batch, RecordSet
+from fluvio_tpu.schema.spu import Isolation
+from fluvio_tpu.storage.config import ReplicaConfig
+from fluvio_tpu.storage.replica import (
+    ISOLATION_READ_COMMITTED,
+    ISOLATION_READ_UNCOMMITTED,
+    FileReplica,
+    OffsetInfo,
+    ReplicaSlice,
+)
+from fluvio_tpu.types import OffsetPublisher, partition_replica_key
+
+
+def _isolation_str(isolation: Isolation) -> str:
+    return (
+        ISOLATION_READ_COMMITTED
+        if isolation == Isolation.READ_COMMITTED
+        else ISOLATION_READ_UNCOMMITTED
+    )
+
+
+class LeaderReplicaState:
+    """One partition's leader: storage + write lock + offset buses."""
+
+    def __init__(
+        self,
+        topic: str,
+        partition: int,
+        config: ReplicaConfig,
+        in_sync_replica: int = 1,
+    ):
+        self.topic = topic
+        self.partition = partition
+        self.replica_key = partition_replica_key(topic, partition)
+        self.in_sync_replica = in_sync_replica
+        self.storage = FileReplica(topic, partition, 0, config)
+        self.leo_publisher = OffsetPublisher(self.storage.get_leo())
+        self.hw_publisher = OffsetPublisher(self.storage.get_hw())
+        self._write_lock = asyncio.Lock()
+
+    # -- offsets ------------------------------------------------------------
+
+    def leo(self) -> int:
+        return self.storage.get_leo()
+
+    def hw(self) -> int:
+        return self.storage.get_hw()
+
+    def offsets(self) -> OffsetInfo:
+        return self.storage.offsets()
+
+    def offset_publisher(self, isolation: Isolation) -> OffsetPublisher:
+        """The bus a consumer stream waits on for new data."""
+        if isolation == Isolation.READ_COMMITTED:
+            return self.hw_publisher
+        return self.leo_publisher
+
+    def read_bound(self, isolation: Isolation) -> int:
+        return self.hw() if isolation == Isolation.READ_COMMITTED else self.leo()
+
+    # -- write path ---------------------------------------------------------
+
+    async def write_record_set(self, records: RecordSet) -> int:
+        """Append batches; with rf=1 the HW advances immediately.
+
+        Returns the base offset assigned to the first batch.
+        """
+        async with self._write_lock:
+            base = self.storage.write_recordset(
+                records, update_highwatermark=(self.in_sync_replica <= 1)
+            )
+        self.leo_publisher.update(self.storage.get_leo())
+        if self.in_sync_replica <= 1:
+            self.hw_publisher.update(self.storage.get_hw())
+        return base
+
+    # -- read path ----------------------------------------------------------
+
+    def read_records(
+        self, offset: int, max_bytes: int, isolation: Isolation
+    ) -> ReplicaSlice:
+        return self.storage.read_partition_slice(
+            offset, max_bytes, _isolation_str(isolation)
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        self.storage.close()
+
+    def remove(self) -> None:
+        self.storage.remove()

@@ -1,0 +1,36 @@
+"""SPU process assembly (parity: fluvio-spu/src/start.rs:15,66).
+
+Builds the GlobalContext and runs the public API server. The internal
+(follower-sync) server and the SC dispatcher attach here when the
+replication / control-plane layers land.
+"""
+
+from __future__ import annotations
+
+from fluvio_tpu.spu.config import SpuConfig
+from fluvio_tpu.spu.context import GlobalContext
+from fluvio_tpu.spu.public_service import SpuPublicService
+from fluvio_tpu.transport.service import FluvioApiServer
+
+
+class SpuServer:
+    def __init__(self, config: SpuConfig):
+        self.config = config
+        self.ctx = GlobalContext(config)
+        self.public_server = FluvioApiServer(
+            config.public_addr, SpuPublicService(), self.ctx
+        )
+
+    @property
+    def public_addr(self) -> str:
+        return self.public_server.local_addr
+
+    async def start(self) -> None:
+        await self.public_server.start()
+
+    async def run(self) -> None:
+        await self.public_server.run()
+
+    async def stop(self) -> None:
+        await self.public_server.stop()
+        self.ctx.close()

@@ -54,6 +54,19 @@ class ReplicaSlice:
     start: OffsetInfo
     end: Optional[OffsetInfo] = None
     file_slice: Optional[FileSlice] = None
+    # offset after the last record covered by file_slice; lets the consume
+    # path advance its cursor without decoding the batches it sendfile()s
+    next_offset: Optional[int] = None
+
+    def decode_batches(self, parse_records: bool = True) -> List[Batch]:
+        """Parse the slice into batches (the non-zero-copy read paths)."""
+        if self.file_slice is None:
+            return []
+        r = ByteReader(self.file_slice.read_bytes())
+        batches: List[Batch] = []
+        while r.remaining() > 0:
+            batches.append(Batch.decode(r, parse_records=parse_records))
+        return batches
 
 
 class FileReplica:
@@ -202,12 +215,14 @@ class FileReplica:
         # iterating to widen up to max_bytes / the isolation bound
         start_bp = None
         end_pos = 0
+        next_off = offset
         hint = seg.index.lookup(max(offset - seg.base_offset, 0))
         for bp in seg.scan_batches(hint):
             if start_bp is None:
                 if bp.records_end_offset > offset:
                     start_bp = bp
                     end_pos = bp.end_position
+                    next_off = bp.records_end_offset
                 elif bp.base_offset > offset:
                     break
                 continue
@@ -216,6 +231,7 @@ class FileReplica:
             if bp.end_position - start_bp.position > max_bytes:
                 break
             end_pos = bp.end_position
+            next_off = bp.records_end_offset
         if start_bp is None:
             return ReplicaSlice(start=info)
         length = end_pos - start_bp.position
@@ -224,6 +240,7 @@ class FileReplica:
         return ReplicaSlice(
             start=info,
             file_slice=FileSlice(seg.log_path, start_bp.position, length),
+            next_offset=next_off,
         )
 
     def read_records(
@@ -233,24 +250,26 @@ class FileReplica:
         isolation: str = ISOLATION_READ_UNCOMMITTED,
     ) -> List[Batch]:
         """Parsed batches (test/lookback convenience over the slice path)."""
-        rslice = self.read_partition_slice(offset, max_bytes, isolation)
-        if rslice.file_slice is None:
-            return []
-        data = rslice.file_slice.read_bytes()
-        r = ByteReader(data)
-        batches = []
-        while r.remaining() > 0:
-            batches.append(Batch.decode(r))
-        return batches
+        return self.read_partition_slice(offset, max_bytes, isolation).decode_batches()
 
-    def read_last_records(self, count: int) -> List[Record]:
-        """Last ``count`` records before HW (lookback support).
+    def read_last_records(
+        self, count: int, min_timestamp: Optional[int] = None
+    ) -> List[Record]:
+        """Recent records before HW (lookback support).
 
-        Walks forward from the start offset across segment boundaries (one
-        slice per segment at most).
+        ``count`` > 0 bounds the result to the last N records;
+        ``min_timestamp`` (ms, resolved per record from its batch header)
+        drops older records — together they implement Lookback::Last and
+        Lookback::Age{age, last}. With only an age bound the walk starts at
+        the log start (no time index yet).
         """
         hw = self.get_hw()
-        start = max(self.get_log_start_offset(), hw - count)
+        if min_timestamp is None and count <= 0:
+            return []
+        if min_timestamp is not None:
+            start = self.get_log_start_offset()
+        else:
+            start = max(self.get_log_start_offset(), hw - count)
         records: List[Record] = []
         off = start
         while off < hw:
@@ -258,10 +277,21 @@ class FileReplica:
             if not batches:
                 break
             for batch in batches:
+                base_ts = batch.header.first_timestamp
                 for rec in batch.memory_records():
                     abs_offset = batch.base_offset + rec.offset_delta
-                    if start <= abs_offset < hw:
-                        records.append(rec)
+                    if not (start <= abs_offset < hw):
+                        continue
+                    if min_timestamp is not None:
+                        abs_ts = (
+                            base_ts + rec.timestamp_delta
+                            if base_ts != NO_TIMESTAMP
+                            else NO_TIMESTAMP
+                        )
+                        # records with no timestamp never satisfy an age bound
+                        if abs_ts < min_timestamp:
+                            continue
+                    records.append(rec)
             off = batches[-1].computed_last_offset()
         return records[-count:] if count else records
 

@@ -1,0 +1,15 @@
+"""Transport layer: framed TCP sockets, multiplexing, servers.
+
+Capability parity: the reference's `fluvio-socket` (framed client/server
+sockets, correlation-id multiplexer, zero-copy file-slice sink, versioned
+serial socket) and `fluvio-service` (generic TCP API server scaffold).
+"""
+
+from fluvio_tpu.transport.socket import FluvioSocket, connect  # noqa: F401
+from fluvio_tpu.transport.sink import ExclusiveSink, FluvioSink  # noqa: F401
+from fluvio_tpu.transport.multiplexing import (  # noqa: F401
+    AsyncResponse,
+    MultiplexerSocket,
+)
+from fluvio_tpu.transport.versioned import VersionedSerialSocket  # noqa: F401
+from fluvio_tpu.transport.service import FluvioApiServer, FluvioService  # noqa: F401

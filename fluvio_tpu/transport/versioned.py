@@ -1,0 +1,64 @@
+"""Version-pinned serial socket (parity: fluvio-socket/src/versioned.rs:218).
+
+Performs ApiVersions negotiation once per connection, then sends every
+request at the highest version the server supports for its api key.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fluvio_tpu.protocol.api import ApiRequest, ApiVersionsRequest, ApiVersionsResponse
+from fluvio_tpu.transport.multiplexing import MultiplexerSocket
+from fluvio_tpu.transport.socket import FluvioSocket, connect
+
+
+class VersionMismatch(Exception):
+    def __init__(self, api_key: int):
+        super().__init__(f"server does not support api key {api_key}")
+        self.api_key = api_key
+
+
+class VersionedSerialSocket:
+    """Multiplexer + negotiated version table."""
+
+    def __init__(self, multiplexer: MultiplexerSocket, versions: ApiVersionsResponse):
+        self.multiplexer = multiplexer
+        self.versions = versions
+
+    @classmethod
+    async def connect(cls, addr: str) -> "VersionedSerialSocket":
+        socket = await connect(addr)
+        return await cls.from_socket(socket)
+
+    @classmethod
+    async def from_socket(cls, socket: FluvioSocket) -> "VersionedSerialSocket":
+        multiplexer = MultiplexerSocket(socket)
+        versions = await multiplexer.send_and_receive(ApiVersionsRequest())
+        return cls(multiplexer, versions)
+
+    def lookup_version(self, request: ApiRequest) -> int:
+        v = self.versions.lookup_version(request.API_KEY)
+        if v is None:
+            raise VersionMismatch(request.API_KEY)
+        return min(v, request.MAX_API_VERSION)
+
+    async def send_receive(self, request: ApiRequest):
+        return await self.multiplexer.send_and_receive(
+            request, self.lookup_version(request)
+        )
+
+    async def create_stream(self, request: ApiRequest, queue_len: int = 10):
+        return await self.multiplexer.create_stream(
+            request, self.lookup_version(request), queue_len
+        )
+
+    async def send_async(self, request: ApiRequest) -> int:
+        return await self.multiplexer.send_async(request, self.lookup_version(request))
+
+    @property
+    def is_stale(self) -> bool:
+        return self.multiplexer.is_stale
+
+    async def close(self) -> None:
+        await self.multiplexer.close()

@@ -1,0 +1,401 @@
+"""Single-process integration tests: real server, real sockets, temp storage.
+
+Mirrors the reference's SPU test pattern (fluvio-spu/src/services/public/
+tests/{stream_fetch.rs,produce.rs}): boot the public server on a random
+localhost port with a FileReplica in a temp dir, drive it with the real
+client over real TCP, covering produce/fetch/stream-fetch, SmartModule
+chains on both paths, isolation, acks, and error propagation.
+"""
+
+import asyncio
+
+import pytest
+
+from fluvio_tpu.client import (
+    ConsumerConfig,
+    Fluvio,
+    Offset,
+    ProducerConfig,
+)
+from fluvio_tpu.protocol.error import ErrorCode, FluvioError
+from fluvio_tpu.schema.smartmodule import (
+    SmartModuleInvocation,
+    SmartModuleInvocationKind,
+    SmartModuleInvocationWasm,
+)
+from fluvio_tpu.schema.spu import FetchRequest, Isolation
+from fluvio_tpu.spu import SpuConfig, SpuServer
+from fluvio_tpu.storage.config import ReplicaConfig
+
+FILTER_SM = b"""
+@smartmodule.filter(dsl=dsl.FilterProgram(
+    predicate=dsl.Contains(arg=dsl.Value(), literal=b"keep")))
+def fil(record):
+    return b"keep" in record.value
+"""
+
+UPPER_MAP_SM = b"""
+@smartmodule.map(dsl=dsl.MapProgram(value=dsl.Upper(arg=dsl.Value())))
+def m(record):
+    return record.value.upper()
+"""
+
+ERROR_SM = b"""
+@smartmodule.map
+def m(record):
+    if record.value == b"boom":
+        raise ValueError("exploded")
+    return record.value
+"""
+
+
+def adhoc(payload: bytes, **kw) -> SmartModuleInvocation:
+    return SmartModuleInvocation(
+        wasm=SmartModuleInvocationWasm.adhoc(payload), **kw
+    )
+
+
+@pytest.fixture()
+def spu(tmp_path):
+    """A running SPU with one replica, plus a loop to drive the tests."""
+    loop = asyncio.new_event_loop()
+    config = SpuConfig(
+        id=5001,
+        public_addr="127.0.0.1:0",
+        log_base_dir=str(tmp_path),
+        replication=ReplicaConfig(base_dir=str(tmp_path)),
+    )
+    config.smart_engine.backend = "auto"
+    server = SpuServer(config)
+
+    async def boot():
+        await server.start()
+        server.ctx.create_replica("topic", 0)
+
+    loop.run_until_complete(boot())
+    try:
+        yield server, loop
+    finally:
+        loop.run_until_complete(server.stop())
+        loop.close()
+
+
+async def produce_values(addr, values, topic="topic", config=None):
+    client = await Fluvio.connect(addr)
+    producer = await client.topic_producer(topic, config=config)
+    futs = [await producer.send(None, v) for v in values]
+    await producer.flush()
+    metas = [await f.wait() for f in futs]
+    await producer.close()
+    await client.close()
+    return metas
+
+
+async def consume_values(addr, offset=None, topic="topic", config=None):
+    client = await Fluvio.connect(addr)
+    consumer = await client.partition_consumer(topic, 0)
+    config = config or ConsumerConfig(disable_continuous=True)
+    out = []
+    async for record in consumer.stream(offset or Offset.beginning(), config):
+        out.append(record)
+    await client.close()
+    return out
+
+
+class TestProduceConsume:
+    def test_roundtrip(self, spu):
+        server, loop = spu
+        values = [f"message-{i}".encode() for i in range(100)]
+
+        async def run():
+            metas = await produce_values(server.public_addr, values)
+            assert [m.offset for m in metas] == list(range(100))
+            records = await consume_values(server.public_addr)
+            assert [r.value for r in records] == values
+            assert [r.offset for r in records] == list(range(100))
+
+        loop.run_until_complete(run())
+
+    def test_produce_with_keys(self, spu):
+        server, loop = spu
+
+        async def run():
+            client = await Fluvio.connect(server.public_addr)
+            producer = await client.topic_producer("topic")
+            fut = await producer.send(b"k1", b"v1")
+            await producer.flush()
+            meta = await fut.wait()
+            assert meta.offset == 0
+            records = await consume_values(server.public_addr)
+            assert records[0].key == b"k1"
+            assert records[0].value == b"v1"
+            await producer.close()
+            await client.close()
+
+        loop.run_until_complete(run())
+
+    def test_consume_from_absolute_offset(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(
+                server.public_addr, [f"m{i}".encode() for i in range(10)]
+            )
+            records = await consume_values(
+                server.public_addr, offset=Offset.absolute(7)
+            )
+            assert [r.value for r in records] == [b"m7", b"m8", b"m9"]
+
+        loop.run_until_complete(run())
+
+    def test_consume_from_end_sees_only_new(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(server.public_addr, [b"old-1", b"old-2"])
+
+            client = await Fluvio.connect(server.public_addr)
+            consumer = await client.partition_consumer("topic", 0)
+            received = []
+
+            async def consume_two():
+                async for rec in consumer.stream(
+                    Offset.end(), ConsumerConfig()
+                ):
+                    received.append(rec.value)
+                    if len(received) == 2:
+                        break
+
+            task = asyncio.ensure_future(consume_two())
+            await asyncio.sleep(0.1)
+            await produce_values(server.public_addr, [b"new-1", b"new-2"])
+            await asyncio.wait_for(task, timeout=5)
+            assert received == [b"new-1", b"new-2"]
+            await client.close()
+
+        loop.run_until_complete(run())
+
+    def test_multiple_produce_rounds_accumulate(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(server.public_addr, [b"a"])
+            await produce_values(server.public_addr, [b"b", b"c"])
+            records = await consume_values(server.public_addr)
+            assert [r.value for r in records] == [b"a", b"b", b"c"]
+            assert [r.offset for r in records] == [0, 1, 2]
+
+        loop.run_until_complete(run())
+
+    def test_fetch_offsets(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(server.public_addr, [b"x"] * 5)
+            client = await Fluvio.connect(server.public_addr)
+            consumer = await client.partition_consumer("topic", 0)
+            offsets = await consumer.fetch_offsets()
+            assert offsets.start_offset == 0
+            assert offsets.leo == 5
+            assert offsets.hw == 5  # rf=1: HW advances with LEO
+            await client.close()
+
+        loop.run_until_complete(run())
+
+    def test_one_shot_fetch(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(server.public_addr, [b"f1", b"f2"])
+            from fluvio_tpu.transport.versioned import VersionedSerialSocket
+
+            sock = await VersionedSerialSocket.connect(server.public_addr)
+            resp = await sock.send_receive(
+                FetchRequest(topic="topic", partition=0, fetch_offset=0)
+            )
+            assert resp.partition.error_code == ErrorCode.NONE
+            values = [
+                r.value
+                for b in resp.partition.records.batches
+                for r in b.memory_records()
+            ]
+            assert values == [b"f1", b"f2"]
+            await sock.close()
+
+        loop.run_until_complete(run())
+
+    def test_unknown_partition_errors(self, spu):
+        server, loop = spu
+
+        async def run():
+            with pytest.raises(FluvioError) as e:
+                await consume_values(server.public_addr, topic="nope")
+            assert e.value.code == ErrorCode.NOT_LEADER_FOR_PARTITION
+
+        loop.run_until_complete(run())
+
+
+class TestSmartModuleStreams:
+    def test_consume_with_filter(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(
+                server.public_addr,
+                [b"keep-1", b"drop-1", b"keep-2", b"drop-2", b"keep-3"],
+            )
+            config = ConsumerConfig(
+                disable_continuous=True,
+                smartmodules=[adhoc(FILTER_SM, kind=SmartModuleInvocationKind.FILTER)],
+            )
+            records = await consume_values(server.public_addr, config=config)
+            assert [r.value for r in records] == [b"keep-1", b"keep-2", b"keep-3"]
+
+        loop.run_until_complete(run())
+
+    def test_consume_with_filter_map_chain(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(server.public_addr, [b"keep-a", b"drop", b"keep-b"])
+            config = ConsumerConfig(
+                disable_continuous=True,
+                smartmodules=[
+                    adhoc(FILTER_SM, kind=SmartModuleInvocationKind.FILTER),
+                    adhoc(UPPER_MAP_SM, kind=SmartModuleInvocationKind.MAP),
+                ],
+            )
+            records = await consume_values(server.public_addr, config=config)
+            assert [r.value for r in records] == [b"KEEP-A", b"KEEP-B"]
+
+        loop.run_until_complete(run())
+
+    def test_predefined_smartmodule_resolution(self, spu):
+        server, loop = spu
+        server.ctx.smartmodules.insert("my-filter", FILTER_SM)
+
+        async def run():
+            await produce_values(server.public_addr, [b"keep", b"drop"])
+            config = ConsumerConfig(
+                disable_continuous=True,
+                smartmodules=[
+                    SmartModuleInvocation(
+                        wasm=SmartModuleInvocationWasm.predefined("my-filter")
+                    )
+                ],
+            )
+            records = await consume_values(server.public_addr, config=config)
+            assert [r.value for r in records] == [b"keep"]
+
+        loop.run_until_complete(run())
+
+    def test_missing_predefined_module_errors(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(server.public_addr, [b"x"])
+            config = ConsumerConfig(
+                disable_continuous=True,
+                smartmodules=[
+                    SmartModuleInvocation(
+                        wasm=SmartModuleInvocationWasm.predefined("ghost")
+                    )
+                ],
+            )
+            with pytest.raises(FluvioError) as e:
+                await consume_values(server.public_addr, config=config)
+            assert e.value.code == ErrorCode.SMARTMODULE_NOT_FOUND
+
+        loop.run_until_complete(run())
+
+    def test_transform_error_propagates(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(server.public_addr, [b"fine", b"boom", b"after"])
+            config = ConsumerConfig(
+                disable_continuous=True,
+                smartmodules=[adhoc(ERROR_SM, kind=SmartModuleInvocationKind.MAP)],
+            )
+            with pytest.raises(FluvioError) as e:
+                await consume_values(server.public_addr, config=config)
+            assert e.value.code == ErrorCode.SMARTMODULE_RUNTIME_ERROR
+            assert "exploded" in e.value.message
+
+        loop.run_until_complete(run())
+
+    def test_producer_side_smartmodule(self, spu):
+        server, loop = spu
+
+        async def run():
+            config = ProducerConfig(
+                smartmodules=[adhoc(UPPER_MAP_SM, kind=SmartModuleInvocationKind.MAP)]
+            )
+            await produce_values(server.public_addr, [b"abc", b"def"], config=config)
+            records = await consume_values(server.public_addr)
+            assert [r.value for r in records] == [b"ABC", b"DEF"]
+
+        loop.run_until_complete(run())
+
+
+class TestIsolation:
+    def test_read_committed_produce(self, spu):
+        server, loop = spu
+
+        async def run():
+            config = ProducerConfig(isolation=Isolation.READ_COMMITTED)
+            metas = await produce_values(server.public_addr, [b"c1"], config=config)
+            assert metas[0].offset == 0
+            records = await consume_values(
+                server.public_addr,
+                config=ConsumerConfig(
+                    disable_continuous=True, isolation=Isolation.READ_COMMITTED
+                ),
+            )
+            assert [r.value for r in records] == [b"c1"]
+
+        loop.run_until_complete(run())
+
+
+class TestMultiplexing:
+    def test_concurrent_serial_requests(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(server.public_addr, [b"m"] * 3)
+            from fluvio_tpu.schema.spu import FetchOffsetsRequest
+            from fluvio_tpu.transport.versioned import VersionedSerialSocket
+
+            sock = await VersionedSerialSocket.connect(server.public_addr)
+            results = await asyncio.gather(
+                *(
+                    sock.send_receive(
+                        FetchOffsetsRequest(topic="topic", partition=0)
+                    )
+                    for _ in range(20)
+                )
+            )
+            assert all(r.leo == 3 for r in results)
+            await sock.close()
+
+        loop.run_until_complete(run())
+
+    def test_stream_and_serial_share_connection(self, spu):
+        server, loop = spu
+
+        async def run():
+            await produce_values(server.public_addr, [b"s1", b"s2"])
+            client = await Fluvio.connect(server.public_addr)
+            consumer = await client.partition_consumer("topic", 0)
+            # stream + a serial offsets request on the same multiplexer
+            records = []
+            async for rec in consumer.stream(
+                Offset.beginning(), ConsumerConfig(disable_continuous=True)
+            ):
+                records.append(rec)
+                offsets = await consumer.fetch_offsets()
+                assert offsets.leo == 2
+            assert len(records) == 2
+            await client.close()
+
+        loop.run_until_complete(run())

@@ -159,6 +159,26 @@ class TestLookback:
         assert [r.value for r in last] == [b"3", b"4", b"5"]
         replica.close()
 
+    def test_read_last_records_age_bound(self, tmp_path):
+        """Lookback::Age{age, last}: drop records older than the floor."""
+        replica = FileReplica("t", 0, 0, make_config(tmp_path))
+        replica.write_recordset(rs(b"old-1", b"old-2", first_timestamp=1_000))
+        replica.write_recordset(rs(b"new-1", b"new-2", first_timestamp=5_000))
+        replica.update_high_watermark_to_end()
+        # age-only (last=0): everything at/after the floor
+        assert [
+            r.value for r in replica.read_last_records(0, min_timestamp=5_000)
+        ] == [b"new-1", b"new-2"]
+        # age + last cap
+        assert [
+            r.value for r in replica.read_last_records(1, min_timestamp=5_000)
+        ] == [b"new-2"]
+        # floor before everything: age bound admits all, count caps
+        assert [
+            r.value for r in replica.read_last_records(3, min_timestamp=0)
+        ] == [b"old-2", b"new-1", b"new-2"]
+        replica.close()
+
 
 class TestCleaner:
     def test_age_retention(self, tmp_path):
