@@ -1,0 +1,48 @@
+"""Control-plane object model (parity: `fluvio-controlplane-metadata`).
+
+Topic / Partition / Spu / SpuGroup / SmartModule / TableFormat specs and
+statuses, shared by the SC, the SPU dispatcher, the admin client, and the
+local metadata store.
+"""
+
+from fluvio_tpu.metadata.topic import (  # noqa: F401
+    CleanupPolicy,
+    Deduplication,
+    ReplicaSpec,
+    TopicResolution,
+    TopicSpec,
+    TopicStatus,
+)
+from fluvio_tpu.metadata.partition import (  # noqa: F401
+    PartitionResolution,
+    PartitionSpec,
+    PartitionStatus,
+    ReplicaStatus,
+)
+from fluvio_tpu.metadata.spu import (  # noqa: F401
+    Endpoint,
+    SpuResolution,
+    SpuSpec,
+    SpuStatus,
+)
+from fluvio_tpu.metadata.spg import SpuGroupSpec, SpuGroupStatus  # noqa: F401
+from fluvio_tpu.metadata.smartmodule import (  # noqa: F401
+    SmartModuleArtifact,
+    SmartModuleSpec,
+    SmartModuleStatus,
+)
+from fluvio_tpu.metadata.tableformat import (  # noqa: F401
+    TableFormatSpec,
+    TableFormatStatus,
+)
+
+ALL_SPECS = [
+    TopicSpec,
+    PartitionSpec,
+    SpuSpec,
+    SpuGroupSpec,
+    SmartModuleSpec,
+    TableFormatSpec,
+]
+
+SPEC_BY_KIND = {spec.KIND: spec for spec in ALL_SPECS}

@@ -1,0 +1,98 @@
+"""MetadataDispatcher: backend <-> StoreContext reconcile loop.
+
+Capability parity: fluvio-stream-dispatcher/src/dispatcher/metadata.rs:28-120
+— one dispatcher task per spec type: (a) full resync from the backend at
+startup and every reconciliation interval, (b) wake on backend change
+hints, (c) drain the StoreContext's write-intent actions back into the
+backend. Controllers only ever talk to the StoreContext; durability is
+the dispatcher's job.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+from fluvio_tpu.metadata.client import MetadataClient
+from fluvio_tpu.stream_model.store import StoreContext
+
+logger = logging.getLogger(__name__)
+
+# parity: FLV_SC_RECONCILIATION_INTERVAL, default 300s
+RECONCILIATION_INTERVAL = float(os.environ.get("FLV_SC_RECONCILIATION_INTERVAL", "300"))
+
+
+class MetadataDispatcher:
+    def __init__(
+        self,
+        client: MetadataClient,
+        ctx: StoreContext,
+        reconcile_interval: Optional[float] = None,
+    ):
+        self.client = client
+        self.ctx = ctx
+        self.spec_type = ctx.spec_type
+        self.interval = (
+            RECONCILIATION_INTERVAL if reconcile_interval is None else reconcile_interval
+        )
+        self._task: Optional[asyncio.Task] = None
+        self._writer_task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._watch_loop())
+        self._writer_task = asyncio.ensure_future(self._writer_loop())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in (self._task, self._writer_task):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+
+    async def resync(self) -> None:
+        objects = await self.client.retrieve_items(self.spec_type)
+        self.ctx.store.sync_all(objects)
+
+    async def _watch_loop(self) -> None:
+        try:
+            await self.resync()
+        except Exception:
+            logger.exception("initial resync failed (%s)", self.spec_type.KIND)
+        next_full = asyncio.get_running_loop().time() + self.interval
+        while not self._stopped:
+            try:
+                timeout = max(next_full - asyncio.get_running_loop().time(), 0.01)
+                changed = await self.client.watch_changed(self.spec_type, timeout)
+                if changed or asyncio.get_running_loop().time() >= next_full:
+                    await self.resync()
+                    if asyncio.get_running_loop().time() >= next_full:
+                        next_full = (
+                            asyncio.get_running_loop().time() + self.interval
+                        )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("resync failed (%s)", self.spec_type.KIND)
+                await asyncio.sleep(0.5)
+
+    async def _writer_loop(self) -> None:
+        """Apply controller write-intents back to the backend."""
+        while not self._stopped:
+            action = await self.ctx.next_action()
+            try:
+                if action[0] == "apply":
+                    await self.client.apply(action[1])
+                elif action[0] == "delete":
+                    await self.client.delete_item(self.spec_type, action[1])
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception(
+                    "backend write failed (%s %s)", self.spec_type.KIND, action[0]
+                )

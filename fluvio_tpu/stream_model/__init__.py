@@ -1,0 +1,21 @@
+"""Metadata store core (parity: the `fluvio-stream-model` crate).
+
+Epoch-versioned in-memory object stores with change fencing — the
+substrate every control-plane controller and client metadata mirror sits
+on. `LocalStore` holds `MetadataStoreObject`s (spec + status + key +
+revision) in a `DualEpochMap` that stamps spec-changes and status-changes
+with separate epochs, so listeners can ask "what changed since epoch E"
+and get precise spec/status deltas instead of full resyncs.
+"""
+
+from fluvio_tpu.stream_model.core import (  # noqa: F401
+    MetadataStoreObject,
+    Spec,
+    Status,
+)
+from fluvio_tpu.stream_model.epoch import DualEpochMap, EpochChanges  # noqa: F401
+from fluvio_tpu.stream_model.store import (  # noqa: F401
+    ChangeListener,
+    LocalStore,
+    StoreContext,
+)

@@ -1,0 +1,200 @@
+"""Stream-model + metadata store tests.
+
+Mirrors fluvio-stream-model's dual_epoch_map tests and the
+stream-dispatcher local-backend behavior: epoch fencing semantics,
+listener wakeups, write-intent flow to the YAML backend, resync.
+"""
+
+import asyncio
+
+import pytest
+
+from fluvio_tpu.metadata import (
+    SmartModuleSpec,
+    SpuSpec,
+    TopicResolution,
+    TopicSpec,
+    TopicStatus,
+)
+from fluvio_tpu.metadata.client import InMemoryMetadataClient, LocalMetadataClient
+from fluvio_tpu.metadata.dispatcher import MetadataDispatcher
+from fluvio_tpu.stream_model import (
+    DualEpochMap,
+    LocalStore,
+    MetadataStoreObject,
+    StoreContext,
+)
+
+
+def topic_obj(key: str, partitions: int = 1) -> MetadataStoreObject:
+    return MetadataStoreObject(key=key, spec=TopicSpec.computed(partitions))
+
+
+class TestDualEpochMap:
+    def test_apply_bumps_epoch_and_revision(self):
+        m = DualEpochMap()
+        assert m.epoch == 0
+        assert m.apply(topic_obj("a"))
+        assert m.epoch == 1
+        assert m.get("a").revision == 0
+        # identical re-apply is a no-op
+        assert not m.apply(topic_obj("a"))
+        assert m.epoch == 1
+        # changed spec bumps both
+        assert m.apply(topic_obj("a", partitions=2))
+        assert m.epoch == 2
+        assert m.get("a").revision == 1
+
+    def test_changes_since_spec_vs_status(self):
+        m = DualEpochMap()
+        m.apply(topic_obj("a"))
+        e1 = m.epoch
+        m.update_status("a", TopicStatus(resolution=TopicResolution.PROVISIONED))
+        spec_changes = m.changes_since(e1, "spec")
+        status_changes = m.changes_since(e1, "status")
+        assert spec_changes.updates == []
+        assert [o.key for o in status_changes.updates] == ["a"]
+
+    def test_deletes_and_full_resync_fence(self):
+        m = DualEpochMap()
+        m.apply(topic_obj("a"))
+        m.apply(topic_obj("b"))
+        e = m.epoch
+        m.delete("a")
+        changes = m.changes_since(e)
+        assert changes.deletes == ["a"]
+        assert not changes.is_sync_all
+        # prune past the deletion: older listeners get full resync
+        m.prune_deletions(m.epoch)
+        stale = m.changes_since(e)
+        assert stale.is_sync_all
+        assert [o.key for o in stale.updates] == ["b"]
+
+    def test_sync_all_deletes_absent(self):
+        m = DualEpochMap()
+        m.apply(topic_obj("a"))
+        m.apply(topic_obj("b"))
+        m.sync_all([topic_obj("b"), topic_obj("c")])
+        assert sorted(m.keys()) == ["b", "c"]
+
+
+class TestLocalStore:
+    def test_listener_wakes_on_change(self):
+        async def run():
+            store = LocalStore(TopicSpec)
+            listener = store.change_listener()
+            assert listener.sync_changes().is_sync_all  # initial full sync
+            got = []
+
+            async def wait_change():
+                await listener.listen()
+                got.extend(o.key for o in listener.sync_changes().updates)
+
+            task = asyncio.ensure_future(wait_change())
+            await asyncio.sleep(0.01)
+            store.apply(topic_obj("t1"))
+            await asyncio.wait_for(task, 2)
+            assert got == ["t1"]
+
+        asyncio.run(run())
+
+    def test_wait_action_resolves_on_status(self):
+        async def run():
+            ctx = StoreContext(TopicSpec)
+            await ctx.apply(topic_obj("t"))
+
+            async def provision():
+                await asyncio.sleep(0.02)
+                await ctx.update_status(
+                    "t", TopicStatus(resolution=TopicResolution.PROVISIONED)
+                )
+
+            asyncio.ensure_future(provision())
+            obj = await ctx.wait_action(
+                "t",
+                lambda o: o is not None
+                and o.status.resolution == TopicResolution.PROVISIONED,
+                timeout=2,
+            )
+            assert obj.status.resolution == TopicResolution.PROVISIONED
+
+        asyncio.run(run())
+
+
+class TestLocalMetadataClient:
+    def test_yaml_roundtrip(self, tmp_path):
+        async def run():
+            client = LocalMetadataClient(str(tmp_path))
+            await client.apply(topic_obj("events", partitions=3))
+            await client.apply(
+                MetadataStoreObject(
+                    key="filt",
+                    spec=SmartModuleSpec.from_source(b"x = 1", "filt"),
+                )
+            )
+            topics = await client.retrieve_items(TopicSpec)
+            assert len(topics) == 1
+            assert topics[0].spec.replicas.partitions == 3
+            sms = await client.retrieve_items(SmartModuleSpec)
+            assert sms[0].spec.artifact.payload == b"x = 1"
+            await client.delete_item(TopicSpec, "events")
+            assert await client.retrieve_items(TopicSpec) == []
+
+        asyncio.run(run())
+
+    def test_watch_detects_writes(self, tmp_path):
+        async def run():
+            client = LocalMetadataClient(str(tmp_path))
+            await client.watch_changed(TopicSpec, 0.01)  # prime mtime
+            changed = await client.watch_changed(TopicSpec, 0.05)
+            assert not changed
+            await client.apply(topic_obj("t"))
+            assert await client.watch_changed(TopicSpec, 1.0)
+
+        asyncio.run(run())
+
+
+class TestDispatcher:
+    def test_resync_and_writeback(self, tmp_path):
+        async def run():
+            client = LocalMetadataClient(str(tmp_path))
+            await client.apply(topic_obj("pre-existing"))
+            ctx = StoreContext(TopicSpec)
+            dispatcher = MetadataDispatcher(client, ctx, reconcile_interval=60)
+            dispatcher.start()
+            # startup resync pulls the pre-existing object
+            obj = await ctx.wait_action(
+                "pre-existing", lambda o: o is not None, timeout=2
+            )
+            assert obj is not None
+            # controller-side apply flows back to the YAML backend
+            await ctx.apply(topic_obj("fresh"))
+            for _ in range(100):
+                if any(
+                    o.key == "fresh" for o in await client.retrieve_items(TopicSpec)
+                ):
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise AssertionError("write-intent never reached backend")
+            await dispatcher.stop()
+
+        asyncio.run(run())
+
+    def test_external_change_propagates(self, tmp_path):
+        async def run():
+            client = LocalMetadataClient(str(tmp_path))
+            ctx = StoreContext(SpuSpec)
+            dispatcher = MetadataDispatcher(client, ctx, reconcile_interval=60)
+            dispatcher.start()
+            await asyncio.sleep(0.05)
+            # an "external" writer (another process) adds an object
+            other = LocalMetadataClient(str(tmp_path))
+            await other.apply(
+                MetadataStoreObject(key="5001", spec=SpuSpec(id=5001))
+            )
+            obj = await ctx.wait_action("5001", lambda o: o is not None, timeout=3)
+            assert obj is not None and obj.spec.id == 5001
+            await dispatcher.stop()
+
+        asyncio.run(run())
