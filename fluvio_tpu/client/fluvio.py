@@ -1,32 +1,51 @@
 """Client entry point (parity: fluvio/src/fluvio.rs `Fluvio::connect`).
 
-Until the SC/control-plane lands, `connect` dials an SPU's public endpoint
-directly and the "pool" is that single connection; the SpuPool interface
-is kept so SC-backed leader routing can slot in.
+Two modes, auto-detected from the endpoint's advertised api keys:
+
+- **SC mode** (the reference architecture): dial the SC public endpoint,
+  start the client-side metadata mirror (admin watch streams), and route
+  each topic/partition to its leader SPU's public address (spu.rs:97).
+- **Direct-SPU mode**: dial one SPU's public endpoint; the pool is that
+  single connection (used by single-broker tests and benches).
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
+from fluvio_tpu.client.admin import FluvioAdmin
 from fluvio_tpu.client.consumer import PartitionConsumer
 from fluvio_tpu.client.producer import ProducerConfig, TopicProducer
+from fluvio_tpu.client.sync import MetadataStores
+from fluvio_tpu.schema.admin import AdminApiKey
 from fluvio_tpu.transport.versioned import VersionedSerialSocket
 
 
 class SpuPool:
     """Leader-routed socket cache (parity: fluvio/src/spu.rs:97,152)."""
 
-    def __init__(self, default_addr: str):
+    def __init__(
+        self,
+        default_addr: Optional[str] = None,
+        metadata: Optional[MetadataStores] = None,
+    ):
         self._default_addr = default_addr
+        self._metadata = metadata
         self._sockets: Dict[str, VersionedSerialSocket] = {}
 
-    def addr_for(self, topic: str, partition: int) -> str:
-        # SC metadata will map partition -> leader SPU; single-SPU for now
+    async def addr_for(self, topic: str, partition: int) -> str:
+        if self._metadata is not None:
+            addr = await self._metadata.wait_for_leader(topic, partition)
+            if addr is not None:
+                return addr
+        if self._default_addr is None:
+            raise ConnectionError(
+                f"no leader known for {topic}-{partition} and no default SPU"
+            )
         return self._default_addr
 
     async def socket_for(self, topic: str, partition: int) -> VersionedSerialSocket:
-        addr = self.addr_for(topic, partition)
+        addr = await self.addr_for(topic, partition)
         sock = self._sockets.get(addr)
         if sock is None or sock.is_stale:
             sock = await VersionedSerialSocket.connect(addr)
@@ -40,23 +59,60 @@ class SpuPool:
 
 
 class Fluvio:
-    def __init__(self, pool: SpuPool):
+    def __init__(
+        self,
+        pool: SpuPool,
+        metadata: Optional[MetadataStores] = None,
+        sc_socket: Optional[VersionedSerialSocket] = None,
+        sc_addr: Optional[str] = None,
+    ):
         self._pool = pool
+        self._metadata = metadata
+        self._sc_socket = sc_socket
+        self._sc_addr = sc_addr
 
     @classmethod
     async def connect(cls, addr: str) -> "Fluvio":
-        """Connect to a cluster (currently: one SPU's public address)."""
-        pool = SpuPool(addr)
-        # eagerly validate connectivity + negotiate versions
-        await pool.socket_for("", 0)
+        """Connect to a cluster: an SC public endpoint or a lone SPU."""
+        socket = await VersionedSerialSocket.connect(addr)
+        if socket.versions.lookup_version(AdminApiKey.CREATE) is not None:
+            metadata = MetadataStores(socket)
+            await metadata.start()
+            return cls(
+                SpuPool(metadata=metadata),
+                metadata=metadata,
+                sc_socket=socket,
+                sc_addr=addr,
+            )
+        await socket.close()
+        pool = SpuPool(default_addr=addr)
+        await pool.socket_for("", 0)  # eager validation + version negotiation
         return cls(pool)
+
+    @property
+    def metadata(self) -> Optional[MetadataStores]:
+        return self._metadata
+
+    async def admin(self) -> FluvioAdmin:
+        if self._sc_addr is None:
+            raise RuntimeError("admin API requires an SC connection")
+        return await FluvioAdmin.connect(self._sc_addr)
 
     async def topic_producer(
         self,
         topic: str,
-        num_partitions: int = 1,
+        num_partitions: Optional[int] = None,
         config: Optional[ProducerConfig] = None,
     ) -> TopicProducer:
+        if num_partitions is None:
+            if self._metadata is not None:
+                count = await self._metadata.wait_partition_count(topic)
+                if count is None:
+                    raise ValueError(f"unknown topic {topic!r}")
+                num_partitions = count
+            else:
+                num_partitions = 1
+
         async def socket_factory(partition: int = 0):
             return await self._pool.socket_for(topic, partition)
 
@@ -67,4 +123,8 @@ class Fluvio:
         return PartitionConsumer(topic, partition, socket)
 
     async def close(self) -> None:
+        if self._metadata is not None:
+            await self._metadata.stop()
         await self._pool.close()
+        if self._sc_socket is not None:
+            await self._sc_socket.close()

@@ -137,7 +137,9 @@ class LocalMetadataClient(MetadataClient):
 
     async def watch_changed(self, spec_type: type, timeout: float) -> bool:
         deadline = asyncio.get_running_loop().time() + timeout
-        poll = min(0.05, timeout)
+        # fast polling only for short (test-style) timeouts; a production
+        # 300s reconcile window polls at 0.5s to keep idle I/O negligible
+        poll = min(0.05 if timeout <= 5 else 0.5, timeout)
         while True:
             m = self._mtime(spec_type)
             if m != self._last_seen.get(spec_type.KIND):

@@ -40,6 +40,7 @@ class MetadataDispatcher:
         self._task: Optional[asyncio.Task] = None
         self._writer_task: Optional[asyncio.Task] = None
         self._stopped = False
+        self._write_inflight = False
 
     def start(self) -> None:
         self._task = asyncio.ensure_future(self._watch_loop())
@@ -56,7 +57,20 @@ class MetadataDispatcher:
                     pass
 
     async def resync(self) -> None:
+        """Full sync backend -> store.
+
+        Deferred while controller write-intents are queued or in flight:
+        sync_all would otherwise transiently delete a freshly-applied
+        object (or resurrect a freshly-deleted one) that the writer loop
+        has not persisted yet, pushing spurious changes to every watcher.
+        """
+        for _ in range(200):
+            if self.ctx.pending_actions() == 0 and not self._write_inflight:
+                break
+            await asyncio.sleep(0.01)
         objects = await self.client.retrieve_items(self.spec_type)
+        if self.ctx.pending_actions() or self._write_inflight:
+            return  # new local writes raced the read; next wake retries
         self.ctx.store.sync_all(objects)
 
     async def _watch_loop(self) -> None:
@@ -85,6 +99,7 @@ class MetadataDispatcher:
         """Apply controller write-intents back to the backend."""
         while not self._stopped:
             action = await self.ctx.next_action()
+            self._write_inflight = True
             try:
                 if action[0] == "apply":
                     await self.client.apply(action[1])
@@ -96,3 +111,5 @@ class MetadataDispatcher:
                 logger.exception(
                     "backend write failed (%s %s)", self.spec_type.KIND, action[0]
                 )
+            finally:
+                self._write_inflight = False

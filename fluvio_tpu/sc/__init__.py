@@ -1,0 +1,12 @@
+"""SC (Streaming Controller): the control plane.
+
+Capability parity: `fluvio-sc` — metadata stores per spec, topic /
+partition / SPU controllers, the rack-aware partition scheduler, the
+public admin API (Create/Delete/List/Watch), and the private API the
+SPUs register with and receive metadata pushes from.
+"""
+
+from fluvio_tpu.sc.context import ScContext
+from fluvio_tpu.sc.start import ScConfig, ScServer
+
+__all__ = ["ScContext", "ScConfig", "ScServer"]

@@ -1,0 +1,10 @@
+from fluvio_tpu.sc.controllers.partitions import PartitionController
+from fluvio_tpu.sc.controllers.spus import SpuController
+from fluvio_tpu.sc.controllers.topics import TopicController, validate_topic_spec
+
+__all__ = [
+    "TopicController",
+    "PartitionController",
+    "SpuController",
+    "validate_topic_spec",
+]

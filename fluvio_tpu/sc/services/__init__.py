@@ -1,0 +1,4 @@
+from fluvio_tpu.sc.services.public_service import ScPublicService
+from fluvio_tpu.sc.services.private_service import ScPrivateService
+
+__all__ = ["ScPublicService", "ScPrivateService"]

@@ -1,0 +1,99 @@
+"""SC process assembly (parity: fluvio-sc/src/{start.rs:22-62,init.rs:22-108}).
+
+Boot order mirrors the reference: metadata dispatchers (when a durable
+backend is configured) -> controllers -> private server -> public server.
+Run modes: in-memory (tests / read-only), local (YAML-file metadata dir).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from fluvio_tpu.metadata.client import (
+    InMemoryMetadataClient,
+    LocalMetadataClient,
+    MetadataClient,
+)
+from fluvio_tpu.metadata.dispatcher import MetadataDispatcher
+from fluvio_tpu.sc.context import ScContext
+from fluvio_tpu.sc.controllers import (
+    PartitionController,
+    SpuController,
+    TopicController,
+)
+from fluvio_tpu.sc.services import ScPrivateService, ScPublicService
+from fluvio_tpu.transport.service import FluvioApiServer
+
+DEFAULT_PUBLIC_PORT = 9003
+DEFAULT_PRIVATE_PORT = 9004
+
+
+@dataclass
+class ScConfig:
+    public_addr: str = "127.0.0.1:0"
+    private_addr: str = "127.0.0.1:0"
+    # None = in-memory metadata; a directory = local YAML-backed metadata
+    metadata_dir: Optional[str] = None
+    reconcile_interval: Optional[float] = None
+
+
+class ScServer:
+    def __init__(self, config: ScConfig = None):
+        self.config = config or ScConfig()
+        self.ctx = ScContext()
+        self.metadata_client: Optional[MetadataClient] = None
+        self.dispatchers: List[MetadataDispatcher] = []
+        if self.config.metadata_dir is not None:
+            self.metadata_client = LocalMetadataClient(self.config.metadata_dir)
+        self.topic_controller = TopicController(self.ctx)
+        self.partition_controller = PartitionController(self.ctx)
+        self.spu_controller = SpuController(self.ctx)
+        self.public_server = FluvioApiServer(
+            self.config.public_addr, ScPublicService(), self.ctx
+        )
+        self.private_server = FluvioApiServer(
+            self.config.private_addr, ScPrivateService(), self.ctx
+        )
+
+    @property
+    def public_addr(self) -> str:
+        return self.public_server.local_addr
+
+    @property
+    def private_addr(self) -> str:
+        return self.private_server.local_addr
+
+    async def start(self) -> None:
+        if self.metadata_client is not None:
+            for store in (
+                self.ctx.topics,
+                self.ctx.partitions,
+                self.ctx.spus,
+                self.ctx.spgs,
+                self.ctx.smartmodules,
+                self.ctx.tableformats,
+            ):
+                d = MetadataDispatcher(
+                    self.metadata_client,
+                    store,
+                    reconcile_interval=self.config.reconcile_interval,
+                )
+                await d.resync()  # load durable state before controllers run
+                d.start()
+                self.dispatchers.append(d)
+        self.topic_controller.start()
+        self.partition_controller.start()
+        self.spu_controller.start()
+        await self.private_server.start()
+        await self.public_server.start()
+
+    async def stop(self) -> None:
+        await self.public_server.stop()
+        await self.private_server.stop()
+        await self.topic_controller.stop()
+        await self.partition_controller.stop()
+        await self.spu_controller.stop()
+        for d in self.dispatchers:
+            await d.stop()
+        self.dispatchers.clear()

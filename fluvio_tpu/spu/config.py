@@ -20,6 +20,7 @@ class SpuConfig:
     id: SpuId = 0
     public_addr: str = f"0.0.0.0:{SPU_PUBLIC_PORT}"
     private_addr: str = ""
+    sc_addr: str = ""  # SC private endpoint; "" = standalone broker
     log_base_dir: str = "/tmp/fluvio-tpu"
     replication: ReplicaConfig = field(default_factory=ReplicaConfig)
     smart_engine: SmartEngineConfig = field(default_factory=SmartEngineConfig)

@@ -1,15 +1,19 @@
 """SPU process assembly (parity: fluvio-spu/src/start.rs:15,66).
 
-Builds the GlobalContext and runs the public API server. The internal
-(follower-sync) server and the SC dispatcher attach here when the
-replication / control-plane layers land.
+Builds the GlobalContext, runs the public API server, and — when an SC
+address is configured — the SC dispatcher (register + metadata pushes +
+LRS reporting). The internal (follower-sync) server attaches with the
+replication layer.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 from fluvio_tpu.spu.config import SpuConfig
 from fluvio_tpu.spu.context import GlobalContext
 from fluvio_tpu.spu.public_service import SpuPublicService
+from fluvio_tpu.spu.sc_dispatcher import ScDispatcher
 from fluvio_tpu.transport.service import FluvioApiServer
 
 
@@ -20,6 +24,9 @@ class SpuServer:
         self.public_server = FluvioApiServer(
             config.public_addr, SpuPublicService(), self.ctx
         )
+        self.sc_dispatcher: Optional[ScDispatcher] = (
+            ScDispatcher(self.ctx, config.sc_addr) if config.sc_addr else None
+        )
 
     @property
     def public_addr(self) -> str:
@@ -27,10 +34,14 @@ class SpuServer:
 
     async def start(self) -> None:
         await self.public_server.start()
+        if self.sc_dispatcher is not None:
+            self.sc_dispatcher.start()
 
     async def run(self) -> None:
         await self.public_server.run()
 
     async def stop(self) -> None:
+        if self.sc_dispatcher is not None:
+            await self.sc_dispatcher.stop()
         await self.public_server.stop()
         self.ctx.close()

@@ -83,8 +83,13 @@ def _coerce(hint: Any, value: Any) -> Any:
         return tuple(out) if origin is tuple else out
     if origin is dict:
         args = typing.get_args(hint)
+        kt = args[0] if len(args) == 2 else Any
         vt = args[1] if len(args) == 2 else Any
-        return {k: _coerce(vt, v) for k, v in value.items()}
+        # JSON object keys are always strings; restore int-keyed maps
+        def _key(k):
+            return int(k) if kt is int and isinstance(k, str) else k
+
+        return {_key(k): _coerce(vt, v) for k, v in value.items()}
     if isinstance(hint, type):
         if dataclasses.is_dataclass(hint):
             return _from_plain(hint, value)

@@ -111,12 +111,19 @@ class DualEpochMap(Generic[S]):
         entry.status_epoch = self._epoch
         return True
 
+    MAX_DELETIONS = 1024  # auto-prune bound; older listeners full-resync
+
     def delete(self, key: str) -> bool:
         if key not in self._entries:
             return False
         self._epoch += 1
         del self._entries[key]
         self._deletions.append((self._epoch, key))
+        if len(self._deletions) > self.MAX_DELETIONS:
+            # keep the newer half; listeners older than the horizon get
+            # a full sync from changes_since
+            mid_epoch = self._deletions[len(self._deletions) // 2][0]
+            self.prune_deletions(mid_epoch)
         return True
 
     def sync_all(self, objects: List[MetadataStoreObject[S]]) -> bool:

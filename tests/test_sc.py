@@ -1,0 +1,484 @@
+"""Control-plane tests: scheduler, topic policy, controllers, admin e2e.
+
+Mirrors the reference's test strategy (SURVEY.md §4): unit tests for the
+scheduler/policy/reducer logic (fluvio-sc topic controller tests), plus a
+single-process integration tier booting a real SC + SPU on localhost and
+driving them through the real admin client (stream_fetch.rs-style, but
+for the control plane).
+"""
+
+import asyncio
+
+import pytest
+
+from fluvio_tpu.client.admin import AdminError, FluvioAdmin
+from fluvio_tpu.client.consumer import ConsumerConfig
+from fluvio_tpu.client.fluvio import Fluvio
+from fluvio_tpu.client.offset import Offset
+from fluvio_tpu.metadata.partition import (
+    PartitionResolution,
+    PartitionSpec,
+    partition_key,
+)
+from fluvio_tpu.metadata.spu import Endpoint, SpuSpec, SpuStatus, SpuResolution
+from fluvio_tpu.metadata.topic import (
+    PartitionMap,
+    ReplicaSpec,
+    TopicResolution,
+    TopicSpec,
+)
+from fluvio_tpu.sc import ScConfig, ScContext, ScServer
+from fluvio_tpu.sc.controllers import (
+    PartitionController,
+    SpuController,
+    TopicController,
+    validate_topic_spec,
+)
+from fluvio_tpu.sc.scheduler import (
+    SchedulingError,
+    generate_replica_map,
+    rack_interleaved_order,
+)
+from fluvio_tpu.spu.config import SpuConfig
+from fluvio_tpu.spu.server import SpuServer
+from fluvio_tpu.storage.config import ReplicaConfig
+from fluvio_tpu.stream_model.core import MetadataStoreObject
+
+
+def spus(*ids, racks=None):
+    racks = racks or {}
+    return [SpuSpec(id=i, rack=racks.get(i)) for i in ids]
+
+
+class TestScheduler:
+    def test_round_robin_rotates_leaders(self):
+        rm = generate_replica_map(spus(0, 1, 2), partitions=3, replication_factor=2)
+        assert rm == {0: [0, 1], 1: [1, 2], 2: [2, 0]}
+
+    def test_start_index_offsets_the_rotation(self):
+        rm = generate_replica_map(
+            spus(0, 1, 2), partitions=2, replication_factor=1, start_index=2
+        )
+        assert rm == {0: [2], 1: [0]}
+
+    def test_insufficient_spus_raises(self):
+        with pytest.raises(SchedulingError):
+            generate_replica_map(spus(0), partitions=1, replication_factor=2)
+
+    def test_rack_interleaving_spans_racks(self):
+        order = rack_interleaved_order(
+            spus(0, 1, 2, 3, racks={0: "a", 1: "a", 2: "b", 3: "b"})
+        )
+        assert order == [0, 2, 1, 3]
+        rm = generate_replica_map(
+            spus(0, 1, 2, 3, racks={0: "a", 1: "a", 2: "b", 3: "b"}),
+            partitions=2,
+            replication_factor=2,
+        )
+        for replicas in rm.values():
+            # each replica set spans both racks
+            rack = {0: "a", 1: "a", 2: "b", 3: "b"}
+            assert {rack[r] for r in replicas} == {"a", "b"}
+
+    def test_ignore_rack_uses_id_order(self):
+        rm = generate_replica_map(
+            spus(0, 1, 2, racks={0: "a", 1: "b", 2: "c"}),
+            partitions=1,
+            replication_factor=1,
+            ignore_rack=True,
+        )
+        assert rm == {0: [0]}
+
+
+class TestTopicPolicy:
+    def test_valid_computed(self):
+        assert validate_topic_spec("t1", TopicSpec.computed(3)) is None
+
+    def test_bad_name(self):
+        assert validate_topic_spec("bad name!", TopicSpec.computed(1)) is not None
+        assert validate_topic_spec("", TopicSpec.computed(1)) is not None
+        assert validate_topic_spec("-lead", TopicSpec.computed(1)) is not None
+
+    def test_bad_partitions(self):
+        assert validate_topic_spec("t", TopicSpec.computed(0)) is not None
+
+    def test_assigned_must_be_contiguous(self):
+        spec = TopicSpec(
+            replicas=ReplicaSpec.assigned([PartitionMap(id=1, replicas=[0])])
+        )
+        assert "contiguous" in validate_topic_spec("t", spec)
+
+    def test_assigned_duplicate_replicas(self):
+        spec = TopicSpec(
+            replicas=ReplicaSpec.assigned([PartitionMap(id=0, replicas=[1, 1])])
+        )
+        assert "duplicate" in validate_topic_spec("t", spec)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def add_spu(ctx: ScContext, spu_id: int, online: bool = True) -> None:
+    await ctx.spus.apply(
+        MetadataStoreObject(key=str(spu_id), spec=SpuSpec(id=spu_id))
+    )
+    if online:
+        await ctx.spus.update_status(
+            str(spu_id), SpuStatus(resolution=SpuResolution.ONLINE)
+        )
+
+
+class TestTopicController:
+    def test_provisions_topic_and_creates_partitions(self):
+        async def body():
+            ctx = ScContext()
+            await add_spu(ctx, 0)
+            await add_spu(ctx, 1)
+            await ctx.topics.apply(
+                MetadataStoreObject(key="t1", spec=TopicSpec.computed(2, 2))
+            )
+            tc = TopicController(ctx)
+            await tc.sync_once()
+            obj = ctx.topics.store.value("t1")
+            assert obj.status.resolution == TopicResolution.PROVISIONED
+            assert set(obj.status.replica_map) == {0, 1}
+            p0 = ctx.partitions.store.value(partition_key("t1", 0))
+            assert p0 is not None
+            assert p0.spec.leader == obj.status.replica_map[0][0]
+            assert len(p0.spec.replicas) == 2
+
+        run(body())
+
+    def test_pending_without_spus_then_provisioned(self):
+        async def body():
+            ctx = ScContext()
+            await ctx.topics.apply(
+                MetadataStoreObject(key="t1", spec=TopicSpec.computed(1, 1))
+            )
+            tc = TopicController(ctx)
+            await tc.sync_once()
+            assert (
+                ctx.topics.store.value("t1").status.resolution
+                == TopicResolution.PENDING
+            )
+            await add_spu(ctx, 0)
+            await tc.sync_once()
+            assert (
+                ctx.topics.store.value("t1").status.resolution
+                == TopicResolution.PROVISIONED
+            )
+
+        run(body())
+
+    def test_invalid_config_is_final(self):
+        async def body():
+            ctx = ScContext()
+            await ctx.topics.apply(
+                MetadataStoreObject(key="t1", spec=TopicSpec.computed(0))
+            )
+            tc = TopicController(ctx)
+            await tc.sync_once()
+            assert (
+                ctx.topics.store.value("t1").status.resolution
+                == TopicResolution.INVALID_CONFIG
+            )
+
+        run(body())
+
+    def test_assigned_map_used_verbatim(self):
+        async def body():
+            ctx = ScContext()
+            await add_spu(ctx, 7)
+            spec = TopicSpec(
+                replicas=ReplicaSpec.assigned([PartitionMap(id=0, replicas=[7])])
+            )
+            await ctx.topics.apply(MetadataStoreObject(key="t1", spec=spec))
+            tc = TopicController(ctx)
+            await tc.sync_once()
+            assert ctx.topics.store.value("t1").status.replica_map == {0: [7]}
+
+        run(body())
+
+
+class TestPartitionController:
+    def test_election_on_leader_offline(self):
+        async def body():
+            ctx = ScContext()
+            await add_spu(ctx, 0)
+            await add_spu(ctx, 1)
+            key = partition_key("t1", 0)
+            await ctx.partitions.apply(
+                MetadataStoreObject(
+                    key=key, spec=PartitionSpec(leader=0, replicas=[0, 1])
+                )
+            )
+            pc = PartitionController(ctx)
+            await pc.sync_once()
+            assert (
+                ctx.partitions.store.value(key).status.resolution
+                == PartitionResolution.ONLINE
+            )
+            # leader goes down -> follower 1 takes over
+            await ctx.spus.update_status(
+                "0", SpuStatus(resolution=SpuResolution.OFFLINE)
+            )
+            await pc.sync_once()
+            obj = ctx.partitions.store.value(key)
+            assert obj.spec.leader == 1
+            assert obj.status.resolution == PartitionResolution.ELECTION_LEADER_FOUND
+            await pc.sync_once()
+            assert (
+                ctx.partitions.store.value(key).status.resolution
+                == PartitionResolution.ONLINE
+            )
+
+        run(body())
+
+    def test_no_live_replica_goes_leader_offline(self):
+        async def body():
+            ctx = ScContext()
+            await add_spu(ctx, 0, online=False)
+            key = partition_key("t1", 0)
+            await ctx.partitions.apply(
+                MetadataStoreObject(key=key, spec=PartitionSpec(leader=0, replicas=[0]))
+            )
+            pc = PartitionController(ctx)
+            await pc.sync_once()
+            assert (
+                ctx.partitions.store.value(key).status.resolution
+                == PartitionResolution.LEADER_OFFLINE
+            )
+
+        run(body())
+
+
+class TestSpuController:
+    def test_health_flips_status(self):
+        async def body():
+            ctx = ScContext()
+            await ctx.spus.apply(MetadataStoreObject(key="3", spec=SpuSpec(id=3)))
+            sc = SpuController(ctx)
+            await sc.sync_once()
+            assert (
+                ctx.spus.store.value("3").status.resolution == SpuResolution.OFFLINE
+            )
+            ctx.health.update(3, True)
+            await sc.sync_once()
+            assert ctx.spus.store.value("3").status.resolution == SpuResolution.ONLINE
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# Integration: real SC + SPU + admin client on localhost
+# ---------------------------------------------------------------------------
+
+
+async def boot_cluster(tmp_path, n_spus=1, metadata_dir=None):
+    """SC + n SPUs wired through the private API, fully registered."""
+    sc = ScServer(
+        ScConfig(metadata_dir=str(metadata_dir) if metadata_dir else None)
+    )
+    await sc.start()
+    admin = await FluvioAdmin.connect(sc.public_addr)
+    spu_servers = []
+    for i in range(n_spus):
+        spu_id = 5000 + i
+        config = SpuConfig(
+            id=spu_id,
+            public_addr="127.0.0.1:0",
+            log_base_dir=str(tmp_path / f"spu-{spu_id}"),
+            replication=ReplicaConfig(base_dir=str(tmp_path / f"spu-{spu_id}")),
+            sc_addr=sc.private_addr,
+        )
+        server = SpuServer(config)
+        await server.start()
+        await admin.register_custom_spu(spu_id, server.public_addr)
+        spu_servers.append(server)
+    # every SPU online from the SC's perspective
+    for i in range(n_spus):
+        await sc.ctx.spus.wait_action(
+            str(5000 + i), lambda o: o is not None and o.status.is_online(), timeout=5
+        )
+    return sc, admin, spu_servers
+
+
+async def shutdown_cluster(sc, admin, spu_servers):
+    await admin.close()
+    for s in spu_servers:
+        await s.stop()
+    await sc.stop()
+
+
+class TestAdminE2E:
+    def test_create_topic_provisions_spu_replica(self, tmp_path):
+        async def body():
+            sc, admin, spus_ = await boot_cluster(tmp_path)
+            try:
+                await admin.create_topic("events", TopicSpec.computed(1))
+                topics = await admin.list_topics()
+                assert [t.key for t in topics] == ["events"]
+                assert topics[0].status.resolution == TopicResolution.PROVISIONED
+                # SPU picks up the replica through the push stream
+                spu = spus_[0]
+                for _ in range(100):
+                    if spu.ctx.leader_for("events", 0) is not None:
+                        break
+                    await asyncio.sleep(0.05)
+                assert spu.ctx.leader_for("events", 0) is not None
+            finally:
+                await shutdown_cluster(sc, admin, spus_)
+
+        run(body())
+
+    def test_duplicate_topic_rejected(self, tmp_path):
+        async def body():
+            sc, admin, spus_ = await boot_cluster(tmp_path)
+            try:
+                await admin.create_topic("t")
+                with pytest.raises(AdminError):
+                    await admin.create_topic("t")
+                with pytest.raises(AdminError):
+                    await admin.create_topic("bad topic!")
+            finally:
+                await shutdown_cluster(sc, admin, spus_)
+
+        run(body())
+
+    def test_delete_topic_cascades_partitions(self, tmp_path):
+        async def body():
+            sc, admin, spus_ = await boot_cluster(tmp_path)
+            try:
+                await admin.create_topic("gone", TopicSpec.computed(2))
+                assert len(await admin.list("partition")) == 2
+                await admin.delete_topic("gone")
+                assert await admin.list_topics() == []
+                assert await admin.list("partition") == []
+                # SPU drops the replicas on the next sync
+                spu = spus_[0]
+                for _ in range(100):
+                    if not spu.ctx.leaders:
+                        break
+                    await asyncio.sleep(0.05)
+                assert not spu.ctx.leaders
+            finally:
+                await shutdown_cluster(sc, admin, spus_)
+
+        run(body())
+
+    def test_produce_consume_via_sc_routing(self, tmp_path):
+        async def body():
+            sc, admin, spus_ = await boot_cluster(tmp_path)
+            try:
+                await admin.create_topic("data")
+                client = await Fluvio.connect(sc.public_addr)
+                assert client.metadata is not None
+                producer = await client.topic_producer("data")
+                for i in range(5):
+                    await producer.send(None, f"msg-{i}".encode())
+                await producer.flush()
+                await producer.close()
+                consumer = await client.partition_consumer("data", 0)
+                got = []
+                async for record in consumer.stream(
+                    Offset.beginning(), ConsumerConfig(disable_continuous=True)
+                ):
+                    got.append(bytes(record.value))
+                assert got == [f"msg-{i}".encode() for i in range(5)]
+                await client.close()
+                # LRS report reaches the SC partition status
+                key = partition_key("data", 0)
+                obj = await sc.ctx.partitions.wait_action(
+                    key, lambda o: o is not None and o.status.leader.leo == 5, timeout=5
+                )
+                assert obj.status.leader.leo == 5
+            finally:
+                await shutdown_cluster(sc, admin, spus_)
+
+        run(body())
+
+    def test_smartmodule_push_and_consume(self, tmp_path):
+        async def body():
+            sc, admin, spus_ = await boot_cluster(tmp_path)
+            try:
+                source = (
+                    b"from fluvio_tpu.smartmodule.sdk import smartmodule\n"
+                    b"@smartmodule('filter')\n"
+                    b"def fil(record):\n"
+                    b"    return b'keep' in bytes(record.value)\n"
+                )
+                await admin.create_smartmodule("keeper", source)
+                spu = spus_[0]
+                for _ in range(100):
+                    if spu.ctx.smartmodules.get("keeper") is not None:
+                        break
+                    await asyncio.sleep(0.05)
+                assert spu.ctx.smartmodules.get("keeper") is not None
+            finally:
+                await shutdown_cluster(sc, admin, spus_)
+
+        run(body())
+
+    def test_metadata_survives_sc_restart(self, tmp_path):
+        async def body():
+            meta_dir = tmp_path / "metadata"
+            sc, admin, spus_ = await boot_cluster(
+                tmp_path, metadata_dir=meta_dir
+            )
+            try:
+                await admin.create_topic("durable")
+            finally:
+                await shutdown_cluster(sc, admin, spus_)
+            sc2 = ScServer(ScConfig(metadata_dir=str(meta_dir)))
+            await sc2.start()
+            try:
+                admin2 = await FluvioAdmin.connect(sc2.public_addr)
+                topics = await admin2.list_topics()
+                assert [t.key for t in topics] == ["durable"]
+                await admin2.close()
+            finally:
+                await sc2.stop()
+
+        run(body())
+
+
+class TestElectionE2E:
+    def test_leader_reelection_on_spu_disconnect(self, tmp_path):
+        async def body():
+            sc, admin, spus_ = await boot_cluster(tmp_path, n_spus=2)
+            try:
+                await admin.create_topic("ha", TopicSpec.computed(1, 2))
+                key = partition_key("ha", 0)
+                obj = await sc.ctx.partitions.wait_action(
+                    key,
+                    lambda o: o is not None
+                    and o.status.resolution == PartitionResolution.ONLINE,
+                    timeout=5,
+                )
+                first_leader = obj.spec.leader
+                victim = next(s for s in spus_ if s.config.id == first_leader)
+                await victim.stop()
+                obj = await sc.ctx.partitions.wait_action(
+                    key,
+                    lambda o: o is not None
+                    and o.spec.leader != first_leader
+                    and o.status.resolution == PartitionResolution.ONLINE,
+                    timeout=10,
+                )
+                assert obj.spec.leader != first_leader
+                survivor = next(s for s in spus_ if s.config.id == obj.spec.leader)
+                # new leader creates the replica when the push arrives
+                for _ in range(100):
+                    if survivor.ctx.leader_for("ha", 0) is not None:
+                        break
+                    await asyncio.sleep(0.05)
+                assert survivor.ctx.leader_for("ha", 0) is not None
+            finally:
+                await shutdown_cluster(sc, admin, spus_)
+
+        run(body())
