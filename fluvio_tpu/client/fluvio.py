@@ -11,6 +11,7 @@ Two modes, auto-detected from the endpoint's advertised api keys:
 
 from __future__ import annotations
 
+import asyncio
 from typing import Dict, Optional
 
 from fluvio_tpu.client.admin import FluvioAdmin
@@ -45,12 +46,32 @@ class SpuPool:
         return self._default_addr
 
     async def socket_for(self, topic: str, partition: int) -> VersionedSerialSocket:
-        addr = await self.addr_for(topic, partition)
-        sock = self._sockets.get(addr)
-        if sock is None or sock.is_stale:
-            sock = await VersionedSerialSocket.connect(addr)
-            self._sockets[addr] = sock
-        return sock
+        """Connect to the partition leader, re-resolving on failure.
+
+        During failover the metadata mirror can briefly lag the SC's
+        election; a refused connection to the old leader is retried
+        against the freshly-resolved address (parity: the client's
+        retry-with-metadata-refresh behavior).
+        """
+        last_err: Exception | None = None
+        for attempt in range(6):
+            addr = await self.addr_for(topic, partition)
+            sock = self._sockets.get(addr)
+            if sock is not None and not sock.is_stale:
+                return sock
+            try:
+                sock = await VersionedSerialSocket.connect(addr)
+                self._sockets[addr] = sock
+                return sock
+            except OSError as e:
+                last_err = e
+                self._sockets.pop(addr, None)
+                if self._metadata is None:
+                    raise
+                await asyncio.sleep(0.1 * (attempt + 1))
+        raise ConnectionError(
+            f"no reachable leader for {topic}-{partition}"
+        ) from last_err
 
     async def close(self) -> None:
         for sock in self._sockets.values():

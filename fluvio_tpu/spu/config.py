@@ -19,7 +19,7 @@ class SmartEngineConfig:
 class SpuConfig:
     id: SpuId = 0
     public_addr: str = f"0.0.0.0:{SPU_PUBLIC_PORT}"
-    private_addr: str = ""
+    private_addr: str = "127.0.0.1:0"  # internal (peer replication) endpoint
     sc_addr: str = ""  # SC private endpoint; "" = standalone broker
     log_base_dir: str = "/tmp/fluvio-tpu"
     replication: ReplicaConfig = field(default_factory=ReplicaConfig)

@@ -44,6 +44,12 @@ class GlobalContext:
     def __init__(self, config: SpuConfig):
         self.config = config
         self.leaders: Dict[str, LeaderReplicaState] = {}
+        # replicas this SPU follows (replication layer), keyed like leaders
+        self.followers: Dict[str, "FollowerReplicaState"] = {}
+        # peer SPU endpoints pushed by the SC (id -> SpuUpdate)
+        self.peers: Dict[int, object] = {}
+        # set by SpuServer when replication is enabled
+        self.followers_controller = None
         self.smartmodules = SmartModuleLocalStore()
         self.engine = SmartEngine(
             backend=config.smart_engine.backend,
@@ -51,19 +57,78 @@ class GlobalContext:
         )
         self.metrics = SpuMetrics()
 
-    def create_replica(self, topic: str, partition: int = 0) -> LeaderReplicaState:
-        """Create-or-load a leader replica (control-plane `ReplicaChange::Add`)."""
+    def create_replica(
+        self, topic: str, partition: int = 0, replica_count: Optional[int] = None
+    ) -> LeaderReplicaState:
+        """Create-or-load a leader replica (control-plane `ReplicaChange::Add`).
+
+        ``replica_count`` (the SC-pushed replica-set size) sets the
+        in-sync quorum: HW advances once every follower in the set has
+        the record. Standalone replicas (no SC) fall back to the
+        process-level config (default 1: HW advances on local write).
+        """
         key = partition_replica_key(topic, partition)
         if key not in self.leaders:
-            self.leaders[key] = LeaderReplicaState(
-                topic, partition, self.config.replication, self.config.in_sync_replica
+            in_sync = (
+                replica_count
+                if replica_count is not None
+                else self.config.in_sync_replica
             )
+            self.leaders[key] = LeaderReplicaState(
+                topic, partition, self.config.replication, max(1, in_sync)
+            )
+        else:
+            if replica_count is not None:
+                self.leaders[key].in_sync_replica = max(1, replica_count)
         return self.leaders[key]
+
+    def create_follower(
+        self, topic: str, partition: int, leader: int
+    ) -> "FollowerReplicaState":
+        from fluvio_tpu.spu.follower import FollowerReplicaState
+
+        key = partition_replica_key(topic, partition)
+        if key not in self.followers:
+            self.followers[key] = FollowerReplicaState(
+                topic, partition, leader, self.config.replication
+            )
+        return self.followers[key]
+
+    def promote_follower(self, topic: str, partition: int) -> LeaderReplicaState:
+        """Follower -> leader on election; storage carries over on disk.
+
+        Parity: the SPU's replica-change handling when the SC re-points
+        a partition's leader at this SPU (control_plane/dispatcher.rs).
+        """
+        key = partition_replica_key(topic, partition)
+        follower = self.followers.pop(key, None)
+        if follower is not None:
+            follower.close()  # FileReplica reloads the same directory
+        return self.create_replica(topic, partition)
+
+    def demote_leader(
+        self, topic: str, partition: int, new_leader: int
+    ) -> "FollowerReplicaState":
+        key = partition_replica_key(topic, partition)
+        leader = self.leaders.pop(key, None)
+        if leader is not None:
+            leader.close()
+        return self.create_follower(topic, partition, new_leader)
 
     def leader_for(self, topic: str, partition: int) -> Optional[LeaderReplicaState]:
         return self.leaders.get(partition_replica_key(topic, partition))
+
+    def follower_for(self, topic: str, partition: int):
+        return self.followers.get(partition_replica_key(topic, partition))
+
+    def notify_followers_changed(self) -> None:
+        if self.followers_controller is not None:
+            self.followers_controller.notify()
 
     def close(self) -> None:
         for leader in self.leaders.values():
             leader.close()
         self.leaders.clear()
+        for follower in self.followers.values():
+            follower.close()
+        self.followers.clear()

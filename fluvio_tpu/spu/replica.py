@@ -11,7 +11,7 @@ stream-fetch select loops wake up. Follower-offset tracking
 from __future__ import annotations
 
 import asyncio
-from typing import List
+from typing import Dict, List
 
 from fluvio_tpu.protocol.record import Batch, RecordSet
 from fluvio_tpu.schema.spu import Isolation
@@ -52,6 +52,8 @@ class LeaderReplicaState:
         self.leo_publisher = OffsetPublisher(self.storage.get_leo())
         self.hw_publisher = OffsetPublisher(self.storage.get_hw())
         self._write_lock = asyncio.Lock()
+        # follower spu id -> (leo, hw) as last reported (replica_state.rs:172)
+        self.followers: Dict[int, tuple] = {}
 
     # -- offsets ------------------------------------------------------------
 
@@ -97,6 +99,35 @@ class LeaderReplicaState:
         return self.storage.read_partition_slice(
             offset, max_bytes, _isolation_str(isolation)
         )
+
+    # -- follower tracking (replication) ------------------------------------
+
+    def update_follower_offsets(self, spu_id: int, leo: int, hw: int) -> bool:
+        """Record a follower's offsets and maybe advance the HW.
+
+        Parity: update_states_from_followers (replica_state.rs:172) —
+        HW advances to the highest offset replicated by at least
+        ``in_sync_replica - 1`` followers (leader included, bounded by
+        the leader's LEO). Returns True when the HW moved.
+        """
+        self.followers[spu_id] = (leo, hw)
+        if self.in_sync_replica <= 1:
+            return False
+        needed = self.in_sync_replica - 1  # followers besides the leader
+        follower_leos = sorted(
+            (l for (l, _) in self.followers.values()), reverse=True
+        )
+        if len(follower_leos) < needed:
+            return False
+        candidate = min(self.leo(), follower_leos[needed - 1])
+        if candidate > self.hw():
+            self.storage.update_high_watermark(candidate)
+            self.hw_publisher.update(self.storage.get_hw())
+            return True
+        return False
+
+    def drop_follower(self, spu_id: int) -> None:
+        self.followers.pop(spu_id, None)
 
     # -- lifecycle ----------------------------------------------------------
 

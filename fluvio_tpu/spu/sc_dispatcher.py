@@ -115,6 +115,8 @@ class ScDispatcher:
                 self.peers[s.id] = s
             for key in update.deleted:
                 self.peers.pop(int(key), None)
+        self.ctx.peers = self.peers
+        self.ctx.notify_followers_changed()
 
     async def _apply_replicas(self, update: InternalUpdate) -> None:
         my_id = self.ctx.config.id
@@ -124,21 +126,46 @@ class ScDispatcher:
                 continue
             if my_id in rep.replicas:
                 wanted[partition_replica_key(rep.topic, rep.partition)] = rep
-        # adds / leader takeover
+        # adds / role changes (promotion and demotion preserve storage)
         for key, rep in wanted.items():
             if rep.leader == my_id:
-                if key not in self.ctx.leaders:
+                live_replicas = len(rep.replicas)
+                if key in self.ctx.followers:
+                    logger.info("replica promote (follower -> leader): %s", key)
+                    self.ctx.promote_follower(rep.topic, rep.partition)
+                    self.ctx.create_replica(rep.topic, rep.partition, live_replicas)
+                elif key not in self.ctx.leaders:
                     logger.info("replica add (leader): %s", key)
-                    self.ctx.create_replica(rep.topic, rep.partition)
+                    self.ctx.create_replica(rep.topic, rep.partition, live_replicas)
+                else:
+                    self.ctx.create_replica(rep.topic, rep.partition, live_replicas)
+            else:
+                if key in self.ctx.leaders:
+                    logger.info("replica demote (leader -> follower): %s", key)
+                    self.ctx.demote_leader(rep.topic, rep.partition, rep.leader)
+                else:
+                    cur = self.ctx.followers.get(key)
+                    if cur is None:
+                        logger.info(
+                            "replica add (follower of %s): %s", rep.leader, key
+                        )
+                        self.ctx.create_follower(rep.topic, rep.partition, rep.leader)
+                    elif cur.leader != rep.leader:
+                        logger.info(
+                            "follower %s re-pointed to leader %s", key, rep.leader
+                        )
+                        cur.leader = rep.leader
         if update.sync_all:
-            # removes: leaders we hold that are no longer assigned to us
+            # removes: replicas we hold that are no longer assigned to us
             for key in list(self.ctx.leaders):
                 rep = wanted.get(key)
                 if rep is None or rep.leader != my_id:
-                    logger.info("replica remove: %s", key)
+                    if rep is not None:
+                        continue  # handled as demotion above
+                    logger.info("replica remove (leader): %s", key)
                     leader = self.ctx.leaders.pop(key)
                     leader.close()
-                    if rep is None and self._socket is not None:
+                    if self._socket is not None:
                         try:
                             await self._socket.send_receive(
                                 ReplicaRemovedRequest(
@@ -149,6 +176,13 @@ class ScDispatcher:
                             )
                         except Exception:
                             pass
+            for key in list(self.ctx.followers):
+                if key not in wanted or wanted[key].leader == my_id:
+                    if key in wanted:
+                        continue  # handled as promotion above
+                    logger.info("replica remove (follower): %s", key)
+                    self.ctx.followers.pop(key).close()
+        self.ctx.notify_followers_changed()
 
     def _apply_smartmodules(self, update: InternalUpdate) -> None:
         store = self.ctx.smartmodules
@@ -176,7 +210,10 @@ class ScDispatcher:
                     leader=ReplicaStatusUpdate(
                         spu=self.ctx.config.id, hw=info.hw, leo=info.leo
                     ),
-                    replicas=[],
+                    replicas=[
+                        ReplicaStatusUpdate(spu=sid, leo=leo, hw=hw)
+                        for sid, (leo, hw) in leader.followers.items()
+                    ],
                 )
             )
         return out
@@ -191,7 +228,14 @@ class ScDispatcher:
             updates = []
             for lrs in self._collect_lrs():
                 key = f"{lrs.topic}-{lrs.partition}"
-                cur = (lrs.leader.hw, lrs.leader.leo)
+                # dedup key covers follower offsets too: a follower
+                # catching up must reach the SC even when the leader's
+                # own offsets are unchanged
+                cur = (
+                    lrs.leader.hw,
+                    lrs.leader.leo,
+                    tuple(sorted((r.spu, r.leo, r.hw) for r in lrs.replicas)),
+                )
                 if last.get(key) != cur:
                     last[key] = cur
                     updates.append(lrs)

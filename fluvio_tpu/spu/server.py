@@ -1,9 +1,9 @@
 """SPU process assembly (parity: fluvio-spu/src/start.rs:15,66).
 
-Builds the GlobalContext, runs the public API server, and — when an SC
+Builds the GlobalContext and runs: the public API server, the internal
+(peer replication) server, the followers controller, and — when an SC
 address is configured — the SC dispatcher (register + metadata pushes +
-LRS reporting). The internal (follower-sync) server attaches with the
-replication layer.
+LRS reporting).
 """
 
 from __future__ import annotations
@@ -12,6 +12,8 @@ from typing import Optional
 
 from fluvio_tpu.spu.config import SpuConfig
 from fluvio_tpu.spu.context import GlobalContext
+from fluvio_tpu.spu.follower import FollowersController
+from fluvio_tpu.spu.internal_service import SpuInternalService
 from fluvio_tpu.spu.public_service import SpuPublicService
 from fluvio_tpu.spu.sc_dispatcher import ScDispatcher
 from fluvio_tpu.transport.service import FluvioApiServer
@@ -24,6 +26,13 @@ class SpuServer:
         self.public_server = FluvioApiServer(
             config.public_addr, SpuPublicService(), self.ctx
         )
+        self.internal_server: Optional[FluvioApiServer] = (
+            FluvioApiServer(config.private_addr, SpuInternalService(), self.ctx)
+            if config.private_addr
+            else None
+        )
+        self.followers_controller = FollowersController(self.ctx)
+        self.ctx.followers_controller = self.followers_controller
         self.sc_dispatcher: Optional[ScDispatcher] = (
             ScDispatcher(self.ctx, config.sc_addr) if config.sc_addr else None
         )
@@ -32,8 +41,16 @@ class SpuServer:
     def public_addr(self) -> str:
         return self.public_server.local_addr
 
+    @property
+    def private_addr(self) -> str:
+        assert self.internal_server is not None, "internal server disabled"
+        return self.internal_server.local_addr
+
     async def start(self) -> None:
         await self.public_server.start()
+        if self.internal_server is not None:
+            await self.internal_server.start()
+        self.followers_controller.start()
         if self.sc_dispatcher is not None:
             self.sc_dispatcher.start()
 
@@ -43,5 +60,8 @@ class SpuServer:
     async def stop(self) -> None:
         if self.sc_dispatcher is not None:
             await self.sc_dispatcher.stop()
+        await self.followers_controller.stop()
+        if self.internal_server is not None:
+            await self.internal_server.stop()
         await self.public_server.stop()
         self.ctx.close()

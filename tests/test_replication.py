@@ -1,0 +1,317 @@
+"""Replication tests: HW advancement, follower sync, election with data.
+
+Mirrors the reference's replication test tier (SURVEY.md §4c:
+fluvio-spu/src/replication/test.rs) — several broker contexts in one
+process wired through real internal-API sockets, plus unit tests for the
+leader's follower-offset bookkeeping (replica_state.rs tests).
+"""
+
+import asyncio
+
+import pytest
+
+from fluvio_tpu.client.admin import FluvioAdmin
+from fluvio_tpu.client.consumer import ConsumerConfig
+from fluvio_tpu.client.fluvio import Fluvio
+from fluvio_tpu.client.offset import Offset
+from fluvio_tpu.metadata.partition import PartitionResolution, partition_key
+from fluvio_tpu.metadata.topic import TopicSpec
+from fluvio_tpu.protocol.record import Batch, Record, RecordSet
+from fluvio_tpu.schema.controlplane import SpuUpdate
+from fluvio_tpu.schema.internal_spu import SyncRecords
+from fluvio_tpu.sc import ScConfig, ScServer
+from fluvio_tpu.spu.config import SpuConfig
+from fluvio_tpu.spu.replica import LeaderReplicaState
+from fluvio_tpu.spu.server import SpuServer
+from fluvio_tpu.storage.config import ReplicaConfig
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def record_set(values):
+    batch = Batch.from_records([Record(value=v) for v in values])
+    return RecordSet(batches=[batch])
+
+
+async def wait_until(cond, timeout=5.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            return False
+        await asyncio.sleep(interval)
+    return True
+
+
+class TestLeaderFollowerOffsets:
+    def test_hw_advances_at_in_sync_quorum(self, tmp_path):
+        async def body():
+            leader = LeaderReplicaState(
+                "t", 0, ReplicaConfig(base_dir=str(tmp_path)), in_sync_replica=2
+            )
+            await leader.write_record_set(record_set([b"a", b"b", b"c"]))
+            assert leader.leo() == 3
+            assert leader.hw() == 0  # rf>1: HW waits for a follower
+            moved = leader.update_follower_offsets(2, leo=2, hw=0)
+            assert moved and leader.hw() == 2
+            moved = leader.update_follower_offsets(2, leo=3, hw=2)
+            assert moved and leader.hw() == 3
+            leader.close()
+
+        run(body())
+
+    def test_hw_uses_second_highest_with_three_replicas(self, tmp_path):
+        async def body():
+            # in_sync_replica=3: HW needs the 2 best followers
+            leader = LeaderReplicaState(
+                "t", 0, ReplicaConfig(base_dir=str(tmp_path)), in_sync_replica=3
+            )
+            await leader.write_record_set(record_set([b"a", b"b", b"c"]))
+            assert not leader.update_follower_offsets(2, leo=3, hw=0)
+            assert leader.hw() == 0  # only one follower caught up
+            assert leader.update_follower_offsets(3, leo=2, hw=0)
+            assert leader.hw() == 2  # second follower at 2 -> HW 2
+            leader.close()
+
+        run(body())
+
+    def test_hw_never_exceeds_leader_leo(self, tmp_path):
+        async def body():
+            leader = LeaderReplicaState(
+                "t", 0, ReplicaConfig(base_dir=str(tmp_path)), in_sync_replica=2
+            )
+            await leader.write_record_set(record_set([b"a"]))
+            leader.update_follower_offsets(2, leo=99, hw=0)
+            assert leader.hw() == 1
+            leader.close()
+
+        run(body())
+
+
+class TestFollowerApply:
+    def test_apply_and_hw_bound(self, tmp_path):
+        from fluvio_tpu.spu.follower import FollowerReplicaState
+
+        async def body():
+            leader = LeaderReplicaState(
+                "t", 0, ReplicaConfig(base_dir=str(tmp_path / "l"))
+            )
+            await leader.write_record_set(record_set([b"x", b"y"]))
+            sl = leader.read_records(0, 1 << 20, 0)
+            follower = FollowerReplicaState(
+                "t", 0, leader=1, config=ReplicaConfig(base_dir=str(tmp_path / "f"))
+            )
+            sync = SyncRecords(
+                topic="t",
+                partition=0,
+                leader_leo=leader.leo(),
+                leader_hw=leader.hw(),
+                records=RecordSet(batches=sl.decode_batches()),
+            )
+            follower.apply_sync(sync)
+            assert follower.leo() == 2
+            assert follower.hw() == 2  # bounded by local leo and leader hw
+            # re-applying the same batches is a no-op (overlap skip)
+            follower.apply_sync(sync)
+            assert follower.leo() == 2
+            leader.close()
+            follower.close()
+
+        run(body())
+
+
+def make_spu(tmp_path, spu_id, sc_addr="", in_sync=1):
+    config = SpuConfig(
+        id=spu_id,
+        public_addr="127.0.0.1:0",
+        private_addr="127.0.0.1:0",
+        log_base_dir=str(tmp_path / f"spu-{spu_id}"),
+        replication=ReplicaConfig(base_dir=str(tmp_path / f"spu-{spu_id}")),
+        sc_addr=sc_addr,
+        in_sync_replica=in_sync,
+    )
+    return SpuServer(config)
+
+
+class TestFollowerSyncE2E:
+    def test_follower_replicates_and_hw_advances(self, tmp_path):
+        """Two brokers wired directly (no SC): leader rf=2 + one follower."""
+
+        async def body():
+            a = make_spu(tmp_path, 1, in_sync=2)
+            b = make_spu(tmp_path, 2)
+            await a.start()
+            await b.start()
+            try:
+                leader = a.ctx.create_replica("t", 0)
+                b.ctx.peers = {
+                    1: SpuUpdate(id=1, private_addr=a.private_addr),
+                }
+                b.ctx.create_follower("t", 0, leader=1)
+                b.ctx.notify_followers_changed()
+
+                await leader.write_record_set(record_set([b"r1", b"r2", b"r3"]))
+                assert leader.hw() == 0  # no follower ack yet
+
+                ok = await wait_until(
+                    lambda: b.ctx.follower_for("t", 0).leo() == 3
+                )
+                assert ok, "follower never caught up"
+                ok = await wait_until(lambda: leader.hw() == 3)
+                assert ok, "leader HW never advanced"
+                ok = await wait_until(
+                    lambda: b.ctx.follower_for("t", 0).hw() == 3
+                )
+                assert ok, "follower HW never advanced"
+
+                # new writes flow continuously on the live stream
+                await leader.write_record_set(record_set([b"r4"]))
+                ok = await wait_until(
+                    lambda: b.ctx.follower_for("t", 0).leo() == 4
+                    and leader.hw() == 4
+                )
+                assert ok
+            finally:
+                await a.stop()
+                await b.stop()
+
+        run(body())
+
+
+async def boot_cluster(tmp_path, n_spus=2):
+    sc = ScServer(ScConfig())
+    await sc.start()
+    admin = await FluvioAdmin.connect(sc.public_addr)
+    spus = []
+    for i in range(n_spus):
+        s = make_spu(tmp_path, 5000 + i, sc_addr=sc.private_addr)
+        await s.start()
+        await admin.register_custom_spu(
+            5000 + i, s.public_addr, private_addr=s.private_addr
+        )
+        spus.append(s)
+    for i in range(n_spus):
+        await sc.ctx.spus.wait_action(
+            str(5000 + i), lambda o: o is not None and o.status.is_online(), timeout=5
+        )
+    return sc, admin, spus
+
+
+class TestReplicatedClusterE2E:
+    def test_committed_produce_waits_for_follower_ack(self, tmp_path):
+        """rf=2 + READ_COMMITTED acks: HW (and the ack) requires the
+        follower to replicate — the SC-pushed replica set drives the
+        in-sync quorum, not the broker's process config."""
+
+        async def body():
+            from fluvio_tpu.client.producer import ProducerConfig
+            from fluvio_tpu.schema.spu import Isolation
+
+            sc, admin, spus = await boot_cluster(tmp_path, 2)
+            client = None
+            try:
+                await admin.create_topic("committed", TopicSpec.computed(1, 2))
+                key = partition_key("committed", 0)
+                await sc.ctx.partitions.wait_action(
+                    key,
+                    lambda o: o is not None
+                    and o.status.resolution == PartitionResolution.ONLINE,
+                    timeout=5,
+                )
+                client = await Fluvio.connect(sc.public_addr)
+                producer = await client.topic_producer(
+                    "committed",
+                    config=ProducerConfig(isolation=Isolation.READ_COMMITTED),
+                )
+                await producer.send(None, b"durable")
+                await producer.flush()
+                await producer.close()
+                # the ack implies the follower already has the record
+                leader_spu = next(
+                    s for s in spus if s.ctx.leader_for("committed", 0) is not None
+                )
+                follower_spu = next(s for s in spus if s is not leader_spu)
+                assert leader_spu.ctx.leader_for("committed", 0).hw() == 1
+                st = follower_spu.ctx.follower_for("committed", 0)
+                assert st is not None and st.leo() == 1
+            finally:
+                if client is not None:
+                    await client.close()
+                await admin.close()
+                for s in spus:
+                    await s.stop()
+                await sc.stop()
+
+        run(body())
+
+    def test_data_survives_leader_failure(self, tmp_path):
+        async def body():
+            sc, admin, spus = await boot_cluster(tmp_path, 2)
+            client = None
+            try:
+                await admin.create_topic("ha", TopicSpec.computed(1, 2))
+                key = partition_key("ha", 0)
+                obj = await sc.ctx.partitions.wait_action(
+                    key,
+                    lambda o: o is not None
+                    and o.status.resolution == PartitionResolution.ONLINE,
+                    timeout=5,
+                )
+                first_leader = obj.spec.leader
+                leader_spu = next(s for s in spus if s.config.id == first_leader)
+                follower_spu = next(s for s in spus if s.config.id != first_leader)
+
+                client = await Fluvio.connect(sc.public_addr)
+                producer = await client.topic_producer("ha")
+                values = [f"rec-{i}".encode() for i in range(10)]
+                for v in values:
+                    await producer.send(None, v)
+                await producer.flush()
+                await producer.close()
+
+                # follower fully replicates before we kill the leader
+                ok = await wait_until(
+                    lambda: follower_spu.ctx.follower_for("ha", 0) is not None
+                    and follower_spu.ctx.follower_for("ha", 0).leo() == 10,
+                    timeout=10,
+                )
+                assert ok, "follower did not replicate"
+
+                await leader_spu.stop()
+                await sc.ctx.partitions.wait_action(
+                    key,
+                    lambda o: o is not None
+                    and o.spec.leader != first_leader
+                    and o.status.resolution == PartitionResolution.ONLINE,
+                    timeout=10,
+                )
+                # promoted follower serves the full log
+                ok = await wait_until(
+                    lambda: follower_spu.ctx.leader_for("ha", 0) is not None,
+                    timeout=10,
+                )
+                assert ok, "survivor never promoted"
+                consumer = await client.partition_consumer("ha", 0)
+                got = []
+                async for record in consumer.stream(
+                    Offset.beginning(), ConsumerConfig(disable_continuous=True)
+                ):
+                    got.append(bytes(record.value))
+                assert got == values
+            finally:
+                if client is not None:
+                    await client.close()
+                await admin.close()
+                for s in spus:
+                    try:
+                        await s.stop()
+                    except Exception:
+                        pass
+                await sc.stop()
+
+        run(body())
