@@ -29,6 +29,7 @@ def lookup(name: str) -> SmartModuleDef:
     from fluvio_tpu.models import (  # noqa: F401 — populate registry
         aggregate_sum,
         array_map_explode,
+        dedup_filter,
         json_map,
         regex_filter,
         windowed_aggregate,
@@ -45,6 +46,7 @@ def builtin_names() -> list:
     from fluvio_tpu.models import (  # noqa: F401
         aggregate_sum,
         array_map_explode,
+        dedup_filter,
         json_map,
         regex_filter,
         windowed_aggregate,

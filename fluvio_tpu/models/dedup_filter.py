@@ -1,0 +1,91 @@
+"""dedup-filter — the bounded-window deduplication SmartModule.
+
+Capability parity: the hub `dedup-filter` module the reference's topic
+Deduplication config names (`fluvio-controlplane-metadata/src/topic/
+deduplication.rs`; wired by `fluvio-spu/src/smartengine/mod.rs:152`
+`dedup_to_invocation`). Keeps a window of seen record keys bounded by
+``count`` entries and optionally ``age`` seconds; records whose key was
+already seen inside the window are dropped. The window is re-seeded from
+the tail of the log on (re)start via ``look_back`` — exactly how the
+broker hands the module `Lookback{last: count, age}`.
+
+The dedup key is the record *key*, falling back to the record *value*
+for keyless records.
+"""
+
+from __future__ import annotations
+
+from fluvio_tpu.models import register
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef, load_source
+
+SOURCE = '''
+import time
+from collections import OrderedDict
+
+_state = {"count": 0, "age_ms": None, "seen": OrderedDict()}
+
+
+def _dedup_key(record):
+    key = record.key
+    return key if key is not None else record.value
+
+
+def _now_ms(record):
+    ts = record.timestamp
+    return ts if ts >= 0 else int(time.time() * 1000)
+
+
+def _evict(now_ms):
+    seen = _state["seen"]
+    age_ms = _state["age_ms"]
+    if age_ms is not None:
+        while seen:
+            _, ts = next(iter(seen.items()))
+            if ts < now_ms - age_ms:
+                seen.popitem(last=False)
+            else:
+                break
+    count = _state["count"]
+    while count and len(seen) > count:
+        seen.popitem(last=False)
+
+
+def _observe(record):
+    seen = _state["seen"]
+    key = _dedup_key(record)
+    now = _now_ms(record)
+    seen.pop(key, None)
+    seen[key] = now
+    _evict(now)
+
+
+@smartmodule.init
+def init(params):
+    _state["count"] = int(params.get("count", "0"))
+    age = params.get("age")  # milliseconds (dedup_to_invocation parity)
+    _state["age_ms"] = int(age) if age is not None else None
+    _state["seen"].clear()
+
+
+@smartmodule.look_back
+def look_back(record):
+    _observe(record)
+
+
+@smartmodule.filter
+def dedup(record):
+    key = _dedup_key(record)
+    now = _now_ms(record)
+    _evict(now)
+    if key in _state["seen"]:
+        return False
+    _observe(record)
+    return True
+'''
+
+
+def module() -> SmartModuleDef:
+    return load_source(SOURCE, name="dedup-filter")
+
+
+register("dedup-filter", module)

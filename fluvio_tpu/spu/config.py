@@ -26,6 +26,9 @@ class SpuConfig:
     smart_engine: SmartEngineConfig = field(default_factory=SmartEngineConfig)
     # produce-side flush guarantees: rf=1 means HW advances on local write
     in_sync_replica: int = 1
+    # metrics unix-socket endpoint (monitoring.rs); None = disabled,
+    # "" = FLUVIO_METRIC_SPU env or the default path
+    monitoring_path: str | None = None
 
     def __post_init__(self) -> None:
         if self.replication.base_dir in (".", ""):

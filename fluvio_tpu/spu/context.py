@@ -51,6 +51,10 @@ class GlobalContext:
         # set by SpuServer when replication is enabled
         self.followers_controller = None
         self.smartmodules = SmartModuleLocalStore()
+        # mirrored topic config per replica key (dedup / storage knobs),
+        # pushed by the SC inside Replica.config (parity: the SPU reading
+        # topic Deduplication off its replica metadata, smartengine/mod.rs:152)
+        self.replica_configs: Dict[str, dict] = {}
         self.engine = SmartEngine(
             backend=config.smart_engine.backend,
             store_max_memory=config.smart_engine.store_max_memory,
@@ -58,7 +62,11 @@ class GlobalContext:
         self.metrics = SpuMetrics()
 
     def create_replica(
-        self, topic: str, partition: int = 0, replica_count: Optional[int] = None
+        self,
+        topic: str,
+        partition: int = 0,
+        replica_count: Optional[int] = None,
+        topic_config: Optional[dict] = None,
     ) -> LeaderReplicaState:
         """Create-or-load a leader replica (control-plane `ReplicaChange::Add`).
 
@@ -68,6 +76,14 @@ class GlobalContext:
         process-level config (default 1: HW advances on local write).
         """
         key = partition_replica_key(topic, partition)
+        if topic_config is not None:
+            prev = self.replica_configs.get(key)
+            self.replica_configs[key] = topic_config
+            if prev is not None and prev != topic_config and key in self.leaders:
+                # topic config changed (e.g. dedup added/retuned): drop the
+                # attached chain so the next produce rebuilds from the new
+                # config with a fresh lookback seed
+                self.leaders[key].sm_chain = None
         if key not in self.leaders:
             in_sync = (
                 replica_count
@@ -117,6 +133,9 @@ class GlobalContext:
 
     def leader_for(self, topic: str, partition: int) -> Optional[LeaderReplicaState]:
         return self.leaders.get(partition_replica_key(topic, partition))
+
+    def replica_config(self, topic: str, partition: int) -> dict:
+        return self.replica_configs.get(partition_replica_key(topic, partition), {})
 
     def follower_for(self, topic: str, partition: int):
         return self.followers.get(partition_replica_key(topic, partition))

@@ -1,0 +1,71 @@
+"""SPU metrics endpoint over a unix socket.
+
+Capability parity: fluvio-spu/src/monitoring.rs:12-67 — the broker's
+metrics struct is serialized as JSON to any client that connects to a
+unix socket whose path comes from ``FLUVIO_METRIC_SPU`` (default
+``SPU_MONITORING_UNIX_SOCKET``). One JSON document per connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+SPU_MONITORING_UNIX_SOCKET = "/tmp/fluvio-spu.sock"
+
+
+def monitoring_path(override: Optional[str] = None) -> str:
+    if override:
+        return override
+    return os.environ.get("FLUVIO_METRIC_SPU", SPU_MONITORING_UNIX_SOCKET)
+
+
+class MonitoringServer:
+    """Serves the SPU metrics JSON dump on a unix socket."""
+
+    def __init__(self, ctx, path: Optional[str] = None):
+        self.ctx = ctx
+        self.path = monitoring_path(path)
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        if os.path.exists(self.path):
+            os.remove(self.path)
+        self._server = await asyncio.start_unix_server(self._handle, path=self.path)
+        logger.info("monitoring started on %s", self.path)
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            payload = json.dumps(self.ctx.metrics.to_dict(), indent=2).encode()
+            writer.write(payload)
+            await writer.drain()
+        finally:
+            writer.close()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        if os.path.exists(self.path):
+            os.remove(self.path)
+
+
+async def read_metrics(path: Optional[str] = None) -> dict:
+    """Client side: connect and decode one metrics dump.
+
+    Parity: fluvio-cli/src/monitoring.rs (the CLI's metrics reader).
+    """
+    reader, writer = await asyncio.open_unix_connection(monitoring_path(path))
+    try:
+        payload = await reader.read()
+    finally:
+        writer.close()
+    return json.loads(payload)

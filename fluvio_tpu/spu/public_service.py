@@ -46,8 +46,10 @@ from fluvio_tpu.spu.replica import LeaderReplicaState
 from fluvio_tpu.spu.smart_chain import (
     BatchProcessResult,
     SmartModuleResolutionError,
+    apply_chain,
     build_chain,
     chain_look_back,
+    ensure_dedup_chain,
     process_batches,
 )
 from fluvio_tpu.smartengine.engine import EngineError, SmartModuleChainInitError
@@ -164,9 +166,19 @@ async def handle_produce(ctx: GlobalContext, req: ProduceRequest) -> ProduceResp
                     f"{topic_data.name}-{pdata.partition_index} has no leader here"
                 )
                 continue
+            try:
+                await ensure_dedup_chain(ctx, leader)
+            except SmartModuleResolutionError as e:
+                presp.error_code = e.code
+                presp.error_message = e.message
+                continue
+            except Exception as e:  # noqa: BLE001 — chain init boundary
+                presp.error_code = ErrorCode.SMARTMODULE_CHAIN_INIT_ERROR
+                presp.error_message = str(e)
+                continue
             records = pdata.records
             if chain is not None:
-                records, err = _apply_produce_chain(chain, records)
+                records, err = _apply_produce_chain(ctx, chain, records)
                 if err is not None:
                     presp.error_code = ErrorCode.SMARTMODULE_RUNTIME_ERROR
                     presp.error_message = str(err)
@@ -185,26 +197,9 @@ async def handle_produce(ctx: GlobalContext, req: ProduceRequest) -> ProduceResp
     return response
 
 
-def _apply_produce_chain(chain, records: RecordSet):
+def _apply_produce_chain(ctx: GlobalContext, chain, records: RecordSet):
     """Producer-side transform (parity: produce_handler.rs:215)."""
-    out = RecordSet()
-    for batch in records.batches:
-        inp = SmartModuleInput.from_records(
-            batch.memory_records(),
-            base_offset=0,  # offsets not assigned until the log write
-            base_timestamp=batch.header.first_timestamp,
-        )
-        output = chain.process(inp)
-        if output.error is not None:
-            return out, output.error
-        if output.successes:
-            out.add(
-                Batch.from_records(
-                    output.successes,
-                    first_timestamp=batch.header.first_timestamp or None,
-                )
-            )
-    return out, None
+    return apply_chain(chain, records, ctx.metrics.smartmodule)
 
 
 async def _wait_for_hw(leader: LeaderReplicaState, target: int, timeout_ms: int) -> None:

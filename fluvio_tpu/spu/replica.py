@@ -54,6 +54,11 @@ class LeaderReplicaState:
         self._write_lock = asyncio.Lock()
         # follower spu id -> (leo, hw) as last reported (replica_state.rs:172)
         self.followers: Dict[int, tuple] = {}
+        # persistent dedup filter chain, attached when the topic carries a
+        # Deduplication config (parity: replica_state.rs:394-405 sm_ctx;
+        # applied to every produced record set before the log append)
+        self.sm_chain = None
+        self.sm_chain_metrics = None
 
     # -- offsets ------------------------------------------------------------
 
@@ -83,6 +88,10 @@ class LeaderReplicaState:
         Returns the base offset assigned to the first batch.
         """
         async with self._write_lock:
+            if self.sm_chain is not None:
+                records = self._transform(records)
+                if not records.batches:
+                    return self.storage.get_leo()
             base = self.storage.write_recordset(
                 records, update_highwatermark=(self.in_sync_replica <= 1)
             )
@@ -90,6 +99,21 @@ class LeaderReplicaState:
         if self.in_sync_replica <= 1:
             self.hw_publisher.update(self.storage.get_hw())
         return base
+
+    def _transform(self, records: RecordSet) -> RecordSet:
+        """Run the attached dedup chain over an incoming record set.
+
+        Parity: replica_state.rs:344-357 `transform` — every produced
+        batch flows through the persistent chain; a transform error fails
+        the produce (raised as a FluvioError the produce handler reports).
+        """
+        from fluvio_tpu.protocol.error import ErrorCode, FluvioError
+        from fluvio_tpu.spu.smart_chain import apply_chain
+
+        out, error = apply_chain(self.sm_chain, records, self.sm_chain_metrics)
+        if error is not None:
+            raise FluvioError(ErrorCode.SMARTMODULE_RUNTIME_ERROR, str(error))
+        return out
 
     # -- read path ----------------------------------------------------------
 

@@ -133,12 +133,18 @@ class ScDispatcher:
                 if key in self.ctx.followers:
                     logger.info("replica promote (follower -> leader): %s", key)
                     self.ctx.promote_follower(rep.topic, rep.partition)
-                    self.ctx.create_replica(rep.topic, rep.partition, live_replicas)
+                    self.ctx.create_replica(
+                        rep.topic, rep.partition, live_replicas, rep.config
+                    )
                 elif key not in self.ctx.leaders:
                     logger.info("replica add (leader): %s", key)
-                    self.ctx.create_replica(rep.topic, rep.partition, live_replicas)
+                    self.ctx.create_replica(
+                        rep.topic, rep.partition, live_replicas, rep.config
+                    )
                 else:
-                    self.ctx.create_replica(rep.topic, rep.partition, live_replicas)
+                    self.ctx.create_replica(
+                        rep.topic, rep.partition, live_replicas, rep.config
+                    )
             else:
                 if key in self.ctx.leaders:
                     logger.info("replica demote (leader -> follower): %s", key)

@@ -14,6 +14,7 @@ from fluvio_tpu.spu.config import SpuConfig
 from fluvio_tpu.spu.context import GlobalContext
 from fluvio_tpu.spu.follower import FollowersController
 from fluvio_tpu.spu.internal_service import SpuInternalService
+from fluvio_tpu.spu.monitoring import MonitoringServer
 from fluvio_tpu.spu.public_service import SpuPublicService
 from fluvio_tpu.spu.sc_dispatcher import ScDispatcher
 from fluvio_tpu.transport.service import FluvioApiServer
@@ -36,6 +37,11 @@ class SpuServer:
         self.sc_dispatcher: Optional[ScDispatcher] = (
             ScDispatcher(self.ctx, config.sc_addr) if config.sc_addr else None
         )
+        self.monitoring: Optional[MonitoringServer] = (
+            MonitoringServer(self.ctx, config.monitoring_path or None)
+            if config.monitoring_path is not None
+            else None
+        )
 
     @property
     def public_addr(self) -> str:
@@ -53,11 +59,15 @@ class SpuServer:
         self.followers_controller.start()
         if self.sc_dispatcher is not None:
             self.sc_dispatcher.start()
+        if self.monitoring is not None:
+            await self.monitoring.start()
 
     async def run(self) -> None:
         await self.public_server.run()
 
     async def stop(self) -> None:
+        if self.monitoring is not None:
+            await self.monitoring.stop()
         if self.sc_dispatcher is not None:
             await self.sc_dispatcher.stop()
         await self.followers_controller.stop()

@@ -61,6 +61,40 @@ def resolve_invocation(
     return payload, invocation.name or wasm.name
 
 
+def dedup_to_invocation(topic_config: dict) -> Optional[SmartModuleInvocation]:
+    """Topic ``Deduplication`` config -> filter SM invocation with lookback.
+
+    Parity: fluvio-spu/src/smartengine/mod.rs:152 `dedup_to_invocation` —
+    the dedup filter is a Predefined module named by
+    ``deduplication.filter.transform.uses``, parameterised by the
+    transform's ``with`` params plus the window bounds, and seeded from
+    the log via Lookback(last=count, age=age).
+    """
+    dedup = topic_config.get("deduplication")
+    if not dedup:
+        return None
+    bounds = dedup.get("bounds") or {}
+    transform = (dedup.get("filter") or {}).get("transform") or {}
+    uses = transform.get("uses", "")
+    count = int(bounds.get("count") or 0)
+    age_seconds = bounds.get("age_seconds")
+    # bounds first, then the transform's `with` params (which may override),
+    # matching the reference's insert order; `age` is in milliseconds there
+    params = {"count": str(count)}
+    if age_seconds is not None:
+        params["age"] = str(int(age_seconds) * 1000)
+    params.update(transform.get("with_params") or {})
+    inv = SmartModuleInvocation(
+        wasm=SmartModuleInvocationWasm.predefined(uses),
+        params=params,
+        lookback_last=count,
+        name=f"dedup/{uses}",
+    )
+    if age_seconds is not None:
+        inv.lookback_age_ms = int(age_seconds) * 1000
+    return inv
+
+
 def build_chain(
     invocations: List[SmartModuleInvocation],
     ctx: GlobalContext,
@@ -83,6 +117,63 @@ def build_chain(
                 f"invalid SmartModule {name!r}: {e}",
             ) from e
     return builder.initialize()
+
+
+async def ensure_dedup_chain(ctx: GlobalContext, leader: LeaderReplicaState) -> None:
+    """Lazily attach the topic's dedup filter chain to a leader replica.
+
+    Parity: Uninit<LeaderReplicaState>::init (replica_state.rs:392-405) —
+    a replica whose topic config carries Deduplication gets a persistent
+    chain (with one lookback seed from the log) that every produced record
+    set is piped through. Init runs under the leader's write lock so no
+    produce can append between the lookback seed and the chain attach;
+    failures (e.g. the SmartModule not yet pushed by the SC) are retried
+    on the next produce.
+    """
+    if leader.sm_chain is not None:
+        return
+    inv = dedup_to_invocation(ctx.replica_config(leader.topic, leader.partition))
+    if inv is None:
+        return
+    async with leader._write_lock:
+        if leader.sm_chain is not None:  # lost the init race
+            return
+        chain = build_chain([inv], ctx)
+        await chain_look_back(chain, leader)
+        leader.sm_chain_metrics = ctx.metrics.smartmodule
+        leader.sm_chain = chain
+
+
+def apply_chain(chain, records: RecordSet, metrics=None):
+    """Run an in-memory record set through a chain, re-batching outputs.
+
+    Shared by the produce-side transform (produce_handler.rs:215
+    apply_smartmodules) and the leader's persistent dedup chain
+    (replica_state.rs:344 transform). Returns (RecordSet, error): on a
+    transform error the partial output is discarded and the produce fails.
+    """
+    out = RecordSet()
+    for batch in records.batches:
+        inp = SmartModuleInput.from_records(
+            batch.memory_records(),
+            base_offset=0,  # offsets not assigned until the log write
+            base_timestamp=batch.header.first_timestamp,
+        )
+        output = chain.process(inp, metrics)
+        if output.error is not None:
+            return out, output.error
+        if output.successes:
+            out.add(
+                Batch.from_records(
+                    output.successes,
+                    first_timestamp=(
+                        batch.header.first_timestamp
+                        if batch.header.first_timestamp != NO_TIMESTAMP
+                        else None
+                    ),
+                )
+            )
+    return out, None
 
 
 async def chain_look_back(

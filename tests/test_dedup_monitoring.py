@@ -1,0 +1,202 @@
+"""Dedup-on-produce and monitoring-socket tests.
+
+Parity targets: fluvio-spu/src/smartengine/mod.rs:152 (dedup_to_invocation
++ its unit test at :198), replica_state.rs:392-405 (persistent leader dedup
+chain with lookback seeding), monitoring.rs:12-67 (metrics JSON over a
+unix socket).
+"""
+
+import asyncio
+
+import pytest
+
+from fluvio_tpu.client import Fluvio, Offset
+from fluvio_tpu.models import dedup_filter
+from fluvio_tpu.spu import SpuConfig, SpuServer
+from fluvio_tpu.spu.monitoring import read_metrics
+from fluvio_tpu.spu.smart_chain import dedup_to_invocation
+from fluvio_tpu.schema.smartmodule import SmartModuleInvocationWasm
+from fluvio_tpu.storage.config import ReplicaConfig
+
+DEDUP_CONFIG = {
+    "deduplication": {
+        "bounds": {"count": 100, "age_seconds": None},
+        "filter": {"transform": {"uses": "dedup-filter", "with_params": {}}},
+    }
+}
+
+
+class TestDedupToInvocation:
+    def test_maps_bounds_and_filter(self):
+        cfg = {
+            "deduplication": {
+                "bounds": {"count": 7, "age_seconds": 60},
+                "filter": {
+                    "transform": {"uses": "dedup-filter", "with_params": {"x": "1"}}
+                },
+            }
+        }
+        inv = dedup_to_invocation(cfg)
+        assert inv.wasm.tag == SmartModuleInvocationWasm.PREDEFINED
+        assert inv.wasm.name == "dedup-filter"
+        assert inv.params["count"] == "7"
+        assert inv.params["age"] == "60000"  # milliseconds, like the reference
+        assert inv.params["x"] == "1"
+        assert inv.lookback_last == 7
+        assert inv.lookback_age_ms == 60_000
+
+    def test_absent_config_is_none(self):
+        assert dedup_to_invocation({}) is None
+        assert dedup_to_invocation({"deduplication": None}) is None
+
+
+@pytest.fixture()
+def dedup_spu(tmp_path):
+    loop = asyncio.new_event_loop()
+    config = SpuConfig(
+        id=5001,
+        public_addr="127.0.0.1:0",
+        log_base_dir=str(tmp_path),
+        replication=ReplicaConfig(base_dir=str(tmp_path)),
+        monitoring_path=str(tmp_path / "metrics.sock"),
+    )
+    server = SpuServer(config)
+
+    async def boot():
+        await server.start()
+        server.ctx.smartmodules.insert(
+            "dedup-filter", dedup_filter.SOURCE.encode()
+        )
+        server.ctx.create_replica("topic", 0, topic_config=DEDUP_CONFIG)
+
+    loop.run_until_complete(boot())
+    try:
+        yield server, loop
+    finally:
+        loop.run_until_complete(server.stop())
+        loop.close()
+
+
+async def produce(addr, values, keys=None, topic="topic"):
+    client = await Fluvio.connect(addr)
+    producer = await client.topic_producer(topic)
+    keys = keys or [None] * len(values)
+    futs = [await producer.send(k, v) for k, v in zip(keys, values)]
+    await producer.flush()
+    for f in futs:
+        await f.wait()
+    await producer.close()
+    await client.close()
+
+
+async def consume_all(addr, n, topic="topic"):
+    from fluvio_tpu.client import ConsumerConfig
+
+    client = await Fluvio.connect(addr)
+    consumer = await client.partition_consumer(topic, 0)
+    out = []
+    config = ConsumerConfig(disable_continuous=True)
+    async for record in consumer.stream(Offset.beginning(), config):
+        out.append(bytes(record.value))
+    await client.close()
+    return out
+
+
+class TestDedupProduce:
+    def test_duplicate_values_dropped(self, dedup_spu):
+        server, loop = dedup_spu
+        addr = server.public_addr
+
+        async def run():
+            await produce(addr, [b"a", b"b", b"a", b"c", b"b", b"d"])
+            return await consume_all(addr, 4)
+
+        values = loop.run_until_complete(run())
+        assert values == [b"a", b"b", b"c", b"d"]
+
+    def test_dedup_by_key(self, dedup_spu):
+        server, loop = dedup_spu
+        addr = server.public_addr
+
+        async def run():
+            await produce(
+                addr,
+                [b"v1", b"v2", b"v3"],
+                keys=[b"k1", b"k1", b"k2"],
+            )
+            return await consume_all(addr, 2)
+
+        values = loop.run_until_complete(run())
+        assert values == [b"v1", b"v3"]
+
+    def test_lookback_seeds_window_across_restart(self, dedup_spu):
+        server, loop = dedup_spu
+        addr = server.public_addr
+
+        async def run():
+            await produce(addr, [b"a", b"b"])
+            # simulate a broker restart: the chain is rebuilt and must
+            # re-seed its seen-window from the log tail via look_back
+            leader = server.ctx.leader_for("topic", 0)
+            leader.sm_chain = None
+            await produce(addr, [b"a", b"c"])
+            return await consume_all(addr, 3)
+
+        values = loop.run_until_complete(run())
+        assert values == [b"a", b"b", b"c"]
+
+    def test_count_bound_evicts_old_keys(self, tmp_path):
+        loop = asyncio.new_event_loop()
+        config = SpuConfig(
+            id=5002,
+            public_addr="127.0.0.1:0",
+            log_base_dir=str(tmp_path),
+            replication=ReplicaConfig(base_dir=str(tmp_path)),
+        )
+        server = SpuServer(config)
+        small = {
+            "deduplication": {
+                "bounds": {"count": 2, "age_seconds": None},
+                "filter": {
+                    "transform": {"uses": "dedup-filter", "with_params": {}}
+                },
+            }
+        }
+
+        async def boot():
+            await server.start()
+            server.ctx.smartmodules.insert(
+                "dedup-filter", dedup_filter.SOURCE.encode()
+            )
+            server.ctx.create_replica("topic", 0, topic_config=small)
+
+        loop.run_until_complete(boot())
+        try:
+            addr = server.public_addr
+
+            async def run():
+                # window holds 2 keys: by the time "a" repeats it has
+                # been evicted, so it is accepted again
+                await produce(addr, [b"a", b"b", b"c", b"a"])
+                return await consume_all(addr, 4)
+
+            values = loop.run_until_complete(run())
+            assert values == [b"a", b"b", b"c", b"a"]
+        finally:
+            loop.run_until_complete(server.stop())
+            loop.close()
+
+
+class TestMonitoring:
+    def test_metrics_json_over_unix_socket(self, dedup_spu):
+        server, loop = dedup_spu
+        addr = server.public_addr
+
+        async def run():
+            await produce(addr, [b"a", b"b"])
+            return await read_metrics(server.config.monitoring_path)
+
+        metrics = loop.run_until_complete(run())
+        assert metrics["inbound"]["records"] == 2
+        assert metrics["inbound"]["bytes"] > 0
+        assert "smartmodule" in metrics
