@@ -1,0 +1,28 @@
+"""Connection identity.
+
+Capability parity: fluvio-auth/src/x509/identity.rs `X509Identity
+{principal, scopes}` — there it is extracted from the TLS client
+certificate's subject (CN = principal, O entries = scopes/roles). This
+framework's local clusters run plaintext (like the reference's default
+local install), so the identity comes from whatever the transport can
+attest: an authenticator callback, or the anonymous default.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class Identity:
+    principal: str = ""
+    scopes: List[str] = field(default_factory=list)
+
+    @classmethod
+    def root(cls) -> "Identity":
+        return cls(principal="root", scopes=["Root"])
+
+    @classmethod
+    def anonymous(cls) -> "Identity":
+        return cls(principal="anonymous", scopes=[])

@@ -1,0 +1,90 @@
+"""The `fluvio`-equivalent CLI (parity: fluvio-cli).
+
+Run as ``python -m fluvio_tpu.cli <command>``. Commands: produce, consume,
+topic, partition, smartmodule, tableformat, spu, profile, cluster, run,
+metrics, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from fluvio_tpu.cli.common import CliError
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from fluvio_tpu.cli import cluster as cluster_cmd
+    from fluvio_tpu.cli import consume as consume_cmd
+    from fluvio_tpu.cli import crud
+    from fluvio_tpu.cli import metrics as metrics_cmd
+    from fluvio_tpu.cli import produce as produce_cmd
+    from fluvio_tpu.cli.common import add_connection_args
+
+    parser = argparse.ArgumentParser(
+        prog="fluvio-tpu",
+        description="TPU-native streaming platform CLI",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    produce_cmd.add_produce_parser(sub)
+    consume_cmd.add_consume_parser(sub)
+    for add in (
+        crud.add_topic_parser,
+        crud.add_partition_parser,
+        crud.add_smartmodule_parser,
+        crud.add_tableformat_parser,
+        crud.add_spu_parser,
+        crud.add_profile_parser,
+        cluster_cmd.add_cluster_parser,
+        cluster_cmd.add_run_parser,
+        metrics_cmd.add_metrics_parser,
+    ):
+        add(sub)
+
+    version = sub.add_parser("version", help="print the framework version")
+    version.set_defaults(fn=_version)
+
+    # attach --sc to every leaf subcommand that talks to the cluster
+    for action in sub.choices.values():
+        _ensure_connection_args(action, add_connection_args)
+    return parser
+
+
+def _ensure_connection_args(parser: argparse.ArgumentParser, add) -> None:
+    """Attach --sc to leaf subcommands that talk to the cluster."""
+    subparsers = [
+        a for a in parser._actions if isinstance(a, argparse._SubParsersAction)
+    ]
+    if subparsers:
+        for sp in subparsers:
+            for child in sp.choices.values():
+                _ensure_connection_args(child, add)
+        return
+    if not any(a.dest == "sc" for a in parser._actions):
+        add(parser)
+
+
+async def _version(args) -> int:
+    from fluvio_tpu import __version__
+
+    print(f"fluvio-tpu {__version__}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    fn = getattr(args, "fn", None)
+    if fn is None:
+        parser.print_help()
+        return 2
+    try:
+        return asyncio.run(fn(args))
+    except CliError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except (ConnectionError, OSError) as e:
+        print(f"connection error: {e}", file=sys.stderr)
+        return 1

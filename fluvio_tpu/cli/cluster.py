@@ -1,0 +1,140 @@
+"""`cluster` and `run` subcommands.
+
+Capability parity: fluvio-cluster/src/cli/ (start/delete/status/check +
+diagnostics) and fluvio-run (hosting sc/spu — delegated to
+``fluvio_tpu.run``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from fluvio_tpu.cli.output import render_table
+from fluvio_tpu.cluster.local import DEFAULT_DATA_DIR
+
+
+def add_cluster_parser(sub: argparse._SubParsersAction) -> None:
+    cluster = sub.add_parser("cluster", help="manage a local cluster")
+    csub = cluster.add_subparsers(dest="action", required=True)
+
+    start = csub.add_parser("start", help="start a local cluster")
+    start.add_argument("--local", action="store_true", default=True,
+                       help="local process mode (the only mode here)")
+    start.add_argument("--spu", type=int, default=1, dest="spus",
+                       help="number of SPUs")
+    start.add_argument("--data-dir", default=DEFAULT_DATA_DIR)
+    start.add_argument("--engine", default="auto",
+                       choices=["auto", "python", "tpu"])
+    start.add_argument("--sc-port", type=int, default=0)
+    start.add_argument("--skip-checks", action="store_true")
+    start.add_argument("--profile", default="local")
+    start.set_defaults(fn=cluster_start)
+
+    delete = csub.add_parser("delete", help="tear the local cluster down")
+    delete.add_argument("--data-dir", default=DEFAULT_DATA_DIR)
+    delete.add_argument("--keep-data", action="store_true")
+    delete.add_argument("--profile", default="local")
+    delete.set_defaults(fn=cluster_delete)
+
+    status = csub.add_parser("status", help="report cluster health")
+    status.add_argument("--data-dir", default=DEFAULT_DATA_DIR)
+    status.set_defaults(fn=cluster_status_cmd)
+
+    check = csub.add_parser("check", help="run preflight checks")
+    check.add_argument("--data-dir", default=DEFAULT_DATA_DIR)
+    check.set_defaults(fn=cluster_check)
+
+    diag = csub.add_parser("diagnostics", help="collect logs + state bundle")
+    diag.add_argument("--data-dir", default=DEFAULT_DATA_DIR)
+    diag.set_defaults(fn=cluster_diagnostics)
+
+
+async def cluster_start(args) -> int:
+    from fluvio_tpu.cluster.local import LocalConfig, LocalInstaller
+
+    installer = LocalInstaller(
+        LocalConfig(
+            data_dir=args.data_dir,
+            spus=args.spus,
+            sc_public_port=args.sc_port,
+            engine=args.engine,
+            skip_checks=args.skip_checks,
+            profile_name=args.profile,
+        )
+    )
+    state = await installer.install()
+    print(f"SC on {state['sc_public']}")
+    for spu in state["spus"]:
+        print(f"SPU {spu['id']} on {spu['public']}")
+    print(f"profile \"{args.profile}\" activated")
+    return 0
+
+
+async def cluster_delete(args) -> int:
+    from fluvio_tpu.cluster.delete import delete_local_cluster
+
+    if delete_local_cluster(args.data_dir, args.keep_data, args.profile):
+        print("cluster deleted")
+        return 0
+    print("no local cluster found")
+    return 1
+
+
+async def cluster_status_cmd(args) -> int:
+    from fluvio_tpu.cluster.status import cluster_status
+
+    report = await cluster_status(args.data_dir)
+    print(json.dumps(report, indent=2))
+    return 0 if report.get("sc_reachable") else 1
+
+
+async def cluster_check(args) -> int:
+    from fluvio_tpu.cluster.check import ClusterChecker
+
+    results = ClusterChecker.local_preflight(args.data_dir).run()
+    rows = [
+        ["ok" if r.ok else "FAIL", r.name, r.message or "-"] for r in results
+    ]
+    print(render_table(["STATUS", "CHECK", "DETAIL"], rows))
+    return 0 if all(r.ok for r in results) else 1
+
+
+async def cluster_diagnostics(args) -> int:
+    """Bundle state + logs into a tar (cli/diagnostics.rs:463)."""
+    import tarfile
+    import time
+    from pathlib import Path
+
+    data_dir = Path(args.data_dir).expanduser()
+    if not data_dir.exists():
+        print("no local cluster data")
+        return 1
+    bundle = Path.cwd() / f"diagnostics-{int(time.time())}.tar.gz"
+    with tarfile.open(bundle, "w:gz") as tar:
+        for item in data_dir.glob("*.log"):
+            tar.add(item, arcname=item.name)
+        state = data_dir / "cluster-state.json"
+        if state.exists():
+            tar.add(state, arcname=state.name)
+    print(f"wrote {bundle}")
+    return 0
+
+
+def add_run_parser(sub: argparse._SubParsersAction) -> None:
+    run = sub.add_parser("run", help="host an SC or SPU process")
+    run.add_argument("role", choices=["sc", "spu"])
+    run.add_argument("rest", nargs=argparse.REMAINDER)
+    run.set_defaults(fn=run_cmd)
+
+
+async def run_cmd(args) -> int:
+    """Delegate to fluvio_tpu.run in-process (fluvio-run parity)."""
+    from fluvio_tpu.run import build_parser, run_sc, run_spu
+
+    sub_args = build_parser().parse_args([args.role, *args.rest])
+    if args.role == "sc":
+        await run_sc(sub_args)
+    else:
+        await run_spu(sub_args)
+    return 0

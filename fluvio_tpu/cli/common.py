@@ -1,0 +1,168 @@
+"""Shared CLI helpers: connection resolution + SmartModule flag parsing.
+
+Capability parity: fluvio-cli's common target resolution (profile or
+--sc override) and the produce/consume SmartModule flag family
+(consume/mod.rs:163-211 — --smartmodule / --smartmodule-path /
+--params / --aggregate-initial / --transforms-file / --transforms-line).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+from fluvio_tpu.client import Fluvio
+from fluvio_tpu.schema.smartmodule import (
+    SmartModuleInvocation,
+    SmartModuleInvocationWasm,
+)
+from fluvio_tpu.smartengine.config import TransformationConfig
+
+
+class CliError(Exception):
+    pass
+
+
+async def connect(args: argparse.Namespace) -> Fluvio:
+    """Dial --sc/--spu override or the active profile's endpoint."""
+    addr = getattr(args, "sc", None) or getattr(args, "spu", None)
+    return await Fluvio.connect(addr)
+
+
+def add_connection_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--sc", metavar="HOST:PORT", help="SC public endpoint (overrides profile)"
+    )
+
+
+def add_smartmodule_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--smartmodule",
+        metavar="NAME",
+        help="named SmartModule loaded on the cluster",
+    )
+    parser.add_argument(
+        "--smartmodule-path",
+        metavar="FILE",
+        help="local SmartModule source file (sent ad-hoc)",
+    )
+    parser.add_argument(
+        "-e",
+        "--params",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="SmartModule init params (repeatable)",
+    )
+    parser.add_argument(
+        "--aggregate-initial",
+        metavar="VALUE",
+        help="aggregate accumulator seed",
+    )
+    parser.add_argument(
+        "--lookback",
+        metavar="N",
+        type=int,
+        help="feed the last N records to the module's look_back",
+    )
+    parser.add_argument(
+        "--transforms-file",
+        metavar="FILE",
+        help="TransformationConfig YAML (transforms: [{uses, with}])",
+    )
+    parser.add_argument(
+        "--transforms-line",
+        action="append",
+        default=[],
+        metavar="JSON",
+        help='one transform as JSON, e.g. \'{"uses":"m","with":{"k":"v"}}\'',
+    )
+
+
+def parse_params(pairs: List[str]) -> dict:
+    params = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise CliError(f"invalid param {pair!r}: expected KEY=VALUE")
+        k, _, v = pair.partition("=")
+        params[k] = v
+    return params
+
+
+def build_invocations(args: argparse.Namespace) -> List[SmartModuleInvocation]:
+    """Turn the SmartModule flag family into wire invocations."""
+    sources = [
+        bool(getattr(args, "smartmodule", None)),
+        bool(getattr(args, "smartmodule_path", None)),
+        bool(getattr(args, "transforms_file", None))
+        or bool(getattr(args, "transforms_line", None)),
+    ]
+    if sum(sources) > 1:
+        raise CliError(
+            "--smartmodule, --smartmodule-path and --transforms-* are exclusive"
+        )
+
+    if getattr(args, "transforms_file", None):
+        with open(args.transforms_file) as f:
+            config = TransformationConfig.from_yaml(f.read())
+        return transforms_to_invocations(config)
+
+    if getattr(args, "transforms_line", None):
+        import json
+
+        steps = []
+        for line in args.transforms_line:
+            entry = json.loads(line)
+            steps.append(
+                {
+                    "uses": entry["uses"],
+                    "with": entry.get("with", {}),
+                    "lookback": entry.get("lookback"),
+                }
+            )
+        config = TransformationConfig.from_yaml(
+            __import__("yaml").safe_dump({"transforms": steps})
+        )
+        return transforms_to_invocations(config)
+
+    name = getattr(args, "smartmodule", None)
+    path = getattr(args, "smartmodule_path", None)
+    if not name and not path:
+        return []
+
+    if path:
+        with open(path, "rb") as f:
+            wasm = SmartModuleInvocationWasm.adhoc(f.read())
+        display = path
+    else:
+        wasm = SmartModuleInvocationWasm.predefined(name)
+        display = name
+
+    inv = SmartModuleInvocation(
+        wasm=wasm,
+        params=parse_params(getattr(args, "params", [])),
+        name=display,
+    )
+    if getattr(args, "aggregate_initial", None):
+        inv.accumulator = args.aggregate_initial.encode()
+    if getattr(args, "lookback", None):
+        inv.lookback_last = args.lookback
+    return [inv]
+
+
+def transforms_to_invocations(
+    config: TransformationConfig,
+) -> List[SmartModuleInvocation]:
+    invocations = []
+    for step in config.transforms:
+        inv = SmartModuleInvocation(
+            wasm=SmartModuleInvocationWasm.predefined(step.uses),
+            params=dict(step.with_params),
+            name=step.uses,
+        )
+        if step.lookback is not None:
+            inv.lookback_last = step.lookback.last
+            if step.lookback.age_ms is not None:
+                inv.lookback_age_ms = step.lookback.age_ms
+        invocations.append(inv)
+    return invocations

@@ -1,0 +1,148 @@
+"""`consume` subcommand.
+
+Capability parity: fluvio-cli/src/client/consume/mod.rs — offset flags
+(-B/--beginning, -H/--head, -T/--tail, --start, -e/--end-offset), -d to
+stop at log end, -n max records, partition selection, the SmartModule
+flag family, key display, and output formats (dynamic/text/json plus a
+`--format` template with {{key}}/{{value}}/{{offset}} substitution).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from fluvio_tpu.cli.common import (
+    CliError,
+    add_connection_args,
+    add_smartmodule_args,
+    build_invocations,
+    connect,
+)
+from fluvio_tpu.client import ConsumerConfig, Offset
+from fluvio_tpu.schema.spu import Isolation
+
+
+def add_consume_parser(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("consume", help="read records from a topic")
+    p.add_argument("topic")
+    p.add_argument("-p", "--partition", type=int, default=0)
+    p.add_argument(
+        "-B", "--beginning", action="store_true", help="start from offset 0"
+    )
+    p.add_argument(
+        "-H", "--head", type=int, metavar="N", help="start N after the beginning"
+    )
+    p.add_argument(
+        "-T", "--tail", type=int, metavar="N", help="start N back from the end"
+    )
+    p.add_argument("--start", type=int, metavar="OFFSET", help="absolute offset")
+    p.add_argument(
+        "-d",
+        "--disable-continuous",
+        action="store_true",
+        help="stop when the end of the log is reached",
+    )
+    p.add_argument("-n", "--num-records", type=int, metavar="N")
+    p.add_argument("-k", "--key-value", action="store_true", help="show keys")
+    p.add_argument(
+        "--isolation",
+        choices=["read-uncommitted", "read-committed"],
+        default="read-uncommitted",
+    )
+    p.add_argument("--max-bytes", type=int)
+    p.add_argument(
+        "-O",
+        "--output",
+        choices=["dynamic", "text", "json", "raw"],
+        default="dynamic",
+    )
+    p.add_argument(
+        "--format",
+        help="per-record template, e.g. '{{offset}}: {{key}} -> {{value}}'",
+    )
+    add_smartmodule_args(p)
+    add_connection_args(p)
+    p.set_defaults(fn=consume)
+
+
+def _resolve_offset(args) -> Offset:
+    picked = [
+        args.beginning,
+        args.head is not None,
+        args.tail is not None,
+        args.start is not None,
+    ]
+    if sum(picked) > 1:
+        raise CliError("pick one of -B / -H / -T / --start")
+    if args.beginning:
+        return Offset.beginning()
+    if args.head is not None:
+        return Offset.from_beginning(args.head)
+    if args.tail is not None:
+        return Offset.from_end(args.tail)
+    if args.start is not None:
+        return Offset.absolute(args.start)
+    return Offset.end()
+
+
+def _print_record(record, args) -> None:
+    key = record.key.decode("utf-8", "replace") if record.key else None
+    value = record.value.decode("utf-8", "replace")
+    if args.format:
+        line = (
+            args.format.replace("{{key}}", key or "null")
+            .replace("{{value}}", value)
+            .replace("{{offset}}", str(record.offset))
+            .replace("{{partition}}", str(record.partition))
+            .replace("{{time}}", str(record.timestamp))
+        )
+        print(line)
+        return
+    if args.output == "json":
+        print(
+            json.dumps(
+                {"key": key, "value": value, "offset": record.offset},
+                ensure_ascii=False,
+            )
+        )
+        return
+    if args.output == "raw":
+        sys.stdout.buffer.write(record.value)
+        sys.stdout.buffer.write(b"\n")
+        return
+    if args.key_value and key is not None:
+        print(f"[{key}] {value}")
+    else:
+        print(value)
+
+
+async def consume(args) -> int:
+    offset = _resolve_offset(args)
+    config = ConsumerConfig(
+        isolation=(
+            Isolation.READ_COMMITTED
+            if args.isolation == "read-committed"
+            else Isolation.READ_UNCOMMITTED
+        ),
+        smartmodules=build_invocations(args),
+        disable_continuous=args.disable_continuous,
+    )
+    if args.max_bytes:
+        config.max_bytes = args.max_bytes
+
+    client = await connect(args)
+    seen = 0
+    try:
+        consumer = await client.partition_consumer(args.topic, args.partition)
+        async for record in consumer.stream(offset, config):
+            _print_record(record, args)
+            seen += 1
+            if args.num_records and seen >= args.num_records:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        await client.close()
+    return 0

@@ -1,0 +1,555 @@
+"""Object CRUD subcommands: topic / partition / smartmodule / tableformat /
+spu / profile.
+
+Capability parity: fluvio-cli/src/client/{topic,partition,smartmodule,
+tableformat}/ and src/profile/ — create/delete/list/describe with
+table/json/yaml output.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fluvio_tpu.cli.common import CliError, connect, parse_params
+from fluvio_tpu.cli.output import OUTPUT_FORMATS, render_objects, render_table
+from fluvio_tpu.client.config import ConfigFile
+from fluvio_tpu.metadata.topic import (
+    Bounds,
+    Deduplication,
+    Filter,
+    ReplicaSpec,
+    TopicSpec,
+    Transform,
+)
+
+
+def _add_output_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "-O", "--output", choices=OUTPUT_FORMATS, default="table",
+        help="output rendering",
+    )
+
+
+# ---------------------------------------------------------------------------
+# topic
+# ---------------------------------------------------------------------------
+
+
+def add_topic_parser(sub: argparse._SubParsersAction) -> None:
+    topic = sub.add_parser("topic", help="manage topics")
+    tsub = topic.add_subparsers(dest="action", required=True)
+
+    create = tsub.add_parser("create", help="create a topic")
+    create.add_argument("name")
+    create.add_argument("-p", "--partitions", type=int, default=1)
+    create.add_argument("-r", "--replication", type=int, default=1)
+    create.add_argument("-i", "--ignore-rack-assignment", action="store_true")
+    create.add_argument("--retention-time", type=int, metavar="SECONDS")
+    create.add_argument("--segment-size", type=int, metavar="BYTES")
+    create.add_argument("--max-partition-size", type=int, metavar="BYTES")
+    create.add_argument(
+        "--dedup-count", type=int, metavar="N",
+        help="deduplication window size (records)",
+    )
+    create.add_argument(
+        "--dedup-age", type=int, metavar="SECONDS",
+        help="deduplication window age bound",
+    )
+    create.add_argument(
+        "--dedup-filter", default="dedup-filter", metavar="SMARTMODULE",
+        help="SmartModule implementing the dedup filter",
+    )
+    create.set_defaults(fn=topic_create)
+
+    delete = tsub.add_parser("delete", help="delete a topic")
+    delete.add_argument("name")
+    delete.set_defaults(fn=topic_delete)
+
+    lst = tsub.add_parser("list", help="list topics")
+    _add_output_arg(lst)
+    lst.set_defaults(fn=topic_list)
+
+    describe = tsub.add_parser("describe", help="show one topic")
+    describe.add_argument("name")
+    _add_output_arg(describe)
+    describe.set_defaults(fn=topic_describe)
+
+
+async def topic_create(args) -> int:
+    spec = TopicSpec(
+        replicas=ReplicaSpec.computed(
+            args.partitions, args.replication, args.ignore_rack_assignment
+        )
+    )
+    if args.retention_time is not None:
+        spec.retention_seconds = args.retention_time
+    if args.segment_size is not None or args.max_partition_size is not None:
+        from fluvio_tpu.metadata.topic import TopicStorageConfig
+
+        spec.storage = TopicStorageConfig(
+            segment_size=args.segment_size,
+            max_partition_size=args.max_partition_size,
+        )
+    if args.dedup_age is not None and args.dedup_count is None:
+        raise CliError("--dedup-age requires --dedup-count")
+    if args.dedup_count is not None:
+        spec.deduplication = Deduplication(
+            bounds=Bounds(count=args.dedup_count, age_seconds=args.dedup_age),
+            filter=Filter(transform=Transform(uses=args.dedup_filter)),
+        )
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        await admin.create_topic(args.name, spec)
+        print(f"topic \"{args.name}\" created")
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+async def topic_delete(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        await admin.delete_topic(args.name)
+        print(f"topic \"{args.name}\" deleted")
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+def _topic_row(obj: dict):
+    spec, status = obj["spec"], obj["status"] or {}
+    replicas = spec.get("replicas", {})
+    retention = spec.get("retention_seconds")
+    return [
+        obj["name"],
+        replicas.get("partitions", "-"),
+        replicas.get("replication_factor", "-"),
+        str(bool(replicas.get("ignore_rack_assignment", False))).lower(),
+        status.get("resolution", "-"),
+        f"{retention}s" if retention else "-",
+    ]
+
+
+async def topic_list(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        objs = await admin.list_topics()
+        plain = [
+            {"name": o.key, "spec": o.spec.to_dict(), "status": _status_dict(o)}
+            for o in objs
+        ]
+        render_objects(
+            plain,
+            ["NAME", "PARTITIONS", "REPLICAS", "IGNORE-RACK", "STATUS", "RETENTION"],
+            _topic_row,
+            args.output,
+        )
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+async def topic_describe(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        objs = await admin.list("topic", [args.name])
+        if not objs:
+            raise CliError(f"topic {args.name!r} not found")
+        o = objs[0]
+        plain = [{"name": o.key, "spec": o.spec.to_dict(), "status": _status_dict(o)}]
+        fmt = "yaml" if args.output == "table" else args.output
+        render_objects(plain, [], None, fmt)
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+def _status_dict(obj) -> dict:
+    status = getattr(obj, "status", None)
+    if status is None:
+        return {}
+    if hasattr(status, "to_dict"):
+        return status.to_dict()
+    import dataclasses
+
+    if dataclasses.is_dataclass(status):
+        return dataclasses.asdict(status)
+    return dict(status) if isinstance(status, dict) else {"value": str(status)}
+
+
+# ---------------------------------------------------------------------------
+# partition
+# ---------------------------------------------------------------------------
+
+
+def add_partition_parser(sub) -> None:
+    part = sub.add_parser("partition", help="inspect partitions")
+    psub = part.add_subparsers(dest="action", required=True)
+    lst = psub.add_parser("list", help="list partitions")
+    _add_output_arg(lst)
+    lst.set_defaults(fn=partition_list)
+
+
+def _partition_row(obj: dict):
+    spec, status = obj["spec"], obj["status"] or {}
+    lrs = status.get("lrs") or {}
+    return [
+        obj["name"],
+        spec.get("leader", "-"),
+        ",".join(str(r) for r in spec.get("replicas", [])),
+        status.get("resolution", "-"),
+        lrs.get("hw", "-"),
+        lrs.get("leo", "-"),
+    ]
+
+
+async def partition_list(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        objs = await admin.list("partition")
+        plain = [
+            {"name": o.key, "spec": o.spec.to_dict(), "status": _status_dict(o)}
+            for o in objs
+        ]
+        render_objects(
+            plain,
+            ["PARTITION", "LEADER", "REPLICAS", "RESOLUTION", "HW", "LEO"],
+            _partition_row,
+            args.output,
+        )
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# smartmodule
+# ---------------------------------------------------------------------------
+
+
+def add_smartmodule_parser(sub) -> None:
+    sm = sub.add_parser("smartmodule", help="manage SmartModules")
+    ssub = sm.add_subparsers(dest="action", required=True)
+
+    create = ssub.add_parser("create", help="load a SmartModule from source")
+    create.add_argument("name")
+    create.add_argument("--wasm-file", "--file", dest="file", required=True,
+                        help="SmartModule source artifact")
+    create.set_defaults(fn=smartmodule_create)
+
+    delete = ssub.add_parser("delete", help="delete a SmartModule")
+    delete.add_argument("name")
+    delete.set_defaults(fn=smartmodule_delete)
+
+    lst = ssub.add_parser("list", help="list SmartModules")
+    _add_output_arg(lst)
+    lst.set_defaults(fn=smartmodule_list)
+
+
+async def smartmodule_create(args) -> int:
+    with open(args.file, "rb") as f:
+        payload = f.read()
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        await admin.create_smartmodule(args.name, payload)
+        print(f"smartmodule \"{args.name}\" created")
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+async def smartmodule_delete(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        await admin.delete(args.name, "smartmodule")
+        print(f"smartmodule \"{args.name}\" deleted")
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+async def smartmodule_list(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        objs = await admin.list("smartmodule")
+        plain = [
+            {"name": o.key, "spec": o.spec.to_dict(), "status": _status_dict(o)}
+            for o in objs
+        ]
+        render_objects(
+            plain,
+            ["SMARTMODULE", "FORMAT", "SIZE"],
+            lambda o: [
+                o["name"],
+                (o["spec"].get("artifact") or {}).get("format", "-"),
+                len((o["spec"].get("artifact") or {}).get("payload") or ""),
+            ],
+            args.output,
+        )
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# tableformat
+# ---------------------------------------------------------------------------
+
+
+def add_tableformat_parser(sub) -> None:
+    tf = sub.add_parser("tableformat", help="manage table formats")
+    tsub = tf.add_subparsers(dest="action", required=True)
+
+    create = tsub.add_parser("create", help="create from a YAML config")
+    create.add_argument("--config", "-c", required=True)
+    create.set_defaults(fn=tableformat_create)
+
+    delete = tsub.add_parser("delete", help="delete a tableformat")
+    delete.add_argument("name")
+    delete.set_defaults(fn=tableformat_delete)
+
+    lst = tsub.add_parser("list", help="list tableformats")
+    _add_output_arg(lst)
+    lst.set_defaults(fn=tableformat_list)
+
+
+async def tableformat_create(args) -> int:
+    import yaml
+
+    with open(args.config) as f:
+        doc = yaml.safe_load(f)
+    name = doc.get("name")
+    if not name:
+        raise CliError("tableformat config needs a `name`")
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        await admin.create(name, "tableformat", doc)
+        print(f"tableformat \"{name}\" created")
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+async def tableformat_delete(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        await admin.delete(args.name, "tableformat")
+        print(f"tableformat \"{args.name}\" deleted")
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+async def tableformat_list(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        objs = await admin.list("tableformat")
+        plain = [
+            {"name": o.key, "spec": o.spec.to_dict(), "status": _status_dict(o)}
+            for o in objs
+        ]
+        render_objects(
+            plain,
+            ["TABLEFORMAT", "COLUMNS"],
+            lambda o: [
+                o["name"],
+                ",".join(
+                    c.get("key_path", "?")
+                    for c in (o["spec"].get("columns") or [])
+                ),
+            ],
+            args.output,
+        )
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# spu
+# ---------------------------------------------------------------------------
+
+
+def add_spu_parser(sub) -> None:
+    spu = sub.add_parser("spu", help="inspect SPUs")
+    ssub = spu.add_subparsers(dest="action", required=True)
+    lst = ssub.add_parser("list", help="list SPUs")
+    _add_output_arg(lst)
+    lst.set_defaults(fn=spu_list)
+
+    register = ssub.add_parser("register", help="register a custom SPU")
+    register.add_argument("--id", type=int, required=True)
+    register.add_argument("--public-server", required=True, metavar="HOST:PORT")
+    register.add_argument("--private-server", default="", metavar="HOST:PORT")
+    register.add_argument("--rack")
+    register.set_defaults(fn=spu_register)
+
+
+def _spu_row(obj: dict):
+    spec, status = obj["spec"], obj["status"] or {}
+    pub = spec.get("public_endpoint") or {}
+    return [
+        spec.get("id", obj["name"]),
+        spec.get("spu_type", "-"),
+        f"{pub.get('host', '')}:{pub.get('port', '')}",
+        spec.get("rack") or "-",
+        status.get("resolution", "-"),
+    ]
+
+
+async def spu_list(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        objs = await admin.list("spu")
+        plain = [
+            {"name": o.key, "spec": o.spec.to_dict(), "status": _status_dict(o)}
+            for o in objs
+        ]
+        render_objects(
+            plain,
+            ["ID", "TYPE", "PUBLIC", "RACK", "STATUS"],
+            _spu_row,
+            args.output,
+        )
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+async def spu_register(args) -> int:
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        await admin.register_custom_spu(
+            args.id, args.public_server, args.private_server, args.rack
+        )
+        print(f"custom spu {args.id} registered")
+        await admin.close()
+    finally:
+        await client.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# profile
+# ---------------------------------------------------------------------------
+
+
+def add_profile_parser(sub) -> None:
+    prof = sub.add_parser("profile", help="manage connection profiles")
+    psub = prof.add_subparsers(dest="action", required=True)
+
+    psub.add_parser("current", help="print the active profile").set_defaults(
+        fn=profile_current
+    )
+    lst = psub.add_parser("list", help="list profiles")
+    _add_output_arg(lst)
+    lst.set_defaults(fn=profile_list)
+
+    switch = psub.add_parser("switch", help="switch the active profile")
+    switch.add_argument("name")
+    switch.set_defaults(fn=profile_switch)
+
+    rename = psub.add_parser("rename", help="rename a profile")
+    rename.add_argument("old")
+    rename.add_argument("new")
+    rename.set_defaults(fn=profile_rename)
+
+    delete = psub.add_parser("delete-profile", help="delete a profile")
+    delete.add_argument("name")
+    delete.set_defaults(fn=profile_delete)
+
+    delc = psub.add_parser("delete-cluster", help="delete a cluster entry")
+    delc.add_argument("name")
+    delc.set_defaults(fn=profile_delete_cluster)
+
+    add = psub.add_parser("add", help="add a cluster + profile")
+    add.add_argument("name")
+    add.add_argument("endpoint", metavar="HOST:PORT")
+    add.set_defaults(fn=profile_add)
+
+
+async def profile_current(args) -> int:
+    cf = ConfigFile.load()
+    print(cf.config.current_profile_name())
+    return 0
+
+
+async def profile_list(args) -> int:
+    cf = ConfigFile.load()
+    rows = []
+    for name, prof in sorted(cf.config.profiles.items()):
+        cluster = cf.config.clusters.get(prof.cluster)
+        rows.append(
+            [
+                "*" if name == cf.config.current_profile else "",
+                name,
+                prof.cluster,
+                cluster.endpoint if cluster else "?",
+            ]
+        )
+    print(render_table(["", "PROFILE", "CLUSTER", "ADDRESS"], rows))
+    return 0
+
+
+async def profile_switch(args) -> int:
+    cf = ConfigFile.load()
+    cf.config.set_current_profile(args.name)
+    cf.save()
+    print(f"switched to profile \"{args.name}\"")
+    return 0
+
+
+async def profile_rename(args) -> int:
+    cf = ConfigFile.load()
+    cf.config.rename_profile(args.old, args.new)
+    cf.save()
+    return 0
+
+
+async def profile_delete(args) -> int:
+    cf = ConfigFile.load()
+    cf.config.delete_profile(args.name)
+    cf.save()
+    return 0
+
+
+async def profile_delete_cluster(args) -> int:
+    cf = ConfigFile.load()
+    cf.config.delete_cluster(args.name)
+    cf.save()
+    return 0
+
+
+async def profile_add(args) -> int:
+    from fluvio_tpu.client.config import FluvioClusterConfig
+
+    cf = ConfigFile.load()
+    cf.config.add_cluster(args.name, FluvioClusterConfig(endpoint=args.endpoint))
+    cf.save()
+    print(f"profile \"{args.name}\" -> {args.endpoint}")
+    return 0

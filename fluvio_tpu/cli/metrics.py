@@ -1,0 +1,26 @@
+"""`metrics` subcommand — read an SPU's monitoring socket.
+
+Capability parity: fluvio-cli/src/monitoring.rs (the CLI-side reader of
+the SPU metrics unix socket).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def add_metrics_parser(sub) -> None:
+    p = sub.add_parser("metrics", help="dump SPU metrics")
+    p.add_argument(
+        "--path",
+        help="monitoring unix-socket path (default: FLUVIO_METRIC_SPU)",
+    )
+    p.set_defaults(fn=metrics)
+
+
+async def metrics(args) -> int:
+    from fluvio_tpu.spu.monitoring import read_metrics
+
+    data = await read_metrics(args.path)
+    print(json.dumps(data, indent=2))
+    return 0

@@ -1,0 +1,46 @@
+"""CLI output rendering.
+
+Capability parity: fluvio-extension-common/src/output/ — the `Terminal`
+abstraction and table/json/yaml serde rendering the CLI's list commands
+use (`-O table|json|yaml`).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Iterable, List, Sequence
+
+import yaml
+
+OUTPUT_FORMATS = ("table", "json", "yaml")
+
+
+def render_table(headers: Sequence[str], rows: Iterable[Sequence[str]]) -> str:
+    """Plain left-aligned column table, like the reference's prettytable."""
+    rows = [[str(c) for c in row] for row in rows]
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip()]
+    for row in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+    return "\n".join(lines)
+
+
+def render_objects(
+    objects: List[dict],
+    headers: Sequence[str],
+    row_fn,
+    fmt: str = "table",
+    out=None,
+) -> None:
+    """Render admin objects as a table or serde dump (output/mod.rs)."""
+    out = out or sys.stdout
+    if fmt == "json":
+        print(json.dumps(objects, indent=2, default=str), file=out)
+    elif fmt == "yaml":
+        print(yaml.safe_dump(objects, sort_keys=False).rstrip(), file=out)
+    else:
+        print(render_table(headers, [row_fn(o) for o in objects]), file=out)

@@ -1,0 +1,97 @@
+"""`produce` subcommand.
+
+Capability parity: fluvio-cli/src/client/produce/mod.rs — read records
+from stdin/file (one per line or whole-file), optional key separator or
+fixed key, SmartModule / transforms flags applied producer-side,
+compression and linger/batch knobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from fluvio_tpu.cli.common import (
+    add_connection_args,
+    add_smartmodule_args,
+    build_invocations,
+    connect,
+)
+from fluvio_tpu.client import ProducerConfig
+from fluvio_tpu.protocol.compression import Compression
+
+
+def add_produce_parser(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("produce", help="write records to a topic")
+    p.add_argument("topic")
+    p.add_argument(
+        "-f", "--file", help="read records from a file instead of stdin"
+    )
+    p.add_argument(
+        "--raw",
+        action="store_true",
+        help="send the whole input as ONE record (instead of one per line)",
+    )
+    p.add_argument(
+        "--key-separator",
+        metavar="SEP",
+        help="split each line into key<SEP>value",
+    )
+    p.add_argument("--key", help="fixed record key for all records")
+    p.add_argument(
+        "--compression",
+        choices=["none", "gzip", "snappy", "lz4", "zstd"],
+        default="none",
+    )
+    p.add_argument("--linger", type=int, metavar="MS", help="batch linger ms")
+    p.add_argument("--batch-size", type=int, metavar="BYTES")
+    add_smartmodule_args(p)
+    add_connection_args(p)
+    p.set_defaults(fn=produce)
+
+
+async def produce(args) -> int:
+    invocations = build_invocations(args)
+    config = ProducerConfig(
+        compression=Compression[args.compression.upper()],
+        smartmodules=invocations,
+    )
+    if args.linger is not None:
+        config.linger_ms = args.linger
+    if args.batch_size is not None:
+        config.batch_size = args.batch_size
+
+    if args.file:
+        with open(args.file, "rb") as f:
+            data = f.read()
+    else:
+        data = sys.stdin.buffer.read()
+
+    records: list[tuple[bytes | None, bytes]] = []
+    fixed_key = args.key.encode() if args.key else None
+    if args.raw:
+        records.append((fixed_key, data))
+    else:
+        for line in data.splitlines():
+            if not line:
+                continue
+            if args.key_separator:
+                sep = args.key_separator.encode()
+                if sep in line:
+                    key, _, value = line.partition(sep)
+                    records.append((key, value))
+                    continue
+            records.append((fixed_key, line))
+
+    client = await connect(args)
+    try:
+        producer = await client.topic_producer(args.topic, config=config)
+        futures = [await producer.send(k, v) for k, v in records]
+        await producer.flush()
+        for fut in futures:
+            await fut.wait()
+        await producer.close()
+    finally:
+        await client.close()
+    print(f"{len(records)} records sent to \"{args.topic}\"", file=sys.stderr)
+    return 0

@@ -1,10 +1,18 @@
 """Client library (parity: the `fluvio` crate, L7).
 
-`Fluvio.connect` -> producer / consumer / (admin once the SC lands).
-Until the control plane exists, `connect` points at an SPU directly and
-partition routing uses a static single-SPU pool.
+`Fluvio.connect` -> producer / consumer / admin against an SC public
+endpoint (with the client-side metadata mirror and leader-routed SPU
+pool), or a lone SPU directly. With no address, the active profile from
+``~/.fluvio-tpu/config`` is used.
 """
 
+from fluvio_tpu.client.config import (  # noqa: F401
+    Config,
+    ConfigFile,
+    FluvioClusterConfig,
+    Profile,
+    TlsPolicy,
+)
 from fluvio_tpu.client.fluvio import Fluvio  # noqa: F401
 from fluvio_tpu.client.offset import Offset  # noqa: F401
 from fluvio_tpu.client.producer import (  # noqa: F401

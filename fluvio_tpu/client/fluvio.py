@@ -93,8 +93,16 @@ class Fluvio:
         self._sc_addr = sc_addr
 
     @classmethod
-    async def connect(cls, addr: str) -> "Fluvio":
-        """Connect to a cluster: an SC public endpoint or a lone SPU."""
+    async def connect(cls, addr: Optional[str] = None) -> "Fluvio":
+        """Connect to a cluster: an SC public endpoint or a lone SPU.
+
+        With no address, the active profile's endpoint is used
+        (parity: Fluvio::connect -> ConfigFile, fluvio.rs:56).
+        """
+        if addr is None:
+            from fluvio_tpu.client.config import current_cluster_endpoint
+
+            addr = current_cluster_endpoint()
         socket = await VersionedSerialSocket.connect(addr)
         if socket.versions.lookup_version(AdminApiKey.CREATE) is not None:
             metadata = MetadataStores(socket)

@@ -56,9 +56,18 @@ class MetadataStores:
             self._tasks.append(task)
 
     async def _sync_loop(self, kind: str, stream) -> None:
+        from fluvio_tpu.protocol.error import ErrorCode
+
         store = self._store_for(kind)
         try:
             async for resp in stream:
+                if resp.error_code != ErrorCode.NONE:
+                    logger.error(
+                        "metadata watch (%s) rejected: %s",
+                        kind,
+                        resp.error_code.name,
+                    )
+                    return
                 self._apply(store, resp)
         except (ConnectionError, asyncio.CancelledError):
             pass

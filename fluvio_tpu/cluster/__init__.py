@@ -1,0 +1,18 @@
+"""Cluster lifecycle (parity: the `fluvio-cluster` crate).
+
+- :mod:`check` — preflight `ClusterChecker` (check/mod.rs)
+- :mod:`local` — `LocalInstaller`: spawn SC + SPUs as processes, register
+  SPUs, write the client profile (start/local.rs)
+- :mod:`delete` / :mod:`status` — teardown and liveness reporting
+"""
+
+from fluvio_tpu.cluster.check import ClusterChecker, CheckResult  # noqa: F401
+from fluvio_tpu.cluster.local import (  # noqa: F401
+    LocalClusterError,
+    LocalConfig,
+    LocalInstaller,
+    cluster_state_path,
+    load_cluster_state,
+)
+from fluvio_tpu.cluster.delete import delete_local_cluster  # noqa: F401
+from fluvio_tpu.cluster.status import cluster_status  # noqa: F401

@@ -1,0 +1,109 @@
+"""Preflight checks (parity: fluvio-cluster/src/check/mod.rs:967
+`ClusterChecker` with its check list — here the local-install relevant
+ones: interpreter, engine stack, data dir writability, port
+availability, and whether a cluster is already installed)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import tempfile
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+@dataclass
+class CheckResult:
+    name: str
+    ok: bool
+    message: str = ""
+
+
+@dataclass
+class ClusterChecker:
+    checks: List[Callable[[], CheckResult]] = field(default_factory=list)
+
+    @classmethod
+    def local_preflight(
+        cls, data_dir: str, ports: Optional[List[int]] = None
+    ) -> "ClusterChecker":
+        checker = cls()
+        checker.checks.append(_check_python)
+        checker.checks.append(_check_engine_stack)
+        checker.checks.append(lambda: _check_data_dir(data_dir))
+        for port in ports or []:
+            checker.checks.append(lambda p=port: _check_port_free(p))
+        checker.checks.append(lambda: _check_not_installed(data_dir))
+        return checker
+
+    def run(self) -> List[CheckResult]:
+        return [check() for check in self.checks]
+
+    def run_or_fail(self) -> List[CheckResult]:
+        results = self.run()
+        failures = [r for r in results if not r.ok]
+        if failures:
+            lines = "; ".join(f"{r.name}: {r.message}" for r in failures)
+            raise RuntimeError(f"preflight failed: {lines}")
+        return results
+
+
+def _check_python() -> CheckResult:
+    ok = sys.version_info >= (3, 10)
+    return CheckResult(
+        "python", ok, "" if ok else f"need >= 3.10, have {sys.version.split()[0]}"
+    )
+
+
+def _check_engine_stack() -> CheckResult:
+    try:
+        import jax  # noqa: F401
+
+        return CheckResult("engine", True)
+    except Exception as e:  # noqa: BLE001 — report, don't crash preflight
+        return CheckResult(
+            "engine", True, f"jax unavailable ({e}); python backend only"
+        )
+
+
+def _check_data_dir(data_dir: str) -> CheckResult:
+    try:
+        os.makedirs(data_dir, exist_ok=True)
+        with tempfile.TemporaryFile(dir=data_dir):
+            pass
+        return CheckResult("data-dir", True)
+    except OSError as e:
+        return CheckResult("data-dir", False, str(e))
+
+
+def _check_port_free(port: int) -> CheckResult:
+    with socket.socket() as s:
+        try:
+            s.bind(("127.0.0.1", port))
+            return CheckResult(f"port-{port}", True)
+        except OSError:
+            return CheckResult(f"port-{port}", False, "already in use")
+
+
+def _check_not_installed(data_dir: str) -> CheckResult:
+    from fluvio_tpu.cluster.local import cluster_state_path, load_cluster_state
+
+    state = load_cluster_state(data_dir)
+    if state and _pid_alive(state.get("sc_pid")):
+        return CheckResult(
+            "existing-cluster",
+            False,
+            f"cluster already running (state: {cluster_state_path(data_dir)})",
+        )
+    return CheckResult("existing-cluster", True)
+
+
+def _pid_alive(pid) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False

@@ -1,0 +1,61 @@
+"""Local cluster teardown (parity: fluvio-cluster/src/delete.rs:332)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import time
+
+from fluvio_tpu.client.config import ConfigFile
+from fluvio_tpu.cluster.local import cluster_state_path, load_cluster_state
+
+
+def _terminate(pid: int, timeout: float = 5.0) -> None:
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except OSError:
+        return
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return
+        time.sleep(0.05)
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except OSError:
+        pass
+
+
+def delete_local_cluster(
+    data_dir: str, keep_data: bool = False, profile_name: str = "local"
+) -> bool:
+    """Kill SC+SPU processes, remove data, drop the profile.
+
+    Returns False when no cluster state was found.
+    """
+    state = load_cluster_state(data_dir)
+    if state is None:
+        return False
+    for spu in state.get("spus", []):
+        if spu.get("pid"):
+            _terminate(spu["pid"])
+    if state.get("sc_pid"):
+        _terminate(state["sc_pid"])
+    if keep_data:
+        os.remove(cluster_state_path(data_dir))
+    else:
+        shutil.rmtree(os.path.expanduser(data_dir), ignore_errors=True)
+
+    cf = ConfigFile.load()
+    try:
+        if profile_name in cf.config.profiles:
+            cf.config.delete_profile(profile_name)
+        if profile_name in cf.config.clusters:
+            cf.config.delete_cluster(profile_name)
+        cf.save()
+    except Exception:  # noqa: BLE001 — profile cleanup is best-effort
+        pass
+    return True

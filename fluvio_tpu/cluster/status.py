@@ -1,0 +1,52 @@
+"""Cluster status report (parity: fluvio-cluster/src/cli/status.rs:231)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from fluvio_tpu.client import Fluvio
+from fluvio_tpu.cluster.local import load_cluster_state
+
+
+def _pid_alive(pid) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+async def cluster_status(data_dir: str, sc_addr: Optional[str] = None) -> dict:
+    """Processes up? SC reachable? SPUs online? Topics present?"""
+    state = load_cluster_state(data_dir) or {}
+    report: dict = {
+        "installed": bool(state),
+        "sc_process": _pid_alive(state.get("sc_pid")),
+        "spu_processes": {
+            str(s["id"]): _pid_alive(s.get("pid")) for s in state.get("spus", [])
+        },
+        "sc_reachable": False,
+        "spus_online": {},
+        "topics": [],
+    }
+    addr = sc_addr or state.get("sc_public")
+    if not addr:
+        return report
+    try:
+        client = await Fluvio.connect(addr)
+    except OSError:
+        return report
+    try:
+        admin = await client.admin()
+        report["sc_reachable"] = True
+        for obj in await admin.list("spu"):
+            online = obj.status is not None and obj.status.is_online()
+            report["spus_online"][obj.key] = online
+        report["topics"] = [o.key for o in await admin.list("topic")]
+        await admin.close()
+    finally:
+        await client.close()
+    return report

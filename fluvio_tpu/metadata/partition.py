@@ -26,6 +26,7 @@ class PartitionSpec(Spec):
     # config mirrored down from the topic at provisioning time
     cleanup_policy: Optional[CleanupPolicy] = None
     storage: Optional[TopicStorageConfig] = None
+    retention_seconds: Optional[int] = None  # mirrored topic retention
     compression_type: str = "any"
     deduplication: Optional[Deduplication] = None
     system: bool = False

@@ -96,6 +96,7 @@ class TopicSpec(Spec):
 
     replicas: ReplicaSpec = field(default_factory=ReplicaSpec)
     cleanup_policy: Optional[CleanupPolicy] = None
+    retention_seconds: Optional[int] = None  # time-based retention window
     storage: Optional[TopicStorageConfig] = None
     compression_type: str = "any"  # any|none|gzip|snappy|lz4|zstd
     deduplication: Optional[Deduplication] = None

@@ -42,6 +42,20 @@ def lookup(name: str) -> SmartModuleDef:
     return _REGISTRY[name]()
 
 
+def builtin_sources() -> Dict[str, bytes]:
+    """Source-artifact payloads for modules brokers pre-provision.
+
+    The analog of hub-provided standard modules (the reference's
+    `dedup-filter`): every SPU seeds its SmartModule local store with
+    these at startup so topic configs can name them without an explicit
+    `smartmodule create`. An SC-pushed module with the same name
+    overrides the bundled copy.
+    """
+    from fluvio_tpu.models import dedup_filter
+
+    return {"dedup-filter": dedup_filter.SOURCE.encode()}
+
+
 def builtin_names() -> list:
     from fluvio_tpu.models import (  # noqa: F401
         aggregate_sum,

@@ -1,0 +1,112 @@
+"""Process host for SC and SPU (parity: fluvio-run/src/lib.rs:15-40).
+
+``python -m fluvio_tpu.run sc ...`` / ``python -m fluvio_tpu.run spu ...``
+boots the respective server and blocks until SIGTERM/SIGINT. After
+binding, the chosen addresses are written to ``--port-file`` (JSON) so a
+launcher that requested port 0 can discover them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="fluvio-tpu-run")
+    sub = parser.add_subparsers(dest="role", required=True)
+
+    sc = sub.add_parser("sc", help="run the streaming controller")
+    sc.add_argument("--public-addr", default="127.0.0.1:9003")
+    sc.add_argument("--private-addr", default="127.0.0.1:9004")
+    sc.add_argument("--metadata-dir", help="YAML metadata dir (durable local mode)")
+    sc.add_argument("--read-only", action="store_true")
+    sc.add_argument("--auth-policy", help="BasicRbacPolicy JSON file")
+    sc.add_argument("--port-file", help="write bound addresses here as JSON")
+
+    spu = sub.add_parser("spu", help="run a streaming processing unit")
+    spu.add_argument("-i", "--id", type=int, required=True)
+    spu.add_argument("-p", "--public-addr", default="127.0.0.1:0")
+    spu.add_argument("-v", "--private-addr", default="127.0.0.1:0")
+    spu.add_argument("--sc-addr", default="", help="SC private endpoint")
+    spu.add_argument("--log-dir", default="/tmp/fluvio-tpu")
+    spu.add_argument("--engine", default="auto", choices=["auto", "python", "tpu"])
+    spu.add_argument("--monitoring-path", help="metrics unix-socket path")
+    spu.add_argument("--port-file", help="write bound addresses here as JSON")
+    return parser
+
+
+async def run_sc(args) -> None:
+    from fluvio_tpu.sc.start import ScConfig, ScServer
+
+    server = ScServer(
+        ScConfig(
+            public_addr=args.public_addr,
+            private_addr=args.private_addr,
+            metadata_dir=args.metadata_dir,
+            read_only=args.read_only,
+            auth_policy_path=args.auth_policy,
+        )
+    )
+    await server.start()
+    _write_port_file(
+        args.port_file,
+        {"public": server.public_addr, "private": server.private_addr},
+    )
+    await _wait_for_shutdown()
+    await server.stop()
+
+
+async def run_spu(args) -> None:
+    from fluvio_tpu.spu import SpuConfig, SpuServer
+    from fluvio_tpu.storage.config import ReplicaConfig
+
+    config = SpuConfig(
+        id=args.id,
+        public_addr=args.public_addr,
+        private_addr=args.private_addr,
+        sc_addr=args.sc_addr,
+        log_base_dir=args.log_dir,
+        replication=ReplicaConfig(base_dir=args.log_dir),
+        monitoring_path=args.monitoring_path,
+    )
+    config.smart_engine.backend = args.engine
+    server = SpuServer(config)
+    await server.start()
+    _write_port_file(
+        args.port_file,
+        {"public": server.public_addr, "private": server.private_addr},
+    )
+    await _wait_for_shutdown()
+    await server.stop()
+
+
+def _write_port_file(path, addrs: dict) -> None:
+    if not path:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(addrs, f)
+    import os
+
+    os.replace(tmp, path)
+
+
+async def _wait_for_shutdown() -> None:
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    runner = run_sc if args.role == "sc" else run_spu
+    try:
+        asyncio.run(runner(args))
+    except KeyboardInterrupt:
+        pass
+    return 0

@@ -1,0 +1,5 @@
+import sys
+
+from fluvio_tpu.run import main
+
+sys.exit(main())

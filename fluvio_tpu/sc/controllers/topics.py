@@ -143,6 +143,7 @@ class TopicController:
                 replicas=list(replicas),
                 cleanup_policy=spec.cleanup_policy,
                 storage=spec.storage,
+                retention_seconds=spec.retention_seconds,
                 compression_type=spec.compression_type,
                 deduplication=spec.deduplication,
                 system=spec.system,

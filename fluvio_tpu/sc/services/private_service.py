@@ -71,6 +71,10 @@ def replicas_for_spu(ctx: ScContext, spu_id: int) -> List[Replica]:
         config = {}
         if spec.deduplication is not None:
             config["deduplication"] = _to_plain(spec.deduplication)
+        if spec.retention_seconds is not None:
+            config["retention_seconds"] = spec.retention_seconds
+        if spec.storage is not None:
+            config["storage"] = _to_plain(spec.storage)
         out.append(
             Replica(
                 topic=topic,

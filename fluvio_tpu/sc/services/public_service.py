@@ -22,6 +22,7 @@ from fluvio_tpu.protocol.api import (
     ResponseMessage,
     decode_request_header,
 )
+from fluvio_tpu.auth import InstanceAction, ObjectType, TypeAction
 from fluvio_tpu.protocol.error import ErrorCode
 from fluvio_tpu.schema.admin import (
     AdminApiKey,
@@ -60,9 +61,20 @@ _ALREADY_EXISTS = {
 }
 
 
+def _allow(auth, kind: str, action) -> bool:
+    try:
+        ty = ObjectType.from_kind(kind)
+    except KeyError:
+        return True  # unknown kind: let the handler produce its error
+    if isinstance(action, TypeAction):
+        return auth.allow_type_action(ty, action)
+    return auth.allow_instance_action(ty, action, "")
+
+
 class ScPublicService(FluvioService[ScContext]):
     async def respond(self, ctx: ScContext, socket: FluvioSocket) -> None:
         sink = ExclusiveSink(FluvioSink(socket.writer))
+        auth = ctx.authorization.create_auth_context(socket)
         watch_tasks: list[asyncio.Task] = []
         try:
             while True:
@@ -81,15 +93,39 @@ class ScPublicService(FluvioService[ScContext]):
                     resp = ApiVersionsResponse(api_keys=list(SC_API_KEYS))
                 elif key == AdminApiKey.CREATE:
                     req = CreateRequest.decode(reader, version)
-                    resp = await handle_create(ctx, req)
+                    if not _allow(auth, req.kind, TypeAction.CREATE):
+                        resp = _permission_denied(req.name)
+                    else:
+                        resp = await handle_create(ctx, req)
                 elif key == AdminApiKey.DELETE:
                     req = DeleteRequest.decode(reader, version)
-                    resp = await handle_delete(ctx, req)
+                    if not _allow(auth, req.kind, InstanceAction.DELETE):
+                        resp = _permission_denied(req.name)
+                    else:
+                        resp = await handle_delete(ctx, req)
                 elif key == AdminApiKey.LIST:
                     req = ListRequest.decode(reader, version)
-                    resp = handle_list(ctx, req)
+                    if not _allow(auth, req.kind, TypeAction.READ):
+                        resp = ListResponse(
+                            error_code=ErrorCode.PERMISSION_DENIED,
+                            error_message="permission denied",
+                        )
+                    else:
+                        resp = handle_list(ctx, req)
                 elif key == AdminApiKey.WATCH:
                     req = WatchRequest.decode(reader, version)
+                    if not _allow(auth, req.kind, TypeAction.READ):
+                        await sink.send_response(
+                            ResponseMessage(
+                                cid,
+                                WatchResponse(
+                                    epoch=-1,
+                                    error_code=ErrorCode.PERMISSION_DENIED,
+                                ),
+                            ),
+                            version,
+                        )
+                        continue
                     task = asyncio.create_task(
                         _watch_stream(ctx, req, version, cid, sink),
                         name=f"admin-watch-{req.kind}",
@@ -105,6 +141,14 @@ class ScPublicService(FluvioService[ScContext]):
                 task.cancel()
             if watch_tasks:
                 await asyncio.gather(*watch_tasks, return_exceptions=True)
+
+
+def _permission_denied(name: str) -> AdminStatus:
+    return AdminStatus(
+        name=name,
+        error_code=ErrorCode.PERMISSION_DENIED,
+        error_message="permission denied",
+    )
 
 
 async def handle_create(ctx: ScContext, req: CreateRequest) -> AdminStatus:

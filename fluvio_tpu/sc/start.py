@@ -36,12 +36,28 @@ class ScConfig:
     # None = in-memory metadata; a directory = local YAML-backed metadata
     metadata_dir: Optional[str] = None
     reconcile_interval: Optional[float] = None
+    # admin API access control (parity: the SC's auth options): read_only
+    # forces ReadOnlyAuthorization; auth_policy_path loads a BasicRbacPolicy
+    # JSON file; default is allow-all RootAuthorization
+    read_only: bool = False
+    auth_policy_path: Optional[str] = None
 
 
 class ScServer:
-    def __init__(self, config: ScConfig = None):
+    def __init__(self, config: ScConfig = None, authorization=None):
         self.config = config or ScConfig()
-        self.ctx = ScContext()
+        if authorization is None:
+            if self.config.read_only:
+                from fluvio_tpu.auth import ReadOnlyAuthorization
+
+                authorization = ReadOnlyAuthorization()
+            elif self.config.auth_policy_path:
+                from fluvio_tpu.auth import BasicAuthorization, BasicRbacPolicy
+
+                authorization = BasicAuthorization(
+                    BasicRbacPolicy.load(self.config.auth_policy_path)
+                )
+        self.ctx = ScContext(authorization=authorization)
         self.metadata_client: Optional[MetadataClient] = None
         self.dispatchers: List[MetadataDispatcher] = []
         if self.config.metadata_dir is not None:

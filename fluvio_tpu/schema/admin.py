@@ -245,6 +245,7 @@ class WatchResponse(Encodable):
     changes: List[AdminObject] = field(default_factory=list)
     deleted: List[str] = field(default_factory=list)
     is_sync_all: bool = False
+    error_code: ErrorCode = ErrorCode.NONE  # stream-fatal (e.g. denied)
 
     def encode(self, w: ByteWriter, version: Version = 0) -> None:
         w.write_i64(self.epoch)
@@ -252,6 +253,7 @@ class WatchResponse(Encodable):
         w.write_vec(self.all_objects, lambda o: o.encode(w, version))
         w.write_vec(self.changes, lambda o: o.encode(w, version))
         w.write_vec(self.deleted, w.write_string)
+        w.write_i16(int(self.error_code))
 
     @classmethod
     def decode(cls, r: ByteReader, version: Version = 0) -> "WatchResponse":
@@ -261,6 +263,7 @@ class WatchResponse(Encodable):
             all_objects=r.read_vec(lambda: AdminObject.decode(r, version)),
             changes=r.read_vec(lambda: AdminObject.decode(r, version)),
             deleted=r.read_vec(r.read_string),
+            error_code=ErrorCode(r.read_i16()),
         )
 
 

@@ -51,6 +51,10 @@ class GlobalContext:
         # set by SpuServer when replication is enabled
         self.followers_controller = None
         self.smartmodules = SmartModuleLocalStore()
+        from fluvio_tpu.models import builtin_sources
+
+        for name, payload in builtin_sources().items():
+            self.smartmodules.insert(name, payload)
         # mirrored topic config per replica key (dedup / storage knobs),
         # pushed by the SC inside Replica.config (parity: the SPU reading
         # topic Deduplication off its replica metadata, smartengine/mod.rs:152)
@@ -91,7 +95,10 @@ class GlobalContext:
                 else self.config.in_sync_replica
             )
             self.leaders[key] = LeaderReplicaState(
-                topic, partition, self.config.replication, max(1, in_sync)
+                topic,
+                partition,
+                self._storage_config(key),
+                max(1, in_sync),
             )
         else:
             if replica_count is not None:
@@ -99,14 +106,20 @@ class GlobalContext:
         return self.leaders[key]
 
     def create_follower(
-        self, topic: str, partition: int, leader: int
+        self,
+        topic: str,
+        partition: int,
+        leader: int,
+        topic_config: Optional[dict] = None,
     ) -> "FollowerReplicaState":
         from fluvio_tpu.spu.follower import FollowerReplicaState
 
         key = partition_replica_key(topic, partition)
+        if topic_config is not None:
+            self.replica_configs[key] = topic_config
         if key not in self.followers:
             self.followers[key] = FollowerReplicaState(
-                topic, partition, leader, self.config.replication
+                topic, partition, leader, self._storage_config(key)
             )
         return self.followers[key]
 
@@ -136,6 +149,24 @@ class GlobalContext:
 
     def replica_config(self, topic: str, partition: int) -> dict:
         return self.replica_configs.get(partition_replica_key(topic, partition), {})
+
+    def _storage_config(self, key: str):
+        """Process-level ReplicaConfig with the topic's storage overrides
+        (retention / segment size / max partition size) applied — how the
+        reference maps TopicStorageConfig onto the replica's storage."""
+        import dataclasses
+
+        cfg = self.config.replication
+        topic_config = self.replica_configs.get(key) or {}
+        overrides = {}
+        if topic_config.get("retention_seconds") is not None:
+            overrides["retention_seconds"] = int(topic_config["retention_seconds"])
+        storage = topic_config.get("storage") or {}
+        if storage.get("segment_size") is not None:
+            overrides["segment_max_bytes"] = int(storage["segment_size"])
+        if storage.get("max_partition_size") is not None:
+            overrides["max_partition_size"] = int(storage["max_partition_size"])
+        return dataclasses.replace(cfg, **overrides) if overrides else cfg
 
     def follower_for(self, topic: str, partition: int):
         return self.followers.get(partition_replica_key(topic, partition))

@@ -129,22 +129,14 @@ class ScDispatcher:
         # adds / role changes (promotion and demotion preserve storage)
         for key, rep in wanted.items():
             if rep.leader == my_id:
-                live_replicas = len(rep.replicas)
                 if key in self.ctx.followers:
                     logger.info("replica promote (follower -> leader): %s", key)
                     self.ctx.promote_follower(rep.topic, rep.partition)
-                    self.ctx.create_replica(
-                        rep.topic, rep.partition, live_replicas, rep.config
-                    )
                 elif key not in self.ctx.leaders:
                     logger.info("replica add (leader): %s", key)
-                    self.ctx.create_replica(
-                        rep.topic, rep.partition, live_replicas, rep.config
-                    )
-                else:
-                    self.ctx.create_replica(
-                        rep.topic, rep.partition, live_replicas, rep.config
-                    )
+                self.ctx.create_replica(
+                    rep.topic, rep.partition, len(rep.replicas), rep.config
+                )
             else:
                 if key in self.ctx.leaders:
                     logger.info("replica demote (leader -> follower): %s", key)
@@ -155,7 +147,9 @@ class ScDispatcher:
                         logger.info(
                             "replica add (follower of %s): %s", rep.leader, key
                         )
-                        self.ctx.create_follower(rep.topic, rep.partition, rep.leader)
+                        self.ctx.create_follower(
+                            rep.topic, rep.partition, rep.leader, rep.config
+                        )
                     elif cur.leader != rep.leader:
                         logger.info(
                             "follower %s re-pointed to leader %s", key, rep.leader
@@ -202,6 +196,13 @@ class ScDispatcher:
         else:
             for name in update.deleted:
                 store.remove(name)
+        # bundled modules survive syncs: deleting an SC override restores
+        # the built-in payload (e.g. the dedup-filter topic configs name)
+        from fluvio_tpu.models import builtin_sources
+
+        for name, payload in builtin_sources().items():
+            if store.get(name) is None:
+                store.insert(name, payload)
 
     # -- LRS reporting -------------------------------------------------------
 

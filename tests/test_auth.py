@@ -1,0 +1,147 @@
+"""Auth layer tests (parity: fluvio-auth policy tests +
+fluvio-sc/src/services/auth/basic.rs tests)."""
+
+import asyncio
+import json
+
+import pytest
+
+from fluvio_tpu.auth import (
+    BasicAuthorization,
+    BasicRbacPolicy,
+    Identity,
+    InstanceAction,
+    ObjectType,
+    ReadOnlyAuthorization,
+    RootAuthorization,
+    TypeAction,
+)
+from fluvio_tpu.protocol.error import ErrorCode
+
+
+class TestPolicies:
+    def test_root_allows_everything(self):
+        ctx = RootAuthorization().create_auth_context(None)
+        assert ctx.allow_type_action(ObjectType.TOPIC, TypeAction.CREATE)
+        assert ctx.allow_instance_action(
+            ObjectType.TOPIC, InstanceAction.DELETE, "t"
+        )
+
+    def test_read_only_blocks_writes(self):
+        ctx = ReadOnlyAuthorization().create_auth_context(None)
+        assert ctx.allow_type_action(ObjectType.TOPIC, TypeAction.READ)
+        assert not ctx.allow_type_action(ObjectType.TOPIC, TypeAction.CREATE)
+        assert not ctx.allow_instance_action(
+            ObjectType.TOPIC, InstanceAction.DELETE, "t"
+        )
+
+    def test_basic_rbac_scopes(self):
+        policy = BasicRbacPolicy(
+            roles={
+                "Viewer": {"Topic": ["Read"]},
+                "Operator": {"Topic": ["All"], "SmartModule": ["Create", "Read"]},
+            }
+        )
+        viewer = BasicAuthorization(
+            policy, authenticator=lambda s: Identity("v", ["Viewer"])
+        ).create_auth_context(None)
+        assert viewer.allow_type_action(ObjectType.TOPIC, TypeAction.READ)
+        assert not viewer.allow_type_action(ObjectType.TOPIC, TypeAction.CREATE)
+        assert not viewer.allow_type_action(ObjectType.SMARTMODULE, TypeAction.READ)
+
+        op = BasicAuthorization(
+            policy, authenticator=lambda s: Identity("o", ["Operator"])
+        ).create_auth_context(None)
+        assert op.allow_type_action(ObjectType.TOPIC, TypeAction.CREATE)
+        assert op.allow_instance_action(ObjectType.TOPIC, InstanceAction.DELETE, "t")
+        assert not op.allow_instance_action(
+            ObjectType.SMARTMODULE, InstanceAction.DELETE, "m"
+        )
+
+    def test_anonymous_denied_under_basic(self):
+        ctx = BasicAuthorization(BasicRbacPolicy.default_root()).create_auth_context(
+            None
+        )
+        assert not ctx.allow_type_action(ObjectType.TOPIC, TypeAction.READ)
+
+    def test_default_root_policy(self):
+        policy = BasicRbacPolicy.default_root()
+        ctx = BasicAuthorization(
+            policy, authenticator=lambda s: Identity.root()
+        ).create_auth_context(None)
+        for ty in ObjectType:
+            assert ctx.allow_type_action(ty, TypeAction.CREATE)
+
+    def test_policy_file_load(self, tmp_path):
+        path = tmp_path / "policy.json"
+        path.write_text(json.dumps({"Viewer": {"Topic": ["Read"]}}))
+        policy = BasicRbacPolicy.load(str(path))
+        assert policy.evaluate("Read", ObjectType.TOPIC, Identity("x", ["Viewer"]))
+        assert not policy.evaluate(
+            "Create", ObjectType.TOPIC, Identity("x", ["Viewer"])
+        )
+
+
+class TestScAuthEnforcement:
+    def test_read_only_sc_rejects_create(self, tmp_path):
+        from fluvio_tpu.client.admin import FluvioAdmin
+        from fluvio_tpu.metadata.topic import TopicSpec
+        from fluvio_tpu.sc.start import ScConfig, ScServer
+
+        loop = asyncio.new_event_loop()
+        server = ScServer(ScConfig(read_only=True))
+
+        async def run():
+            from fluvio_tpu.client.admin import AdminError
+
+            await server.start()
+            admin = await FluvioAdmin.connect(server.public_addr)
+            with pytest.raises(AdminError) as ei:
+                await admin.create("t1", "topic", TopicSpec.computed(1, 1).to_dict())
+            assert ei.value.status.error_code == ErrorCode.PERMISSION_DENIED
+            # reads still work
+            objs = await admin.list("topic")
+            assert objs == []
+            await admin.close()
+
+        try:
+            loop.run_until_complete(run())
+        finally:
+            loop.run_until_complete(server.stop())
+            loop.close()
+
+    def test_denied_watch_reports_permission_error(self):
+        from fluvio_tpu.sc.start import ScConfig, ScServer
+        from fluvio_tpu.transport.versioned import VersionedSerialSocket
+        from fluvio_tpu.schema.admin import WatchRequest
+
+        loop = asyncio.new_event_loop()
+        server = ScServer(ScConfig(), authorization=_DenyReadsAuthorization())
+
+        async def run():
+            await server.start()
+            sock = await VersionedSerialSocket.connect(server.public_addr)
+            stream = await sock.create_stream(WatchRequest(kind="topic"))
+            resp = await stream.__anext__()
+            assert resp.error_code == ErrorCode.PERMISSION_DENIED
+            await sock.close()
+
+        try:
+            loop.run_until_complete(run())
+        finally:
+            loop.run_until_complete(server.stop())
+            loop.close()
+
+
+class _DenyReadsAuthorization(RootAuthorization):
+    def create_auth_context(self, socket):
+        from fluvio_tpu.auth import ReadOnlyAuthorization
+
+        class _Deny:
+            def allow_type_action(self, ty, action):
+                return False
+
+            def allow_instance_action(self, ty, action, key):
+                return False
+
+        return _Deny()

@@ -1,0 +1,131 @@
+"""CLI + local-cluster e2e tests.
+
+The analog of the reference's bats CLI suites
+(tests/cli/fluvio_smoke_tests/*.bats) and fluvio-cluster's local install
+tests: drive `python -m fluvio_tpu.cli` main() against a real local
+cluster of child processes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from fluvio_tpu.cli import main
+
+FILTER_SM = """
+@smartmodule.filter(dsl=dsl.FilterProgram(
+    predicate=dsl.Contains(arg=dsl.Value(), literal=b"keep")))
+def fil(record):
+    return b"keep" in record.value
+"""
+
+
+@pytest.fixture()
+def cli_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("FLUVIO_TPU_CONFIG", str(tmp_path / "config"))
+    data_dir = str(tmp_path / "data")
+    yield data_dir
+    # always tear down any cluster the test left behind
+    from fluvio_tpu.cluster.delete import delete_local_cluster
+
+    delete_local_cluster(data_dir)
+
+
+class TestPreflight:
+    def test_check_passes_on_fresh_dir(self, cli_env, capsys):
+        assert main(["cluster", "check", "--data-dir", cli_env]) == 0
+        out = capsys.readouterr().out
+        assert "FAIL" not in out
+
+
+class TestClusterE2E:
+    def test_full_lifecycle(self, cli_env, tmp_path, capsys):
+        data = cli_env
+        assert (
+            main(
+                [
+                    "cluster",
+                    "start",
+                    "--data-dir",
+                    data,
+                    "--spu",
+                    "1",
+                    "--engine",
+                    "python",
+                ]
+            )
+            == 0
+        )
+        assert main(["topic", "create", "smoke", "-p", "1"]) == 0
+        assert main(["topic", "list"]) == 0
+        assert "smoke" in capsys.readouterr().out
+
+        payload = tmp_path / "input.txt"
+        payload.write_bytes(b"keep me\ndrop me\nkeep this too\n")
+        assert main(["produce", "smoke", "--file", str(payload)]) == 0
+
+        assert main(["consume", "smoke", "-B", "-d"]) == 0
+        out = capsys.readouterr().out
+        assert out.splitlines() == ["keep me", "drop me", "keep this too"]
+
+        # smartmodule: load named, consume through it
+        sm_path = tmp_path / "filter.py"
+        sm_path.write_text(FILTER_SM)
+        assert (
+            main(["smartmodule", "create", "keeper", "--wasm-file", str(sm_path)])
+            == 0
+        )
+        assert main(["smartmodule", "list"]) == 0
+        assert "keeper" in capsys.readouterr().out
+        assert main(["consume", "smoke", "-B", "-d", "--smartmodule", "keeper"]) == 0
+        out = capsys.readouterr().out
+        assert out.splitlines() == ["keep me", "keep this too"]
+
+        # key separator produce + key display consume
+        kv = tmp_path / "kv.txt"
+        kv.write_bytes(b"k1:keep a\nk2:keep b\n")
+        assert (
+            main(["produce", "smoke", "--file", str(kv), "--key-separator", ":"])
+            == 0
+        )
+        assert main(["consume", "smoke", "--start", "3", "-d", "-k"]) == 0
+        out = capsys.readouterr().out
+        assert out.splitlines() == ["[k1] keep a", "[k2] keep b"]
+
+        # status healthy, then delete tears everything down
+        assert main(["cluster", "status", "--data-dir", data]) == 0
+        assert main(["cluster", "delete", "--data-dir", data]) == 0
+        assert not os.path.exists(os.path.join(data, "cluster-state.json"))
+        assert main(["cluster", "status", "--data-dir", data]) == 1
+
+
+class TestArgValidation:
+    def test_conflicting_offsets_error(self, cli_env, capsys):
+        rc = main(["consume", "t", "-B", "--start", "5", "--sc", "127.0.0.1:1"])
+        assert rc == 1
+        assert "pick one of" in capsys.readouterr().err
+
+    def test_exclusive_smartmodule_flags(self, cli_env, capsys, tmp_path):
+        f = tmp_path / "x.yaml"
+        f.write_text("transforms: []\n")
+        rc = main(
+            [
+                "consume",
+                "t",
+                "-B",
+                "--smartmodule",
+                "a",
+                "--transforms-file",
+                str(f),
+                "--sc",
+                "127.0.0.1:1",
+            ]
+        )
+        assert rc == 1
+        assert "exclusive" in capsys.readouterr().err
+
+    def test_version(self, capsys):
+        assert main(["version"]) == 0
+        assert "fluvio-tpu" in capsys.readouterr().out

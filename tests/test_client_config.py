@@ -1,0 +1,125 @@
+"""Profile config tests (parity: fluvio/src/config/config.rs unit tests)."""
+
+import asyncio
+
+import pytest
+
+from fluvio_tpu.client import Fluvio
+from fluvio_tpu.client.config import (
+    CONFIG_ENV,
+    Config,
+    ConfigError,
+    ConfigFile,
+    FluvioClusterConfig,
+    Profile,
+    TlsPolicy,
+    current_cluster_endpoint,
+)
+
+
+def make_config() -> Config:
+    c = Config()
+    c.add_cluster("local", FluvioClusterConfig(endpoint="127.0.0.1:9003"))
+    c.add_cluster(
+        "cloud",
+        FluvioClusterConfig(
+            endpoint="sc.example.com:9003",
+            tls=TlsPolicy(mode="verified", domain="sc.example.com",
+                          ca_cert="/certs/ca.pem"),
+        ),
+        make_current=False,
+    )
+    return c
+
+
+class TestConfigModel:
+    def test_roundtrip(self, tmp_path):
+        cf = ConfigFile(str(tmp_path / "config"))
+        cf.config = make_config()
+        cf.save()
+        loaded = ConfigFile.load(str(tmp_path / "config"))
+        assert loaded.config.current_profile == "local"
+        assert loaded.config.clusters["cloud"].tls.mode == "verified"
+        assert loaded.config.clusters["cloud"].tls.domain == "sc.example.com"
+        assert loaded.config.current_cluster().endpoint == "127.0.0.1:9003"
+
+    def test_profile_switching(self):
+        c = make_config()
+        c.set_current_profile("cloud")
+        assert c.current_cluster().endpoint == "sc.example.com:9003"
+        with pytest.raises(ConfigError):
+            c.set_current_profile("nope")
+
+    def test_rename_and_delete_profile(self):
+        c = make_config()
+        c.rename_profile("local", "dev")
+        assert c.current_profile == "dev"
+        c.delete_profile("dev")
+        assert c.current_profile == "cloud"
+
+    def test_delete_cluster_in_use_refuses(self):
+        c = make_config()
+        with pytest.raises(ConfigError):
+            c.delete_cluster("local")
+        c.delete_profile("local")
+        c.delete_cluster("local")
+        assert "local" not in c.clusters
+
+    def test_missing_profile_errors(self):
+        c = Config()
+        with pytest.raises(ConfigError):
+            c.current_cluster()
+
+    def test_dangling_cluster_reference_errors(self):
+        c = Config()
+        c.profiles["p"] = Profile(cluster="ghost")
+        c.current_profile = "p"
+        with pytest.raises(ConfigError):
+            c.current_cluster()
+
+    def test_env_override(self, tmp_path, monkeypatch):
+        path = tmp_path / "custom-config"
+        monkeypatch.setenv(CONFIG_ENV, str(path))
+        cf = ConfigFile.load()
+        cf.config.add_cluster("x", FluvioClusterConfig(endpoint="h:1"))
+        cf.save()
+        assert path.exists()
+        assert current_cluster_endpoint() == "h:1"
+
+
+class TestConnectViaProfile:
+    def test_connect_uses_active_profile(self, tmp_path, monkeypatch):
+        from fluvio_tpu.spu import SpuConfig, SpuServer
+        from fluvio_tpu.storage.config import ReplicaConfig
+
+        monkeypatch.setenv(CONFIG_ENV, str(tmp_path / "config"))
+        loop = asyncio.new_event_loop()
+        config = SpuConfig(
+            id=1,
+            public_addr="127.0.0.1:0",
+            log_base_dir=str(tmp_path),
+            replication=ReplicaConfig(base_dir=str(tmp_path)),
+        )
+        server = SpuServer(config)
+
+        async def run():
+            await server.start()
+            server.ctx.create_replica("t", 0)
+            cf = ConfigFile.load()
+            cf.config.add_cluster(
+                "test", FluvioClusterConfig(endpoint=server.public_addr)
+            )
+            cf.save()
+            client = await Fluvio.connect()  # no addr: profile resolves it
+            producer = await client.topic_producer("t")
+            fut = await producer.send(None, b"via-profile")
+            await producer.flush()
+            await fut.wait()
+            await producer.close()
+            await client.close()
+
+        try:
+            loop.run_until_complete(run())
+        finally:
+            loop.run_until_complete(server.stop())
+            loop.close()

@@ -482,3 +482,77 @@ class TestElectionE2E:
                 await shutdown_cluster(sc, admin, spus_)
 
         run(body())
+
+
+class TestTopicConfigPropagation:
+    """Topic-level knobs (retention/storage/dedup) flow SC -> SPU."""
+
+    def test_retention_and_storage_reach_spu_replica(self, tmp_path):
+        from fluvio_tpu.metadata.topic import TopicStorageConfig
+
+        async def body():
+            sc, admin, spus_ = await boot_cluster(tmp_path)
+            try:
+                spec = TopicSpec.computed(1)
+                spec.retention_seconds = 120
+                spec.storage = TopicStorageConfig(
+                    segment_size=1 << 20, max_partition_size=1 << 24
+                )
+                await admin.create_topic("bounded", spec)
+                spu = spus_[0]
+                for _ in range(100):
+                    if spu.ctx.leader_for("bounded", 0) is not None:
+                        break
+                    await asyncio.sleep(0.05)
+                leader = spu.ctx.leader_for("bounded", 0)
+                assert leader is not None
+                cfg = leader.storage.config
+                assert cfg.retention_seconds == 120
+                assert cfg.segment_max_bytes == 1 << 20
+                assert cfg.max_partition_size == 1 << 24
+            finally:
+                await shutdown_cluster(sc, admin, spus_)
+
+        run(body())
+
+    def test_dedup_topic_works_without_manual_module_load(self, tmp_path):
+        """The bundled dedup-filter resolves on the SPU out of the box."""
+        from fluvio_tpu.metadata.topic import (
+            Bounds,
+            Deduplication,
+            Filter,
+            Transform,
+        )
+
+        async def body():
+            sc, admin, spus_ = await boot_cluster(tmp_path)
+            try:
+                spec = TopicSpec.computed(1)
+                spec.deduplication = Deduplication(
+                    bounds=Bounds(count=50),
+                    filter=Filter(transform=Transform(uses="dedup-filter")),
+                )
+                await admin.create_topic("uniq", spec)
+                spu = spus_[0]
+                for _ in range(100):
+                    if spu.ctx.leader_for("uniq", 0) is not None:
+                        break
+                    await asyncio.sleep(0.05)
+                client = await Fluvio.connect(sc.public_addr)
+                producer = await client.topic_producer("uniq")
+                for v in [b"a", b"b", b"a", b"c", b"b"]:
+                    await producer.send(None, v)
+                await producer.flush()
+                await producer.close()
+                consumer = await client.partition_consumer("uniq", 0)
+                got = []
+                async for rec in consumer.stream(
+                    Offset.beginning(), ConsumerConfig(disable_continuous=True)
+                ):
+                    got.append(bytes(rec.value))
+                assert got == [b"a", b"b", b"c"]
+                await client.close()
+            finally:
+                await shutdown_cluster(sc, admin, spus_)
+
+        run(body())
