@@ -1,0 +1,6 @@
+"""Connector Development Kit (parity: the `cdk` crate).
+
+``python -m fluvio_tpu.cdk generate|build|test|deploy|publish`` — scaffold
+a connector project, validate it, run it locally against a cluster, or
+publish it to the hub.
+"""

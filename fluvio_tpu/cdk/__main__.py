@@ -1,0 +1,5 @@
+import sys
+
+from fluvio_tpu.cdk.cli import main
+
+sys.exit(main())

@@ -1,0 +1,205 @@
+"""cdk command line.
+
+Capability parity: cdk/src/ — generate (scaffold a connector project),
+build (validate the entry), test (run briefly against a cluster),
+deploy start/shutdown (the local deployer), publish (hub).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+import yaml
+
+CONNECTOR_FILE = "connector.py"
+CONFIG_FILE = "connector.yaml"
+MANIFEST = "Connector.yaml"
+
+_SOURCE_TEMPLATE = '''"""{name} — a source connector."""
+
+import asyncio
+
+from fluvio_tpu.connector import connector
+
+
+@connector.source
+async def {fn}(config, producer):
+    interval = int(config.parameters.get("interval_ms", 1000)) / 1000
+    n = 0
+    while True:
+        await producer.send(None, f"record-{{n}}".encode())
+        n += 1
+        await asyncio.sleep(interval)
+'''
+
+_SINK_TEMPLATE = '''"""{name} — a sink connector."""
+
+from fluvio_tpu.connector import connector
+
+
+@connector.sink
+async def {fn}(config, stream):
+    async for record in stream:
+        print(record.value.decode("utf-8", "replace"))
+'''
+
+
+class CdkError(Exception):
+    pass
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="cdk", description="Connector dev kit")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    gen = sub.add_parser("generate", help="scaffold a connector project")
+    gen.add_argument("name")
+    gen.add_argument("--direction", choices=["source", "sink"], default="source")
+    gen.add_argument("--destination", default=".")
+    gen.set_defaults(fn=cmd_generate)
+
+    build = sub.add_parser("build", help="validate the connector entry")
+    build.add_argument("--path", default=".")
+    build.set_defaults(fn=cmd_build)
+
+    test = sub.add_parser("test", help="run the connector for a bounded time")
+    test.add_argument("--path", default=".")
+    test.add_argument("--config", "-c")
+    test.add_argument("--secrets", "-s")
+    test.add_argument("--sc", metavar="HOST:PORT")
+    test.add_argument("--duration", type=float, default=3.0, metavar="SECONDS")
+    test.set_defaults(fn=cmd_test)
+
+    deploy = sub.add_parser("deploy", help="run the connector until interrupted")
+    deploy.add_argument("--path", default=".")
+    deploy.add_argument("--config", "-c")
+    deploy.add_argument("--secrets", "-s")
+    deploy.add_argument("--sc", metavar="HOST:PORT")
+    deploy.set_defaults(fn=cmd_deploy)
+
+    publish = sub.add_parser("publish", help="publish the connector to the hub")
+    publish.add_argument("--path", default=".")
+    publish.add_argument("--hub-dir")
+    publish.set_defaults(fn=cmd_publish)
+    return parser
+
+
+def _project(path: str) -> Path:
+    root = Path(path)
+    if not (root / CONNECTOR_FILE).exists():
+        raise CdkError(f"{root} is not a connector project (no {CONNECTOR_FILE})")
+    return root
+
+
+def cmd_generate(args) -> int:
+    root = Path(args.destination) / args.name
+    if root.exists() and any(root.iterdir()):
+        raise CdkError(f"{root} already exists and is not empty")
+    root.mkdir(parents=True, exist_ok=True)
+    fn = args.name.replace("-", "_")
+    template = _SOURCE_TEMPLATE if args.direction == "source" else _SINK_TEMPLATE
+    (root / CONNECTOR_FILE).write_text(template.format(name=args.name, fn=fn))
+    (root / MANIFEST).write_text(
+        yaml.safe_dump(
+            {
+                "package": {
+                    "name": args.name,
+                    "version": "0.1.0",
+                    "direction": args.direction,
+                }
+            },
+            sort_keys=False,
+        )
+    )
+    (root / CONFIG_FILE).write_text(
+        yaml.safe_dump(
+            {
+                "apiVersion": "0.1.0",
+                "meta": {
+                    "name": args.name,
+                    "type": args.name,
+                    "topic": f"{args.name}-topic",
+                    "direction": args.direction,
+                },
+            },
+            sort_keys=False,
+        )
+    )
+    print(f"connector project created at {root}")
+    return 0
+
+
+def cmd_build(args) -> int:
+    from fluvio_tpu.connector.deployer import find_entry, load_connector_module
+
+    root = _project(args.path)
+    entry = find_entry(load_connector_module(str(root / CONNECTOR_FILE)))
+    print(f"connector ok: {entry.fn.__name__} ({entry.direction})")
+    return 0
+
+
+def _run_deploy(args, duration=None) -> int:
+    from fluvio_tpu.connector.deployer import deploy_local
+
+    root = _project(args.path)
+    config_path = args.config or str(root / CONFIG_FILE)
+
+    async def body() -> None:
+        stop = asyncio.Event()
+        if duration is not None:
+            asyncio.get_running_loop().call_later(duration, stop.set)
+        await deploy_local(
+            str(root / CONNECTOR_FILE),
+            config_path,
+            secrets_path=args.secrets,
+            sc_addr=args.sc,
+            stop=stop,
+        )
+
+    try:
+        asyncio.run(body())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_test(args) -> int:
+    return _run_deploy(args, duration=args.duration)
+
+
+def cmd_deploy(args) -> int:
+    return _run_deploy(args)
+
+
+def cmd_publish(args) -> int:
+    from fluvio_tpu.hub.package import PackageMeta
+    from fluvio_tpu.hub.registry import HubRegistry
+
+    root = _project(args.path)
+    manifest = yaml.safe_load((root / MANIFEST).read_text()) or {}
+    meta_doc = manifest.get("package") or {}
+    meta = PackageMeta(
+        name=meta_doc.get("name", root.name),
+        version=str(meta_doc.get("version", "0.1.0")),
+        kind="connector",
+        description=meta_doc.get("description", ""),
+    )
+    artifacts = {CONNECTOR_FILE: (root / CONNECTOR_FILE).read_bytes()}
+    config = root / CONFIG_FILE
+    if config.exists():
+        artifacts[CONFIG_FILE] = config.read_bytes()
+    ref = HubRegistry(args.hub_dir).publish(meta, artifacts)
+    print(f"published {ref}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: {e}", file=sys.stderr)
+        return 1

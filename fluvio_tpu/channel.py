@@ -1,0 +1,65 @@
+"""Release channels (parity: fluvio-channel / fluvio-channel-cli).
+
+The reference switches the `fluvio` binary between stable/latest/dev
+release channels recorded in ``~/.fluvio/channel``. Here a channel names
+a framework version (resolved through the version manager's inventory);
+the active channel is stored in ``~/.fluvio-tpu/channel.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Optional
+
+STABLE = "stable"
+LATEST = "latest"
+DEV = "dev"
+KNOWN_CHANNELS = (STABLE, LATEST, DEV)
+
+
+def channel_file() -> Path:
+    return Path(
+        os.environ.get("FLUVIO_TPU_CHANNEL_FILE", "~/.fluvio-tpu/channel.json")
+    ).expanduser()
+
+
+@dataclass
+class ChannelConfig:
+    current: str = STABLE
+    # channel -> pinned version ("" = track newest installed)
+    pins: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls) -> "ChannelConfig":
+        path = channel_file()
+        if path.exists():
+            data = json.loads(path.read_text())
+            return cls(current=data.get("current", STABLE),
+                       pins=data.get("pins", {}))
+        return cls()
+
+    def save(self) -> None:
+        path = channel_file()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(
+            json.dumps({"current": self.current, "pins": self.pins}, indent=2)
+        )
+
+    def switch(self, channel: str) -> None:
+        if channel not in KNOWN_CHANNELS:
+            raise ValueError(
+                f"unknown channel {channel!r}; pick one of {KNOWN_CHANNELS}"
+            )
+        self.current = channel
+        self.save()
+
+    def resolve_version(self, installed: list[str]) -> Optional[str]:
+        """Channel -> version against an inventory (newest wins when
+        unpinned; dev tracks newest, stable prefers its pin)."""
+        pin = self.pins.get(self.current, "")
+        if pin:
+            return pin if pin in installed else None
+        return installed[-1] if installed else None

@@ -18,6 +18,7 @@ def build_parser() -> argparse.ArgumentParser:
     from fluvio_tpu.cli import cluster as cluster_cmd
     from fluvio_tpu.cli import consume as consume_cmd
     from fluvio_tpu.cli import crud
+    from fluvio_tpu.cli import hub as hub_cmd
     from fluvio_tpu.cli import metrics as metrics_cmd
     from fluvio_tpu.cli import produce as produce_cmd
     from fluvio_tpu.cli.common import add_connection_args
@@ -39,6 +40,7 @@ def build_parser() -> argparse.ArgumentParser:
         crud.add_profile_parser,
         cluster_cmd.add_cluster_parser,
         cluster_cmd.add_run_parser,
+        hub_cmd.add_hub_parser,
         metrics_cmd.add_metrics_parser,
     ):
         add(sub)
@@ -87,4 +89,7 @@ def main(argv=None) -> int:
         return 1
     except (ConnectionError, OSError) as e:
         print(f"connection error: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001 — CLI boundary, like smdk/cdk
+        print(f"error: {e}", file=sys.stderr)
         return 1

@@ -1,0 +1,74 @@
+"""`hub` subcommand.
+
+Capability parity: fluvio-cli/src/client/hub/ — list hub packages and
+download a SmartModule package straight onto the cluster.
+"""
+
+from __future__ import annotations
+
+from fluvio_tpu.cli.common import connect
+from fluvio_tpu.cli.output import render_table
+
+
+def add_hub_parser(sub) -> None:
+    hub = sub.add_parser("hub", help="hub package registry")
+    hsub = hub.add_subparsers(dest="action", required=True)
+
+    lst = hsub.add_parser("list", help="list hub packages")
+    lst.add_argument("--hub-dir")
+    lst.set_defaults(fn=hub_list)
+
+    dl = hsub.add_parser(
+        "download", help="download a SmartModule package onto the cluster"
+    )
+    dl.add_argument("ref", metavar="[group/]name[@version]")
+    dl.add_argument("--hub-dir")
+    dl.add_argument(
+        "--local-only",
+        action="store_true",
+        help="just print the artifact, don't load it",
+    )
+    dl.set_defaults(fn=hub_download)
+
+
+async def hub_list(args) -> int:
+    from fluvio_tpu.hub.registry import HubRegistry
+
+    packages = HubRegistry(args.hub_dir).list_packages()
+    rows = [
+        [p["name"], p["kind"], p["latest"], ",".join(p["versions"])]
+        for p in packages
+    ]
+    print(render_table(["PACKAGE", "KIND", "LATEST", "VERSIONS"], rows))
+    return 0
+
+
+async def hub_download(args) -> int:
+    from fluvio_tpu.cli.common import CliError
+    from fluvio_tpu.hub.registry import HubRegistry
+
+    registry = HubRegistry(args.hub_dir)
+    meta, artifacts = registry.download(args.ref)
+    if meta.kind != "smartmodule":
+        raise CliError(
+            f"{meta.ref} is a {meta.kind} package; only smartmodule "
+            f"packages can be downloaded onto a cluster"
+        )
+    payload = artifacts.get(f"{meta.name}.py")
+    if payload is None:
+        raise CliError(
+            f"{meta.ref} has no {meta.name}.py artifact (found: "
+            f"{sorted(artifacts)})"
+        )
+    if args.local_only:
+        print(payload.decode("utf-8", "replace"))
+        return 0
+    client = await connect(args)
+    try:
+        admin = await client.admin()
+        await admin.create_smartmodule(meta.name, payload)
+        print(f"downloaded {meta.ref} -> smartmodule \"{meta.name}\"")
+        await admin.close()
+    finally:
+        await client.close()
+    return 0

@@ -1,0 +1,17 @@
+"""Connector framework (parity: fluvio-connector-common / -derive /
+-package / -deployer).
+
+- :mod:`config` — `ConnectorConfig` YAML (apiVersion/meta/transforms)
+  with `${{ secrets.NAME }}` rendering
+- :mod:`common` — `@connector.source` / `@connector.sink` entry points
+  and the runtime that wires them to producers/consumer streams
+- :mod:`deployer` — launch a connector locally from its config + secrets
+"""
+
+from fluvio_tpu.connector.common import (  # noqa: F401
+    ConnectorRuntimeError,
+    connector,
+    run_connector,
+)
+from fluvio_tpu.connector.config import ConnectorConfig, render_secrets  # noqa: F401
+from fluvio_tpu.connector.deployer import deploy_local  # noqa: F401

@@ -1,0 +1,127 @@
+"""Connector runtime: source/sink entry points + cluster wiring.
+
+Capability parity: fluvio-connector-common/src/lib.rs (`Source`/`Sink`
+traits, `ensure_topic_exists`, producer/consumer glue + monitoring) and
+fluvio-connector-derive's `#[connector(source|sink)]` entry macro.
+
+Authoring surface::
+
+    from fluvio_tpu.connector import connector
+
+    @connector.source
+    async def my_source(config, producer):
+        while True:
+            await producer.send(None, next_value())
+
+    @connector.sink
+    async def my_sink(config, stream):
+        async for record in stream:
+            handle(record.value)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset, ProducerConfig
+from fluvio_tpu.cli.common import transforms_to_invocations
+from fluvio_tpu.connector.config import ConnectorConfig
+from fluvio_tpu.metadata.topic import TopicSpec
+
+logger = logging.getLogger(__name__)
+
+
+class ConnectorRuntimeError(Exception):
+    pass
+
+
+@dataclass
+class ConnectorEntry:
+    fn: Callable
+    direction: str  # source | sink
+
+
+class _ConnectorNamespace:
+    """The `connector` decorator namespace (derive-macro analog)."""
+
+    def source(self, fn: Callable) -> ConnectorEntry:
+        return ConnectorEntry(fn=fn, direction="source")
+
+    def sink(self, fn: Callable) -> ConnectorEntry:
+        return ConnectorEntry(fn=fn, direction="sink")
+
+
+connector = _ConnectorNamespace()
+
+
+async def ensure_topic_exists(client: Fluvio, topic: str, partitions: int = 1) -> None:
+    """Create the connector's topic when absent (lib.rs:42)."""
+    admin = await client.admin()
+    try:
+        existing = {o.key for o in await admin.list("topic")}
+        if topic not in existing:
+            await admin.create_topic(topic, TopicSpec.computed(partitions))
+    finally:
+        await admin.close()
+
+
+async def run_connector(
+    entry: ConnectorEntry,
+    config: ConnectorConfig,
+    sc_addr: Optional[str] = None,
+    stop: Optional[asyncio.Event] = None,
+) -> None:
+    """Connect, ensure the topic, and drive the user fn.
+
+    Sources get a `TopicProducer` with the config's transforms applied
+    producer-side; sinks get the consumer record stream with transforms
+    applied broker-side on consume. A `stop` event cancels the user fn
+    (the deployer's shutdown path).
+    """
+    client = await Fluvio.connect(sc_addr)
+    try:
+        await ensure_topic_exists(client, config.meta.topic)
+        invocations = transforms_to_invocations(config.transforms)
+        if entry.direction == "source":
+            pconf = ProducerConfig(smartmodules=invocations)
+            if config.meta.producer.get("linger") is not None:
+                pconf.linger_ms = int(config.meta.producer["linger"])
+            if config.meta.producer.get("batch_size") is not None:
+                pconf.batch_size = int(config.meta.producer["batch_size"])
+            producer = await client.topic_producer(config.meta.topic, config=pconf)
+            try:
+                await _run_until(entry.fn(config, producer), stop)
+            finally:
+                await producer.flush()
+                await producer.close()
+        elif entry.direction == "sink":
+            consumer = await client.partition_consumer(
+                config.meta.topic, int(config.meta.consumer.get("partition", 0))
+            )
+            cconf = ConsumerConfig(smartmodules=invocations)
+            stream = consumer.stream(Offset.beginning(), cconf)
+            await _run_until(entry.fn(config, stream), stop)
+        else:
+            raise ConnectorRuntimeError(f"unknown direction {entry.direction!r}")
+    finally:
+        await client.close()
+
+
+async def _run_until(coro, stop: Optional[asyncio.Event]) -> None:
+    if stop is None:
+        await coro
+        return
+    task = asyncio.ensure_future(coro)
+    stopper = asyncio.ensure_future(stop.wait())
+    done, pending = await asyncio.wait(
+        [task, stopper], return_when=asyncio.FIRST_COMPLETED
+    )
+    for p in pending:
+        p.cancel()
+    if pending:
+        await asyncio.gather(*pending, return_exceptions=True)
+    if task in done:
+        task.result()  # surface connector exceptions

@@ -1,0 +1,102 @@
+"""Connector configuration model.
+
+Capability parity: fluvio-connector-package/src/config/ — the
+`ConnectorConfig` YAML (`apiVersion`, `meta{name, type, topic, version,
+secrets, producer, consumer}`, free-form connector parameters,
+`transforms`) — and src/render/: `${{ secrets.NAME }}` substitution from
+a secrets backing store.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from fluvio_tpu.smartengine.config import TransformationConfig
+
+_SECRET_RE = re.compile(r"\$\{\{\s*secrets\.([A-Za-z0-9_]+)\s*\}\}")
+
+
+class ConnectorConfigError(Exception):
+    pass
+
+
+def render_secrets(text: str, secrets: Dict[str, str]) -> str:
+    """Substitute `${{ secrets.NAME }}` (render/mod.rs semantics: unknown
+    secret -> error, not silent empty)."""
+
+    def sub(m: re.Match) -> str:
+        name = m.group(1)
+        if name not in secrets:
+            raise ConnectorConfigError(f"undefined secret {name!r}")
+        return secrets[name]
+
+    return _SECRET_RE.sub(sub, text)
+
+
+@dataclass
+class ConnectorMeta:
+    name: str = ""
+    type: str = ""  # e.g. "json-test-source", "file-sink"
+    topic: str = ""
+    version: str = "0.1.0"
+    direction: str = ""  # source | sink (derived from type when empty)
+    secrets: List[str] = field(default_factory=list)
+    producer: Dict[str, Any] = field(default_factory=dict)
+    consumer: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ConnectorConfig:
+    api_version: str = "0.1.0"
+    meta: ConnectorMeta = field(default_factory=ConnectorMeta)
+    # free-form connector-specific parameters
+    parameters: Dict[str, Any] = field(default_factory=dict)
+    transforms: TransformationConfig = field(default_factory=TransformationConfig)
+
+    @classmethod
+    def from_yaml(
+        cls, text: str, secrets: Optional[Dict[str, str]] = None
+    ) -> "ConnectorConfig":
+        text = render_secrets(text, secrets or {})
+        doc = yaml.safe_load(text) or {}
+        meta_doc = doc.get("meta") or {}
+        if not meta_doc.get("name"):
+            raise ConnectorConfigError("connector config needs meta.name")
+        if not meta_doc.get("topic"):
+            raise ConnectorConfigError("connector config needs meta.topic")
+        meta = ConnectorMeta(
+            name=meta_doc["name"],
+            type=meta_doc.get("type", ""),
+            topic=meta_doc["topic"],
+            version=str(meta_doc.get("version", "0.1.0")),
+            direction=meta_doc.get("direction", ""),
+            secrets=[s["name"] if isinstance(s, dict) else s
+                     for s in meta_doc.get("secrets") or []],
+            producer=meta_doc.get("producer") or {},
+            consumer=meta_doc.get("consumer") or {},
+        )
+        transforms = TransformationConfig.from_yaml(
+            yaml.safe_dump({"transforms": doc.get("transforms") or []})
+        )
+        params = {
+            k: v
+            for k, v in doc.items()
+            if k not in ("apiVersion", "meta", "transforms")
+        }
+        return cls(
+            api_version=str(doc.get("apiVersion", "0.1.0")),
+            meta=meta,
+            parameters=params,
+            transforms=transforms,
+        )
+
+    @classmethod
+    def from_file(
+        cls, path: str, secrets: Optional[Dict[str, str]] = None
+    ) -> "ConnectorConfig":
+        with open(path) as f:
+            return cls.from_yaml(f.read(), secrets)

@@ -1,0 +1,78 @@
+"""Local connector deployment.
+
+Capability parity: fluvio-connector-deployer/src/local.rs — launch a
+connector from its config + secrets file. The connector code is a Python
+module exposing exactly one `@connector.source`/`@connector.sink` entry;
+secrets come from an env-style file (NAME=VALUE per line), mirroring the
+deployer's --secrets flag.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+import importlib.util
+import sys
+from pathlib import Path
+from typing import Dict, Optional
+
+from fluvio_tpu.connector.common import ConnectorEntry, run_connector
+from fluvio_tpu.connector.config import ConnectorConfig, ConnectorConfigError
+
+
+def load_secrets_file(path: Optional[str]) -> Dict[str, str]:
+    if not path:
+        return {}
+    secrets: Dict[str, str] = {}
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "=" not in line:
+            raise ConnectorConfigError(f"bad secrets line: {line!r}")
+        name, _, value = line.partition("=")
+        secrets[name.strip()] = value.strip()
+    return secrets
+
+
+def find_entry(module) -> ConnectorEntry:
+    entries = [v for v in vars(module).values() if isinstance(v, ConnectorEntry)]
+    if len(entries) != 1:
+        raise ConnectorConfigError(
+            f"connector module must expose exactly one "
+            f"@connector.source/@connector.sink entry, found {len(entries)}"
+        )
+    return entries[0]
+
+
+def load_connector_module(spec: str):
+    """`path/to/file.py` or a dotted module name."""
+    if spec.endswith(".py"):
+        path = Path(spec)
+        mod_spec = importlib.util.spec_from_file_location(path.stem, path)
+        module = importlib.util.module_from_spec(mod_spec)
+        sys.modules[path.stem] = module
+        mod_spec.loader.exec_module(module)
+        return module
+    return importlib.import_module(spec)
+
+
+async def deploy_local(
+    module_spec: str,
+    config_path: str,
+    secrets_path: Optional[str] = None,
+    sc_addr: Optional[str] = None,
+    stop: Optional[asyncio.Event] = None,
+) -> None:
+    """Resolve secrets, parse config, run the connector until it returns
+    (sources typically loop forever) or `stop` fires."""
+    secrets = load_secrets_file(secrets_path)
+    config = ConnectorConfig.from_file(config_path, secrets)
+    module = load_connector_module(module_spec)
+    entry = find_entry(module)
+    if config.meta.direction and config.meta.direction != entry.direction:
+        raise ConnectorConfigError(
+            f"config says {config.meta.direction!r} but module is "
+            f"{entry.direction!r}"
+        )
+    await run_connector(entry, config, sc_addr=sc_addr, stop=stop)

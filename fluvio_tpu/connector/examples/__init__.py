@@ -1,0 +1,2 @@
+"""Example connectors (parity: connector/{json-test-connector,
+sink-test-connector} used by the reference's CI)."""

@@ -1,0 +1,29 @@
+"""json-test-connector — a source emitting JSON records on a timer.
+
+Capability parity: connector/json-test-connector in the reference: a
+test source that produces `{"key": N}`-style JSON at an interval, used
+to exercise the connector runtime end-to-end. Parameters: `interval_ms`
+(default 10), `count` (default unbounded; tests set a small number).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from fluvio_tpu.connector import connector
+
+
+@connector.source
+async def json_source(config, producer) -> None:
+    interval = int(config.parameters.get("interval_ms", 10)) / 1000
+    count = config.parameters.get("count")
+    template = config.parameters.get("template", {"source": "json-test"})
+    n = 0
+    while count is None or n < int(count):
+        record = dict(template)
+        record["seq"] = n
+        await producer.send(None, json.dumps(record).encode())
+        n += 1
+        await asyncio.sleep(interval)
+    await producer.flush()

@@ -1,0 +1,22 @@
+"""sink-test-connector — a sink appending record values to a file.
+
+Capability parity: connector/sink-test-connector in the reference: a
+test sink that materializes consumed records, used to exercise the sink
+runtime. Parameter: `path` (output file, one record value per line).
+"""
+
+from __future__ import annotations
+
+from fluvio_tpu.connector import connector
+
+
+@connector.sink
+async def file_sink(config, stream) -> None:
+    path = config.parameters.get("path")
+    if not path:
+        raise ValueError("sink-test-connector needs a `path` parameter")
+    with open(path, "ab") as f:
+        async for record in stream:
+            f.write(record.value)
+            f.write(b"\n")
+            f.flush()

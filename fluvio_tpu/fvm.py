@@ -1,0 +1,136 @@
+"""fvm — framework version manager (parity: fluvio-version-manager).
+
+Maintains an inventory of installed framework versions under
+``~/.fluvio-tpu/versions/<version>/`` (each a hub package unpack or a
+recorded source tree), an active version switched per release channel,
+and a ``python -m fluvio_tpu.fvm`` CLI: ``install | list | current |
+switch``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from fluvio_tpu.channel import ChannelConfig
+from fluvio_tpu.hub.registry import version_sort_key as _version_key
+
+
+def versions_dir() -> Path:
+    return Path(
+        os.environ.get("FLUVIO_TPU_VERSIONS_DIR", "~/.fluvio-tpu/versions")
+    ).expanduser()
+
+
+def installed_versions() -> List[str]:
+    root = versions_dir()
+    if not root.exists():
+        return []
+    return sorted(
+        (p.name for p in root.iterdir() if (p / "fvm.json").exists()),
+        key=_version_key,
+    )
+
+
+def install_version(version: str, source: Optional[str] = None) -> Path:
+    """Record a framework version in the inventory.
+
+    ``source`` may be a hub ref (fetched + verified through the
+    registry) or a filesystem path; default records the running tree.
+    """
+    dest = versions_dir() / version
+    dest.mkdir(parents=True, exist_ok=True)
+    origin = source or str(Path(__file__).resolve().parent)
+    if source and not os.path.exists(source):
+        from fluvio_tpu.hub.registry import HubRegistry
+
+        package_path = HubRegistry().resolve(source)
+        origin = str(package_path)
+    (dest / "fvm.json").write_text(
+        json.dumps({"version": version, "origin": origin}, indent=2)
+    )
+    return dest
+
+
+def current_version() -> Optional[str]:
+    channels = ChannelConfig.load()
+    return channels.resolve_version(installed_versions())
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="fvm", description="version manager")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    install = sub.add_parser("install", help="record a framework version")
+    install.add_argument("version")
+    install.add_argument("--source", help="hub ref or filesystem path")
+    install.set_defaults(fn=cmd_install)
+
+    sub.add_parser("list", help="list installed versions").set_defaults(fn=cmd_list)
+    sub.add_parser("current", help="show the active version").set_defaults(
+        fn=cmd_current
+    )
+
+    switch = sub.add_parser("switch", help="switch release channel")
+    switch.add_argument("channel", choices=["stable", "latest", "dev"])
+    switch.add_argument("--pin", help="pin the channel to a version")
+    switch.set_defaults(fn=cmd_switch)
+    return parser
+
+
+def cmd_install(args) -> int:
+    dest = install_version(args.version, args.source)
+    print(f"installed {args.version} -> {dest}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    channels = ChannelConfig.load()
+    active = channels.resolve_version(installed_versions())
+    for v in installed_versions():
+        marker = "*" if v == active else " "
+        print(f"{marker} {v}")
+    if not installed_versions():
+        print("(no versions installed)")
+    return 0
+
+
+def cmd_current(args) -> int:
+    channels = ChannelConfig.load()
+    installed = installed_versions()
+    version = channels.resolve_version(installed)
+    print(f"channel: {channels.current}")
+    pin = channels.pins.get(channels.current, "")
+    if version is None and pin:
+        print(f"version: {pin} (pinned, NOT installed — run `fvm install {pin}`)")
+    elif version is None:
+        print("version: (none installed)")
+    else:
+        print(f"version: {version}")
+    return 0
+
+
+def cmd_switch(args) -> int:
+    channels = ChannelConfig.load()
+    if args.pin:
+        channels.pins[args.channel] = args.pin
+    channels.switch(args.channel)
+    print(f"switched to channel \"{args.channel}\"")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Hub: signed package registry (parity: fluvio-hub-protocol +
+fluvio-hub-util + fluvio-package-index).
+
+A local-filesystem registry of signed SmartModule/connector packages:
+tarballs with a checksummed, HMAC-signed manifest, organized
+group/name/version with a JSON index supporting latest-version
+resolution.
+"""
+
+from fluvio_tpu.hub.package import (  # noqa: F401
+    HubError,
+    PackageMeta,
+    build_package,
+    publish_project,
+    verify_package,
+)
+from fluvio_tpu.hub.registry import HubRegistry, default_hub_dir  # noqa: F401

@@ -1,0 +1,175 @@
+"""Hub package format: metadata, checksums, signing.
+
+Capability parity: fluvio-hub-protocol/src/package_meta.rs (PackageMeta:
+name/version/group/description/files with sha256 sums) and
+fluvio-hub-util's tar build/verify + keymgmt. Signatures are
+HMAC-SHA256 with a locally-generated key (the reference signs with
+ed25519 key pairs; same trust model — possession of the key — without a
+crypto dependency).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import io
+import json
+import os
+import tarfile
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+MANIFEST_NAME = "package-meta.json"
+SIGNATURE_NAME = "package-meta.json.sig"
+DEFAULT_GROUP = "local"
+
+
+class HubError(Exception):
+    pass
+
+
+def key_path() -> Path:
+    return Path(os.environ.get("FLUVIO_TPU_HUB_KEY", "~/.fluvio-tpu/hub.key")).expanduser()
+
+
+def load_or_create_key() -> bytes:
+    """Signing key management (parity: hub-util keymgmt.rs)."""
+    path = key_path()
+    if path.exists():
+        return bytes.fromhex(path.read_text().strip())
+    key = os.urandom(32)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(key.hex())
+    path.chmod(0o600)
+    return key
+
+
+@dataclass
+class PackageMeta:
+    """Signed package manifest (package_meta.rs PackageMeta)."""
+
+    name: str = ""
+    version: str = "0.1.0"
+    group: str = DEFAULT_GROUP
+    kind: str = "smartmodule"  # smartmodule | connector
+    description: str = ""
+    created_at: int = 0
+    # artifact name -> sha256 hex
+    files: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ref(self) -> str:
+        return f"{self.group}/{self.name}@{self.version}"
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__, sort_keys=True, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "PackageMeta":
+        return cls(**json.loads(text))
+
+
+def build_package(
+    out_path: str | Path,
+    meta: PackageMeta,
+    artifacts: Dict[str, bytes],
+    key: Optional[bytes] = None,
+) -> PackageMeta:
+    """Create a signed package tar (parity: hub-util package_sign/build).
+
+    Layout: package-meta.json + its HMAC signature + the artifact files,
+    each checksummed into the manifest before signing.
+    """
+    meta.created_at = meta.created_at or int(time.time())
+    meta.files = {
+        name: hashlib.sha256(data).hexdigest() for name, data in artifacts.items()
+    }
+    manifest = meta.to_json().encode()
+    key = key if key is not None else load_or_create_key()
+    signature = hmac.new(key, manifest, hashlib.sha256).hexdigest().encode()
+
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name, data in [
+            (MANIFEST_NAME, manifest),
+            (SIGNATURE_NAME, signature),
+            *artifacts.items(),
+        ]:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = meta.created_at
+            tar.addfile(info, io.BytesIO(data))
+    return meta
+
+
+def _read_contents(path: str | Path) -> Dict[str, bytes]:
+    with tarfile.open(path, "r:gz") as tar:
+        return {
+            m.name: tar.extractfile(m).read() for m in tar.getmembers() if m.isfile()
+        }
+
+
+def _split_artifacts(contents: Dict[str, bytes]) -> Dict[str, bytes]:
+    return {
+        k: v
+        for k, v in contents.items()
+        if k not in (MANIFEST_NAME, SIGNATURE_NAME)
+    }
+
+
+def read_package(path: str | Path) -> tuple[PackageMeta, Dict[str, bytes]]:
+    contents = _read_contents(path)
+    if MANIFEST_NAME not in contents:
+        raise HubError(f"{path}: not a hub package (no {MANIFEST_NAME})")
+    meta = PackageMeta.from_json(contents[MANIFEST_NAME].decode())
+    return meta, _split_artifacts(contents)
+
+
+def verify_package(
+    path: str | Path,
+    key: Optional[bytes] = None,
+    contents: Optional[Dict[str, bytes]] = None,
+) -> PackageMeta:
+    """Check signature + checksums (parity: hub-util package_verify).
+
+    Pass pre-extracted ``contents`` to avoid re-reading the tarball.
+    """
+    if contents is None:
+        contents = _read_contents(path)
+    manifest = contents.get(MANIFEST_NAME)
+    signature = contents.get(SIGNATURE_NAME)
+    if manifest is None or signature is None:
+        raise HubError(f"{path}: missing manifest or signature")
+    key = key if key is not None else load_or_create_key()
+    expected = hmac.new(key, manifest, hashlib.sha256).hexdigest().encode()
+    if not hmac.compare_digest(expected, signature):
+        raise HubError(f"{path}: signature verification failed")
+    meta = PackageMeta.from_json(manifest.decode())
+    for name, digest in meta.files.items():
+        data = contents.get(name)
+        if data is None:
+            raise HubError(f"{path}: manifest lists missing file {name!r}")
+        if hashlib.sha256(data).hexdigest() != digest:
+            raise HubError(f"{path}: checksum mismatch for {name!r}")
+    return meta
+
+
+def publish_project(project, hub_dir: Optional[str] = None, kind: str = "smartmodule"):
+    """Build + sign + store a project's artifact in the registry
+    (parity: smdk/cdk publish)."""
+    from fluvio_tpu.hub.registry import HubRegistry
+
+    artifact = project.dist_path
+    if not artifact.exists():
+        raise HubError(f"build the project first (missing {artifact})")
+    meta = PackageMeta(
+        name=project.name,
+        version=project.version,
+        kind=kind,
+        description=getattr(project, "description", ""),
+    )
+    registry = HubRegistry(hub_dir)
+    return registry.publish(meta, {f"{project.name}.py": artifact.read_bytes()})

@@ -1,0 +1,135 @@
+"""Local hub registry with a version index.
+
+Capability parity: fluvio-hub-util's hub access API (list/download) +
+fluvio-package-index (per-package version index with latest resolution,
+package_id.rs `group/name@version` refs). The registry is a directory —
+the analog of the hosted hub — addressable via FLUVIO_TPU_HUB_DIR.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from fluvio_tpu.hub.package import (
+    DEFAULT_GROUP,
+    HubError,
+    PackageMeta,
+    _read_contents,
+    _split_artifacts,
+    build_package,
+    verify_package,
+)
+
+INDEX_NAME = "index.json"
+
+
+def default_hub_dir() -> str:
+    return os.environ.get(
+        "FLUVIO_TPU_HUB_DIR", str(Path("~/.fluvio-tpu/hub").expanduser())
+    )
+
+
+def parse_ref(ref: str) -> Tuple[str, str, Optional[str]]:
+    """`[group/]name[@version]` -> (group, name, version)."""
+    group, name = DEFAULT_GROUP, ref
+    if "/" in name:
+        group, _, name = name.partition("/")
+    version = None
+    if "@" in name:
+        name, _, version = name.partition("@")
+    return group, name, version
+
+
+def version_sort_key(v: str):
+    """Numeric version ordering (shared with fvm/channel resolution)."""
+    return tuple(int(p) if p.isdigit() else 0 for p in v.split("."))
+
+
+_version_key = version_sort_key
+
+
+class HubRegistry:
+    def __init__(self, hub_dir: Optional[str] = None):
+        self.root = Path(hub_dir or default_hub_dir())
+
+    # -- index --------------------------------------------------------------
+
+    @property
+    def index_path(self) -> Path:
+        return self.root / INDEX_NAME
+
+    def _load_index(self) -> dict:
+        if self.index_path.exists():
+            return json.loads(self.index_path.read_text())
+        return {"packages": {}}
+
+    def _save_index(self, index: dict) -> None:
+        self.root.mkdir(parents=True, exist_ok=True)
+        tmp = self.index_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(index, indent=2, sort_keys=True))
+        os.replace(tmp, self.index_path)
+
+    # -- operations ---------------------------------------------------------
+
+    def package_path(self, meta: PackageMeta) -> Path:
+        return (
+            self.root
+            / meta.group
+            / meta.name
+            / meta.version
+            / f"{meta.name}-{meta.version}.tar.gz"
+        )
+
+    def publish(self, meta: PackageMeta, artifacts: Dict[str, bytes]) -> str:
+        path = self.package_path(meta)
+        build_package(path, meta, artifacts)
+        index = self._load_index()
+        key = f"{meta.group}/{meta.name}"
+        entry = index["packages"].setdefault(
+            key, {"kind": meta.kind, "versions": []}
+        )
+        if meta.version not in entry["versions"]:
+            entry["versions"].append(meta.version)
+            entry["versions"].sort(key=_version_key)
+        self._save_index(index)
+        return meta.ref
+
+    def list_packages(self) -> List[dict]:
+        index = self._load_index()
+        return [
+            {
+                "name": key,
+                "kind": entry.get("kind", "?"),
+                "latest": entry["versions"][-1] if entry["versions"] else "-",
+                "versions": list(entry["versions"]),
+            }
+            for key, entry in sorted(index["packages"].items())
+        ]
+
+    def resolve(self, ref: str, verify: bool = True) -> Path:
+        """Resolve `[group/]name[@version]` to a (verified) package path."""
+        group, name, version = parse_ref(ref)
+        index = self._load_index()
+        entry = index["packages"].get(f"{group}/{name}")
+        if entry is None:
+            raise HubError(f"package {group}/{name} not in the hub")
+        if version is None:
+            if not entry["versions"]:
+                raise HubError(f"package {group}/{name} has no versions")
+            version = entry["versions"][-1]
+        path = self.root / group / name / version / f"{name}-{version}.tar.gz"
+        if not path.exists():
+            raise HubError(f"package file missing: {path}")
+        if verify:
+            verify_package(path)
+        return path
+
+    def download(self, ref: str) -> tuple[PackageMeta, Dict[str, bytes]]:
+        """Fetch + verify a package's artifacts in one read (hub download)."""
+        path = self.resolve(ref, verify=False)
+        contents = _read_contents(path)
+        meta = verify_package(path, contents=contents)
+        return meta, _split_artifacts(contents)

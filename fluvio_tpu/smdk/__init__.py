@@ -1,0 +1,12 @@
+"""SmartModule Development Kit (parity: the `smdk` crate).
+
+``python -m fluvio_tpu.smdk generate|build|test|load|publish`` — scaffold
+a SmartModule project, validate/build its artifact, run it in-process
+against sample records, load it onto a cluster, or publish it to the hub.
+"""
+
+from fluvio_tpu.smdk.project import (  # noqa: F401
+    ProjectError,
+    SmartModuleProject,
+    generate_project,
+)

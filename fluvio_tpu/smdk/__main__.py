@@ -1,0 +1,5 @@
+import sys
+
+from fluvio_tpu.smdk.cli import main
+
+sys.exit(main())

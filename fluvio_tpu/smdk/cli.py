@@ -1,0 +1,157 @@
+"""smdk command line.
+
+Capability parity: smartmodule-development-kit/src/ —
+generate (scaffold), build (artifact), test (run the chain in-process
+against --text/--file records with -e params, printing outputs,
+smdk test.rs:57), load (create the SmartModule object on the cluster,
+load.rs:105), publish (push to the hub, publish.rs:310).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from fluvio_tpu.smdk.project import KINDS, SmartModuleProject, generate_project
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="smdk", description="SmartModule dev kit")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    gen = sub.add_parser("generate", help="scaffold a SmartModule project")
+    gen.add_argument("name")
+    gen.add_argument("--kind", choices=KINDS, default="filter")
+    gen.add_argument("--with-init", action="store_true")
+    gen.add_argument("--with-look-back", action="store_true")
+    gen.add_argument("--destination", default=".")
+    gen.set_defaults(fn=cmd_generate)
+
+    build = sub.add_parser("build", help="validate + build the artifact")
+    build.add_argument("--path", default=".")
+    build.set_defaults(fn=cmd_build)
+
+    test = sub.add_parser("test", help="run the module against sample records")
+    test.add_argument("--path", default=".")
+    test.add_argument("--text", action="append", default=[],
+                      help="one input record value (repeatable)")
+    test.add_argument("--file", help="file with one record per line")
+    test.add_argument("--key", help="record key for all records")
+    test.add_argument("-e", "--params", action="append", default=[],
+                      metavar="KEY=VALUE")
+    test.add_argument("--aggregate-initial", default="")
+    test.set_defaults(fn=cmd_test)
+
+    load = sub.add_parser("load", help="create the SmartModule on a cluster")
+    load.add_argument("--path", default=".")
+    load.add_argument("--name", help="override the object name")
+    load.add_argument("--sc", metavar="HOST:PORT")
+    load.set_defaults(fn=cmd_load)
+
+    publish = sub.add_parser("publish", help="publish the artifact to the hub")
+    publish.add_argument("--path", default=".")
+    publish.add_argument("--hub-dir", help="hub registry dir (default local hub)")
+    publish.set_defaults(fn=cmd_publish)
+    return parser
+
+
+def cmd_generate(args) -> int:
+    project = generate_project(
+        args.destination,
+        args.name,
+        kind=args.kind,
+        with_init=args.with_init,
+        with_look_back=args.with_look_back,
+    )
+    print(f"project created at {project.root}")
+    return 0
+
+
+def cmd_build(args) -> int:
+    project = SmartModuleProject.open(args.path)
+    artifact = project.build()
+    print(f"artifact written to {artifact}")
+    return 0
+
+
+def cmd_test(args) -> int:
+    from fluvio_tpu.cli.common import parse_params
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartengine.engine import SmartEngine
+    from fluvio_tpu.smartengine.config import SmartModuleConfig
+    from fluvio_tpu.smartmodule.types import SmartModuleInput
+
+    project = SmartModuleProject.open(args.path)
+    module = project.load_module()
+
+    values = [t.encode() for t in args.text]
+    if args.file:
+        with open(args.file, "rb") as f:
+            values.extend(line for line in f.read().splitlines() if line)
+    if not values:
+        print("error: provide --text or --file records", file=sys.stderr)
+        return 1
+
+    key = args.key.encode() if args.key else None
+    records = [Record(key=key, value=v) for v in values]
+    config = SmartModuleConfig(
+        params=parse_params(args.params),
+        initial_data=args.aggregate_initial.encode(),
+    )
+    chain = (
+        SmartEngine(backend="python")
+        .builder()
+        .add_smart_module(config, module, name=project.name)
+        .initialize()
+    )
+    output = chain.process(SmartModuleInput.from_records(records))
+    for record in output.successes:
+        if record.key is not None:
+            print(f"[{record.key.decode('utf-8', 'replace')}] ", end="")
+        print(record.value.decode("utf-8", "replace"))
+    if output.error is not None:
+        print(f"error: {output.error}", file=sys.stderr)
+        return 1
+    print(f"{len(output.successes)} records output", file=sys.stderr)
+    return 0
+
+
+def cmd_load(args) -> int:
+    async def body() -> int:
+        from fluvio_tpu.client import Fluvio
+
+        project = SmartModuleProject.open(args.path)
+        artifact = project.build()
+        client = await Fluvio.connect(args.sc)
+        try:
+            admin = await client.admin()
+            await admin.create_smartmodule(
+                args.name or project.name, artifact.read_bytes()
+            )
+            print(f"smartmodule \"{args.name or project.name}\" loaded")
+            await admin.close()
+        finally:
+            await client.close()
+        return 0
+
+    return asyncio.run(body())
+
+
+def cmd_publish(args) -> int:
+    from fluvio_tpu.hub.package import publish_project
+
+    project = SmartModuleProject.open(args.path)
+    project.build()
+    ref = publish_project(project, hub_dir=args.hub_dir, kind="smartmodule")
+    print(f"published {ref}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: {e}", file=sys.stderr)
+        return 1

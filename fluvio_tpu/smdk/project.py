@@ -1,0 +1,202 @@
+"""SmartModule project scaffolding and builds.
+
+Capability parity: smartmodule-development-kit/src/{generate.rs,build.rs}
+and the `smartmodule/cargo_template` — one template per transform kind
+(filter/map/filter_map/array_map/aggregate, plus optional init/look_back
+hooks), a `SmartModule.yaml` package manifest, and `build` producing the
+loadable artifact under `dist/`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import yaml
+
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef, load_source
+
+MANIFEST = "SmartModule.yaml"
+SOURCE_FILE = "smartmodule.py"
+
+KINDS = ("filter", "map", "filter-map", "array-map", "aggregate")
+
+
+class ProjectError(Exception):
+    pass
+
+
+_TEMPLATES: Dict[str, str] = {
+    "filter": '''"""{name} — a filter SmartModule.
+
+Return True to keep the record, False to drop it.
+"""
+
+
+@smartmodule.filter
+def {fn}(record):
+    return b"a" in record.value
+''',
+    "map": '''"""{name} — a map SmartModule.
+
+Return the new record value (or a (key, value) tuple).
+"""
+
+
+@smartmodule.map
+def {fn}(record):
+    return record.value.upper()
+''',
+    "filter-map": '''"""{name} — a filter_map SmartModule.
+
+Return None to drop the record, or the new value to keep it.
+"""
+
+
+@smartmodule.filter_map
+def {fn}(record):
+    if len(record.value) < 2:
+        return None
+    return record.value[1:]
+''',
+    "array-map": '''"""{name} — an array_map SmartModule.
+
+Return a list of output values per input record.
+"""
+
+
+@smartmodule.array_map
+def {fn}(record):
+    return record.value.split(b",")
+''',
+    "aggregate": '''"""{name} — an aggregate SmartModule.
+
+Fold each record into the accumulator; return the new accumulator.
+"""
+
+
+@smartmodule.aggregate
+def {fn}(acc, record):
+    total = int(acc.decode() or "0") + len(record.value)
+    return str(total).encode()
+''',
+}
+
+_INIT_TEMPLATE = '''
+
+_params = {}
+
+
+@smartmodule.init
+def init(params):
+    _params.update(params)
+'''
+
+_LOOKBACK_TEMPLATE = '''
+
+@smartmodule.look_back
+def look_back(record):
+    # observe one recent record from the log at (re)start
+    pass
+'''
+
+
+@dataclass
+class SmartModuleProject:
+    """A project dir: manifest + source (parity: an smdk cargo project)."""
+
+    root: Path
+    name: str = ""
+    version: str = "0.1.0"
+    description: str = ""
+    params: List[str] = field(default_factory=list)
+
+    @classmethod
+    def open(cls, root: str | Path) -> "SmartModuleProject":
+        root = Path(root)
+        manifest = root / MANIFEST
+        if not manifest.exists():
+            raise ProjectError(f"{root} is not a SmartModule project (no {MANIFEST})")
+        doc = yaml.safe_load(manifest.read_text()) or {}
+        meta = doc.get("package") or {}
+        return cls(
+            root=root,
+            name=meta.get("name", root.name),
+            version=str(meta.get("version", "0.1.0")),
+            description=meta.get("description", ""),
+            params=[p["name"] for p in doc.get("params") or []],
+        )
+
+    @property
+    def source_path(self) -> Path:
+        return self.root / SOURCE_FILE
+
+    @property
+    def dist_path(self) -> Path:
+        return self.root / "dist" / f"{self.name}.py"
+
+    def load_module(self) -> SmartModuleDef:
+        """Compile the project source (build-time validation)."""
+        return load_source(self.source_path.read_text(), name=self.name)
+
+    def build(self) -> Path:
+        """Validate + emit the loadable artifact (parity: smdk build)."""
+        module = self.load_module()  # raises on bad source / no transform
+        kind = module.transform_kind()
+        self.dist_path.parent.mkdir(parents=True, exist_ok=True)
+        self.dist_path.write_text(self.source_path.read_text())
+        manifest = {
+            "name": self.name,
+            "version": self.version,
+            "kind": kind.value,
+            "has_init": module.has_init(),
+            "has_look_back": module.has_look_back(),
+        }
+        (self.dist_path.parent / "manifest.yaml").write_text(
+            yaml.safe_dump(manifest, sort_keys=False)
+        )
+        return self.dist_path
+
+
+def generate_project(
+    dest: str | Path,
+    name: str,
+    kind: str = "filter",
+    with_init: bool = False,
+    with_look_back: bool = False,
+    description: str = "",
+) -> SmartModuleProject:
+    """Scaffold a new project (parity: smdk generate / cargo_template)."""
+    if kind not in KINDS:
+        raise ProjectError(f"unknown kind {kind!r}; pick one of {KINDS}")
+    root = Path(dest) / name
+    if root.exists() and any(root.iterdir()):
+        raise ProjectError(f"{root} already exists and is not empty")
+    root.mkdir(parents=True, exist_ok=True)
+
+    fn = name.replace("-", "_")
+    source = _TEMPLATES[kind].format(name=name, fn=fn)
+    if with_init:
+        source += _INIT_TEMPLATE
+    if with_look_back:
+        source += _LOOKBACK_TEMPLATE
+    (root / SOURCE_FILE).write_text(source)
+
+    manifest = {
+        "apiVersion": "0.1.0",
+        "package": {
+            "name": name,
+            "version": "0.1.0",
+            "description": description,
+        },
+        "params": [],
+    }
+    (root / MANIFEST).write_text(yaml.safe_dump(manifest, sort_keys=False))
+    (root / "README.md").write_text(
+        f"# {name}\n\nA `{kind}` SmartModule. Build with "
+        f"`python -m fluvio_tpu.smdk build`, test with "
+        f"`python -m fluvio_tpu.smdk test --text <value>`.\n"
+    )
+    return SmartModuleProject.open(root)

@@ -1,0 +1,332 @@
+"""Developer-kit, hub, connector, and version-manager tests.
+
+Parity targets: smdk generate/build/test/load (smartmodule-development-kit),
+cdk generate/build/test/publish, fluvio-connector-* (config + secrets +
+source/sink runtime), fluvio-hub-util (signed package build/verify +
+registry index), fluvio-channel + fluvio-version-manager.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from fluvio_tpu.smdk.cli import main as smdk_main
+from fluvio_tpu.cdk.cli import main as cdk_main
+
+
+@pytest.fixture()
+def hub_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("FLUVIO_TPU_HUB_DIR", str(tmp_path / "hub"))
+    monkeypatch.setenv("FLUVIO_TPU_HUB_KEY", str(tmp_path / "hub.key"))
+    return tmp_path
+
+
+class TestSmdk:
+    def test_generate_build_test_all_kinds(self, tmp_path, capsys):
+        from fluvio_tpu.smdk.project import KINDS, SmartModuleProject
+
+        for kind in KINDS:
+            name = f"my-{kind}"
+            assert (
+                smdk_main(
+                    [
+                        "generate",
+                        name,
+                        "--kind",
+                        kind,
+                        "--destination",
+                        str(tmp_path),
+                    ]
+                )
+                == 0
+            )
+            assert smdk_main(["build", "--path", str(tmp_path / name)]) == 0
+            project = SmartModuleProject.open(tmp_path / name)
+            assert project.dist_path.exists()
+            module = project.load_module()
+            assert module.transform_kind().value == kind.replace("-", "_")
+
+    def test_smdk_test_runs_filter(self, tmp_path, capsys):
+        smdk_main(["generate", "keep", "--destination", str(tmp_path)])
+        rc = smdk_main(
+            [
+                "test",
+                "--path",
+                str(tmp_path / "keep"),
+                "--text",
+                "has a here",
+                "--text",
+                "nothing",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "has a here" in out
+        assert "nothing" not in out
+
+    def test_generate_with_hooks(self, tmp_path):
+        from fluvio_tpu.smdk.project import SmartModuleProject
+
+        smdk_main(
+            [
+                "generate",
+                "hooked",
+                "--with-init",
+                "--with-look-back",
+                "--destination",
+                str(tmp_path),
+            ]
+        )
+        module = SmartModuleProject.open(tmp_path / "hooked").load_module()
+        assert module.has_init()
+        assert module.has_look_back()
+
+    def test_existing_dir_refused(self, tmp_path, capsys):
+        smdk_main(["generate", "dup", "--destination", str(tmp_path)])
+        assert smdk_main(["generate", "dup", "--destination", str(tmp_path)]) == 1
+
+
+class TestHub:
+    def test_publish_download_verify(self, hub_env, tmp_path, capsys):
+        from fluvio_tpu.hub import HubRegistry, verify_package
+
+        smdk_main(["generate", "pkg", "--destination", str(tmp_path)])
+        smdk_main(["build", "--path", str(tmp_path / "pkg")])
+        assert smdk_main(["publish", "--path", str(tmp_path / "pkg")]) == 0
+
+        registry = HubRegistry()
+        packages = registry.list_packages()
+        assert packages[0]["name"] == "local/pkg"
+        assert packages[0]["latest"] == "0.1.0"
+
+        meta, artifacts = registry.download("pkg")
+        assert meta.ref == "local/pkg@0.1.0"
+        assert b"@smartmodule.filter" in artifacts["pkg.py"]
+        verify_package(registry.resolve("pkg@0.1.0"))
+
+    def test_tampered_package_rejected(self, hub_env, tmp_path):
+        import tarfile
+
+        from fluvio_tpu.hub import HubError, HubRegistry
+        from fluvio_tpu.hub.package import PackageMeta
+
+        registry = HubRegistry()
+        registry.publish(
+            PackageMeta(name="evil", version="1.0.0"), {"evil.py": b"ok"}
+        )
+        path = registry.resolve("evil")
+        # tamper: rewrite the artifact without re-signing
+        import io
+
+        with tarfile.open(path, "r:gz") as tar:
+            members = {
+                m.name: tar.extractfile(m).read()
+                for m in tar.getmembers()
+                if m.isfile()
+            }
+        members["evil.py"] = b"malicious"
+        with tarfile.open(path, "w:gz") as tar:
+            for name, data in members.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+        with pytest.raises(HubError):
+            registry.download("evil")
+
+    def test_version_resolution_latest(self, hub_env):
+        from fluvio_tpu.hub import HubRegistry
+        from fluvio_tpu.hub.package import PackageMeta
+
+        registry = HubRegistry()
+        for v in ("0.9.0", "0.10.0", "0.2.0"):
+            registry.publish(PackageMeta(name="m", version=v), {"m.py": b"x"})
+        meta, _ = registry.download("m")
+        assert meta.version == "0.10.0"  # numeric, not lexicographic
+
+
+class TestConnectorConfig:
+    def test_yaml_with_secrets_and_transforms(self):
+        from fluvio_tpu.connector import ConnectorConfig
+
+        text = """
+apiVersion: 0.1.0
+meta:
+  name: my-source
+  type: http-source
+  topic: events
+  secrets:
+    - name: API_TOKEN
+endpoint: https://x.test?token=${{ secrets.API_TOKEN }}
+interval_ms: 5
+transforms:
+  - uses: regex-filter
+    with:
+      regex: "hello"
+"""
+        config = ConnectorConfig.from_yaml(text, {"API_TOKEN": "s3cret"})
+        assert config.meta.topic == "events"
+        assert config.parameters["endpoint"].endswith("token=s3cret")
+        assert config.transforms.transforms[0].uses == "regex-filter"
+
+    def test_missing_secret_errors(self):
+        from fluvio_tpu.connector import ConnectorConfig
+        from fluvio_tpu.connector.config import ConnectorConfigError
+
+        with pytest.raises(ConnectorConfigError):
+            ConnectorConfig.from_yaml(
+                "meta: {name: x, topic: t}\nv: ${{ secrets.NOPE }}\n", {}
+            )
+
+    def test_secrets_file_parsing(self, tmp_path):
+        from fluvio_tpu.connector.deployer import load_secrets_file
+
+        f = tmp_path / "secrets"
+        f.write_text("# comment\nA=1\nB = spaced \n")
+        assert load_secrets_file(str(f)) == {"A": "1", "B": "spaced"}
+
+
+class TestConnectorRuntime:
+    def test_source_and_sink_end_to_end(self, tmp_path):
+        """json-test source produces; sink-test materializes to a file."""
+        from fluvio_tpu.connector.deployer import deploy_local
+        from fluvio_tpu.sc.start import ScConfig, ScServer
+        from fluvio_tpu.spu import SpuConfig, SpuServer
+        from fluvio_tpu.storage.config import ReplicaConfig
+        from fluvio_tpu.client.admin import FluvioAdmin
+
+        examples = "fluvio_tpu/connector/examples"
+        config_yaml = tmp_path / "source.yaml"
+        config_yaml.write_text(
+            """
+meta:
+  name: json-test
+  type: json-test-source
+  topic: connector-events
+count: 5
+interval_ms: 1
+"""
+        )
+        sink_yaml = tmp_path / "sink.yaml"
+        out_file = tmp_path / "out.txt"
+        sink_yaml.write_text(
+            f"""
+meta:
+  name: file-sink
+  type: sink-test
+  topic: connector-events
+path: {out_file}
+"""
+        )
+
+        async def body():
+            sc = ScServer(ScConfig())
+            await sc.start()
+            spu_dir = tmp_path / "spu"
+            spu = SpuServer(
+                SpuConfig(
+                    id=7001,
+                    public_addr="127.0.0.1:0",
+                    log_base_dir=str(spu_dir),
+                    replication=ReplicaConfig(base_dir=str(spu_dir)),
+                    sc_addr=sc.private_addr,
+                )
+            )
+            await spu.start()
+            admin = await FluvioAdmin.connect(sc.public_addr)
+            await admin.register_custom_spu(7001, spu.public_addr)
+            await sc.ctx.spus.wait_action(
+                "7001", lambda o: o is not None and o.status.is_online(), timeout=5
+            )
+            await admin.close()
+            try:
+                await deploy_local(
+                    f"{examples}/json_test_connector.py",
+                    str(config_yaml),
+                    sc_addr=sc.public_addr,
+                )
+                stop = asyncio.Event()
+                sink_task = asyncio.create_task(
+                    deploy_local(
+                        f"{examples}/sink_test_connector.py",
+                        str(sink_yaml),
+                        sc_addr=sc.public_addr,
+                        stop=stop,
+                    )
+                )
+                for _ in range(100):
+                    if (
+                        out_file.exists()
+                        and len(out_file.read_bytes().splitlines()) >= 5
+                    ):
+                        break
+                    await asyncio.sleep(0.05)
+                stop.set()
+                await sink_task
+            finally:
+                await spu.stop()
+                await sc.stop()
+
+        asyncio.new_event_loop().run_until_complete(body())
+        lines = out_file.read_bytes().splitlines()
+        assert len(lines) >= 5
+        first = json.loads(lines[0])
+        assert first["seq"] == 0
+        assert first["source"] == "json-test"
+
+
+class TestCdk:
+    def test_generate_build_publish(self, hub_env, tmp_path, capsys):
+        assert (
+            cdk_main(["generate", "my-conn", "--destination", str(tmp_path)]) == 0
+        )
+        assert cdk_main(["build", "--path", str(tmp_path / "my-conn")]) == 0
+        assert cdk_main(["publish", "--path", str(tmp_path / "my-conn")]) == 0
+        from fluvio_tpu.hub import HubRegistry
+
+        packages = HubRegistry().list_packages()
+        assert packages[0]["name"] == "local/my-conn"
+        assert packages[0]["kind"] == "connector"
+
+    def test_generate_sink(self, tmp_path):
+        assert (
+            cdk_main(
+                [
+                    "generate",
+                    "my-sink",
+                    "--direction",
+                    "sink",
+                    "--destination",
+                    str(tmp_path),
+                ]
+            )
+            == 0
+        )
+        assert cdk_main(["build", "--path", str(tmp_path / "my-sink")]) == 0
+
+
+class TestFvmAndChannel:
+    def test_install_switch_resolve(self, tmp_path, monkeypatch, capsys):
+        monkeypatch.setenv(
+            "FLUVIO_TPU_VERSIONS_DIR", str(tmp_path / "versions")
+        )
+        monkeypatch.setenv(
+            "FLUVIO_TPU_CHANNEL_FILE", str(tmp_path / "channel.json")
+        )
+        from fluvio_tpu.fvm import main as fvm_main
+
+        assert fvm_main(["install", "0.1.0"]) == 0
+        assert fvm_main(["install", "0.2.0"]) == 0
+        assert fvm_main(["current"]) == 0
+        assert "0.2.0" in capsys.readouterr().out  # newest wins unpinned
+
+        assert fvm_main(["switch", "stable", "--pin", "0.1.0"]) == 0
+        assert fvm_main(["current"]) == 0
+        assert "0.1.0" in capsys.readouterr().out
+
+        assert fvm_main(["switch", "dev"]) == 0
+        assert fvm_main(["list"]) == 0
+        out = capsys.readouterr().out
+        assert "* 0.2.0" in out
