@@ -1,0 +1,10 @@
+"""Benchmark matrix tool — fbm equivalent (parity: fluvio-benchmark).
+
+``python -m fluvio_tpu.benchmark`` sweeps producer/consumer/topic/load
+dimensions against a cluster (or an in-process broker) and reports
+throughput + latency percentiles per config.
+"""
+
+from fluvio_tpu.benchmark.matrix import BenchmarkConfig, BenchmarkMatrix  # noqa: F401
+from fluvio_tpu.benchmark.stats import LatencyStats  # noqa: F401
+from fluvio_tpu.benchmark.driver import run_benchmark  # noqa: F401

@@ -1,0 +1,60 @@
+"""fbm command line (parity: the `fbm` binary).
+
+Run the default matrix (reference defaults) or a YAML matrix file, print
+one JSON line per cell plus a human summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from fluvio_tpu.benchmark.driver import run_benchmark
+from fluvio_tpu.benchmark.matrix import BenchmarkMatrix
+from fluvio_tpu.benchmark.stats import human_us
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fbm", description="benchmark matrix")
+    parser.add_argument("--matrix", help="matrix YAML (defaults: reference values)")
+    parser.add_argument("--sc", metavar="HOST:PORT", help="cluster SC endpoint")
+    parser.add_argument(
+        "--in-process",
+        action="store_true",
+        help="boot a single broker in this process instead of dialing a cluster",
+    )
+    parser.add_argument("--json", action="store_true", help="JSON lines only")
+    args = parser.parse_args(argv)
+
+    if args.matrix:
+        with open(args.matrix) as f:
+            matrix = BenchmarkMatrix.from_yaml(f.read())
+    else:
+        matrix = BenchmarkMatrix()
+
+    async def body() -> int:
+        for config in matrix.configs():
+            result = await run_benchmark(
+                config, sc_addr=args.sc, in_process=args.in_process
+            )
+            print(json.dumps(result))
+            if not args.json:
+                produce, consume = result["produce"], result["consume"]
+                lat = produce["latency"]
+                print(
+                    f"# {result['config']}: produce "
+                    f"{produce['records_per_sec']}/s ({produce['mb_per_sec']} MB/s, "
+                    f"p50 {human_us(lat.get('p50_us', 0))}, "
+                    f"p99 {human_us(lat.get('p99_us', 0))}), consume "
+                    f"{consume['records_per_sec']}/s",
+                    file=sys.stderr,
+                )
+        return 0
+
+    return asyncio.run(body())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

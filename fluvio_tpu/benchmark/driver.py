@@ -1,0 +1,187 @@
+"""Benchmark driver: run one matrix cell against a cluster.
+
+Capability parity: fluvio-benchmark/src/benchmark_driver.rs — set up a
+fresh topic, run concurrent producer workers and per-partition
+consumers, record produce-ack latencies and throughput, tear down.
+``in_process=True`` boots a single-broker SPU in this process instead of
+dialing a cluster (the harness tests use it; real runs pass --sc).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import string
+import time
+from typing import Dict, Optional
+
+from fluvio_tpu.benchmark.matrix import BenchmarkConfig
+from fluvio_tpu.benchmark.stats import LatencyStats
+from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset, ProducerConfig
+from fluvio_tpu.protocol.compression import Compression
+from fluvio_tpu.schema.spu import Isolation
+
+
+def _isolation(name: str) -> Isolation:
+    return (
+        Isolation.READ_COMMITTED
+        if name == "read-committed"
+        else Isolation.READ_UNCOMMITTED
+    )
+
+
+def _payload(size: int) -> bytes:
+    return os.urandom(max(1, size))
+
+
+async def run_benchmark(
+    config: BenchmarkConfig,
+    sc_addr: Optional[str] = None,
+    in_process: bool = False,
+    work_dir: Optional[str] = None,
+) -> Dict:
+    if in_process:
+        return await _run_in_process(config, work_dir)
+    client = await Fluvio.connect(sc_addr)
+    topic = _topic_name(config)
+    admin = await client.admin()
+    from fluvio_tpu.metadata.topic import TopicSpec
+
+    await admin.create_topic(topic, TopicSpec.computed(config.num_partitions))
+    try:
+        return await _drive(client, topic, config)
+    finally:
+        try:
+            await admin.delete_topic(topic)
+        finally:
+            await admin.close()
+            await client.close()
+
+
+def _topic_name(config: BenchmarkConfig) -> str:
+    suffix = "".join(random.choices(string.ascii_lowercase, k=6))
+    return f"{config.topic_prefix}-{suffix}"
+
+
+async def _run_in_process(config: BenchmarkConfig, work_dir: Optional[str]) -> Dict:
+    import shutil
+    import tempfile
+
+    from fluvio_tpu.spu import SpuConfig, SpuServer
+    from fluvio_tpu.storage.config import ReplicaConfig
+
+    own_dir = work_dir is None
+    work_dir = work_dir or tempfile.mkdtemp(prefix="fbm-")
+    spu_config = SpuConfig(
+        id=9001,
+        public_addr="127.0.0.1:0",
+        log_base_dir=work_dir,
+        replication=ReplicaConfig(base_dir=work_dir),
+    )
+    server = SpuServer(spu_config)
+    await server.start()
+    topic = _topic_name(config)
+    for p in range(config.num_partitions):
+        server.ctx.create_replica(topic, p)
+    client = await Fluvio.connect(server.public_addr)
+    try:
+        return await _drive(client, topic, config)
+    finally:
+        await client.close()
+        await server.stop()
+        if own_dir:
+            shutil.rmtree(work_dir, ignore_errors=True)
+
+
+async def _drive(client: Fluvio, topic: str, config: BenchmarkConfig) -> Dict:
+    producer_config = ProducerConfig(
+        batch_size=config.batch_size,
+        linger_ms=config.linger_ms,
+        compression=Compression[config.compression.upper()],
+        isolation=_isolation(config.isolation),
+        delivery=config.delivery,
+    )
+    produce_stats = LatencyStats()
+    per_worker = max(1, config.num_records // config.num_producer_workers)
+    total_records = per_worker * config.num_producer_workers
+    payload = _payload(config.record_size)
+
+    async def producer_worker(worker_id: int) -> None:
+        producer = await client.topic_producer(
+            topic, num_partitions=config.num_partitions, config=producer_config
+        )
+        at_most_once = config.delivery == "at-most-once"
+        pending = []
+        for i in range(per_worker):
+            key = (
+                f"worker-{worker_id}-{i}".encode()
+                if config.key_strategy != "none"
+                else None
+            )
+            t0 = time.monotonic()
+            fut = await producer.send(key, payload)
+            if at_most_once:
+                continue
+            pending.append((t0, fut))
+        await producer.flush()
+        for t0, fut in pending:
+            await fut.wait()
+            produce_stats.record((time.monotonic() - t0) * 1e6)
+        await producer.close()
+
+    produce_t0 = time.monotonic()
+    await asyncio.gather(
+        *(producer_worker(w) for w in range(config.num_producer_workers))
+    )
+    produce_seconds = time.monotonic() - produce_t0
+
+    consume_stats = LatencyStats()
+
+    async def consumer_worker(partition: int) -> int:
+        consumer = await client.partition_consumer(topic, partition)
+        cconf = ConsumerConfig(
+            max_bytes=config.max_bytes,
+            isolation=_isolation(config.isolation),
+            disable_continuous=True,
+        )
+        seen = 0
+        async for record in consumer.stream(Offset.beginning(), cconf):
+            if record.timestamp > 0:
+                consume_stats.record(
+                    max(0.0, time.time() * 1000 - record.timestamp) * 1000
+                )
+            seen += 1
+        return seen
+
+    consume_t0 = time.monotonic()
+    counts = await asyncio.gather(
+        *(
+            consumer_worker(p)
+            for p in range(config.num_partitions)
+            for _ in range(config.num_consumers_per_partition)
+        )
+    )
+    consume_seconds = time.monotonic() - consume_t0
+    consumed = sum(counts) // max(1, config.num_consumers_per_partition)
+
+    mb = total_records * config.record_size / 1e6
+    return {
+        "config": config.label(),
+        "produced": total_records,
+        "consumed": consumed,
+        "produce": {
+            "seconds": round(produce_seconds, 4),
+            "records_per_sec": round(total_records / max(produce_seconds, 1e-9)),
+            "mb_per_sec": round(mb / max(produce_seconds, 1e-9), 2),
+            "latency": produce_stats.summary(),
+        },
+        "consume": {
+            "seconds": round(consume_seconds, 4),
+            "records_per_sec": round(consumed / max(consume_seconds, 1e-9)),
+            "mb_per_sec": round(
+                consumed * config.record_size / 1e6 / max(consume_seconds, 1e-9), 2
+            ),
+            "latency": consume_stats.summary(),
+        },
+    }

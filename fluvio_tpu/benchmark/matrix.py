@@ -1,0 +1,81 @@
+"""Benchmark configuration matrix.
+
+Capability parity: fluvio-benchmark/src/benchmark_config/
+benchmark_matrix.rs — sweepable dimensions with the reference's defaults
+(batch_size=16000, queue 100, linger=10ms, max_bytes=64000, 1 partition,
+AtLeastOnce delivery), cross-producted into concrete `BenchmarkConfig`s.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Iterator, List
+
+import yaml
+
+
+@dataclass
+class BenchmarkConfig:
+    """One concrete run (a single cell of the matrix)."""
+
+    topic_prefix: str = "benchmark"
+    num_partitions: int = 1
+    # producer
+    batch_size: int = 16000
+    linger_ms: int = 10
+    compression: str = "none"
+    isolation: str = "read-uncommitted"
+    delivery: str = "at-least-once"  # at-most-once | at-least-once
+    # consumer
+    max_bytes: int = 64000
+    # load
+    num_records: int = 1000
+    record_size: int = 1000
+    num_producer_workers: int = 1
+    num_consumers_per_partition: int = 1
+    key_strategy: str = "none"  # none | round-robin (keyed)
+
+    def label(self) -> str:
+        return (
+            f"p{self.num_partitions}/{self.compression}/{self.isolation}/"
+            f"{self.delivery}/{self.record_size}B x {self.num_records}"
+        )
+
+
+@dataclass
+class BenchmarkMatrix:
+    """Sweep definition: every field is a list; configs() is the product."""
+
+    num_partitions: List[int] = field(default_factory=lambda: [1])
+    batch_size: List[int] = field(default_factory=lambda: [16000])
+    linger_ms: List[int] = field(default_factory=lambda: [10])
+    compression: List[str] = field(default_factory=lambda: ["none"])
+    isolation: List[str] = field(default_factory=lambda: ["read-uncommitted"])
+    delivery: List[str] = field(default_factory=lambda: ["at-least-once"])
+    max_bytes: List[int] = field(default_factory=lambda: [64000])
+    num_records: List[int] = field(default_factory=lambda: [1000])
+    record_size: List[int] = field(default_factory=lambda: [1000])
+    num_producer_workers: List[int] = field(default_factory=lambda: [1])
+    num_consumers_per_partition: List[int] = field(default_factory=lambda: [1])
+    key_strategy: List[str] = field(default_factory=lambda: ["none"])
+
+    def configs(self) -> Iterator[BenchmarkConfig]:
+        fields = list(self.__dataclass_fields__)
+        for combo in itertools.product(*(getattr(self, f) for f in fields)):
+            yield BenchmarkConfig(**dict(zip(fields, combo)))
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "BenchmarkMatrix":
+        doc = yaml.safe_load(text) or {}
+        known = set(cls.__dataclass_fields__)
+        unknown = set(doc) - known
+        if unknown:
+            raise ValueError(f"unknown matrix fields: {sorted(unknown)}")
+        # scalars (including strings) are one-element sweeps
+        return cls(
+            **{
+                k: list(v) if isinstance(v, list) else [v]
+                for k, v in doc.items()
+            }
+        )

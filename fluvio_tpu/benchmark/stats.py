@@ -1,0 +1,51 @@
+"""Latency statistics.
+
+Capability parity: fluvio-benchmark/src/stats.rs — per-config latency
+percentiles (the reference uses an HDR histogram; exact-sample
+percentiles here) and throughput aggregation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class LatencyStats:
+    samples_us: List[float] = field(default_factory=list)
+
+    def record(self, latency_us: float) -> None:
+        self.samples_us.append(latency_us)
+
+    @staticmethod
+    def _pick(data, p: float) -> float:
+        idx = min(len(data) - 1, int(round((p / 100.0) * (len(data) - 1))))
+        return data[idx]
+
+    def percentile(self, p: float) -> float:
+        if not self.samples_us:
+            return 0.0
+        return self._pick(sorted(self.samples_us), p)
+
+    def summary(self) -> Dict[str, float]:
+        if not self.samples_us:
+            return {"count": 0}
+        data = sorted(self.samples_us)  # one sort serves every statistic
+        return {
+            "count": len(data),
+            "min_us": data[0],
+            "mean_us": sum(data) / len(data),
+            "p50_us": self._pick(data, 50),
+            "p95_us": self._pick(data, 95),
+            "p99_us": self._pick(data, 99),
+            "max_us": data[-1],
+        }
+
+
+def human_us(us: float) -> str:
+    if us >= 1_000_000:
+        return f"{us / 1_000_000:.2f}s"
+    if us >= 1_000:
+        return f"{us / 1_000:.2f}ms"
+    return f"{us:.0f}us"

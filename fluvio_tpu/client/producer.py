@@ -29,6 +29,41 @@ from fluvio_tpu.schema.spu import (
 DEFAULT_BATCH_SIZE = 16_384
 DEFAULT_LINGER_MS = 100
 
+# broker-reported errors worth retrying under at-least-once: leadership is
+# mid-move (parity: producer/config.rs RetryPolicy error classes); transport
+# failures are classified separately where they are caught
+RETRIABLE_ERRORS = frozenset({ErrorCode.NOT_LEADER_FOR_PARTITION})
+
+
+@dataclass
+class RetryPolicy:
+    """Backoff schedule for at-least-once delivery (config.rs:348).
+
+    Strategies mirror the reference: exponential (doubling), fibonacci,
+    fixed — each capped at ``max_delay_ms``.
+    """
+
+    max_retries: int = 4
+    initial_delay_ms: int = 50
+    max_delay_ms: int = 2000
+    strategy: str = "exponential"  # exponential | fibonacci | fixed
+
+    def __post_init__(self) -> None:
+        if self.strategy not in ("exponential", "fibonacci", "fixed"):
+            raise ValueError(f"unknown retry strategy {self.strategy!r}")
+
+    def delays_ms(self):
+        a, b = self.initial_delay_ms, self.initial_delay_ms
+        for attempt in range(self.max_retries):
+            if self.strategy == "fixed":
+                delay = self.initial_delay_ms
+            elif self.strategy == "fibonacci":
+                delay = a
+                a, b = b, a + b
+            else:
+                delay = self.initial_delay_ms * (2**attempt)
+            yield min(delay, self.max_delay_ms)
+
 
 @dataclass
 class ProducerConfig:
@@ -39,6 +74,13 @@ class ProducerConfig:
     timeout_ms: int = 1500
     max_request_size: int = 1 << 20
     smartmodules: List[SmartModuleInvocation] = field(default_factory=list)
+    # delivery semantics (config.rs AtMostOnce / AtLeastOnce(RetryPolicy))
+    delivery: str = "at-least-once"  # at-least-once | at-most-once
+    retry_policy: RetryPolicy = field(default_factory=RetryPolicy)
+
+    def __post_init__(self) -> None:
+        if self.delivery not in ("at-least-once", "at-most-once"):
+            raise ValueError(f"unknown delivery semantic {self.delivery!r}")
 
 
 @dataclass
@@ -178,28 +220,52 @@ class PartitionProducer:
             ],
             smartmodules=list(self.config.smartmodules),
         )
-        try:
-            socket = await self._socket_factory()
-            response = await socket.send_receive(request)
-            presp = response.find_partition(self.topic, self.partition)
-        except Exception as e:  # noqa: BLE001 — propagate via futures
-            err = e if isinstance(e, FluvioError) else FluvioError(ErrorCode.OTHER, str(e))
+        err = await self._send_with_retry(request, pending)
+        if err is not None:
             for p in pending:
                 if not p.future.done():
                     p.future.set_exception(err)
-            return
-        if presp.error_code != ErrorCode.NONE:
-            err = FluvioError(presp.error_code, presp.error_message)
-            for p in pending:
-                if not p.future.done():
-                    p.future.set_exception(err)
-            return
-        # offsets are contiguous across the batches of one request
-        offset = presp.base_offset
-        for p in pending:
-            if not p.future.done():
-                p.future.set_result((self.partition, offset))
-            offset += len(p.records)
+
+    async def _send_with_retry(
+        self, request: ProduceRequest, pending: List[_PendingBatch]
+    ) -> Optional[FluvioError]:
+        """One attempt, plus retries under at-least-once for leadership
+        moves / dropped connections (partition_producer.rs delivery
+        semantics). Returns the final error, or None on success."""
+        retries = (
+            self.config.retry_policy.delays_ms()
+            if self.config.delivery == "at-least-once"
+            else iter(())
+        )
+        while True:
+            try:
+                socket = await self._socket_factory()
+                response = await socket.send_receive(request)
+                presp = response.find_partition(self.topic, self.partition)
+            except Exception as e:  # noqa: BLE001 — classify then retry/raise
+                if isinstance(e, FluvioError):
+                    err, retriable = e, e.code in RETRIABLE_ERRORS
+                else:
+                    # only genuine transport failures are transient;
+                    # programming/parse errors propagate immediately
+                    retriable = isinstance(e, (ConnectionError, OSError))
+                    err = FluvioError(ErrorCode.OTHER, str(e))
+            else:
+                if presp.error_code == ErrorCode.NONE:
+                    offset = presp.base_offset
+                    for p in pending:
+                        if not p.future.done():
+                            p.future.set_result((self.partition, offset))
+                        offset += len(p.records)
+                    return None
+                err = FluvioError(presp.error_code, presp.error_message)
+                retriable = err.code in RETRIABLE_ERRORS
+            if not retriable:
+                return err
+            delay_ms = next(retries, None)
+            if delay_ms is None:
+                return err
+            await asyncio.sleep(delay_ms / 1000)
 
     async def close(self) -> None:
         await self.flush()

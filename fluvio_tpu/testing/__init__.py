@@ -1,0 +1,15 @@
+"""Black-box test harness (parity: fluvio-test + fluvio-test-util).
+
+Tests register with ``@fluvio_test(...)``; the runner boots (or attaches
+to) a cluster, forks each test into a child process with a timeout, and
+reports pass/fail. ``python -m fluvio_tpu.testing <name>`` runs one,
+``--all`` runs the suite.
+"""
+
+from fluvio_tpu.testing.runner import (  # noqa: F401
+    TestResult,
+    fluvio_test,
+    registered_tests,
+    run_test,
+)
+from fluvio_tpu.testing.driver import TestDriver  # noqa: F401

@@ -1,0 +1,103 @@
+"""fluvio-test command line.
+
+Capability parity: the `fluvio-test` binary — run one registered test
+(or --all), attaching to a cluster (--sc) or bootstrapping a throwaway
+local one (--cluster-start, like the reference's environment setup).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import tempfile
+
+from fluvio_tpu.testing.runner import TestEnv, registered_tests, run_test
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fluvio-test")
+    parser.add_argument("test", nargs="?", help="registered test name")
+    parser.add_argument("--all", action="store_true", help="run the whole suite")
+    parser.add_argument("--list", action="store_true")
+    parser.add_argument("--sc", metavar="HOST:PORT", help="attach to a cluster")
+    parser.add_argument(
+        "--cluster-start",
+        action="store_true",
+        help="boot a throwaway local cluster for the run",
+    )
+    parser.add_argument("--spu", type=int, default=2, dest="spus")
+    parser.add_argument("--timeout", type=float)
+    parser.add_argument(
+        "--no-fork", action="store_true", help="run in-process (debugging)"
+    )
+    args = parser.parse_args(argv)
+
+    tests = registered_tests()
+    if args.list:
+        for name, test in sorted(tests.items()):
+            print(f"{name}  (timeout {test.timeout_s}s, min_spu {test.min_spu})")
+        return 0
+
+    names = sorted(tests) if args.all else ([args.test] if args.test else [])
+    if not names:
+        parser.error("pass a test name, --all, or --list")
+
+    env, cleanup = _make_env(args)
+    try:
+        failures = 0
+        # attach mode has no process handles: only single-SPU tests can run
+        cluster_size = len(env.spus) if env.spus else 1
+        for name in names:
+            test = tests[name]
+            if test.min_spu > cluster_size:
+                print(
+                    f"skipped {name}  (needs {test.min_spu} SPUs, "
+                    f"cluster has {cluster_size})"
+                )
+                continue
+            result = run_test(
+                name, env, fork=not args.no_fork, timeout_s=args.timeout
+            )
+            marker = "ok" if result.ok else "FAILED"
+            print(f"{marker:7s} {name}  ({result.seconds:.2f}s)")
+            if not result.ok:
+                failures += 1
+                if result.detail:
+                    print(result.detail, file=sys.stderr)
+        return 1 if failures else 0
+    finally:
+        cleanup()
+
+
+def _make_env(args):
+    if args.sc and not args.cluster_start:
+        return TestEnv(sc_addr=args.sc, spus=[]), lambda: None
+
+    from fluvio_tpu.cluster.delete import delete_local_cluster
+    from fluvio_tpu.cluster.local import LocalConfig, LocalInstaller
+
+    data_dir = tempfile.mkdtemp(prefix="fluvio-test-")
+    installer = LocalInstaller(
+        LocalConfig(
+            data_dir=data_dir,
+            spus=args.spus,
+            profile_name="fluvio-test",
+            skip_checks=True,
+        )
+    )
+    state = asyncio.run(installer.install())
+
+    def cleanup() -> None:
+        delete_local_cluster(data_dir, profile_name="fluvio-test")
+
+    return (
+        TestEnv(
+            sc_addr=state["sc_public"], spus=state["spus"], data_dir=data_dir
+        ),
+        cleanup,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""Test registry + forked execution.
+
+Capability parity: fluvio-test-derive's `#[fluvio_test]` registration +
+fluvio-test-util's fork/timeout machinery (test_meta/fork.rs): each test
+runs in a forked child process with a timeout; the parent collects
+pass/fail/timeout. The cluster environment comes from the runner
+(attach via --sc, or --cluster-start a local process cluster).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import multiprocessing
+import os
+import sys
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+_REGISTRY: Dict[str, "RegisteredTest"] = {}
+
+
+@dataclass
+class RegisteredTest:
+    name: str
+    fn: Callable  # async fn(driver_factory, env) -> None
+    timeout_s: float = 60.0
+    min_spu: int = 1
+
+
+@dataclass
+class TestEnv:
+    """What a test may use: the SC address + cluster control hooks."""
+
+    __test__ = False  # keep pytest from collecting this
+
+    sc_addr: str
+    spus: list  # [{"id", "pid", "public", "private"}] for kill-based tests
+    data_dir: str = ""
+
+    def kill_spu(self, spu_id: int) -> None:
+        """Fault injection: SIGKILL one SPU process (election tests)."""
+        import signal
+
+        for spu in self.spus:
+            if spu["id"] == spu_id and spu.get("pid"):
+                os.kill(spu["pid"], signal.SIGKILL)
+                return
+        raise RuntimeError(f"no process handle for SPU {spu_id}")
+
+
+@dataclass
+class TestResult:
+    __test__ = False  # keep pytest from collecting this
+
+    name: str
+    ok: bool
+    seconds: float
+    detail: str = ""
+
+
+def fluvio_test(timeout_s: float = 60.0, min_spu: int = 1):
+    """Register a black-box test (the `#[fluvio_test]` analog)."""
+
+    def wrap(fn: Callable) -> Callable:
+        name = fn.__name__.replace("_", "-")
+        _REGISTRY[name] = RegisteredTest(
+            name=name, fn=fn, timeout_s=timeout_s, min_spu=min_spu
+        )
+        return fn
+
+    return wrap
+
+
+def registered_tests() -> Dict[str, RegisteredTest]:
+    _load_builtin_suites()
+    return dict(_REGISTRY)
+
+
+def _load_builtin_suites() -> None:
+    from fluvio_tpu.testing import suites  # noqa: F401 — registers on import
+
+
+def _child_main(test_name: str, fn, env: TestEnv, queue) -> None:
+    try:
+        if fn is None:  # dynamic registration: resolve in the child
+            fn = registered_tests()[test_name].fn
+        asyncio.run(fn(env))
+        queue.put(("ok", ""))
+    except BaseException:  # noqa: BLE001 — report any child failure
+        queue.put(("fail", traceback.format_exc()))
+
+
+def run_test(
+    name: str, env: TestEnv, fork: bool = True, timeout_s: Optional[float] = None
+) -> TestResult:
+    tests = registered_tests()
+    if name not in tests:
+        raise KeyError(f"unknown test {name!r}; have {sorted(tests)}")
+    test = tests[name]
+    timeout = timeout_s or test.timeout_s
+    t0 = time.monotonic()
+
+    if not fork:
+        try:
+            asyncio.run(test.fn(env))
+            return TestResult(name, True, time.monotonic() - t0)
+        except BaseException:  # noqa: BLE001
+            return TestResult(
+                name, False, time.monotonic() - t0, traceback.format_exc()
+            )
+
+    # spawn, not fork: the parent may have jax (or other thread-holding
+    # libraries) loaded, and forked children inherit dead thread state
+    # and hang. The reference forks because its runtime is fork-safe.
+    ctx = multiprocessing.get_context("spawn")
+    queue = ctx.Queue()
+    import pickle
+
+    try:
+        pickle.dumps(test.fn)
+        fn = test.fn
+    except Exception:  # noqa: BLE001 — closures re-resolve by name in child
+        fn = None
+    proc = ctx.Process(target=_child_main, args=(name, fn, env, queue))
+    proc.start()
+    proc.join(timeout)
+    seconds = time.monotonic() - t0
+    if proc.is_alive():
+        proc.kill()
+        proc.join()
+        return TestResult(name, False, seconds, f"timeout after {timeout}s")
+    status, detail = ("fail", "child died") if queue.empty() else queue.get()
+    return TestResult(name, status == "ok", seconds, detail)

@@ -1,0 +1,143 @@
+"""Benchmark-matrix and black-box-runner harness tests.
+
+Parity targets: fluvio-benchmark (matrix expansion, stats, driver run)
+and fluvio-test (registry, forked execution with timeout, suite run
+against a real process cluster — the self_test pattern from
+makefiles/test.mk).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import tempfile
+
+import pytest
+
+from fluvio_tpu.benchmark import BenchmarkConfig, BenchmarkMatrix, LatencyStats
+from fluvio_tpu.benchmark.driver import run_benchmark
+from fluvio_tpu.testing.runner import TestEnv, registered_tests, run_test
+
+
+class TestBenchmarkMatrix:
+    def test_defaults_match_reference(self):
+        matrix = BenchmarkMatrix()
+        configs = list(matrix.configs())
+        assert len(configs) == 1
+        c = configs[0]
+        assert c.batch_size == 16000
+        assert c.linger_ms == 10
+        assert c.max_bytes == 64000
+        assert c.delivery == "at-least-once"
+
+    def test_cross_product(self):
+        matrix = BenchmarkMatrix(
+            compression=["none", "gzip"],
+            isolation=["read-uncommitted", "read-committed"],
+            num_partitions=[1, 2],
+        )
+        configs = list(matrix.configs())
+        assert len(configs) == 8
+        labels = {c.label() for c in configs}
+        assert len(labels) == 8
+
+    def test_yaml_round_trip(self):
+        matrix = BenchmarkMatrix.from_yaml(
+            "num_records: [50]\nrecord_size: [10, 100]\n"
+        )
+        assert [c.record_size for c in matrix.configs()] == [10, 100]
+        with pytest.raises(ValueError):
+            BenchmarkMatrix.from_yaml("bogus_field: [1]\n")
+
+    def test_stats_percentiles(self):
+        stats = LatencyStats()
+        for v in range(1, 101):
+            stats.record(float(v))
+        s = stats.summary()
+        assert s["p50_us"] == pytest.approx(50, abs=1)
+        assert s["p99_us"] == pytest.approx(99, abs=1)
+        assert s["min_us"] == 1 and s["max_us"] == 100
+
+    def test_driver_in_process(self, tmp_path):
+        config = BenchmarkConfig(
+            num_records=200, record_size=64, linger_ms=1, num_partitions=2
+        )
+        result = asyncio.new_event_loop().run_until_complete(
+            run_benchmark(config, in_process=True, work_dir=str(tmp_path))
+        )
+        assert result["produced"] == 200
+        assert result["consumed"] == 200
+        assert result["produce"]["records_per_sec"] > 0
+        assert result["produce"]["latency"]["count"] == 200
+
+    def test_driver_at_most_once(self, tmp_path):
+        config = BenchmarkConfig(
+            num_records=100, record_size=32, linger_ms=1, delivery="at-most-once"
+        )
+        result = asyncio.new_event_loop().run_until_complete(
+            run_benchmark(config, in_process=True, work_dir=str(tmp_path))
+        )
+        assert result["consumed"] == 100
+        assert result["produce"]["latency"]["count"] == 0  # fire-and-forget
+
+
+class TestBlackBoxRunner:
+    def test_registry_has_reference_suites(self):
+        tests = registered_tests()
+        for name in (
+            "smoke",
+            "concurrent",
+            "election",
+            "longevity",
+            "batching",
+            "reconnection",
+            "multiple-partitions",
+            "self-check",
+        ):
+            assert name in tests, name
+        assert tests["election"].min_spu == 2
+
+    def test_forked_timeout_kills_hung_test(self):
+        from fluvio_tpu.testing.runner import _REGISTRY, RegisteredTest
+
+        _REGISTRY["hang-forever"] = RegisteredTest("hang-forever", _hang, 60)
+        try:
+            result = run_test(
+                "hang-forever",
+                TestEnv(sc_addr="127.0.0.1:1", spus=[]),
+                timeout_s=1.0,
+            )
+            assert not result.ok
+            assert "timeout" in result.detail
+            assert result.seconds < 10
+        finally:
+            _REGISTRY.pop("hang-forever", None)
+
+    def test_suite_against_process_cluster(self, tmp_path, monkeypatch):
+        """smoke + election against a real local process cluster."""
+        monkeypatch.setenv("FLUVIO_TPU_CONFIG", str(tmp_path / "config"))
+        from fluvio_tpu.cluster.delete import delete_local_cluster
+        from fluvio_tpu.cluster.local import LocalConfig, LocalInstaller
+
+        data_dir = str(tmp_path / "data")
+        installer = LocalInstaller(
+            LocalConfig(
+                data_dir=data_dir,
+                spus=2,
+                profile_name="harness-test",
+                skip_checks=True,
+            )
+        )
+        state = asyncio.new_event_loop().run_until_complete(installer.install())
+        env = TestEnv(
+            sc_addr=state["sc_public"], spus=state["spus"], data_dir=data_dir
+        )
+        try:
+            for name in ("self-check", "smoke", "election"):
+                result = run_test(name, env)
+                assert result.ok, f"{name}: {result.detail}"
+        finally:
+            delete_local_cluster(data_dir, profile_name="harness-test")
+
+
+async def _hang(env):  # module-level so the spawn-based runner can pickle it
+    await asyncio.sleep(60)
