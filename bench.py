@@ -97,16 +97,39 @@ def bench_tpu(buf, runs: int) -> tuple:
     return out, [sustained]
 
 
-def bench_python_baseline(values, base_n: int) -> float:
-    """Per-record reference engine on a subset; returns records/sec."""
+def bench_host_baseline(values, base_n: int, backend: str) -> float:
+    """Per-record engine on a subset; returns records/sec.
+
+    ``native`` is the honest wasmtime proxy (compiled C++ per-record
+    loops, the reference engine's execution model); ``python`` is the
+    interpreted floor.
+    """
     from fluvio_tpu.protocol.record import Record
     from fluvio_tpu.smartmodule import SmartModuleInput
 
-    chain = build_chain("python")
+    from fluvio_tpu.smartengine.engine import EngineError
+
+    try:
+        chain = build_chain(backend)
+    except EngineError:
+        return 0.0  # e.g. no C++ toolchain for the native engine
+    if backend == "native" and chain.backend_in_use != "native":
+        return 0.0
     records = [Record(value=v) for v in values[:base_n]]
     for i, r in enumerate(records):
         r.offset_delta = i
-    inp = SmartModuleInput.from_records(records)
+    if backend == "native":
+        # wire-encoded slab: decode + transform run in compiled code,
+        # exactly the wasmtime-guest execution model (encode untimed,
+        # as the broker hands the engine already-encoded batches)
+        from fluvio_tpu.protocol.codec import ByteWriter
+
+        w = ByteWriter()
+        for r in records:
+            r.encode(w)
+        inp = SmartModuleInput(base_offset=0, raw_bytes=w.bytes())
+    else:
+        inp = SmartModuleInput.from_records(records)
     t0 = time.time()
     out = chain.process(inp)
     dt = time.time() - t0
@@ -151,8 +174,15 @@ def main() -> None:
     tpu_rps = n / t_med
     log(f"tpu: {[f'{t*1000:.1f}ms' for t in times]} -> {tpu_rps:,.0f} records/s")
 
-    base_rps = bench_python_baseline(values, base_n)
-    log(f"reference engine baseline: {base_rps:,.0f} records/s ({base_n} records)")
+    py_rps = bench_host_baseline(values, base_n, "python")
+    log(f"python engine baseline: {py_rps:,.0f} records/s ({base_n} records)")
+    native_rps = bench_host_baseline(values, min(n, base_n * 10), "native")
+    if native_rps:
+        log(
+            f"native (C++) engine baseline: {native_rps:,.0f} records/s "
+            f"(wasmtime-proxy denominator)"
+        )
+    base_rps = native_rps or py_rps
 
     print(
         json.dumps(

@@ -123,11 +123,17 @@ async def _drive(client: Fluvio, topic: str, config: BenchmarkConfig) -> Dict:
             fut = await producer.send(key, payload)
             if at_most_once:
                 continue
-            pending.append((t0, fut))
+            # latency = send -> ack, captured the moment the ack lands
+            # (not when the post-flush drain loop reaches this future)
+            fut.add_done_callback(
+                lambda t0=t0: produce_stats.record(
+                    (time.monotonic() - t0) * 1e6
+                )
+            )
+            pending.append(fut)
         await producer.flush()
-        for t0, fut in pending:
+        for fut in pending:
             await fut.wait()
-            produce_stats.record((time.monotonic() - t0) * 1e6)
         await producer.close()
 
     produce_t0 = time.monotonic()

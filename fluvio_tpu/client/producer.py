@@ -100,6 +100,10 @@ class FutureRecordMetadata:
         partition, base_offset = await self._future
         return RecordMetadata(partition=partition, offset=base_offset + self._index)
 
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn()`` the moment the batch is acked (latency probes)."""
+        self._future.add_done_callback(lambda _f: fn())
+
 
 class Partitioner:
     """Key-hash (stable) or round-robin routing (partitioning.rs:39)."""

@@ -105,6 +105,7 @@ class SmartModuleChainBuilder:
 
         backend = engine.backend
         tpu_chain = None
+        native_chain = None
         # an empty chain is decode-and-passthrough on every backend
         # (parity: engine.rs:180-184); nothing to lower
         if backend in ("tpu", "auto") and self.entries:
@@ -123,8 +124,26 @@ class SmartModuleChainBuilder:
                     "backend='tpu' requires every module in the chain to "
                     "carry a DSL program (or jax is unavailable)"
                 )
+        # native (C++) per-record engine: the compiled host path — auto
+        # falls back to it when the TPU path is unavailable
+        if backend in ("native", "auto") and self.entries and tpu_chain is None:
+            from fluvio_tpu.smartengine.native_backend import NativeChainExecutor
+
+            native_chain = NativeChainExecutor.try_build(
+                [(e.module, e.config) for e in self.entries]
+            )
+            if native_chain is not None:
+                native_chain.attach(instances)
+            elif backend == "native":
+                raise EngineError(
+                    "backend='native' requires every module in the chain to "
+                    "carry a DSL program (or no C++ toolchain is available)"
+                )
         return SmartModuleChainInstance(
-            engine=engine, instances=instances, tpu_chain=tpu_chain
+            engine=engine,
+            instances=instances,
+            tpu_chain=tpu_chain,
+            native_chain=native_chain,
         )
 
 
@@ -136,17 +155,23 @@ class SmartModuleChainInstance:
         engine: SmartEngine,
         instances: List[PythonInstance],
         tpu_chain=None,
+        native_chain=None,
     ):
         self.engine = engine
         self.instances = instances
         self.tpu_chain = tpu_chain
+        self.native_chain = native_chain
 
     def __len__(self) -> int:
         return len(self.instances)
 
     @property
     def backend_in_use(self) -> str:
-        return "tpu" if self.tpu_chain is not None else "python"
+        if self.tpu_chain is not None:
+            return "tpu"
+        if self.native_chain is not None:
+            return "native"
+        return "python"
 
     def process(
         self,
@@ -161,6 +186,11 @@ class SmartModuleChainInstance:
 
         if self.tpu_chain is not None:
             output = self.tpu_chain.process(inp, metrics)
+            metrics.add_records_out(len(output.successes))
+            return output
+
+        if self.native_chain is not None:
+            output = self.native_chain.process(inp, metrics)
             metrics.add_records_out(len(output.successes))
             return output
 
@@ -204,6 +234,8 @@ class SmartModuleChainInstance:
             if metrics is not None:
                 metrics.add_bytes_in(sum(len(r.value) for r in records))
             instance.call_look_back(records)
-            # keep any TPU-side state in sync after host-side replay
+            # keep any device/native-side state in sync after host replay
             if self.tpu_chain is not None:
                 self.tpu_chain.sync_state_from(self.instances)
+            if self.native_chain is not None:
+                self.native_chain.sync_state_from(self.instances)

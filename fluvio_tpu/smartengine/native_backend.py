@@ -1,0 +1,438 @@
+"""Native (C++) chain backend: lowering + ctypes bridge.
+
+Capability parity: the reference's wasmtime engine executes *compiled*
+per-record transform code on the host CPU; this backend is that
+execution model for our artifact format — DSL programs lower to a
+compact postfix spec interpreted by ``native/baseline_engine.cpp``
+(compiled on demand with g++, cached by source hash). It is both the
+fast host path (``backend="native"``) and the honest wasmtime-proxy
+denominator for bench.py.
+
+State parity: aggregate accumulators round-trip to the Python instances
+after every call (like the TPU executor's attach/sync), so lookback and
+`--aggregate-initial` behave identically across backends.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartmodule.types import (
+    SmartModuleInput,
+    SmartModuleKind,
+    SmartModuleOutput,
+    SmartModuleTransformRuntimeError,
+)
+
+logger = logging.getLogger(__name__)
+
+_SOURCE = Path(__file__).resolve().parents[2] / "native" / "baseline_engine.cpp"
+_BUILD_DIR = Path(
+    os.environ.get("FLUVIO_TPU_NATIVE_BUILD", str(_SOURCE.parent / "_build"))
+)
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+class NativeResult(ctypes.Structure):
+    _fields_ = [
+        ("count", ctypes.c_int64),
+        ("error_src", ctypes.c_int64),
+        ("val_flat", ctypes.POINTER(ctypes.c_uint8)),
+        ("val_off", ctypes.POINTER(ctypes.c_int64)),
+        ("key_flat", ctypes.POINTER(ctypes.c_uint8)),
+        ("key_off", ctypes.POINTER(ctypes.c_int64)),
+        ("key_present", ctypes.POINTER(ctypes.c_uint8)),
+        ("src_idx", ctypes.POINTER(ctypes.c_int64)),
+        ("fresh", ctypes.POINTER(ctypes.c_uint8)),
+        ("out_off_delta", ctypes.POINTER(ctypes.c_int64)),
+        ("out_ts_delta", ctypes.POINTER(ctypes.c_int64)),
+        ("acc_out", ctypes.POINTER(ctypes.c_int64)),
+        ("acc_count", ctypes.c_int64),
+    ]
+
+
+def _compile_library() -> Path:
+    source = _SOURCE.read_bytes()
+    digest = hashlib.sha256(source).hexdigest()[:16]
+    out = _BUILD_DIR / f"baseline_engine-{digest}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(".so.tmp")
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        str(_SOURCE),
+        "-o",
+        str(tmp),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, out)
+    return out
+
+
+def load_library():
+    """Build-once, load-once; None when no toolchain is available."""
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            path = _compile_library()
+            lib = ctypes.CDLL(str(path))
+        except (OSError, subprocess.CalledProcessError) as e:
+            logger.warning("native engine unavailable: %s", e)
+            _lib_failed = True
+            return None
+        lib.chain_create.restype = ctypes.c_void_p
+        lib.chain_create.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.chain_destroy.argtypes = [ctypes.c_void_p]
+        lib.chain_set_accumulator.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+        ]
+        lib.chain_run.restype = ctypes.POINTER(NativeResult)
+        lib.chain_run.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+        ]
+        lib.chain_run_encoded.restype = ctypes.POINTER(NativeResult)
+        lib.chain_run_encoded.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.result_free.argtypes = [ctypes.POINTER(NativeResult)]
+        _lib = lib
+        return _lib
+
+
+# ---------------------------------------------------------------------------
+# DSL -> postfix spec lowering
+# ---------------------------------------------------------------------------
+
+
+class LoweringError(Exception):
+    pass
+
+
+def _hex(data: bytes) -> str:
+    return data.hex() or "00"[:0] or ""
+
+
+def _lower_expr(expr: dsl.Expr, out: List[str]) -> None:
+    e = _lower_expr
+    if isinstance(expr, dsl.Value):
+        out.append("VALUE")
+    elif isinstance(expr, dsl.Key):
+        out.append("KEY")
+    elif isinstance(expr, dsl.Const):
+        out.append(f"CONST {expr.data.hex()}")
+    elif isinstance(expr, dsl.Upper):
+        e(expr.arg, out)
+        out.append("UPPER")
+    elif isinstance(expr, dsl.Lower):
+        e(expr.arg, out)
+        out.append("LOWER")
+    elif isinstance(expr, dsl.Concat):
+        for a in expr.args:
+            e(a, out)
+        out.append(f"CONCAT {len(expr.args)}")
+    elif isinstance(expr, dsl.JsonGet):
+        e(expr.arg, out)
+        out.append(f"JSONGET {expr.key.encode('utf-8').hex()}")
+    elif isinstance(expr, dsl.RegexMatch):
+        e(expr.arg, out)
+        out.append(f"REGEX {expr.pattern.encode('utf-8').hex()}")
+    elif isinstance(expr, dsl.Contains):
+        e(expr.arg, out)
+        out.append(f"CONTAINS {expr.literal.hex()}")
+    elif isinstance(expr, dsl.StartsWith):
+        e(expr.arg, out)
+        out.append(f"STARTSWITH {expr.literal.hex()}")
+    elif isinstance(expr, dsl.EndsWith):
+        e(expr.arg, out)
+        out.append(f"ENDSWITH {expr.literal.hex()}")
+    elif isinstance(expr, dsl.Len):
+        e(expr.arg, out)
+        out.append("LEN")
+    elif isinstance(expr, dsl.ParseInt):
+        e(expr.arg, out)
+        out.append("PARSEINT")
+    elif isinstance(expr, dsl.IntToBytes):
+        e(expr.arg, out)
+        out.append("INT2BYTES")
+    elif isinstance(expr, dsl.Cmp):
+        e(expr.left, out)
+        e(expr.right, out)
+        out.append(f"CMP {expr.cmp}")
+    elif isinstance(expr, dsl.And):
+        for a in expr.args:
+            e(a, out)
+        out.append(f"AND {len(expr.args)}")
+    elif isinstance(expr, dsl.Or):
+        for a in expr.args:
+            e(a, out)
+        out.append(f"OR {len(expr.args)}")
+    elif isinstance(expr, dsl.Not):
+        e(expr.arg, out)
+        out.append("NOT")
+    else:
+        raise LoweringError(f"cannot lower {type(expr).__name__} natively")
+
+
+def lower_chain(entries: List[Tuple]) -> str:
+    """[(module, config)] -> native spec text; raises LoweringError."""
+    lines: List[str] = []
+    for module, config in entries:
+        kind = module.transform_kind()
+        program = module.dsl_program(kind)
+        if program is None:
+            raise LoweringError(f"module {module.name!r} has no DSL program")
+        program = dsl.resolve_params(program, config.params)
+        if isinstance(program, dsl.FilterProgram):
+            pred: List[str] = []
+            _lower_expr(program.predicate, pred)
+            lines.append(f"STEP FILTER {len(pred)} 0 0")
+            lines.extend(pred)
+        elif isinstance(program, dsl.MapProgram):
+            val: List[str] = []
+            _lower_expr(program.value, val)
+            key: List[str] = []
+            if program.key is not None:
+                _lower_expr(program.key, key)
+            lines.append(f"STEP MAP 0 {len(val)} {len(key)}")
+            lines.extend(val)
+            lines.extend(key)
+        elif isinstance(program, dsl.FilterMapProgram):
+            pred, val, key = [], [], []
+            _lower_expr(program.predicate, pred)
+            _lower_expr(program.value, val)
+            if program.key is not None:
+                _lower_expr(program.key, key)
+            lines.append(f"STEP FILTER_MAP {len(pred)} {len(val)} {len(key)}")
+            lines.extend(pred)
+            lines.extend(val)
+            lines.extend(key)
+        elif isinstance(program, dsl.ArrayMapProgram):
+            lines.append(
+                f"STEP ARRAY_MAP {program.mode} {program.sep.hex() or '0a'}"
+            )
+        elif isinstance(program, dsl.AggregateProgram):
+            window = program.window_ms if program.window_ms else -1
+            seed = (config.initial_data or b"").hex()
+            lines.append(f"STEP AGGREGATE {program.kind} {window} {seed or '00'[:0]}")
+        else:
+            raise LoweringError(
+                f"cannot lower program {type(program).__name__} natively"
+            )
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+
+def _as_ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeChainExecutor:
+    """Compiled-chain executor with the TPU executor's interface shape."""
+
+    def __init__(self, handle, lib, entries):
+        self._handle = handle
+        self._lib = lib
+        self._entries = entries
+        self._instances: List = []
+        self.agg_kinds = [
+            module.dsl_program(module.transform_kind()).kind
+            for module, _ in entries
+            if isinstance(
+                module.dsl_program(module.transform_kind()), dsl.AggregateProgram
+            )
+        ]
+
+    @classmethod
+    def try_build(cls, entries: List[Tuple]) -> Optional["NativeChainExecutor"]:
+        lib = load_library()
+        if lib is None:
+            return None
+        try:
+            spec = lower_chain(entries)
+        except LoweringError as e:
+            logger.debug("native lowering unavailable: %s", e)
+            return None
+        err = ctypes.create_string_buffer(512)
+        handle = lib.chain_create(spec.encode(), err, len(err))
+        if not handle:
+            logger.warning(
+                "native chain rejected: %s", err.value.decode("utf-8", "replace")
+            )
+            return None
+        return cls(handle, lib, entries)
+
+    def attach(self, instances: List) -> None:
+        self._instances = instances
+
+    def sync_state_from(self, instances: List) -> None:
+        """Host aggregate state becomes authoritative (post-lookback)."""
+        slot = 0
+        for inst in instances:
+            if inst.kind != SmartModuleKind.AGGREGATE:
+                continue
+            acc = inst.accumulator or b""
+            buf = (ctypes.c_uint8 * max(1, len(acc))).from_buffer_copy(
+                acc or b"\x00"
+            )
+            self._lib.chain_set_accumulator(self._handle, slot, buf, len(acc))
+            slot += 1
+
+    def _sync_instances(self, accs: List[int]) -> None:
+        slot = 0
+        for inst in self._instances:
+            if inst.kind != SmartModuleKind.AGGREGATE:
+                continue
+            if slot < len(accs):
+                inst.accumulator = str(accs[slot]).encode("ascii")
+            slot += 1
+
+    def process(self, inp: SmartModuleInput, metrics=None) -> SmartModuleOutput:
+        if inp.raw_bytes is not None and inp.records is None:
+            # wire-encoded slab: decode + transform entirely in native code
+            # (the wasmtime-guest execution model)
+            result = self._lib.chain_run_encoded(
+                self._handle,
+                inp.raw_bytes,
+                len(inp.raw_bytes),
+                inp.base_timestamp,
+            )
+            return self._collect(result, inp, records=None)
+        records = inp.into_records()
+        n = len(records)
+        base_ts = inp.base_timestamp
+
+        val_off = np.zeros(n + 1, dtype=np.int64)
+        key_off = np.zeros(n + 1, dtype=np.int64)
+        key_present = np.zeros(max(n, 1), dtype=np.uint8)
+        timestamps = np.full(max(n, 1), -1, dtype=np.int64)
+        val_parts, key_parts = [], []
+        vo = ko = 0
+        for i, rec in enumerate(records):
+            val_parts.append(rec.value)
+            vo += len(rec.value)
+            val_off[i + 1] = vo
+            if rec.key is not None:
+                key_present[i] = 1
+                key_parts.append(rec.key)
+                ko += len(rec.key)
+            key_off[i + 1] = ko
+            if base_ts >= 0:
+                timestamps[i] = base_ts + rec.timestamp_delta
+        flat = np.frombuffer(b"".join(val_parts), dtype=np.uint8) if vo else np.zeros(1, np.uint8)
+        kflat = np.frombuffer(b"".join(key_parts), dtype=np.uint8) if ko else np.zeros(1, np.uint8)
+
+        result = self._lib.chain_run(
+            self._handle,
+            _as_ptr(flat, ctypes.c_uint8),
+            _as_ptr(val_off, ctypes.c_int64),
+            _as_ptr(kflat, ctypes.c_uint8),
+            _as_ptr(key_off, ctypes.c_int64),
+            _as_ptr(key_present, ctypes.c_uint8),
+            _as_ptr(timestamps, ctypes.c_int64),
+            n,
+        )
+        return self._collect(result, inp, records)
+
+    def _collect(
+        self, result, inp: SmartModuleInput, records: Optional[List[Record]]
+    ) -> SmartModuleOutput:
+        """Rebuild output Records from the flat native result.
+
+        With ``records`` (the flat input path) deltas come from the source
+        Python records; without (the encoded path) they come from the
+        native decoder's per-output delta arrays.
+        """
+        try:
+            res = result.contents
+            count = res.count
+            out = SmartModuleOutput()
+            vflat = bytes(
+                np.ctypeslib.as_array(res.val_flat, shape=(max(1, res.val_off[count]),))
+            ) if count else b""
+            kflat_out = bytes(
+                np.ctypeslib.as_array(res.key_flat, shape=(max(1, res.key_off[count]),))
+            ) if count else b""
+            for i in range(count):
+                value = vflat[res.val_off[i] : res.val_off[i + 1]]
+                key = (
+                    kflat_out[res.key_off[i] : res.key_off[i + 1]]
+                    if res.key_present[i]
+                    else None
+                )
+                fresh = bool(res.fresh[i])  # fan-out records reset deltas
+                if records is not None:
+                    src = records[res.src_idx[i]]
+                    ts_delta = 0 if fresh else src.timestamp_delta
+                    off_delta = 0 if fresh else src.offset_delta
+                else:
+                    ts_delta = res.out_ts_delta[i]
+                    off_delta = res.out_off_delta[i]
+                out.successes.append(
+                    Record(
+                        value=value,
+                        key=key,
+                        timestamp_delta=ts_delta,
+                        offset_delta=off_delta,
+                    )
+                )
+            if res.error_src >= 0:
+                failing = (records or inp.into_records())[res.error_src]
+                out.error = SmartModuleTransformRuntimeError(
+                    hint="input record is not a JSON array",
+                    offset=inp.base_offset + failing.offset_delta,
+                    kind=SmartModuleKind.ARRAY_MAP,
+                    record_key=failing.key,
+                )
+            accs = [res.acc_out[i] for i in range(res.acc_count)]
+        finally:
+            self._lib.result_free(result)
+        self._sync_instances(accs)
+        return out
+
+    def __del__(self):
+        try:
+            if self._handle and self._lib is not None:
+                self._lib.chain_destroy(self._handle)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

@@ -53,6 +53,29 @@ class RecordBuffer:
     count: int
     base_offset: int = 0
     base_timestamp: int = NO_TIMESTAMP
+    # cached ragged (flat) form of `values` for transfer-thin H2D staging
+    _flat: Optional[np.ndarray] = None
+    _starts: Optional[np.ndarray] = None
+
+    def ragged_values(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(flat, starts): concatenated live bytes + per-row start index.
+
+        The host link is the consume path's bottleneck; shipping the flat
+        form (sum of lengths) instead of the padded matrix (rows x width)
+        cuts H2D bytes by the padding ratio. The device re-pads with one
+        gather. Cached: stream benches reuse the same buffer.
+        """
+        if self._flat is None:
+            width = self.values.shape[1]
+            mask = np.arange(width, dtype=np.int32)[None, :] < self.lengths[:, None]
+            self._flat = np.ascontiguousarray(self.values[mask])
+            starts = np.zeros(len(self.lengths), dtype=np.int32)
+            starts[1:] = np.cumsum(self.lengths[:-1])
+            self._starts = starts
+        return self._flat, self._starts
+
+    def has_keys(self) -> bool:
+        return bool((self.key_lengths[: self.count] >= 0).any())
 
     # -- construction -------------------------------------------------------
 

@@ -53,6 +53,14 @@ class SpuServer:
         return self.internal_server.local_addr
 
     async def start(self) -> None:
+        if self.config.smart_engine.backend in ("auto", "native"):
+            # warm the native engine's g++ build off the event loop so the
+            # first SmartModule chain build doesn't stall request handling
+            import threading
+
+            from fluvio_tpu.smartengine.native_backend import load_library
+
+            threading.Thread(target=load_library, daemon=True).start()
         await self.public_server.start()
         if self.internal_server is not None:
             await self.internal_server.start()

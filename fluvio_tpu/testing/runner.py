@@ -121,8 +121,26 @@ def run_test(
     try:
         pickle.dumps(test.fn)
         fn = test.fn
-    except Exception:  # noqa: BLE001 — closures re-resolve by name in child
-        fn = None
+    except Exception:  # noqa: BLE001 — unpicklable (closure/lambda) test fn
+        # a spawned child cannot see dynamic registrations; run in-process
+        # with the timeout enforced by asyncio instead of process kill
+        async def _bounded() -> None:
+            await asyncio.wait_for(test.fn(env), timeout=timeout)
+
+        try:
+            asyncio.run(_bounded())
+            return TestResult(name, True, time.monotonic() - t0)
+        except asyncio.TimeoutError:
+            return TestResult(
+                name,
+                False,
+                time.monotonic() - t0,
+                f"timeout after {timeout}s (in-process)",
+            )
+        except BaseException:  # noqa: BLE001
+            return TestResult(
+                name, False, time.monotonic() - t0, traceback.format_exc()
+            )
     proc = ctx.Process(target=_child_main, args=(name, fn, env, queue))
     proc.start()
     proc.join(timeout)

@@ -23,8 +23,8 @@ from fluvio_tpu.transport.socket import FluvioSocket, SocketClosed
 _STREAM_END = object()
 
 
-class MultiplexerClosed(Exception):
-    pass
+class MultiplexerClosed(ConnectionError):
+    """Socket already stale/closed — transient, like SocketClosed."""
 
 
 class AsyncResponse:

@@ -18,8 +18,12 @@ from fluvio_tpu.protocol.api import (
 from fluvio_tpu.protocol.codec import ByteReader
 
 
-class SocketClosed(Exception):
-    """Peer closed the connection (parity: SocketError::SocketClosed)."""
+class SocketClosed(ConnectionError):
+    """Peer closed the connection (parity: SocketError::SocketClosed).
+
+    A ConnectionError subclass so transport-failure classification (e.g.
+    the producer's at-least-once retry) treats it as transient.
+    """
 
 
 class FluvioSocket:

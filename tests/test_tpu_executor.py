@@ -225,9 +225,20 @@ class TestBackendSelection:
         assert chain.backend_in_use == "tpu"
 
     def test_unsupported_regex_falls_back(self):
+        """Backreferences can't become DFAs: auto skips the TPU backend
+        and lands on a host engine (native when a toolchain exists)."""
+        from fluvio_tpu.protocol.record import Record
+        from fluvio_tpu.smartmodule.types import SmartModuleInput
+
         b = SmartEngine(backend="auto").builder()
         b.add_smart_module(
             SmartModuleConfig(params={"regex": r"(a)\1"}), lookup("regex-filter")
         )
         chain = b.initialize()
-        assert chain.backend_in_use == "python"
+        assert chain.backend_in_use in ("python", "native")
+        out = chain.process(
+            SmartModuleInput.from_records(
+                [Record(value=b"has aa here"), Record(value=b"only a")]
+            )
+        )
+        assert [r.value for r in out.successes] == [b"has aa here"]
