@@ -1,0 +1,280 @@
+"""Pallas TPU kernels: fused single-pass byte-state machines.
+
+The XLA lowering of ``json_get`` costs ~12 separate gather/scan
+primitives per call; on a remotely-attached chip each primitive pays
+dispatch overhead, so collapsing the whole field extraction into ONE
+pallas kernel is the difference between ~600ms and a few ms per batch
+(BASELINE.md round-1 optimization roadmap).
+
+Layout: the byte matrix is processed TRANSPOSED — (width, rows) — so the
+sequential scan walks sublanes (cheap dynamic index) while records ride
+the 128-wide lanes. The state machine is the *sequential* reference
+automaton of ``dsl.json_get_bytes`` (exact semantics, including the
+malformed-input corners where the parallel structural kernel deviates).
+
+Falls back cleanly: callers use :func:`json_get_available` /
+``try`` the build and keep the XLA kernel otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas availability is platform-dependent
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS = True
+except Exception:  # noqa: BLE001 — optional dependency surface
+    _PALLAS = False
+
+LANES = 512  # records per block (lane axis, multiple of 128)
+
+# scan phases
+_SCAN, _SKIP_KEY, _SEEK_COLON, _SEEK_VAL, _STR_VAL, _RAW_VAL, _DONE = range(7)
+
+
+def json_get_available() -> bool:
+    return _PALLAS
+
+
+def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
+                      start_ref, vlen_ref, wc_ref):
+    """One row-block: full json_get state machine + in-kernel extraction.
+
+    vt_ref: (width, LANES) int32 transposed bytes; len_ref: (1, LANES).
+    Outputs: out_ref (width, LANES) extracted bytes (zero-padded),
+    start_ref/vlen_ref (1, LANES). wc_ref: VMEM scratch holding the
+    precomputed windowed needle-compare, read back with a dynamic row
+    index inside the scan (refs support pl.ds; values don't).
+    """
+    klen = len(needle)
+    lengths = len_ref[0:1, :]  # (1, n) — keep every state vector 2-D
+    n = lengths.shape[1]
+    zero = jnp.zeros((1, n), dtype=jnp.int32)
+
+    # windowed needle compare (static shifts): wc[j] = needle matches at j
+    vt = vt_ref[:, :]  # (width, n)
+    wc = jnp.ones((width, n), dtype=jnp.bool_)
+    for i, b in enumerate(needle):
+        if i == 0:
+            shifted = vt
+        else:
+            shifted = jnp.concatenate(
+                [vt[i:, :], jnp.zeros((i, n), dtype=jnp.int32)], axis=0
+            )
+        wc = wc & (shifted == b)
+    jcol = jax.lax.broadcasted_iota(jnp.int32, (width, n), 0)
+    wc = wc & (jcol + klen <= lengths)
+    wc_ref[:, :] = jnp.where(wc, 1, 0)
+
+    def step(j, state):
+        (phase, in_str, esc, depth, d2, skip, start, end, last_nonws) = state
+        c = vt_ref[pl.ds(j, 1), :]  # (1, n)
+        wc_j = wc_ref[pl.ds(j, 1), :] != 0
+        inrec = j < lengths
+        is_ws = (c == 32) | (c == 9) | (c == 13) | (c == 10)
+
+        # ---- key-match branch arming (only in _SCAN phase) -------------
+        in_str_b = in_str != 0
+        esc_b = esc != 0
+        scanning = (phase == _SCAN) & inrec
+        instr_now = scanning & in_str_b
+        new_esc = jnp.where(instr_now & ~esc_b & (c == 92), 1, 0)
+        exit_str = instr_now & ~esc_b & (c == 34)
+        in_str1 = jnp.where(instr_now, jnp.where(exit_str, 0, in_str), in_str)
+        esc1 = jnp.where(instr_now, new_esc, esc)
+
+        outside = scanning & ~in_str_b
+        quote_here = outside & (c == 34)
+        matched = quote_here & (depth == 1) & wc_j
+        open_str = quote_here & ~matched
+        in_str2 = jnp.where(open_str, 1, in_str1)
+        depth1 = jnp.where(
+            outside & (c == 123), depth + 1,
+            jnp.where(outside & (c == 125), depth - 1, depth),
+        )
+
+        phase1 = jnp.where(matched, _SKIP_KEY, phase)
+        skip1 = jnp.where(matched, klen - 1, skip)
+
+        # ---- skip over the needle bytes --------------------------------
+        skipping = (phase == _SKIP_KEY) & inrec
+        skip2 = jnp.where(skipping, skip - 1, skip1)
+        phase2 = jnp.where(skipping & (skip <= 1), _SEEK_COLON, phase1)
+
+        # ---- whitespace to the colon -----------------------------------
+        seek_c = (phase == _SEEK_COLON) & inrec
+        phase3 = jnp.where(
+            seek_c & ~is_ws,
+            jnp.where(c == 58, _SEEK_VAL, _SCAN),  # not a colon: resume
+            phase2,
+        )
+
+        # ---- whitespace to the value -----------------------------------
+        seek_v = (phase == _SEEK_VAL) & inrec
+        val_here = seek_v & ~is_ws
+        str_val = val_here & (c == 34)
+        phase4 = jnp.where(
+            val_here, jnp.where(str_val, _STR_VAL, _RAW_VAL), phase3
+        )
+        start1 = jnp.where(str_val, j + 1, jnp.where(val_here, j, start))
+        esc2 = jnp.where(str_val, 0, esc1)
+        d2a = jnp.where(val_here & ~str_val, 0, d2)
+        raw_now = val_here & ~str_val
+
+        # ---- string value: to the closing quote ------------------------
+        instrval = (phase == _STR_VAL) & inrec
+        esc_sv = jnp.where(instrval & ~esc_b & (c == 92), 1,
+                           jnp.where(instrval, 0, esc2))
+        close = instrval & ~esc_b & (c == 34)
+        phase5 = jnp.where(close, _DONE, phase4)
+        end1 = jnp.where(close, j, end)
+
+        # ---- raw value: to top-level , ] } -----------------------------
+        inraw = ((phase == _RAW_VAL) & inrec) | raw_now
+        opens = inraw & ((c == 91) | (c == 123))
+        closes = inraw & ((c == 93) | (c == 125))
+        term = inraw & (
+            (((c == 93) | (c == 125)) & (d2a == 0))
+            | ((c == 44) & (d2a == 0))
+        )
+        d2b = jnp.where(opens, d2a + 1, jnp.where(closes & ~term, d2a - 1, d2a))
+        phase6 = jnp.where(term, _DONE, phase5)
+        end2 = jnp.where(term, j, end1)
+        last_nonws1 = jnp.where(inraw & ~is_ws & ~term, j, last_nonws)
+
+        # ---- end of record: unterminated values resolve ----------------
+        at_end = (j + 1 >= lengths) & inrec
+        raw_eof = at_end & (phase6 == _RAW_VAL)
+        str_eof = at_end & (phase6 == _STR_VAL)
+        phase7 = jnp.where(raw_eof | str_eof, _DONE, phase6)
+        end3 = jnp.where(raw_eof | str_eof, lengths, end2)
+
+        return (
+            phase7,
+            in_str2,
+            esc_sv,  # chains the in-string and string-value escape updates
+            depth1,
+            d2b,
+            skip2,
+            start1,
+            end3,
+            last_nonws1,
+        )
+
+    init = (
+        jnp.full((1, n), _SCAN, dtype=jnp.int32),
+        zero,  # in_str (0/1 int32: Mosaic bool vectors are fragile)
+        zero,  # esc
+        zero,
+        zero,
+        zero,
+        zero,
+        zero,
+        jnp.full((1, n), -1, dtype=jnp.int32),
+    )
+    (phase, _in_str, _esc, _depth, _d2, _skip, start, end, last_nonws) = (
+        jax.lax.fori_loop(0, width, step, init)
+    )
+
+    found = phase == _DONE
+    raw_trim = found & (last_nonws >= 0)
+    end = jnp.where(
+        raw_trim & (last_nonws + 1 < end), last_nonws + 1, end
+    )
+    vlen = jnp.where(found, jnp.maximum(end - start, 0), 0)
+    start = jnp.where(found, start, 0)
+    start_ref[0:1, :] = start
+    vlen_ref[0:1, :] = vlen
+
+
+def _extract_kernel(width: int, vt_ref, start_ref, vlen_ref, out_ref):
+    """Shift each record's rows up by its `start` and mask to `vlen`.
+
+    Separate pallas call: fusing this into the scan kernel trips an
+    infinite recursion in the Mosaic convert-lowering on this jax
+    version; two kernels still collapse ~12 XLA primitives into 2.
+    """
+    vt = vt_ref[:, :]
+    n = vt.shape[1]
+    start = start_ref[0:1, :]
+    vlen = vlen_ref[0:1, :]
+    shifted = vt
+    for bit in range(int(np.log2(max(width, 2))) + 1):
+        amount = 1 << bit
+        if amount >= width:
+            break
+        take = jnp.concatenate(
+            [shifted[amount:, :], jnp.zeros((amount, n), dtype=jnp.int32)],
+            axis=0,
+        )
+        cond = ((start >> bit) & 1) == 1  # (1, n)
+        shifted = jnp.where(cond, take, shifted)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (width, n), 0)
+    out_ref[:, :] = jnp.where(rows < vlen, shifted, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("key", "interpret"))
+def json_get_pallas(
+    values: jnp.ndarray,
+    lengths: jnp.ndarray,
+    key: str,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused JSON field extraction: (out_values, out_lengths).
+
+    Semantics: exactly ``dsl.json_get_bytes`` (sequential automaton).
+    """
+    if not _PALLAS:
+        raise RuntimeError("pallas unavailable")
+    needle = b'"' + key.encode("utf-8") + b'"'
+    n, width = values.shape
+    blocks = max(1, (n + LANES - 1) // LANES)
+    padded_n = blocks * LANES
+    vt = jnp.transpose(values.astype(jnp.int32))  # (width, n)
+    if padded_n != n:
+        vt = jnp.pad(vt, ((0, 0), (0, padded_n - n)))
+        lengths = jnp.pad(lengths, (0, padded_n - n))
+    len2d = lengths.astype(jnp.int32)[None, :]
+
+    scan = functools.partial(_json_scan_kernel, needle, width)
+    start, vlen = pl.pallas_call(
+        scan,
+        grid=(blocks,),
+        in_specs=[
+            pl.BlockSpec((width, LANES), lambda b: (0, b)),
+            pl.BlockSpec((1, LANES), lambda b: (0, b)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, LANES), lambda b: (0, b)),
+            pl.BlockSpec((1, LANES), lambda b: (0, b)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, padded_n), jnp.int32),
+            jax.ShapeDtypeStruct((1, padded_n), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((width, LANES), jnp.int32)],
+        interpret=interpret,
+    )(vt, len2d)
+    extract = functools.partial(_extract_kernel, width)
+    outT = pl.pallas_call(
+        extract,
+        grid=(blocks,),
+        in_specs=[
+            pl.BlockSpec((width, LANES), lambda b: (0, b)),
+            pl.BlockSpec((1, LANES), lambda b: (0, b)),
+            pl.BlockSpec((1, LANES), lambda b: (0, b)),
+        ],
+        out_specs=pl.BlockSpec((width, LANES), lambda b: (0, b)),
+        out_shape=jax.ShapeDtypeStruct((width, padded_n), jnp.int32),
+        interpret=interpret,
+    )(vt, start, vlen)
+    out_values = jnp.transpose(outT[:, :n]).astype(jnp.uint8)
+    out_lengths = vlen[0, :n]
+    return out_values, out_lengths
