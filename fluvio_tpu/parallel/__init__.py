@@ -9,9 +9,15 @@ ICI (GSPMD partitions `associative_scan`/`cumsum` automatically).
 """
 
 from fluvio_tpu.parallel.mesh import (
+    RECORD_AXIS,
     make_record_mesh,
     shard_buffer_arrays,
     sharded_chain_step,
 )
 
-__all__ = ["make_record_mesh", "shard_buffer_arrays", "sharded_chain_step"]
+__all__ = [
+    "RECORD_AXIS",
+    "make_record_mesh",
+    "shard_buffer_arrays",
+    "sharded_chain_step",
+]
