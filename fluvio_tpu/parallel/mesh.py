@@ -55,9 +55,14 @@ def sharded_chain_step(executor, mesh: Mesh):
 
     # shardings bound at call time (array pytree structure varies per chain)
     def run(arrays, count, base_ts, carries):
+        from fluvio_tpu.smartengine.tpu.pallas_kernels import disable_pallas
+
         jitted = jax.jit(
             step, in_shardings=in_shardings(arrays, count, base_ts, carries)
         )
-        return jitted(arrays, count, base_ts, carries)
+        # trace with pallas off: GSPMD partitions XLA kernels transparently
+        # but cannot partition pallas_call bodies
+        with disable_pallas():
+            return jitted(arrays, count, base_ts, carries)
 
     return run

@@ -62,16 +62,22 @@ class RecordBuffer:
 
         The host link is the consume path's bottleneck; shipping the flat
         form (sum of lengths) instead of the padded matrix (rows x width)
-        cuts H2D bytes by the padding ratio. The device re-pads with one
-        gather. Cached: stream benches reuse the same buffer.
+        cuts H2D bytes by the padding ratio. Each record's span is padded
+        to a 4-byte boundary (~6% overhead on short records) so the
+        device re-pad can gather whole i32 words — a 4x cheaper gather
+        than per-byte on TPU. The device derives the starts from a cumsum
+        of the aligned lengths; they are returned here for host-side
+        consumers. Cached: stream benches reuse the same buffer.
         """
         if self._flat is None:
             width = self.values.shape[1]
-            mask = np.arange(width, dtype=np.int32)[None, :] < self.lengths[:, None]
+            lengths4 = (self.lengths.astype(np.int64) + 3) & ~3
+            # rows' padding bytes are already zero in `values`
+            mask = np.arange(width, dtype=np.int64)[None, :] < lengths4[:, None]
             self._flat = np.ascontiguousarray(self.values[mask])
-            starts = np.zeros(len(self.lengths), dtype=np.int32)
-            starts[1:] = np.cumsum(self.lengths[:-1])
-            self._starts = starts
+            starts = np.zeros(len(self.lengths), dtype=np.int64)
+            starts[1:] = np.cumsum(lengths4[:-1])
+            self._starts = starts.astype(np.int32)
         return self._flat, self._starts
 
     def has_keys(self) -> bool:

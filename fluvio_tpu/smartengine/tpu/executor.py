@@ -160,7 +160,15 @@ class TpuChainExecutor:
         self._device_carries = None
         self._jit_ragged = jax.jit(
             self._chain_fn_ragged,
-            static_argnames=("width", "kwidth", "has_keys"),
+            static_argnames=(
+                "width", "kwidth", "has_keys", "has_offsets", "ts_mode"
+            ),
+        )
+        # do any stages write key columns? (drives D2H key download)
+        self._writes_keys = any(
+            (isinstance(s, _MapStage) and s.key_fn is not None)
+            or (isinstance(s, _AggregateStage) and s.window_ms)
+            for s in stages
         )
 
     # -- build --------------------------------------------------------------
@@ -235,11 +243,17 @@ class TpuChainExecutor:
             state["key_lengths"],
             state["offset_deltas"],
             state["timestamp_deltas"],
+            jnp.arange(n, dtype=jnp.int32),  # survivor source-row index
         )
-        values, lengths, keys, key_lengths, off_d, ts_d = packed
+        values, lengths, keys, key_lengths, off_d, ts_d, src_idx = packed
         # D2H is the scarce resource on the host link: ship bounds first
-        # (header) so the host can slice each column to count x used-width
-        # and run the downloads as concurrent streams.
+        # (header) so every column can be sliced to count x used-width
+        # before the copy. The src_idx column lets the host rebuild
+        # offset/timestamp deltas from the input it already holds (every
+        # current stage is row-preserving), so those i32/i64 columns never
+        # cross the link. (An on-device ragged flatten of the values was
+        # tried and reverted: the 64M-element gather costs ~4x the D2H
+        # bytes it saves on this chip.)
         header = jnp.stack(
             [
                 out_count.astype(jnp.int64),
@@ -247,12 +261,12 @@ class TpuChainExecutor:
                 jnp.max(key_lengths).astype(jnp.int64),
             ]
         )
+        packed = (values, lengths, keys, key_lengths, off_d, ts_d, src_idx)
         return header, packed, carries
 
     def _chain_fn_ragged(
         self,
         flat,
-        starts,
         lengths,
         keys,
         key_lengths,
@@ -265,23 +279,45 @@ class TpuChainExecutor:
         width: int,
         kwidth: int,
         has_keys: bool,
+        has_offsets: bool,
+        ts_mode: str,
     ):
         """Reconstruct the padded matrix on device from the flat upload.
 
         One gather re-pads; the host link only carried sum(lengths) bytes
-        (plus pow-2 bucketing) instead of rows x width.
+        (plus bucketing) instead of rows x width. The flat staging is
+        4-byte aligned per record, so the gather moves i32 words — 4x
+        fewer gather elements than per-byte, which is what the TPU's
+        gather throughput is sensitive to. Derivable columns never cross
+        the link: row starts come from a device cumsum of the aligned
+        lengths, arange offset deltas (``has_offsets=False``) and zero
+        timestamp deltas (``ts_mode='zero'``) are synthesized, and
+        ``ts_mode='i32'`` timestamps upload narrow and widen on device.
         """
+        lengths = lengths.astype(jnp.int32)
         n = lengths.shape[0]
+        lengths4 = (lengths + 3) & ~3
+        word_starts = (jnp.cumsum(lengths4) - lengths4) >> 2
+        wwidth = width // 4
+        jw = jnp.arange(wwidth, dtype=jnp.int32)[None, :]
+        widx = word_starts[:, None] + jw
+        words = jnp.take(flat, jnp.clip(widx, 0, flat.shape[0] - 1), axis=0)
+        # unpack LE bytes from words: byte k of word w = (w >> 8k) & 0xFF
+        shifts = jnp.arange(4, dtype=jnp.int32)[None, None, :] * 8
+        unpacked = (words[:, :, None] >> shifts) & 0xFF
+        gathered = unpacked.reshape(n, width)
         jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
-        idx = starts[:, None] + jidx
-        gathered = jnp.take(
-            flat, jnp.clip(idx, 0, flat.shape[0] - 1), axis=0
-        )
         mask = jidx < lengths[:, None]
         values = jnp.where(mask, gathered, 0).astype(jnp.uint8)
         if not has_keys:
             keys = jnp.zeros((n, kwidth), dtype=jnp.uint8)
             key_lengths = jnp.full((n,), -1, dtype=jnp.int32)
+        if not has_offsets:
+            offset_deltas = jnp.arange(n, dtype=jnp.int32)
+        if ts_mode == "zero":
+            timestamp_deltas = jnp.zeros((n,), dtype=jnp.int64)
+        else:
+            timestamp_deltas = timestamp_deltas.astype(jnp.int64)
         arrays = {
             "values": values,
             "lengths": lengths,
@@ -290,7 +326,11 @@ class TpuChainExecutor:
             "offset_deltas": offset_deltas,
             "timestamp_deltas": timestamp_deltas,
         }
-        return self._chain_fn(arrays, count, base_ts, carries)
+        header, packed, carries = self._chain_fn(arrays, count, base_ts, carries)
+        # the host rebuilds offset/timestamp deltas from src_idx; drop the
+        # compacted device columns so they are never materialized as outputs
+        values, lengths, keys, key_lengths, _off, _ts, src_idx = packed
+        return header, (values, lengths, keys, key_lengths, src_idx), carries
 
     def _dispatch(self, buf: RecordBuffer):
         """Async-dispatch one batch.
@@ -307,26 +347,47 @@ class TpuChainExecutor:
                 (jnp.int64(acc), jnp.int64(win), jnp.asarray(has))
                 for acc, win, has in self.carries
             )
-        flat, starts = buf.ragged_values()
-        # bucket the flat size to powers of two: one compile per bucket
-        bucket = self._pad_slice(max(len(flat), 1), 1024)
+        flat, _starts = buf.ragged_values()
+        # bucket the flat size at pow2/16 granularity: bounded compile
+        # count (<=16 per size decade) without pow2's up-to-2x H2D blowup
+        bucket = self._bucket_bytes(max(len(flat), 4))
         if len(flat) < bucket:
             flat = np.pad(flat, (0, bucket - len(flat)))
+        # ship the aligned flat as i32 words (see _chain_fn_ragged)
+        flat = flat.view(np.int32)
         has_keys = buf.has_keys()
+        # derivable columns stay off the link (synthesized on device)
+        off = buf.offset_deltas[: buf.count]
+        has_offsets = not np.array_equal(off, np.arange(buf.count, dtype=off.dtype))
+        ts = buf.timestamp_deltas
+        live_ts = ts[: buf.count]
+        if buf.count == 0 or not live_ts.any():
+            ts_mode, ts_up = "zero", None
+        elif np.abs(live_ts).max() < 2**31:
+            ts_mode, ts_up = "i32", jnp.asarray(ts.astype(np.int32))
+        else:
+            ts_mode, ts_up = "i64", jnp.asarray(ts)
+        # lengths ride the link narrow (u16) whenever the width allows
+        lengths_up = (
+            buf.lengths.astype(np.uint16)
+            if buf.values.shape[1] < (1 << 16)
+            else buf.lengths
+        )
         header, packed, new_carries = self._jit_ragged(
             jnp.asarray(flat),
-            jnp.asarray(starts),
-            jnp.asarray(buf.lengths),
+            jnp.asarray(lengths_up),
             jnp.asarray(buf.keys) if has_keys else None,
             jnp.asarray(buf.key_lengths) if has_keys else None,
-            jnp.asarray(buf.offset_deltas),
-            jnp.asarray(buf.timestamp_deltas),
+            jnp.asarray(buf.offset_deltas) if has_offsets else None,
+            ts_up,
             jnp.int32(buf.count),
             jnp.int64(buf.base_timestamp),
             carries,
             width=buf.values.shape[1],
             kwidth=buf.keys.shape[1],
             has_keys=has_keys,
+            has_offsets=has_offsets,
+            ts_mode=ts_mode,
         )
         # keep aggregate state device-resident; host mirrors sync on demand
         self._device_carries = new_carries
@@ -346,33 +407,63 @@ class TpuChainExecutor:
             v <<= 1
         return v
 
+    @staticmethod
+    def _bucket_bytes(n: int, floor: int = 1024) -> int:
+        """pow2/16-granular bucket: <=6.25% padding, bounded compiles."""
+        v = floor
+        while v < n:
+            v <<= 1
+        step = max(floor, v >> 4)
+        return ((n + step - 1) // step) * step
+
     def _fetch(self, buf: RecordBuffer, header, packed) -> RecordBuffer:
-        """Minimal-D2H materialization: slice every output column to
-        (bucketed) count x used-width, start all copies, then collect —
-        the link runs the streams concurrently."""
-        values, lengths, keys, key_lengths, off_d, ts_d = packed
+        """Minimal-D2H materialization.
+
+        Downloads the ragged flat bytes (bucketed to sum of output
+        lengths), the length column, and the survivor source-row index —
+        offset/timestamp deltas are rebuilt from the input columns the
+        host already holds. Key columns cross the link only when the
+        input had keys or a stage writes them. All copies start async so
+        the link runs them as concurrent streams.
+        """
+        values, lengths, keys, key_lengths, src_idx = packed
         hdr = jax.device_get(header)
         count, max_v, max_k = int(hdr[0]), int(hdr[1]), int(hdr[2])
         n_rows = values.shape[0]
-        rows = min(self._pad_slice(max(count, 1)), n_rows)
+        rows = min(self._bucket_bytes(max(count, 1), 8), n_rows)
         vw = min(self._pad_slice(max(max_v, 1)), values.shape[1])
         kw = (
             min(self._pad_slice(max(max_k, 1)), keys.shape[1]) if max_k > 0 else 0
         )
+        len16 = values.shape[1] < (1 << 16)
+        out_len_col = lengths.astype(jnp.uint16) if len16 else lengths
+        want_keys = buf.has_keys() or self._writes_keys
         slices = [
             lax.slice(values, (0, 0), (rows, vw)),
-            lax.slice(lengths, (0,), (rows,)),
-            lax.slice(key_lengths, (0,), (rows,)),
-            lax.slice(off_d, (0,), (rows,)),
-            lax.slice(ts_d, (0,), (rows,)),
+            lax.slice(out_len_col, (0,), (rows,)),
+            lax.slice(src_idx, (0,), (rows,)),
         ]
-        if kw:
-            slices.append(lax.slice(keys, (0, 0), (rows, kw)))
+        if want_keys:
+            slices.append(lax.slice(key_lengths, (0,), (rows,)))
+            if kw:
+                slices.append(lax.slice(keys, (0, 0), (rows, kw)))
         for s in slices:
             s.copy_to_host_async()
         host = jax.device_get(slices)
-        out_values, out_lengths, out_klens, out_off, out_ts = host[:5]
-        out_keys = host[5] if kw else np.zeros((rows, 1), dtype=np.uint8)
+        out_values, out_lengths, out_src = host[:3]
+        out_lengths = out_lengths.astype(np.int32)
+        if want_keys:
+            out_klens = host[3]
+            out_keys = host[4] if kw else np.zeros((rows, 1), dtype=np.uint8)
+        else:
+            out_klens = np.full((rows,), -1, dtype=np.int32)
+            out_keys = np.zeros((rows, 1), dtype=np.uint8)
+        # rebuild passthrough columns from the survivor index
+        src = np.clip(out_src, 0, buf.offset_deltas.shape[0] - 1)
+        out_off = buf.offset_deltas[src].astype(np.int32)
+        out_ts = buf.timestamp_deltas[src].astype(np.int64)
+        out_off[count:] = 0
+        out_ts[count:] = 0
         return RecordBuffer(
             values=out_values,
             lengths=out_lengths,

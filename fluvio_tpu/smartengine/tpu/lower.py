@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from fluvio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex, literal_of
 from fluvio_tpu.smartmodule import dsl
-from fluvio_tpu.smartengine.tpu import kernels
+from fluvio_tpu.smartengine.tpu import kernels, pallas_kernels
 
 
 class Unlowerable(Exception):
@@ -78,14 +78,24 @@ def lower_expr(expr: dsl.Expr) -> Callable[[Dict[str, jnp.ndarray]], object]:
     if isinstance(expr, dsl.JsonGet):
         inner = lower_expr(expr.arg)
         key = expr.key
-        # the sequential scan kernel is exact on all inputs (incl. the
-        # malformed-JSON corner json_get_parallel documents); opt in when
-        # exactness on garbage matters more than speed
-        exact = os.environ.get("FLUVIO_TPU_EXACT_JSON") == "1"
-        json_kernel = kernels.json_get if exact else kernels.json_get_parallel
+        # Default XLA fallback is the sequential scan kernel: exact on all
+        # inputs, same semantics as the pallas kernel, so a record's
+        # extraction never depends on which path (pallas / XLA / sharded)
+        # its batch took. FLUVIO_TPU_FAST_JSON=1 opts the fallback into
+        # the structural-index kernel, which is faster under XLA but has a
+        # documented malformed-JSON deviation.
+        fast = os.environ.get("FLUVIO_TPU_FAST_JSON") == "1"
+        json_kernel = kernels.json_get_parallel if fast else kernels.json_get
 
         def json_fn(s):
             v, l = inner(s)
+            # single-pass pallas state machine when the platform has it:
+            # collapses ~12 XLA primitives into 2 kernels AND carries the
+            # exact sequential semantics (dsl.json_get_bytes)
+            if pallas_kernels.pallas_active(v.shape[1]):
+                return pallas_kernels.json_get_pallas(
+                    v, l, key, interpret=pallas_kernels.interpret_mode()
+                )
             return json_kernel(v, l, key)
 
         return json_fn
@@ -125,6 +135,12 @@ def lower_expr(expr: dsl.Expr) -> Callable[[Dict[str, jnp.ndarray]], object]:
 
         def regex_fn(s):
             v, l = inner(s)
+            # pallas select-chain scan (2 primitives) over the XLA
+            # per-step-gather scan when the platform + DFA size allow
+            if pallas_kernels.pallas_active(v.shape[1]) and pallas_kernels.dfa_supported(dfa):
+                return pallas_kernels.dfa_match_pallas(
+                    v, l, dfa, interpret=pallas_kernels.interpret_mode()
+                )
             return kernels.dfa_match(v, l, dfa)
 
         return regex_fn

@@ -18,7 +18,9 @@ Falls back cleanly: callers use :func:`json_get_available` /
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -34,6 +36,7 @@ except Exception:  # noqa: BLE001 — optional dependency surface
     _PALLAS = False
 
 LANES = 512  # records per block (lane axis, multiple of 128)
+MAX_PALLAS_WIDTH = 1024  # VMEM: width x LANES x int32 blocks must fit
 
 # scan phases
 _SCAN, _SKIP_KEY, _SEEK_COLON, _SEEK_VAL, _STR_VAL, _RAW_VAL, _DONE = range(7)
@@ -41,6 +44,50 @@ _SCAN, _SKIP_KEY, _SEEK_COLON, _SEEK_VAL, _STR_VAL, _RAW_VAL, _DONE = range(7)
 
 def json_get_available() -> bool:
     return _PALLAS
+
+
+# ---------------------------------------------------------------------------
+# Kernel selection: when the lowerer should emit pallas calls
+# ---------------------------------------------------------------------------
+
+_disable_depth = 0
+
+
+@contextlib.contextmanager
+def disable_pallas():
+    """Trace-time escape hatch: GSPMD cannot partition `pallas_call`, so
+    the sharded chain path traces with pallas off (XLA kernels shard
+    transparently)."""
+    global _disable_depth
+    _disable_depth += 1
+    try:
+        yield
+    finally:
+        _disable_depth -= 1
+
+
+def interpret_mode() -> bool:
+    """Interpret pallas on non-TPU backends (tests on the CPU mesh)."""
+    return jax.default_backend() in ("cpu", "gpu")
+
+
+def pallas_active(width: int = 0) -> bool:
+    """Should the lowerer emit a pallas kernel here?
+
+    ``FLUVIO_TPU_PALLAS``: ``0`` disables, ``interpret`` forces the
+    (slow) interpreter on CPU for equivalence testing, ``auto`` (default)
+    enables on real TPU backends only.
+    """
+    if _disable_depth or not _PALLAS:
+        return False
+    if width > MAX_PALLAS_WIDTH:
+        return False
+    mode = os.environ.get("FLUVIO_TPU_PALLAS", "auto")
+    if mode == "0":
+        return False
+    if mode in ("interpret", "1"):
+        return True
+    return not interpret_mode()
 
 
 def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
@@ -71,7 +118,10 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
         wc = wc & (shifted == b)
     jcol = jax.lax.broadcasted_iota(jnp.int32, (width, n), 0)
     wc = wc & (jcol + klen <= lengths)
-    wc_ref[:, :] = jnp.where(wc, 1, 0)
+    # NOTE: x64 is enabled package-wide, so `jnp.where(wc, 1, 0)` would
+    # produce int64 — and Mosaic's convert lowering infinitely recurses on
+    # any i64->i32 convert. Every kernel value must stay explicitly int32.
+    wc_ref[:, :] = wc.astype(jnp.int32)
 
     def step(j, state):
         (phase, in_str, esc, depth, d2, skip, start, end, last_nonws) = state
@@ -85,7 +135,7 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
         esc_b = esc != 0
         scanning = (phase == _SCAN) & inrec
         instr_now = scanning & in_str_b
-        new_esc = jnp.where(instr_now & ~esc_b & (c == 92), 1, 0)
+        new_esc = (instr_now & ~esc_b & (c == 92)).astype(jnp.int32)
         exit_str = instr_now & ~esc_b & (c == 34)
         in_str1 = jnp.where(instr_now, jnp.where(exit_str, 0, in_str), in_str)
         esc1 = jnp.where(instr_now, new_esc, esc)
@@ -112,7 +162,8 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
         seek_c = (phase == _SEEK_COLON) & inrec
         phase3 = jnp.where(
             seek_c & ~is_ws,
-            jnp.where(c == 58, _SEEK_VAL, _SCAN),  # not a colon: resume
+            # not a colon: resume scanning (int32 literals: see x64 note)
+            jnp.where(c == 58, jnp.int32(_SEEK_VAL), jnp.int32(_SCAN)),
             phase2,
         )
 
@@ -121,7 +172,9 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
         val_here = seek_v & ~is_ws
         str_val = val_here & (c == 34)
         phase4 = jnp.where(
-            val_here, jnp.where(str_val, _STR_VAL, _RAW_VAL), phase3
+            val_here,
+            jnp.where(str_val, jnp.int32(_STR_VAL), jnp.int32(_RAW_VAL)),
+            phase3,
         )
         start1 = jnp.where(str_val, j + 1, jnp.where(val_here, j, start))
         esc2 = jnp.where(str_val, 0, esc1)
@@ -179,8 +232,10 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
         zero,
         jnp.full((1, n), -1, dtype=jnp.int32),
     )
+    # int32 loop bounds: under x64 a Python-int fori index is i64 and every
+    # use site would emit Mosaic-unlowerable i64<->i32 converts
     (phase, _in_str, _esc, _depth, _d2, _skip, start, end, last_nonws) = (
-        jax.lax.fori_loop(0, width, step, init)
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(width), step, init)
     )
 
     found = phase == _DONE
@@ -244,37 +299,191 @@ def json_get_pallas(
     len2d = lengths.astype(jnp.int32)[None, :]
 
     scan = functools.partial(_json_scan_kernel, needle, width)
-    start, vlen = pl.pallas_call(
-        scan,
-        grid=(blocks,),
-        in_specs=[
-            pl.BlockSpec((width, LANES), lambda b: (0, b)),
-            pl.BlockSpec((1, LANES), lambda b: (0, b)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, LANES), lambda b: (0, b)),
-            pl.BlockSpec((1, LANES), lambda b: (0, b)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, padded_n), jnp.int32),
-            jax.ShapeDtypeStruct((1, padded_n), jnp.int32),
-        ],
-        scratch_shapes=[pltpu.VMEM((width, LANES), jnp.int32)],
-        interpret=interpret,
-    )(vt, len2d)
-    extract = functools.partial(_extract_kernel, width)
-    outT = pl.pallas_call(
-        extract,
-        grid=(blocks,),
-        in_specs=[
-            pl.BlockSpec((width, LANES), lambda b: (0, b)),
-            pl.BlockSpec((1, LANES), lambda b: (0, b)),
-            pl.BlockSpec((1, LANES), lambda b: (0, b)),
-        ],
-        out_specs=pl.BlockSpec((width, LANES), lambda b: (0, b)),
-        out_shape=jax.ShapeDtypeStruct((width, padded_n), jnp.int32),
-        interpret=interpret,
-    )(vt, start, vlen)
+    # kernels trace with x64 off: under the package-wide x64 every weak
+    # Python-int literal becomes i64 and Mosaic's convert lowering recurses
+    # infinitely on the resulting i64->i32 casts
+    with jax.enable_x64(False):
+        start, vlen = pl.pallas_call(
+            scan,
+            grid=(blocks,),
+            in_specs=[
+                pl.BlockSpec((width, LANES), lambda b: (0, b)),
+                pl.BlockSpec((1, LANES), lambda b: (0, b)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, LANES), lambda b: (0, b)),
+                pl.BlockSpec((1, LANES), lambda b: (0, b)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((1, padded_n), jnp.int32),
+                jax.ShapeDtypeStruct((1, padded_n), jnp.int32),
+            ],
+            scratch_shapes=[pltpu.VMEM((width, LANES), jnp.int32)],
+            interpret=interpret,
+        )(vt, len2d)
+        extract = functools.partial(_extract_kernel, width)
+        outT = pl.pallas_call(
+            extract,
+            grid=(blocks,),
+            in_specs=[
+                pl.BlockSpec((width, LANES), lambda b: (0, b)),
+                pl.BlockSpec((1, LANES), lambda b: (0, b)),
+                pl.BlockSpec((1, LANES), lambda b: (0, b)),
+            ],
+            out_specs=pl.BlockSpec((width, LANES), lambda b: (0, b)),
+            out_shape=jax.ShapeDtypeStruct((width, padded_n), jnp.int32),
+            interpret=interpret,
+        )(vt, start, vlen)
     out_values = jnp.transpose(outT[:, :n]).astype(jnp.uint8)
     out_lengths = vlen[0, :n]
     return out_values, out_lengths
+
+
+# ---------------------------------------------------------------------------
+# DFA regex scan
+# ---------------------------------------------------------------------------
+
+MAX_DFA_SELECTS = 512  # select-chain length bound (compile time + VPU cost)
+
+
+def _dfa_mode(table_flat) -> int:
+    """Most common transition target — the select-chain default."""
+    vals, counts = np.unique(np.asarray(table_flat), return_counts=True)
+    return int(vals[np.argmax(counts)])
+
+
+def dfa_supported(dfa) -> bool:
+    flat = dfa.table.reshape(-1)
+    bc = dfa.byte_class
+    cvals, ccounts = np.unique(bc, return_counts=True)
+    n_byte_selects = int(np.sum(bc != cvals[np.argmax(ccounts)]))
+    n_edge_selects = int(np.sum(flat != _dfa_mode(flat)))
+    return n_byte_selects + n_edge_selects <= MAX_DFA_SELECTS
+
+
+def _dfa_scan_kernel(
+    table_flat: tuple,
+    byte_to_class: tuple,
+    default_class: int,
+    n_classes: int,
+    eos_class: int,
+    pad_class: int,
+    accept_states: tuple,
+    start_state: int,
+    width: int,
+    vt_ref,
+    len_ref,
+    out_ref,
+):
+    """One row-block: DFA scan over raw (transposed) byte columns.
+
+    Gather-free end to end: both the byte->class map and the transition
+    ``table[state, cls]`` are chains of compare-selects — Mosaic has no
+    vector gather, but constant selects on the lane vectors cost ~one
+    VPU op each (an XLA-side 64M-element class gather costs ~600ms on
+    this chip; the in-kernel chain is ~free). Both chains only cover
+    entries that differ from their modal value: for literal-heavy DFAs
+    most bytes map to the catch-all class and most transitions hit the
+    dead state.
+    """
+    lengths = len_ref[0:1, :]
+    n = lengths.shape[1]
+    default = _dfa_mode(table_flat)
+
+    def classify(c):
+        cls = jnp.full_like(c, default_class)
+        for b, k in byte_to_class:
+            cls = jnp.where(c == b, k, cls)
+        return cls
+
+    def transition(state, cls):
+        idx = state * n_classes + cls
+        nxt = jnp.full_like(state, default)
+        for k, v in enumerate(table_flat):
+            if v != default:
+                nxt = jnp.where(idx == k, v, nxt)
+        return nxt
+
+    eos_i32, pad_i32 = jnp.int32(eos_class), jnp.int32(pad_class)
+
+    def step(j, state):
+        c = vt_ref[pl.ds(j, 1), :]
+        cls = classify(c)
+        cls = jnp.where(
+            j < lengths,
+            cls,
+            jnp.where(j == lengths, eos_i32, pad_i32),
+        )
+        return transition(state, cls)
+
+    state = jnp.full((1, n), start_state, dtype=jnp.int32)
+    state = jax.lax.fori_loop(jnp.int32(0), jnp.int32(width), step, state)
+    # trailing symbol: records exactly `width` long still need their EOS
+    cls = jnp.where(lengths == width, eos_i32, pad_i32)
+    state = transition(state, cls)
+
+    acc = jnp.zeros((1, n), dtype=jnp.int32)
+    for s in accept_states:
+        acc = jnp.where(state == s, 1, acc)
+    out_ref[0:1, :] = acc
+
+
+def dfa_match_pallas(
+    values: jnp.ndarray,
+    lengths: jnp.ndarray,
+    dfa,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas DFA match: True where the regex matches (semantics:
+    `kernels.dfa_match` / the numpy reference in `ops.regex_dfa`).
+
+    Two device primitives total — a transpose and one pallas scan —
+    replacing the XLA `lax.scan` whose per-step dual gathers dominate
+    the regex stage's 0.58s/1M-record cost.
+    """
+    if not _PALLAS:
+        raise RuntimeError("pallas unavailable")
+    if not dfa_supported(dfa):
+        raise ValueError("DFA too large for the select-chain kernel")
+    n, width = values.shape
+    blocks = max(1, (n + LANES - 1) // LANES)
+    padded_n = blocks * LANES
+    vt = jnp.transpose(values.astype(jnp.int32))  # (width, n)
+    lengths = lengths.astype(jnp.int32)
+    if padded_n != n:
+        vt = jnp.pad(vt, ((0, 0), (0, padded_n - n)))
+        # padded lanes get length -1: every column reads PAD, state stays dead
+        lengths = jnp.pad(lengths, (0, padded_n - n), constant_values=-1)
+    len2d = lengths[None, :]
+
+    bc = dfa.byte_class.astype(np.int32)
+    cvals, ccounts = np.unique(bc, return_counts=True)
+    default_class = int(cvals[np.argmax(ccounts)])
+    byte_to_class = tuple(
+        (int(b), int(bc[b])) for b in range(256) if int(bc[b]) != default_class
+    )
+    kernel = functools.partial(
+        _dfa_scan_kernel,
+        tuple(int(x) for x in dfa.table.reshape(-1)),
+        byte_to_class,
+        default_class,
+        dfa.n_classes,
+        dfa.eos_class,
+        dfa.pad_class,
+        tuple(int(s) for s in np.nonzero(dfa.accept)[0]),
+        dfa.start,
+        width,
+    )
+    with jax.enable_x64(False):  # see the x64/Mosaic note in json_get_pallas
+        out = pl.pallas_call(
+            kernel,
+            grid=(blocks,),
+            in_specs=[
+                pl.BlockSpec((width, LANES), lambda b: (0, b)),
+                pl.BlockSpec((1, LANES), lambda b: (0, b)),
+            ],
+            out_specs=pl.BlockSpec((1, LANES), lambda b: (0, b)),
+            out_shape=jax.ShapeDtypeStruct((1, padded_n), jnp.int32),
+            interpret=interpret,
+        )(vt, len2d)
+    return out[0, :n] != 0
