@@ -60,20 +60,37 @@ class PartitionConsumer:
             raise FluvioError(resp.error_code)
         return resp
 
-    async def stream(
+    async def stream_batches(
         self,
         offset: Offset,
         config: Optional[ConsumerConfig] = None,
-    ) -> AsyncIterator[ConsumerRecord]:
-        """Yield records from ``offset`` onward, acking as it goes."""
+        start: Optional[int] = None,
+        end_at: Optional[int] = None,
+    ) -> AsyncIterator["Batch"]:
+        """Yield raw (shallow-decoded) batches from ``offset`` onward.
+
+        The batch-level consumer surface: records inside each batch stay
+        wire-encoded (``batch.raw_records``) until the caller asks for
+        ``memory_records()``, so high-throughput consumers never pay a
+        per-record Python decode. Offsets are acked per response exactly
+        as `stream` does. ``start``/``end_at`` are pre-resolved bounds
+        passed by `stream` so offset resolution happens exactly once.
+        """
         config = config or ConsumerConfig()
-        offsets = await self.fetch_offsets()
-        start = offset.resolve(offsets, config.isolation)
-        end_at = None
-        if config.disable_continuous:
-            end_at = offsets.hw if config.isolation == Isolation.READ_COMMITTED else offsets.leo
-            if start >= end_at:
-                return
+        if start is None:
+            offsets = await self.fetch_offsets()
+            start = offset.resolve(offsets, config.isolation)
+            end_at = None
+            if config.disable_continuous:
+                end_at = (
+                    offsets.hw
+                    if config.isolation == Isolation.READ_COMMITTED
+                    else offsets.leo
+                )
+                if start >= end_at:
+                    return
+        else:
+            end_at = end_at if config.disable_continuous else None
 
         request = StreamFetchRequest(
             topic=self.topic,
@@ -91,21 +108,7 @@ class PartitionConsumer:
                     raise FluvioError(part.error_code, part.error_message)
                 last_seen = start - 1
                 for batch in part.records.batches:
-                    base = batch.base_offset
-                    ts = batch.header.first_timestamp
-                    for rec in batch.memory_records():
-                        abs_offset = base + rec.offset_delta
-                        if abs_offset < start:
-                            continue  # skip records before the requested offset
-                        yield ConsumerRecord(
-                            partition=self.partition,
-                            offset=abs_offset,
-                            timestamp=(
-                                ts + rec.timestamp_delta if ts >= 0 else -1
-                            ),
-                            key=rec.key,
-                            value=rec.value,
-                        )
+                    yield batch
                     last_seen = max(last_seen, batch.computed_last_offset() - 1)
                 # next offset to continue from: the engine's filter cursor
                 # when present, else the last stored offset we decoded
@@ -127,3 +130,40 @@ class PartitionConsumer:
                     return
         finally:
             await stream.close()
+
+    async def stream(
+        self,
+        offset: Offset,
+        config: Optional[ConsumerConfig] = None,
+    ) -> AsyncIterator[ConsumerRecord]:
+        """Yield records from ``offset`` onward, acking as it goes."""
+        config = config or ConsumerConfig()
+        offsets = await self.fetch_offsets()
+        start = offset.resolve(offsets, config.isolation)
+        end_at = None
+        if config.disable_continuous:
+            end_at = (
+                offsets.hw
+                if config.isolation == Isolation.READ_COMMITTED
+                else offsets.leo
+            )
+            if start >= end_at:
+                return
+        async for batch in self.stream_batches(
+            offset, config, start=start, end_at=end_at
+        ):
+            base = batch.base_offset
+            ts = batch.header.first_timestamp
+            for rec in batch.memory_records():
+                abs_offset = base + rec.offset_delta
+                if abs_offset < start:
+                    continue  # skip records before the requested offset
+                yield ConsumerRecord(
+                    partition=self.partition,
+                    offset=abs_offset,
+                    timestamp=(
+                        ts + rec.timestamp_delta if ts >= 0 else -1
+                    ),
+                    key=rec.key,
+                    value=rec.value,
+                )

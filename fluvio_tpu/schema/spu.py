@@ -53,6 +53,8 @@ class PartitionProduceData:
 
     @classmethod
     def decode(cls, r: ByteReader, version: Version = 0) -> "PartitionProduceData":
+        # full parse at ingest: malformed record framing must fail the
+        # produce, not surface at consume time from the durable log
         return cls(
             partition_index=r.read_i32(),
             records=RecordSet.decode(r, version),
@@ -199,7 +201,9 @@ class FetchablePartitionResponse(Encodable):
             high_watermark=r.read_i64(),
             log_start_offset=r.read_i64(),
             next_filter_offset=r.read_i64(),
-            records=RecordSet.decode(r, version),
+            # shallow: consumers parse records lazily (batch-level APIs
+            # never pay the per-record decode)
+            records=RecordSet.decode(r, version, parse_records=False),
         )
 
 

@@ -46,6 +46,26 @@ _lib = None
 _lib_failed = False
 
 
+class RecordColumns(ctypes.Structure):
+    _fields_ = [
+        ("count", ctypes.c_int64),
+        ("val_flat", ctypes.POINTER(ctypes.c_uint8)),
+        ("val_off", ctypes.POINTER(ctypes.c_int64)),
+        ("key_flat", ctypes.POINTER(ctypes.c_uint8)),
+        ("key_off", ctypes.POINTER(ctypes.c_int64)),
+        ("key_present", ctypes.POINTER(ctypes.c_uint8)),
+        ("off_delta", ctypes.POINTER(ctypes.c_int64)),
+        ("ts_delta", ctypes.POINTER(ctypes.c_int64)),
+    ]
+
+
+class EncodedRecords(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.POINTER(ctypes.c_uint8)),
+        ("len", ctypes.c_int64),
+    ]
+
+
 class NativeResult(ctypes.Structure):
     _fields_ = [
         ("count", ctypes.c_int64),
@@ -132,8 +152,104 @@ def load_library():
             ctypes.c_int64,
         ]
         lib.result_free.argtypes = [ctypes.POINTER(NativeResult)]
+        lib.decode_record_columns.restype = ctypes.POINTER(RecordColumns)
+        lib.decode_record_columns.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.record_columns_free.argtypes = [ctypes.POINTER(RecordColumns)]
+        lib.encode_record_columns.restype = ctypes.POINTER(EncodedRecords)
+        lib.encode_record_columns.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+        ]
+        lib.encoded_records_free.argtypes = [ctypes.POINTER(EncodedRecords)]
         _lib = lib
         return _lib
+
+
+def _ptr_array(ptr, n, dtype):
+    if n <= 0:
+        return np.zeros(0, dtype=dtype)
+    return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+
+def decode_record_columns(raw: bytes):
+    """Record slab -> columnar numpy arrays via the native parser.
+
+    Returns ``None`` when the native library is unavailable (callers fall
+    back to the per-record Python decode). Layout mirrors the wire format
+    parsed by `protocol.record.Record.decode`.
+    """
+    lib = load_library()
+    if lib is None:
+        return None
+    c = lib.decode_record_columns(raw, len(raw))
+    try:
+        cc = c.contents
+        n = int(cc.count)
+        val_off = _ptr_array(cc.val_off, n + 1, np.int64)
+        key_off = _ptr_array(cc.key_off, n + 1, np.int64)
+        return {
+            "count": n,
+            "val_off": val_off,
+            "val_flat": _ptr_array(cc.val_flat, int(val_off[-1]) if n else 0, np.uint8),
+            "key_off": key_off,
+            "key_flat": _ptr_array(cc.key_flat, int(key_off[-1]) if n else 0, np.uint8),
+            "key_present": _ptr_array(cc.key_present, n, np.uint8),
+            "off_delta": _ptr_array(cc.off_delta, n, np.int64),
+            "ts_delta": _ptr_array(cc.ts_delta, n, np.int64),
+        }
+    finally:
+        lib.record_columns_free(c)
+
+
+def encode_record_columns(
+    val_flat: np.ndarray,
+    val_off: np.ndarray,
+    key_flat: np.ndarray,
+    key_off: np.ndarray,
+    key_present: np.ndarray,
+    off_delta: np.ndarray,
+    ts_delta: np.ndarray,
+) -> "bytes | None":
+    """Columnar arrays -> wire-format record slab via the native encoder.
+
+    Returns ``None`` when the native library is unavailable.
+    """
+    lib = load_library()
+    if lib is None:
+        return None
+    n = len(val_off) - 1
+
+    def p8(a):
+        a = np.ascontiguousarray(a, dtype=np.uint8)
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), a
+
+    def p64(a):
+        a = np.ascontiguousarray(a, dtype=np.int64)
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), a
+
+    # keep the arrays alive across the call
+    vf, _vf = p8(val_flat if len(val_flat) else np.zeros(1, np.uint8))
+    vo, _vo = p64(val_off)
+    kf, _kf = p8(key_flat if len(key_flat) else np.zeros(1, np.uint8))
+    ko, _ko = p64(key_off)
+    kp, _kp = p8(key_present)
+    od, _od = p64(off_delta)
+    td, _td = p64(ts_delta)
+    e = lib.encode_record_columns(vf, vo, kf, ko, kp, od, td, n)
+    try:
+        ee = e.contents
+        ln = int(ee.len)
+        if ln == 0:
+            return b""
+        return bytes(np.ctypeslib.as_array(ee.data, shape=(ln,)))
+    finally:
+        lib.encoded_records_free(e)
 
 
 # ---------------------------------------------------------------------------

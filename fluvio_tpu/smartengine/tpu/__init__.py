@@ -16,6 +16,23 @@ int64 is enabled process-wide here: offsets/timestamps/aggregates are
 64-bit in the protocol and must not silently truncate.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: a broker must not stall ~25s on the
+# first consume of each chain/shape bucket in every process. Compiled
+# executables persist across processes keyed by HLO hash; set
+# FLUVIO_TPU_XLA_CACHE=off to disable (e.g. hermetic tests).
+_cache_dir = os.environ.get("FLUVIO_TPU_XLA_CACHE", "~/.cache/fluvio_tpu/xla")
+if _cache_dir != "off":
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.expanduser(_cache_dir)
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — older jax without these flags
+        pass

@@ -173,6 +173,94 @@ class RecordBuffer:
             base_timestamp=base_timestamp,
         )
 
+    @classmethod
+    def from_columns(
+        cls,
+        cols: dict,
+        base_offset: int = 0,
+        base_timestamp: int = NO_TIMESTAMP,
+    ) -> "RecordBuffer":
+        """Adopt native-decoded columnar arrays (broker fast path).
+
+        ``cols`` is the dict produced by
+        `native_backend.decode_record_columns`: flat byte runs + offsets,
+        re-padded here with one vectorized mask assignment — no
+        per-record Python objects anywhere on the path.
+        """
+        n = cols["count"]
+        rows = _next_pow2(max(n, 1), MIN_ROWS)
+        val_off = cols["val_off"]
+        lengths_live = (val_off[1:] - val_off[:-1]).astype(np.int32)
+        max_v = int(lengths_live.max()) if n else 0
+        width = _next_pow2(max(max_v, 1), MIN_WIDTH)
+        if width > MAX_WIDTH:
+            raise ValueError(f"record value of {max_v} bytes exceeds {MAX_WIDTH}")
+        lengths = np.zeros(rows, dtype=np.int32)
+        lengths[:n] = lengths_live
+        values = np.zeros((rows, width), dtype=np.uint8)
+        mask = np.arange(width, dtype=np.int32)[None, :] < lengths[:, None]
+        values[mask] = cols["val_flat"]
+
+        key_present = cols["key_present"].astype(bool)
+        key_lengths = np.full(rows, -1, dtype=np.int32)
+        if n and key_present.any():
+            key_off = cols["key_off"]
+            klive = (key_off[1:] - key_off[:-1]).astype(np.int32)
+            key_lengths[:n] = np.where(key_present, klive, -1)
+            max_k = int(klive.max())
+            kwidth = _next_pow2(max(max_k, 1), MIN_WIDTH)
+            keys = np.zeros((rows, kwidth), dtype=np.uint8)
+            kmask = (
+                np.arange(kwidth, dtype=np.int32)[None, :]
+                < np.maximum(key_lengths, 0)[:, None]
+            )
+            keys[kmask] = cols["key_flat"]
+        else:
+            keys = np.zeros((rows, MIN_WIDTH), dtype=np.uint8)
+        offset_deltas = np.zeros(rows, dtype=np.int32)
+        offset_deltas[:n] = cols["off_delta"].astype(np.int32)
+        timestamp_deltas = np.zeros(rows, dtype=np.int64)
+        timestamp_deltas[:n] = cols["ts_delta"]
+        return cls(
+            values=values,
+            lengths=lengths,
+            keys=keys,
+            key_lengths=key_lengths,
+            offset_deltas=offset_deltas,
+            timestamp_deltas=timestamp_deltas,
+            count=n,
+            base_offset=base_offset,
+            base_timestamp=base_timestamp,
+        )
+
+    def to_columns(self) -> dict:
+        """Exact (unaligned) columnar form of the live rows — the input
+        shape of `native_backend.encode_record_columns`."""
+        n = self.count
+        lengths = self.lengths[:n].astype(np.int64)
+        val_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lengths, out=val_off[1:])
+        width = self.values.shape[1]
+        mask = np.arange(width, dtype=np.int32)[None, :] < lengths[:, None]
+        val_flat = self.values[:n][mask]
+        key_present = (self.key_lengths[:n] >= 0).astype(np.uint8)
+        klens = np.maximum(self.key_lengths[:n], 0).astype(np.int64)
+        key_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(klens, out=key_off[1:])
+        kwidth = self.keys.shape[1]
+        kmask = np.arange(kwidth, dtype=np.int32)[None, :] < klens[:, None]
+        key_flat = self.keys[:n][kmask]
+        return {
+            "count": n,
+            "val_flat": val_flat,
+            "val_off": val_off,
+            "key_flat": key_flat,
+            "key_off": key_off,
+            "key_present": key_present,
+            "off_delta": self.offset_deltas[:n].astype(np.int64),
+            "ts_delta": self.timestamp_deltas[:n].astype(np.int64),
+        }
+
     # -- materialization ----------------------------------------------------
 
     def to_records(self) -> List[Record]:

@@ -409,11 +409,13 @@ class TpuChainExecutor:
 
     @staticmethod
     def _bucket_bytes(n: int, floor: int = 1024) -> int:
-        """pow2/16-granular bucket: <=6.25% padding, bounded compiles."""
+        """pow2/8-granular bucket: <=12.5% padding, <=8 compiles per size
+        decade (each distinct bucket is a fresh XLA compile — persisted
+        across processes by the compilation cache, but still paid once)."""
         v = floor
         while v < n:
             v <<= 1
-        step = max(floor, v >> 4)
+        step = max(floor, v >> 3)
         return ((n + step - 1) // step) * step
 
     def _fetch(self, buf: RecordBuffer, header, packed) -> RecordBuffer:

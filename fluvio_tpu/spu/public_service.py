@@ -483,8 +483,10 @@ class StreamFetchHandler:
             self.ctx.metrics.outbound.add(0, rslice.file_slice.length)
             return rslice.next_offset
 
-        # SmartModule path: decode -> chain -> re-batch -> push
-        batches = rslice.decode_batches()
+        # SmartModule path: decode -> chain -> re-batch -> push.
+        # Shallow decode: the TPU fast path stages raw record slabs into
+        # columnar buffers natively; the per-record path parses on demand.
+        batches = rslice.decode_batches(parse_records=False)
         result: BatchProcessResult = process_batches(
             chain, batches, req.max_bytes, self.metrics
         )

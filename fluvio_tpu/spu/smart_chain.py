@@ -10,9 +10,45 @@ a max_bytes cutoff (batch.rs:41-140).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
+
+import numpy as np
+
+# dense staging cap for the coalesced fast path (bytes of padded values)
+_MAX_STAGING_BYTES = int(os.environ.get("FLUVIO_TPU_MAX_STAGING", 1 << 29))
+
+
+def _varint_sizes(x: np.ndarray) -> np.ndarray:
+    """Exact zigzag-varint encoded sizes, vectorized."""
+    xi = x.astype(np.int64)
+    u = ((xi << 1) ^ (xi >> 63)).view(np.uint64)
+    nb = np.ones(len(u), dtype=np.int64)
+    for k in range(1, 10):
+        nb += (u >= np.uint64(1 << (7 * k))).astype(np.int64)
+    return nb
+
+
+def _encoded_record_sizes(outbuf, deltas: np.ndarray, ts: np.ndarray) -> np.ndarray:
+    """Per-record wire sizes (parity: protocol.record.Record.write_size)."""
+    n = len(deltas)
+    vlens = outbuf.lengths[:n].astype(np.int64)
+    klens_raw = outbuf.key_lengths[:n].astype(np.int64)
+    has_key = klens_raw >= 0
+    klens = np.maximum(klens_raw, 0)
+    inner = (
+        1  # attributes
+        + _varint_sizes(ts)
+        + _varint_sizes(deltas)
+        + 1  # key tag
+        + np.where(has_key, _varint_sizes(klens) + klens, 0)
+        + _varint_sizes(vlens)
+        + vlens
+        + 1  # varint(0) header count
+    )
+    return _varint_sizes(inner) + inner
 
 from fluvio_tpu.protocol.error import ErrorCode
 from fluvio_tpu.protocol.record import Batch, RecordSet
@@ -203,6 +239,154 @@ class BatchProcessResult:
     error: Optional[SmartModuleTransformRuntimeError] = None
 
 
+def _tpu_process_batches(
+    chain: SmartModuleChainInstance,
+    batches: List[Batch],
+    max_bytes: int,
+    metrics=None,
+) -> Optional[BatchProcessResult]:
+    """Pipelined TPU fast path for the stream-fetch hot loop.
+
+    Stored record slabs go straight to RecordBuffer columns through the
+    native parser (no per-record Python objects), consecutive buffers run
+    through the executor's dispatch/download-overlapped pipeline
+    (`TpuChainExecutor.process_stream`), and output batches are
+    re-assembled at the byte level by the native encoder. Falls back to
+    the per-record path (returns None) when the chain has no TPU
+    executor, the native library is unavailable, or a batch's slab
+    disagrees with its header.
+
+    Wire/offset semantics match `process_batches`: each output batch
+    spans its input batch's offset range with sequentially re-deltaed
+    records. Aggregate chains always deliver every processed batch —
+    device carries have already advanced, so dropping computed outputs
+    would double-count on refetch; stateless chains honor the max_bytes
+    cutoff exactly like the per-record path.
+    """
+    from fluvio_tpu.protocol.compression import Compression, decompress
+    from fluvio_tpu.smartengine import native_backend
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+
+    tpu = getattr(chain, "tpu_chain", None)
+    if tpu is None or not batches:
+        return None
+    staged: List[tuple] = []
+    total_raw = 0
+    for batch in batches:
+        raw = batch.raw_records
+        if raw is None:
+            return None
+        if batch.header.compression() != Compression.NONE:
+            raw = decompress(batch.header.compression(), raw)
+        cols = native_backend.decode_record_columns(raw)
+        if cols is None or cols["count"] != batch.records_len():
+            return None
+        staged.append((batch, cols))
+        total_raw += len(raw)
+    # the per-record path's input-size guard (engine.py StoreMemoryExceeded)
+    engine = getattr(chain, "engine", None)
+    if engine is not None and total_raw > engine.store_max_memory:
+        return None  # the per-record path raises the typed error
+
+    # Coalesce the whole read slice into ONE device dispatch: per-batch
+    # dispatches pay fixed host<->device round trips that dwarf a 16k-record
+    # batch's compute. Offset deltas rebase to the first batch's base
+    # offset; timestamp deltas rebase to its base timestamp.
+    base0 = staged[0][0].base_offset
+    ts0 = staged[0][0].header.first_timestamp
+    ts_list = [b.header.first_timestamp for b, _ in staged]
+    if any(t < 0 for t in ts_list) and any(t >= 0 for t in ts_list):
+        return None  # mixed absent/present base timestamps: rebase undefined
+    merged = {
+        "count": sum(c["count"] for _, c in staged),
+        "val_flat": np.concatenate([c["val_flat"] for _, c in staged]),
+        "key_flat": np.concatenate([c["key_flat"] for _, c in staged]),
+        "key_present": np.concatenate([c["key_present"] for _, c in staged]),
+    }
+    off_parts, ts_parts, val_offs, key_offs = [], [], [], []
+    v_base = k_base = 0
+    for b, c in staged:
+        off_parts.append(c["off_delta"] + (b.base_offset - base0))
+        ts_parts.append(
+            c["ts_delta"] + (b.header.first_timestamp - ts0 if ts0 >= 0 else 0)
+        )
+        val_offs.append(c["val_off"][:-1] + v_base)
+        key_offs.append(c["key_off"][:-1] + k_base)
+        v_base += int(c["val_off"][-1])
+        k_base += int(c["key_off"][-1])
+    merged["off_delta"] = np.concatenate(off_parts)
+    merged["ts_delta"] = np.concatenate(ts_parts)
+    merged["val_off"] = np.concatenate(
+        [np.concatenate(val_offs), np.array([v_base], dtype=np.int64)]
+    )
+    merged["key_off"] = np.concatenate(
+        [np.concatenate(key_offs), np.array([k_base], dtype=np.int64)]
+    )
+    try:
+        buf = RecordBuffer.from_columns(
+            merged, base_offset=base0, base_timestamp=ts0
+        )
+    except ValueError:  # value wider than MAX_WIDTH: per-record path
+        return None
+    # dense-staging amplification guard: one huge value would pad every
+    # row of the slice to its pow2 width
+    if buf.values.nbytes > _MAX_STAGING_BYTES:
+        return None
+
+    if metrics is not None:
+        metrics.add_bytes_in(total_raw)
+    result = BatchProcessResult()
+    last_batch = staged[-1][0]
+    result.next_offset = last_batch.computed_last_offset()
+    outbuf = tpu.process_buffer(buf)
+    n_out = outbuf.count
+    # survivors keep their stored offsets (deltas are already rebased to
+    # base0), so a consumer resuming mid-slice filters correctly
+    out_deltas = outbuf.offset_deltas[:n_out].astype(np.int64)
+    out_ts = outbuf.timestamp_deltas[:n_out].astype(np.int64)
+    if n_out and not tpu.agg_configs and max_bytes > 0:
+        # stateless chains honor max_bytes: keep the longest record prefix
+        # whose encoded size fits (>= semantics: always keep one batch's
+        # worth of progress by including at least the first record)
+        sizes = _encoded_record_sizes(outbuf, out_deltas, out_ts)
+        cum = np.cumsum(sizes)
+        keep = int(np.searchsorted(cum, max_bytes, side="left")) + 1
+        if keep < n_out:
+            n_out = max(keep, 1)
+            result.next_offset = base0 + int(out_deltas[n_out - 1]) + 1
+    if n_out:
+        cols = outbuf.to_columns()
+        raw_out = native_backend.encode_record_columns(
+            cols["val_flat"][: int(cols["val_off"][n_out])],
+            cols["val_off"][: n_out + 1],
+            cols["key_flat"][: int(cols["key_off"][n_out])],
+            cols["key_off"][: n_out + 1],
+            cols["key_present"][:n_out],
+            out_deltas[:n_out],
+            out_ts[:n_out],
+        )
+        if raw_out is None:
+            return None
+        out_batch = Batch(
+            base_offset=base0,
+            raw_records=raw_out,
+            raw_record_count=n_out,
+        )
+        now = int(time.time() * 1000) if ts0 == NO_TIMESTAMP else ts0
+        out_batch.header.first_timestamp = now
+        out_batch.header.max_time_stamp = now
+        # span the full consumed offset range so the consumer's next fetch
+        # advances past every input record (incl. filtered-out ones)
+        out_batch.header.last_offset_delta = result.next_offset - 1 - base0
+        result.records.add(out_batch)
+    if metrics is not None:
+        metrics.add_fuel_used(buf.count * max(len(tpu.stages), 1))
+        metrics.add_records_out(n_out)
+    if tpu.agg_configs:
+        tpu._ensure_host_state()
+    return result
+
+
 def process_batches(
     chain: SmartModuleChainInstance,
     batches: List[Batch],
@@ -217,7 +401,13 @@ def process_batches(
     their offsets past filtered-out records. Output records are re-deltaed
     sequentially. Stops at max_bytes or on the first transform error
     (partial output is kept, matching engine.rs:159-161).
+
+    Chains with a TPU executor take `_tpu_process_batches`'s pipelined
+    batch-level path when the native codecs are available.
     """
+    fast = _tpu_process_batches(chain, batches, max_bytes, metrics)
+    if fast is not None:
+        return fast
     result = BatchProcessResult()
     total_bytes = 0
     for batch in batches:

@@ -772,6 +772,172 @@ NativeResult* chain_run_encoded(void* p, const uint8_t* raw, int64_t raw_len,
     return run_and_pack(chain, recs);
 }
 
+// ---------------------------------------------------------------------------
+// Columnar record codecs — the broker's TPU staging path. The SPU feeds
+// stored record slabs straight into RecordBuffer columns (and back) with no
+// per-record Python objects; mirrors the layout fluvio-storage hands to the
+// engine (FileBatch, fluvio-spu/src/smartengine/file_batch.rs:10).
+// ---------------------------------------------------------------------------
+
+static int64_t varint_encoded_size(int64_t v) {
+    uint64_t u = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+    int64_t size = 1;
+    while (u >= 0x80) { u >>= 7; size++; }
+    return size;
+}
+
+static void write_varint(uint8_t*& p, int64_t v) {
+    uint64_t u = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+    while (u >= 0x80) { *p++ = (uint8_t)(u | 0x80); u >>= 7; }
+    *p++ = (uint8_t)u;
+}
+
+struct RecordColumns {
+    int64_t count;
+    uint8_t* val_flat;
+    int64_t* val_off;   // count + 1
+    uint8_t* key_flat;
+    int64_t* key_off;   // count + 1
+    uint8_t* key_present;
+    int64_t* off_delta;
+    int64_t* ts_delta;
+};
+
+RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len) {
+    struct View { int64_t voff, vlen, koff, klen, od, td; bool has_key; };
+    std::vector<View> views;
+    int64_t pos = 0, total_v = 0, total_k = 0;
+    while (pos < raw_len) {
+        int64_t inner = 0;
+        if (!read_varint(raw, raw_len, pos, inner)) break;
+        int64_t end = pos + inner;
+        if (end > raw_len || inner < 0) break;
+        View v{};
+        pos += 1;  // attributes
+        read_varint(raw, end, pos, v.td);
+        read_varint(raw, end, pos, v.od);
+        uint8_t has_key = pos < end ? raw[pos++] : 0;
+        if (has_key) {
+            int64_t klen = 0;
+            read_varint(raw, end, pos, klen);
+            if (klen < 0 || pos + klen > end) break;
+            v.has_key = true;
+            v.koff = pos;
+            v.klen = klen;
+            pos += klen;
+            total_k += klen;
+        }
+        int64_t vlen = 0;
+        read_varint(raw, end, pos, vlen);
+        if (vlen < 0 || pos + vlen > end) break;
+        v.voff = pos;
+        v.vlen = vlen;
+        pos = end;  // skip record headers
+        total_v += vlen;
+        views.push_back(v);
+    }
+    auto* c = new RecordColumns();
+    int64_t n = (int64_t)views.size();
+    c->count = n;
+    c->val_flat = (uint8_t*)std::malloc(total_v ? total_v : 1);
+    c->val_off = (int64_t*)std::malloc((n + 1) * sizeof(int64_t));
+    c->key_flat = (uint8_t*)std::malloc(total_k ? total_k : 1);
+    c->key_off = (int64_t*)std::malloc((n + 1) * sizeof(int64_t));
+    c->key_present = (uint8_t*)std::malloc(n ? n : 1);
+    c->off_delta = (int64_t*)std::malloc(n ? n * sizeof(int64_t) : 8);
+    c->ts_delta = (int64_t*)std::malloc(n ? n * sizeof(int64_t) : 8);
+    int64_t vo = 0, ko = 0;
+    for (int64_t i = 0; i < n; i++) {
+        const View& v = views[(size_t)i];
+        c->val_off[i] = vo;
+        std::memcpy(c->val_flat + vo, raw + v.voff, (size_t)v.vlen);
+        vo += v.vlen;
+        c->key_off[i] = ko;
+        if (v.has_key) {
+            std::memcpy(c->key_flat + ko, raw + v.koff, (size_t)v.klen);
+            ko += v.klen;
+        }
+        c->key_present[i] = v.has_key ? 1 : 0;
+        c->off_delta[i] = v.od;
+        c->ts_delta[i] = v.td;
+    }
+    c->val_off[n] = vo;
+    c->key_off[n] = ko;
+    return c;
+}
+
+void record_columns_free(RecordColumns* c) {
+    if (!c) return;
+    std::free(c->val_flat);
+    std::free(c->val_off);
+    std::free(c->key_flat);
+    std::free(c->key_off);
+    std::free(c->key_present);
+    std::free(c->off_delta);
+    std::free(c->ts_delta);
+    delete c;
+}
+
+struct EncodedRecords {
+    uint8_t* data;
+    int64_t len;
+};
+
+EncodedRecords* encode_record_columns(
+    const uint8_t* val_flat, const int64_t* val_off,
+    const uint8_t* key_flat, const int64_t* key_off,
+    const uint8_t* key_present,
+    const int64_t* off_delta, const int64_t* ts_delta, int64_t n) {
+    int64_t total = 0;
+    std::vector<int64_t> inner_sizes((size_t)n);
+    for (int64_t i = 0; i < n; i++) {
+        int64_t vlen = val_off[i + 1] - val_off[i];
+        int64_t inner = 1;  // attributes
+        inner += varint_encoded_size(ts_delta ? ts_delta[i] : 0);
+        inner += varint_encoded_size(off_delta ? off_delta[i] : i);
+        inner += 1;  // key tag
+        if (key_present && key_present[i]) {
+            int64_t klen = key_off[i + 1] - key_off[i];
+            inner += varint_encoded_size(klen) + klen;
+        }
+        inner += varint_encoded_size(vlen) + vlen;
+        inner += varint_encoded_size(0);  // header count
+        inner_sizes[(size_t)i] = inner;
+        total += varint_encoded_size(inner) + inner;
+    }
+    auto* e = new EncodedRecords();
+    e->data = (uint8_t*)std::malloc(total ? total : 1);
+    e->len = total;
+    uint8_t* p = e->data;
+    for (int64_t i = 0; i < n; i++) {
+        int64_t vlen = val_off[i + 1] - val_off[i];
+        write_varint(p, inner_sizes[(size_t)i]);
+        *p++ = 0;  // attributes
+        write_varint(p, ts_delta ? ts_delta[i] : 0);
+        write_varint(p, off_delta ? off_delta[i] : i);
+        if (key_present && key_present[i]) {
+            int64_t klen = key_off[i + 1] - key_off[i];
+            *p++ = 1;
+            write_varint(p, klen);
+            std::memcpy(p, key_flat + key_off[i], (size_t)klen);
+            p += klen;
+        } else {
+            *p++ = 0;
+        }
+        write_varint(p, vlen);
+        std::memcpy(p, val_flat + val_off[i], (size_t)vlen);
+        p += vlen;
+        write_varint(p, 0);  // no record headers
+    }
+    return e;
+}
+
+void encoded_records_free(EncodedRecords* e) {
+    if (!e) return;
+    std::free(e->data);
+    delete e;
+}
+
 void result_free(NativeResult* r) {
     if (!r) return;
     std::free(r->val_flat);
