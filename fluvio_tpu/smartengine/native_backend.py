@@ -49,6 +49,7 @@ _lib_failed = False
 class RecordColumns(ctypes.Structure):
     _fields_ = [
         ("count", ctypes.c_int64),
+        ("parsed", ctypes.c_int64),  # bytes consumed; != raw len => malformed
         ("val_flat", ctypes.POINTER(ctypes.c_uint8)),
         ("val_off", ctypes.POINTER(ctypes.c_int64)),
         ("key_flat", ctypes.POINTER(ctypes.c_uint8)),
@@ -182,7 +183,10 @@ def decode_record_columns(raw: bytes):
 
     Returns ``None`` when the native library is unavailable (callers fall
     back to the per-record Python decode). Layout mirrors the wire format
-    parsed by `protocol.record.Record.decode`.
+    parsed by `protocol.record.Record.decode`. ``parsed`` is the number of
+    slab bytes consumed by whole well-formed records — callers must treat
+    ``parsed != len(raw)`` as a malformed slab and fall back rather than
+    silently dropping the tail.
     """
     lib = load_library()
     if lib is None:
@@ -195,6 +199,7 @@ def decode_record_columns(raw: bytes):
         key_off = _ptr_array(cc.key_off, n + 1, np.int64)
         return {
             "count": n,
+            "parsed": int(cc.parsed),
             "val_off": val_off,
             "val_flat": _ptr_array(cc.val_flat, int(val_off[-1]) if n else 0, np.uint8),
             "key_off": key_off,

@@ -348,8 +348,8 @@ class TpuChainExecutor:
                 for acc, win, has in self.carries
             )
         flat, _starts = buf.ragged_values()
-        # bucket the flat size at pow2/16 granularity: bounded compile
-        # count (<=16 per size decade) without pow2's up-to-2x H2D blowup
+        # bucket the flat size at pow2/8 granularity: bounded compile
+        # count (<=8 per size decade) without pow2's up-to-2x H2D blowup
         bucket = self._bucket_bytes(max(len(flat), 4))
         if len(flat) < bucket:
             flat = np.pad(flat, (0, bucket - len(flat)))

@@ -245,16 +245,17 @@ def _tpu_process_batches(
     max_bytes: int,
     metrics=None,
 ) -> Optional[BatchProcessResult]:
-    """Pipelined TPU fast path for the stream-fetch hot loop.
+    """Coalesced TPU fast path for the stream-fetch hot loop.
 
     Stored record slabs go straight to RecordBuffer columns through the
-    native parser (no per-record Python objects), consecutive buffers run
-    through the executor's dispatch/download-overlapped pipeline
-    (`TpuChainExecutor.process_stream`), and output batches are
-    re-assembled at the byte level by the native encoder. Falls back to
-    the per-record path (returns None) when the chain has no TPU
-    executor, the native library is unavailable, or a batch's slab
-    disagrees with its header.
+    native parser (no per-record Python objects), the whole read slice
+    runs as ONE device dispatch (`TpuChainExecutor.process_buffer`), and
+    output batches are re-assembled at the byte level by the native
+    encoder. Cross-slice overlap (dispatch slice k+1 while slice k
+    downloads) lives in the stream-fetch handler's pipelined loop, not
+    here. Falls back to the per-record path (returns None) when the
+    chain has no TPU executor, the native library is unavailable, or a
+    batch's slab disagrees with its header.
 
     Wire/offset semantics match `process_batches`: each output batch
     spans its input batch's offset range with sequentially re-deltaed
@@ -279,7 +280,11 @@ def _tpu_process_batches(
         if batch.header.compression() != Compression.NONE:
             raw = decompress(batch.header.compression(), raw)
         cols = native_backend.decode_record_columns(raw)
-        if cols is None or cols["count"] != batch.records_len():
+        if (
+            cols is None
+            or cols["count"] != batch.records_len()
+            or cols["parsed"] != len(raw)
+        ):
             return None
         staged.append((batch, cols))
         total_raw += len(raw)
@@ -333,8 +338,6 @@ def _tpu_process_batches(
     if buf.values.nbytes > _MAX_STAGING_BYTES:
         return None
 
-    if metrics is not None:
-        metrics.add_bytes_in(total_raw)
     result = BatchProcessResult()
     last_batch = staged[-1][0]
     result.next_offset = last_batch.computed_last_offset()
@@ -379,7 +382,10 @@ def _tpu_process_batches(
         # advances past every input record (incl. filtered-out ones)
         out_batch.header.last_offset_delta = result.next_offset - 1 - base0
         result.records.add(out_batch)
+    # metrics only after the last possible fallback return: the per-record
+    # path re-counts bytes_in when this path bails out
     if metrics is not None:
+        metrics.add_bytes_in(total_raw)
         metrics.add_fuel_used(buf.count * max(len(tpu.stages), 1))
         metrics.add_records_out(n_out)
     if tpu.agg_configs:

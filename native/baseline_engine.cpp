@@ -794,6 +794,7 @@ static void write_varint(uint8_t*& p, int64_t v) {
 
 struct RecordColumns {
     int64_t count;
+    int64_t parsed;     // bytes consumed; != input len => malformed slab
     uint8_t* val_flat;
     int64_t* val_off;   // count + 1
     uint8_t* key_flat;
@@ -806,12 +807,13 @@ struct RecordColumns {
 RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len) {
     struct View { int64_t voff, vlen, koff, klen, od, td; bool has_key; };
     std::vector<View> views;
-    int64_t pos = 0, total_v = 0, total_k = 0;
+    int64_t pos = 0, total_v = 0, total_k = 0, good = 0;
     while (pos < raw_len) {
+        int64_t rec_start = pos;
         int64_t inner = 0;
-        if (!read_varint(raw, raw_len, pos, inner)) break;
+        if (!read_varint(raw, raw_len, pos, inner)) { pos = rec_start; break; }
         int64_t end = pos + inner;
-        if (end > raw_len || inner < 0) break;
+        if (end > raw_len || inner < 0) { pos = rec_start; break; }
         View v{};
         pos += 1;  // attributes
         read_varint(raw, end, pos, v.td);
@@ -833,12 +835,14 @@ RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len) {
         v.voff = pos;
         v.vlen = vlen;
         pos = end;  // skip record headers
+        good = pos;
         total_v += vlen;
         views.push_back(v);
     }
     auto* c = new RecordColumns();
     int64_t n = (int64_t)views.size();
     c->count = n;
+    c->parsed = good;
     c->val_flat = (uint8_t*)std::malloc(total_v ? total_v : 1);
     c->val_off = (int64_t*)std::malloc((n + 1) * sizeof(int64_t));
     c->key_flat = (uint8_t*)std::malloc(total_k ? total_k : 1);
