@@ -23,6 +23,7 @@ pins the byte-level semantics the TPU backend must reproduce.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable, List, Optional, Tuple
 
 from fluvio_tpu.protocol.record import Record
@@ -86,6 +87,12 @@ class PythonInstance:
         self, inp: SmartModuleInput, metrics: Optional[SmartModuleChainMetrics] = None
     ) -> SmartModuleOutput:
         records = inp.into_records(self.config.version)
+        if inp.records is not None:
+            # inputs built via from_records alias caller objects; map-family
+            # transforms below rewrite record fields in place, and the
+            # reference's guest-copy ABI (input.rs:83 raw_bytes) makes such
+            # mutation impossible — work on copies for the same contract
+            records = [dataclasses.replace(r) for r in records]
         sm_records = [
             SmartModuleRecord(r, inp.base_offset, inp.base_timestamp) for r in records
         ]

@@ -26,6 +26,17 @@ MIN_WIDTH = 32
 MAX_WIDTH = 1 << 16
 
 
+def apply_postops_host(values: np.ndarray, postops) -> np.ndarray:
+    """Host mirror of `lower.apply_postops`: static byte-wise case folds
+    applied after view-mode materialization (case folds flip bit 5 of
+    ASCII letters; padding zeros are outside both letter ranges)."""
+    for op in postops:
+        lo, hi = (0x61, 0x7A) if op == "upper" else (0x41, 0x5A)
+        fold = (values >= lo) & (values <= hi)
+        values = np.where(fold, values ^ 0x20, values).astype(np.uint8)
+    return values
+
+
 def _next_pow2(n: int, floor: int) -> int:
     v = floor
     while v < n:

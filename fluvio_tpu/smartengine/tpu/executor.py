@@ -37,8 +37,14 @@ from fluvio_tpu.smartmodule.types import (
 from fluvio_tpu.smartengine.config import SmartModuleConfig
 from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
 from fluvio_tpu.smartengine.tpu import kernels
-from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
-from fluvio_tpu.smartengine.tpu.lower import Unlowerable, infer_type, lower_expr
+from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer, apply_postops_host
+from fluvio_tpu.smartengine.tpu.lower import (
+    Unlowerable,
+    apply_postops,
+    infer_type,
+    lower_expr,
+    lower_span,
+)
 
 _AGG_OP = {
     "sum_int": "add",
@@ -58,6 +64,11 @@ _AGG_NEUTRAL = {
 class _FilterStage:
     predicate: Callable
 
+    # structural invariants the executor checks at build time (ADVICE r2):
+    # stages that break them force the off/ts columns onto the D2H path
+    preserves_rows = True      # output row i corresponds to input row i
+    rewrites_offsets = False   # touches offset/timestamp delta columns
+
     def apply(self, state: Dict, carries, base_ts):
         state = dict(state)
         state["valid"] = state["valid"] & self.predicate(state)
@@ -66,20 +77,47 @@ class _FilterStage:
 
 @dataclass
 class _MapStage:
-    value_fn: Callable
+    value_fn: Optional[Callable]
     key_fn: Optional[Callable]
     predicate: Optional[Callable] = None  # filter_map when set
+    span_fn: Optional[Callable] = None    # value is a view of current values
+    span_postops: Tuple[str, ...] = ()    # static byte-wise folds on the view
+
+    preserves_rows = True
+    rewrites_offsets = False
 
     def apply(self, state: Dict, carries, base_ts):
         new_state = dict(state)
         if self.predicate is not None:
             new_state["valid"] = state["valid"] & self.predicate(state)
-        v, l = self.value_fn(state)
-        new_state["values"], new_state["lengths"] = v, l.astype(jnp.int32)
+        if self.span_fn is not None:
+            # view-preserving rewrite: track provenance into the original
+            # record bytes; byte materialization below is DCE'd by XLA
+            # whenever no later stage (and no output) reads it
+            st, ln = self.span_fn(state)
+            ln = ln.astype(jnp.int32)
+            new_state["view_start"] = state["view_start"] + st
+            new_state["values"] = apply_postops(
+                _materialize_span(state["values"], st, ln), self.span_postops
+            )
+            new_state["lengths"] = ln
+        else:
+            v, l = self.value_fn(state)
+            new_state["values"], new_state["lengths"] = v, l.astype(jnp.int32)
         if self.key_fn is not None:
             kv, kl = self.key_fn(state)
             new_state["keys"], new_state["key_lengths"] = kv, kl.astype(jnp.int32)
         return new_state, carries
+
+
+def _materialize_span(values, start, lengths):
+    from fluvio_tpu.smartengine.tpu import pallas_kernels
+
+    if pallas_kernels.pallas_active(values.shape[1]):
+        return pallas_kernels.extract_pallas(
+            values, start, lengths, interpret=pallas_kernels.interpret_mode()
+        )
+    return kernels.extract_span(values, start, lengths)
 
 
 @dataclass
@@ -87,6 +125,9 @@ class _AggregateStage:
     kind: str
     window_ms: Optional[int]
     index: int  # carry slot
+
+    preserves_rows = True
+    rewrites_offsets = False
 
     def _contribution(self, state: Dict) -> jnp.ndarray:
         values, lengths = state["values"], state["lengths"]
@@ -170,6 +211,37 @@ class TpuChainExecutor:
             or (isinstance(s, _AggregateStage) and s.window_ms)
             for s in stages
         )
+        # late materialization: when every value-writing stage is a view
+        # of the record's own bytes, the device ships descriptors
+        # (survivor bitmask + start/length per survivor) and the host
+        # rebuilds output bytes from the slab it already holds — the D2H
+        # link (the measured bottleneck: ~25 MB/s vs ~800 MB/s H2D on
+        # this chip's tunnel) carries ~5x fewer bytes
+        self._viewable = not agg_configs and all(
+            isinstance(s, _FilterStage)
+            or (
+                isinstance(s, _MapStage)
+                and s.span_fn is not None
+                and s.key_fn is None
+            )
+            for s in stages
+        )
+        # cumulative host-side postops for view-mode materialization;
+        # valid because every postop is position-wise (commutes with the
+        # later stages' slicing)
+        self._view_postops = tuple(
+            op
+            for s in stages
+            if isinstance(s, _MapStage) and s.span_fn is not None
+            for op in s.span_postops
+        )
+        # structural invariant (ADVICE r2): the host rebuilds off/ts
+        # columns from survivor indices only while every stage passes
+        # them through untouched; a stage that renumbers or fans out rows
+        # forces the device columns onto the D2H path instead
+        self._rebuild_offsets_from_src = all(
+            s.preserves_rows and not s.rewrites_offsets for s in stages
+        )
 
     # -- build --------------------------------------------------------------
 
@@ -193,18 +265,26 @@ class TpuChainExecutor:
                         raise Unlowerable("filter predicate must be bool")
                     stages.append(_FilterStage(lower_expr(prog.predicate)))
                 elif isinstance(prog, dsl.MapProgram):
+                    sp = lower_span(prog.value)
+                    span_fn, span_post = sp if sp is not None else (None, ())
                     stages.append(
                         _MapStage(
-                            value_fn=lower_expr(prog.value),
+                            value_fn=None if span_fn else lower_expr(prog.value),
                             key_fn=lower_expr(prog.key) if prog.key is not None else None,
+                            span_fn=span_fn,
+                            span_postops=span_post,
                         )
                     )
                 elif isinstance(prog, dsl.FilterMapProgram):
+                    sp = lower_span(prog.value)
+                    span_fn, span_post = sp if sp is not None else (None, ())
                     stages.append(
                         _MapStage(
-                            value_fn=lower_expr(prog.value),
+                            value_fn=None if span_fn else lower_expr(prog.value),
                             key_fn=lower_expr(prog.key) if prog.key is not None else None,
                             predicate=lower_expr(prog.predicate),
+                            span_fn=span_fn,
+                            span_postops=span_post,
                         )
                     )
                 elif isinstance(prog, dsl.AggregateProgram):
@@ -230,38 +310,66 @@ class TpuChainExecutor:
     # -- execution ----------------------------------------------------------
 
     def _chain_fn(self, arrays: Dict, count, base_ts, carries):
+        """Fused chain body. Returns (header, packed dict, carries).
+
+        D2H is the scarce resource on the host link (~25 MB/s vs
+        ~800 MB/s H2D through the tunnel): the survivor set always ships
+        as a 1-bit-per-input-row bitmask (the host rebuilds survivor
+        indices and the untouched offset/timestamp columns from it), and
+        view-mode chains ship (start, length) descriptors instead of
+        value bytes — the host rebuilds outputs from the input slab it
+        already holds. ``packed``'s keys are static per executor config.
+        """
         n = arrays["values"].shape[0]
         state = dict(arrays)
         state["valid"] = jnp.arange(n, dtype=jnp.int32) < count
+        state["view_start"] = jnp.zeros((n,), dtype=jnp.int32)
         for stage in self.stages:
             state, carries = stage.apply(state, carries, base_ts)
-        out_count, packed = kernels.compact_rows(
-            state["valid"],
+        valid = state["valid"]
+        out_count = jnp.sum(valid.astype(jnp.int32))
+        packed: Dict = {}
+        if self._rebuild_offsets_from_src:
+            # host-side survivor recovery (view mode always qualifies:
+            # its stages are all row-preserving)
+            packed["mask"] = kernels.pack_mask(valid)
+        if self._viewable:
+            _, (cstart, clen) = kernels.compact_rows(
+                valid, state["view_start"], state["lengths"]
+            )
+            header = jnp.stack(
+                [
+                    out_count.astype(jnp.int64),
+                    jnp.max(clen).astype(jnp.int64),
+                    jnp.int64(0),
+                ]
+            )
+            packed["span_start"] = cstart
+            packed["span_len"] = clen
+            return header, packed, carries
+        compact_cols = [
             state["values"],
             state["lengths"],
             state["keys"],
             state["key_lengths"],
-            state["offset_deltas"],
-            state["timestamp_deltas"],
-            jnp.arange(n, dtype=jnp.int32),  # survivor source-row index
-        )
-        values, lengths, keys, key_lengths, off_d, ts_d, src_idx = packed
-        # D2H is the scarce resource on the host link: ship bounds first
-        # (header) so every column can be sliced to count x used-width
-        # before the copy. The src_idx column lets the host rebuild
-        # offset/timestamp deltas from the input it already holds (every
-        # current stage is row-preserving), so those i32/i64 columns never
-        # cross the link. (An on-device ragged flatten of the values was
-        # tried and reverted: the 64M-element gather costs ~4x the D2H
-        # bytes it saves on this chip.)
+        ]
+        if not self._rebuild_offsets_from_src:
+            compact_cols += [state["offset_deltas"], state["timestamp_deltas"]]
+        _, compacted = kernels.compact_rows(valid, *compact_cols)
+        packed["values"] = compacted[0]
+        packed["lengths"] = compacted[1]
+        packed["keys"] = compacted[2]
+        packed["key_lengths"] = compacted[3]
+        if not self._rebuild_offsets_from_src:
+            packed["offset_deltas"] = compacted[4]
+            packed["timestamp_deltas"] = compacted[5]
         header = jnp.stack(
             [
                 out_count.astype(jnp.int64),
-                jnp.max(lengths).astype(jnp.int64),
-                jnp.max(key_lengths).astype(jnp.int64),
+                jnp.max(packed["lengths"]).astype(jnp.int64),
+                jnp.max(packed["key_lengths"]).astype(jnp.int64),
             ]
         )
-        packed = (values, lengths, keys, key_lengths, off_d, ts_d, src_idx)
         return header, packed, carries
 
     def _chain_fn_ragged(
@@ -326,11 +434,7 @@ class TpuChainExecutor:
             "offset_deltas": offset_deltas,
             "timestamp_deltas": timestamp_deltas,
         }
-        header, packed, carries = self._chain_fn(arrays, count, base_ts, carries)
-        # the host rebuilds offset/timestamp deltas from src_idx; drop the
-        # compacted device columns so they are never materialized as outputs
-        values, lengths, keys, key_lengths, _off, _ts, src_idx = packed
-        return header, (values, lengths, keys, key_lengths, src_idx), carries
+        return self._chain_fn(arrays, count, base_ts, carries)
 
     def _dispatch(self, buf: RecordBuffer):
         """Async-dispatch one batch.
@@ -421,51 +525,141 @@ class TpuChainExecutor:
     def _fetch(self, buf: RecordBuffer, header, packed) -> RecordBuffer:
         """Minimal-D2H materialization.
 
-        Downloads the ragged flat bytes (bucketed to sum of output
-        lengths), the length column, and the survivor source-row index —
-        offset/timestamp deltas are rebuilt from the input columns the
-        host already holds. Key columns cross the link only when the
-        input had keys or a stage writes them. All copies start async so
-        the link runs them as concurrent streams.
+        Always downloads the survivor bitmask (1 bit per input row) and
+        rebuilds survivor indices + untouched offset/timestamp columns
+        host-side. View-mode chains additionally download only the
+        compacted (start, length) descriptors and rebuild output bytes
+        from the input slab the host already holds; byte-mode chains
+        download the compacted value (and key) columns sliced to
+        count x used-width. All copies start async so the link runs them
+        as concurrent streams.
         """
-        values, lengths, keys, key_lengths, src_idx = packed
         hdr = jax.device_get(header)
         count, max_v, max_k = int(hdr[0]), int(hdr[1]), int(hdr[2])
-        n_rows = values.shape[0]
+        width = buf.values.shape[1]
+        len16 = width < (1 << 16)
+
+        if self._viewable:
+            n_desc = packed["span_start"].shape[0]
+            rows = min(self._bucket_bytes(max(count, 1), 8), n_desc)
+            st_col = packed["span_start"]
+            ln_col = packed["span_len"]
+            if len16:
+                st_col = st_col.astype(jnp.uint16)
+                ln_col = ln_col.astype(jnp.uint16)
+            slices = [
+                packed["mask"],
+                lax.slice(st_col, (0,), (rows,)),
+                lax.slice(ln_col, (0,), (rows,)),
+            ]
+            for s in slices:
+                s.copy_to_host_async()
+            mask_h, st_h, ln_h = jax.device_get(slices)
+            src = np.flatnonzero(
+                np.unpackbits(mask_h, bitorder="little")[: buf.values.shape[0]]
+            )
+            st = st_h[:count].astype(np.int64)
+            ln = ln_h[:count].astype(np.int32)
+            vw = min(self._pad_slice(max(max_v, 1)), width)
+            out_values = np.zeros((rows, vw), dtype=np.uint8)
+            if count:
+                cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
+                gathered = buf.values[
+                    src[:count, None], np.clip(cols, 0, width - 1)
+                ]
+                keep = np.arange(vw, dtype=np.int32)[None, :] < ln[:, None]
+                gathered = np.where(keep, gathered, 0)
+                out_values[:count] = apply_postops_host(
+                    gathered, self._view_postops
+                )
+            out_lengths = np.zeros((rows,), dtype=np.int32)
+            out_lengths[:count] = ln
+            if buf.has_keys():
+                out_keys = np.zeros((rows, buf.keys.shape[1]), dtype=np.uint8)
+                out_klens = np.full((rows,), -1, dtype=np.int32)
+                out_keys[:count] = buf.keys[src[:count]]
+                out_klens[:count] = buf.key_lengths[src[:count]]
+            else:
+                out_keys = np.zeros((rows, 1), dtype=np.uint8)
+                out_klens = np.full((rows,), -1, dtype=np.int32)
+            return self._assemble(buf, count, rows, out_values, out_lengths,
+                                  out_keys, out_klens, src)
+
+        n_rows = packed["values"].shape[0]
         rows = min(self._bucket_bytes(max(count, 1), 8), n_rows)
-        vw = min(self._pad_slice(max(max_v, 1)), values.shape[1])
+        vw = min(self._pad_slice(max(max_v, 1)), packed["values"].shape[1])
         kw = (
-            min(self._pad_slice(max(max_k, 1)), keys.shape[1]) if max_k > 0 else 0
+            min(self._pad_slice(max(max_k, 1)), packed["keys"].shape[1])
+            if max_k > 0
+            else 0
         )
-        len16 = values.shape[1] < (1 << 16)
-        out_len_col = lengths.astype(jnp.uint16) if len16 else lengths
+        out_len_col = (
+            packed["lengths"].astype(jnp.uint16) if len16 else packed["lengths"]
+        )
         want_keys = buf.has_keys() or self._writes_keys
+        # the survivor bitmask crosses the link only when the host rebuilds
+        # off/ts columns from it; offset-rewriting chains ship the device
+        # columns instead and never need src
+        want_mask = self._rebuild_offsets_from_src
         slices = [
-            lax.slice(values, (0, 0), (rows, vw)),
+            lax.slice(packed["values"], (0, 0), (rows, vw)),
             lax.slice(out_len_col, (0,), (rows,)),
-            lax.slice(src_idx, (0,), (rows,)),
         ]
+        if want_mask:
+            slices.append(packed["mask"])
         if want_keys:
-            slices.append(lax.slice(key_lengths, (0,), (rows,)))
+            slices.append(lax.slice(packed["key_lengths"], (0,), (rows,)))
             if kw:
-                slices.append(lax.slice(keys, (0, 0), (rows, kw)))
+                slices.append(lax.slice(packed["keys"], (0, 0), (rows, kw)))
+        if not self._rebuild_offsets_from_src:
+            slices.append(lax.slice(packed["offset_deltas"], (0,), (rows,)))
+            slices.append(lax.slice(packed["timestamp_deltas"], (0,), (rows,)))
         for s in slices:
             s.copy_to_host_async()
         host = jax.device_get(slices)
-        out_values, out_lengths, out_src = host[:3]
+        out_values, out_lengths = host[:2]
         out_lengths = out_lengths.astype(np.int32)
+        pos = 2
+        mask_h = None
+        if want_mask:
+            mask_h = host[pos]
+            pos += 1
         if want_keys:
-            out_klens = host[3]
-            out_keys = host[4] if kw else np.zeros((rows, 1), dtype=np.uint8)
+            out_klens = host[pos]
+            out_keys = host[pos + 1] if kw else np.zeros((rows, 1), dtype=np.uint8)
+            pos += 1 + (1 if kw else 0)
         else:
             out_klens = np.full((rows,), -1, dtype=np.int32)
             out_keys = np.zeros((rows, 1), dtype=np.uint8)
-        # rebuild passthrough columns from the survivor index
-        src = np.clip(out_src, 0, buf.offset_deltas.shape[0] - 1)
-        out_off = buf.offset_deltas[src].astype(np.int32)
-        out_ts = buf.timestamp_deltas[src].astype(np.int64)
-        out_off[count:] = 0
-        out_ts[count:] = 0
+        if not self._rebuild_offsets_from_src:
+            out_off = np.asarray(host[pos]).astype(np.int32)
+            out_ts = np.asarray(host[pos + 1]).astype(np.int64)
+            out_off[count:] = 0
+            out_ts[count:] = 0
+            return RecordBuffer(
+                values=out_values, lengths=out_lengths, keys=out_keys,
+                key_lengths=out_klens, offset_deltas=out_off,
+                timestamp_deltas=out_ts, count=count,
+                base_offset=buf.base_offset, base_timestamp=buf.base_timestamp,
+            )
+        src = np.flatnonzero(
+            np.unpackbits(mask_h, bitorder="little")[: buf.values.shape[0]]
+        )
+        return self._assemble(buf, count, rows, out_values, out_lengths,
+                              out_keys, out_klens, src)
+
+    def _assemble(self, buf, count, rows, out_values, out_lengths, out_keys,
+                  out_klens, src) -> RecordBuffer:
+        """Rebuild passthrough offset/timestamp columns from survivors."""
+        src_c = np.clip(
+            src[:count] if len(src) >= count else np.zeros(count, np.int64),
+            0,
+            buf.offset_deltas.shape[0] - 1,
+        )
+        out_off = np.zeros((rows,), dtype=np.int32)
+        out_ts = np.zeros((rows,), dtype=np.int64)
+        out_off[:count] = buf.offset_deltas[src_c]
+        out_ts[:count] = buf.timestamp_deltas[src_c]
         return RecordBuffer(
             values=out_values,
             lengths=out_lengths,

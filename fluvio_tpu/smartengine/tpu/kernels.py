@@ -68,16 +68,55 @@ def dfa_match(values: jnp.ndarray, lengths: jnp.ndarray, dfa: CompiledDfa) -> jn
 _P_SCAN, _P_COLON, _P_WS, _P_STR, _P_RAW, _P_DONE = range(6)
 
 
+def extract_span(
+    values: jnp.ndarray, start: jnp.ndarray, out_lengths: jnp.ndarray
+) -> jnp.ndarray:
+    """Materialize per-record substrings ``values[i, start:start+len]``.
+
+    The gather half of every extraction kernel; span-producing kernels
+    (`json_get_span` family) stay gather-free so the executor can ship
+    descriptors instead of bytes and let XLA dead-code-eliminate this.
+    """
+    width = values.shape[1]
+    idx = start[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    gathered = jnp.take_along_axis(values, jnp.clip(idx, 0, width - 1), axis=1)
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < out_lengths[:, None]
+    return jnp.where(mask, gathered, 0).astype(jnp.uint8)
+
+
+def pack_mask(valid: jnp.ndarray) -> jnp.ndarray:
+    """bool[N] -> little-endian bitmask u8[N/8] (N padded to a byte).
+
+    The survivor set crosses the host link as one bit per input row; the
+    host rebuilds survivor indices with ``np.unpackbits(bitorder="little")``.
+    """
+    n = valid.shape[0]
+    pad = (-n) % 8
+    v = jnp.pad(valid.astype(jnp.uint8), (0, pad)) if pad else valid.astype(jnp.uint8)
+    weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint8)
+    return jnp.sum(v.reshape(-1, 8) * weights[None, :], axis=1, dtype=jnp.int32).astype(jnp.uint8)
+
+
 def json_get(
     values: jnp.ndarray, lengths: jnp.ndarray, key: str
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-record top-level JSON field extraction.
 
+    Returns ``(out_values u8[N, L], out_lengths i32[N])`` — missing/
+    malformed yields length 0. Span computation + shared gather.
+    """
+    start, out_lengths = json_get_span(values, lengths, key)
+    return extract_span(values, start, out_lengths), out_lengths
+
+
+def json_get_span(
+    values: jnp.ndarray, lengths: jnp.ndarray, key: str
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Field span (start, length) within each record's value bytes.
+
     Bit-identical to `dsl.json_get_bytes`: a byte state machine tracking
     (in-string, escape, brace depth, progressive needle match, value phase)
-    as N-lane vectors, scanned over the L byte columns. Returns
-    ``(out_values u8[N, L], out_lengths i32[N])`` — missing/malformed
-    yields length 0.
+    as N-lane vectors, scanned over the L byte columns.
     """
     needle = b'"' + key.encode("utf-8") + b'"'
     klen = len(needle)
@@ -217,11 +256,7 @@ def json_get(
     found = (phase == _P_DONE) | (phase == _P_STR) | (phase == _P_RAW)
 
     out_lengths = jnp.where(found, jnp.maximum(end - start, 0), 0).astype(jnp.int32)
-    idx = start[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
-    gathered = jnp.take_along_axis(values, jnp.clip(idx, 0, width - 1), axis=1)
-    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < out_lengths[:, None]
-    out_values = jnp.where(mask, gathered, 0).astype(jnp.uint8)
-    return out_values, out_lengths
+    return jnp.clip(start, 0, width), out_lengths
 
 
 # ---------------------------------------------------------------------------
@@ -495,7 +530,15 @@ def _bwd_fill_flag(cond: jnp.ndarray, flag: jnp.ndarray, width: int) -> jnp.ndar
 def json_get_parallel(
     values: jnp.ndarray, lengths: jnp.ndarray, key: str
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Structural-index JSON field extraction — scan-free.
+    """Structural-index extraction: span computation + shared gather."""
+    start, out_lengths = json_get_parallel_span(values, lengths, key)
+    return extract_span(values, start, out_lengths), out_lengths
+
+
+def json_get_parallel_span(
+    values: jnp.ndarray, lengths: jnp.ndarray, key: str
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Structural-index JSON field span — scan-free.
 
     simdjson-style: build per-byte structural masks with parallel
     prefixes (escape parity, in-string parity, brace depth), find the
@@ -540,7 +583,8 @@ def json_get_parallel(
     # windowed needle compare at candidate opening quotes
     span = width - klen + 1
     if span <= 0:
-        return jnp.zeros_like(values), jnp.zeros((n,), dtype=jnp.int32)
+        z = jnp.zeros((n,), dtype=jnp.int32)
+        return z, z
     wc = jnp.ones((n, span), dtype=bool)
     for i, b in enumerate(needle):
         wc = wc & (c[:, i : i + span] == b)
@@ -610,9 +654,4 @@ def json_get_parallel(
     out_lengths = jnp.where(found & j2_in, jnp.maximum(end - start, 0), 0)
     # found but value beyond record end (e.g. colon then EOF) -> empty
     out_lengths = jnp.where(found & ~j2_in, 0, out_lengths).astype(jnp.int32)
-
-    idx = start[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
-    gathered = jnp.take_along_axis(values, jnp.clip(idx, 0, width - 1), axis=1)
-    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < out_lengths[:, None]
-    out_values = jnp.where(mask, gathered, 0).astype(jnp.uint8)
-    return out_values, out_lengths
+    return jnp.clip(start, 0, width), out_lengths

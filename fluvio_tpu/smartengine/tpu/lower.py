@@ -29,6 +29,87 @@ class Unlowerable(Exception):
 BytesVal = Tuple[jnp.ndarray, jnp.ndarray]
 
 
+def _json_span_fn(key: str):
+    """Span kernel chooser shared by byte and descriptor lowering.
+
+    Default XLA fallback is the sequential scan kernel: exact on all
+    inputs, same semantics as the pallas kernel, so a record's extraction
+    never depends on which path (pallas / XLA / sharded) its batch took.
+    FLUVIO_TPU_FAST_JSON=1 opts the fallback into the structural-index
+    kernel, which is faster under XLA but has a documented malformed-JSON
+    deviation.
+    """
+    fast = os.environ.get("FLUVIO_TPU_FAST_JSON") == "1"
+    xla_kernel = kernels.json_get_parallel_span if fast else kernels.json_get_span
+
+    def span(v, l):
+        # single-pass pallas state machine when the platform has it:
+        # collapses ~12 XLA primitives into one kernel AND carries the
+        # exact sequential semantics (dsl.json_get_bytes)
+        if pallas_kernels.pallas_active(v.shape[1]):
+            return pallas_kernels.json_get_span_pallas(
+                v, l, key, interpret=pallas_kernels.interpret_mode()
+            )
+        return xla_kernel(v, l, key)
+
+    return span
+
+
+def lower_span(expr: dsl.Expr):
+    """Descriptor lowering: ``(fn, postops)`` where ``fn(state) ->
+    (start, length)`` within the CURRENT value bytes, or ``None`` when
+    the expression's output is not a (position-wise transformed) view of
+    them.
+
+    This is what makes late materialization possible: chains whose final
+    values are views of the stored record bytes ship (row, start, length)
+    descriptors over the host link instead of the bytes themselves, and
+    the host rebuilds outputs from the slab it already holds. ``postops``
+    is a static tuple of length-preserving byte-wise transforms
+    (``"upper"``/``"lower"``) the host applies after the gather — they
+    commute with slicing, so spans computed on folded bytes are valid
+    positions in the original.
+    """
+    if isinstance(expr, dsl.Value):
+        return (lambda s: (jnp.zeros_like(s["lengths"]), s["lengths"])), ()
+
+    if isinstance(expr, (dsl.Upper, dsl.Lower)):
+        inner = lower_span(expr.arg)
+        if inner is None:
+            return None
+        fn, post = inner
+        tag = "upper" if isinstance(expr, dsl.Upper) else "lower"
+        return fn, post + (tag,)
+
+    if isinstance(expr, dsl.JsonGet):
+        inner = lower_span(expr.arg)
+        if inner is None:
+            return None
+        inner_fn, inner_post = inner
+        inner_bytes = lower_expr(expr.arg)
+        span = _json_span_fn(expr.key)
+
+        def fn(s):
+            v, l = inner_bytes(s)
+            st, ln = span(v, l)
+            ist, _ = inner_fn(s)
+            return ist + st, ln
+
+        return fn, inner_post
+
+    return None
+
+
+def apply_postops(values: jnp.ndarray, postops) -> jnp.ndarray:
+    """Apply static span postops on device (host mirror:
+    `buffer.apply_postops_host`)."""
+    for op in postops:
+        values = (
+            kernels.ascii_upper(values) if op == "upper" else kernels.ascii_lower(values)
+        )
+    return values
+
+
 def infer_type(expr: dsl.Expr) -> str:
     if isinstance(expr, (dsl.Value, dsl.Key, dsl.Const, dsl.Upper, dsl.Lower,
                          dsl.Concat, dsl.JsonGet, dsl.IntToBytes)):
@@ -77,26 +158,18 @@ def lower_expr(expr: dsl.Expr) -> Callable[[Dict[str, jnp.ndarray]], object]:
 
     if isinstance(expr, dsl.JsonGet):
         inner = lower_expr(expr.arg)
-        key = expr.key
-        # Default XLA fallback is the sequential scan kernel: exact on all
-        # inputs, same semantics as the pallas kernel, so a record's
-        # extraction never depends on which path (pallas / XLA / sharded)
-        # its batch took. FLUVIO_TPU_FAST_JSON=1 opts the fallback into
-        # the structural-index kernel, which is faster under XLA but has a
-        # documented malformed-JSON deviation.
-        fast = os.environ.get("FLUVIO_TPU_FAST_JSON") == "1"
-        json_kernel = kernels.json_get_parallel if fast else kernels.json_get
+        span = _json_span_fn(expr.key)
 
         def json_fn(s):
             v, l = inner(s)
-            # single-pass pallas state machine when the platform has it:
-            # collapses ~12 XLA primitives into 2 kernels AND carries the
-            # exact sequential semantics (dsl.json_get_bytes)
+            st, ln = span(v, l)
             if pallas_kernels.pallas_active(v.shape[1]):
-                return pallas_kernels.json_get_pallas(
-                    v, l, key, interpret=pallas_kernels.interpret_mode()
+                out = pallas_kernels.extract_pallas(
+                    v, st, ln, interpret=pallas_kernels.interpret_mode()
                 )
-            return json_kernel(v, l, key)
+            else:
+                out = kernels.extract_span(v, st, ln)
+            return out, ln
 
         return json_fn
 

@@ -275,16 +275,17 @@ def _extract_kernel(width: int, vt_ref, start_ref, vlen_ref, out_ref):
     out_ref[:, :] = jnp.where(rows < vlen, shifted, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("key", "interpret"))
-def json_get_pallas(
+def json_get_span_pallas(
     values: jnp.ndarray,
     lengths: jnp.ndarray,
     key: str,
     interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Fused JSON field extraction: (out_values, out_lengths).
+    """JSON field span (start, length) via the pallas byte automaton.
 
-    Semantics: exactly ``dsl.json_get_bytes`` (sequential automaton).
+    Semantics: exactly ``dsl.json_get_bytes``. Gather-free — the span
+    feeds either `extract_pallas` (materialized bytes) or the executor's
+    descriptor D2H path (late materialization on the host).
     """
     if not _PALLAS:
         raise RuntimeError("pallas unavailable")
@@ -321,6 +322,29 @@ def json_get_pallas(
             scratch_shapes=[pltpu.VMEM((width, LANES), jnp.int32)],
             interpret=interpret,
         )(vt, len2d)
+    return start[0, :n], vlen[0, :n]
+
+
+def extract_pallas(
+    values: jnp.ndarray,
+    start: jnp.ndarray,
+    vlen: jnp.ndarray,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Materialize per-record substrings with the pallas shift kernel."""
+    if not _PALLAS:
+        raise RuntimeError("pallas unavailable")
+    n, width = values.shape
+    blocks = max(1, (n + LANES - 1) // LANES)
+    padded_n = blocks * LANES
+    vt = jnp.transpose(values.astype(jnp.int32))
+    start = start.astype(jnp.int32)
+    vlen = vlen.astype(jnp.int32)
+    if padded_n != n:
+        vt = jnp.pad(vt, ((0, 0), (0, padded_n - n)))
+        start = jnp.pad(start, (0, padded_n - n))
+        vlen = jnp.pad(vlen, (0, padded_n - n))
+    with jax.enable_x64(False):
         extract = functools.partial(_extract_kernel, width)
         outT = pl.pallas_call(
             extract,
@@ -333,10 +357,26 @@ def json_get_pallas(
             out_specs=pl.BlockSpec((width, LANES), lambda b: (0, b)),
             out_shape=jax.ShapeDtypeStruct((width, padded_n), jnp.int32),
             interpret=interpret,
-        )(vt, start, vlen)
-    out_values = jnp.transpose(outT[:, :n]).astype(jnp.uint8)
-    out_lengths = vlen[0, :n]
-    return out_values, out_lengths
+        )(vt, start[None, :], vlen[None, :])
+    return jnp.transpose(outT[:, :n]).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("key", "interpret"))
+def json_get_pallas(
+    values: jnp.ndarray,
+    lengths: jnp.ndarray,
+    key: str,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused JSON field extraction: (out_values, out_lengths).
+
+    Semantics: exactly ``dsl.json_get_bytes`` (sequential automaton).
+    Span + extract trace inline (un-jitted helpers) so XLA CSEs the
+    shared transpose/pad of the values matrix between the two kernels.
+    """
+    start, vlen = json_get_span_pallas(values, lengths, key, interpret=interpret)
+    out_values = extract_pallas(values, start, vlen, interpret=interpret)
+    return out_values, vlen
 
 
 # ---------------------------------------------------------------------------

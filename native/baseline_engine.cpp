@@ -815,14 +815,16 @@ RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len) {
         int64_t end = pos + inner;
         if (end > raw_len || inner < 0) { pos = rec_start; break; }
         View v{};
+        if (pos >= end) { pos = rec_start; break; }
         pos += 1;  // attributes
-        read_varint(raw, end, pos, v.td);
-        read_varint(raw, end, pos, v.od);
-        uint8_t has_key = pos < end ? raw[pos++] : 0;
+        if (!read_varint(raw, end, pos, v.td) ||
+            !read_varint(raw, end, pos, v.od)) { pos = rec_start; break; }
+        if (pos >= end) { pos = rec_start; break; }
+        uint8_t has_key = raw[pos++];
         if (has_key) {
             int64_t klen = 0;
-            read_varint(raw, end, pos, klen);
-            if (klen < 0 || pos + klen > end) break;
+            if (!read_varint(raw, end, pos, klen)) { pos = rec_start; break; }
+            if (klen < 0 || pos + klen > end) { pos = rec_start; break; }
             v.has_key = true;
             v.koff = pos;
             v.klen = klen;
@@ -830,8 +832,8 @@ RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len) {
             total_k += klen;
         }
         int64_t vlen = 0;
-        read_varint(raw, end, pos, vlen);
-        if (vlen < 0 || pos + vlen > end) break;
+        if (!read_varint(raw, end, pos, vlen)) { pos = rec_start; break; }
+        if (vlen < 0 || pos + vlen > end) { pos = rec_start; break; }
         v.voff = pos;
         v.vlen = vlen;
         pos = end;  // skip record headers

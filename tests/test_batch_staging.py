@@ -97,6 +97,42 @@ class TestNativeCodecs:
         cols = native_backend.decode_record_columns(b"")
         assert cols["count"] == 0
 
+    def test_malformed_slabs_report_partial_parse(self):
+        """Any truncation/garbage => parsed != len(raw), so the broker
+        fast path falls back instead of silently dropping the tail."""
+        records = _records(5, keyed=True)
+        raw = _encode_records(records)
+        cases = {
+            "truncated final record": raw[:-3],
+            "trailing garbage": raw + b"\x07\x01",
+            "mid-varint cut": raw[: len(raw) - len(raw) // 3],
+        }
+        for label, bad in cases.items():
+            cols = native_backend.decode_record_columns(bad)
+            assert cols["parsed"] != len(bad), label
+            # whatever did parse is whole records with intact values
+            for i in range(cols["count"]):
+                v = cols["val_flat"][cols["val_off"][i] : cols["val_off"][i + 1]]
+                assert v.tobytes() == records[i].value, label
+
+    def test_well_formed_slab_parses_to_end(self):
+        records = _records(7, keyed=True)
+        raw = _encode_records(records)
+        cols = native_backend.decode_record_columns(raw)
+        assert cols["parsed"] == len(raw)
+
+    def test_malformed_slab_falls_back_to_per_record_path(self):
+        """A batch whose slab is truncated but whose header still claims
+        the full record count must not be served by the fast path."""
+        from fluvio_tpu.spu import smart_chain
+
+        records = _records(6)
+        raw = _encode_records(records)
+        batch = Batch(base_offset=0, raw_records=raw[:-2], raw_record_count=6)
+        chain = _chain("tpu", ("regex-filter", {"regex": "fluvio"}))
+        res = smart_chain._tpu_process_batches(chain, [batch], max_bytes=1 << 20)
+        assert res is None  # declined -> per-record path decides
+
 
 def _chain(backend, *specs):
     b = SmartEngine(backend=backend).builder()

@@ -99,9 +99,11 @@ def _assert_equal(single, sharded):
     s_header, s_packed, s_carries = single
     m_header, m_packed, m_carries = sharded
     np.testing.assert_array_equal(np.asarray(s_header), np.asarray(m_header))
-    for i, (a, b) in enumerate(zip(s_packed, m_packed)):
+    assert set(s_packed.keys()) == set(m_packed.keys())
+    for k in s_packed:
         np.testing.assert_array_equal(
-            np.asarray(a), np.asarray(b), err_msg=f"packed column {i}"
+            np.asarray(s_packed[k]), np.asarray(m_packed[k]),
+            err_msg=f"packed column {k}",
         )
     for i, (ca, cb) in enumerate(zip(s_carries, m_carries)):
         for j, (a, b) in enumerate(zip(ca, cb)):
