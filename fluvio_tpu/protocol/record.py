@@ -213,14 +213,25 @@ class Batch:
         base_offset: Offset = 0,
         first_timestamp: Optional[Timestamp] = None,
         compression: Compression = Compression.NONE,
+        preserve_offsets: bool = False,
     ) -> "Batch":
+        """``preserve_offsets`` keeps each record's existing offset delta
+        (the consume-path transform contract, fluvio-spu batch.rs: output
+        records keep their stored offsets so consumers resuming mid-slice
+        filter correctly); the default re-deltas sequentially (produce
+        path, where offsets are not assigned until the log write)."""
         b = cls(base_offset=base_offset, records=list(records))
         now = int(time.time() * 1000) if first_timestamp is None else first_timestamp
         b.header.first_timestamp = now
         b.header.max_time_stamp = now
-        for i, rec in enumerate(b.records):
-            rec.offset_delta = i
-        b.header.last_offset_delta = len(b.records) - 1
+        if not preserve_offsets:
+            for i, rec in enumerate(b.records):
+                rec.offset_delta = i
+        b.header.last_offset_delta = (
+            max((r.offset_delta for r in b.records), default=0)
+            if preserve_offsets
+            else len(b.records) - 1
+        )
         b.header.set_compression(compression)
         return b
 

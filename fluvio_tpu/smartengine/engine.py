@@ -185,7 +185,17 @@ class SmartModuleChainInstance:
         metrics.add_bytes_in(raw_len)
 
         if self.tpu_chain is not None:
-            output = self.tpu_chain.process(inp, metrics)
+            from fluvio_tpu.smartengine.tpu.executor import TpuSpill
+
+            try:
+                output = self.tpu_chain.process(inp, metrics)
+            except TpuSpill:
+                # device detected a transform error (or exhausted fan-out
+                # capacity): the interpreting python instances re-run the
+                # batch for exact first-error semantics (device carries
+                # were restored, and are re-mirrored from the instances
+                # after the rerun)
+                return self._process_instances(inp, metrics)
             metrics.add_records_out(len(output.successes))
             return output
 
@@ -198,6 +208,12 @@ class SmartModuleChainInstance:
             # Empty chain: decode-and-passthrough (parity: engine.rs:180-184)
             return SmartModuleOutput.new(inp.into_records())
 
+        return self._process_instances(inp, metrics)
+
+    def _process_instances(
+        self, inp: SmartModuleInput, metrics: SmartModuleChainMetrics
+    ) -> SmartModuleOutput:
+        """Interpreting per-instance pipeline (exact reference semantics)."""
         base_offset = inp.base_offset
         base_timestamp = inp.base_timestamp
         next_input = inp
@@ -206,14 +222,18 @@ class SmartModuleChainInstance:
             output = instance.process(next_input, metrics)
             if output.error is not None:
                 # stop processing, return partial output (engine.rs:159-161)
-                return output
+                break
             if i + 1 < len(self.instances):
                 next_input = SmartModuleInput.from_records(
                     output.successes,
                     base_offset=base_offset,
                     base_timestamp=base_timestamp,
                 )
-        metrics.add_records_out(len(output.successes))
+        if self.tpu_chain is not None:
+            # a spill rerun advanced the python accumulators; mirror back
+            self.tpu_chain.sync_state_from(self.instances)
+        if output.error is None:
+            metrics.add_records_out(len(output.successes))
         return output
 
     async def look_back(

@@ -64,6 +64,12 @@ class RecordBuffer:
     count: int
     base_offset: int = 0
     base_timestamp: int = NO_TIMESTAMP
+    # fan-out (array_map) outputs are "fresh" relative to their source
+    # record's batch: these host-side columns hold the per-record batch
+    # rebase deltas the broker's coalescer computed (None = zeros, the
+    # single-input engine surface)
+    fresh_offset_deltas: Optional[np.ndarray] = None
+    fresh_timestamp_deltas: Optional[np.ndarray] = None
     # cached ragged (flat) form of `values` for transfer-thin H2D staging
     _flat: Optional[np.ndarray] = None
     _starts: Optional[np.ndarray] = None

@@ -44,6 +44,7 @@ from fluvio_tpu.smartengine.tpu.lower import (
     infer_type,
     lower_expr,
     lower_span,
+    materialize_span,
 )
 
 _AGG_OP = {
@@ -60,6 +61,19 @@ _AGG_NEUTRAL = {
 }
 
 
+class TpuSpill(Exception):
+    """Raised when a batch must be re-run on the interpreting backend for
+    exact semantics (device-detected transform error, or fan-out capacity
+    exhaustion after retry). Aggregate device carries are restored before
+    raising so the rerun cannot double-count."""
+
+
+class _FanoutOverflow(Exception):
+    def __init__(self, total: int):
+        super().__init__(f"fanout total {total} exceeded capacity")
+        self.total = total
+
+
 @dataclass
 class _FilterStage:
     predicate: Callable
@@ -69,7 +83,7 @@ class _FilterStage:
     preserves_rows = True      # output row i corresponds to input row i
     rewrites_offsets = False   # touches offset/timestamp delta columns
 
-    def apply(self, state: Dict, carries, base_ts):
+    def apply(self, state: Dict, carries, base_ts, ctx):
         state = dict(state)
         state["valid"] = state["valid"] & self.predicate(state)
         return state, carries
@@ -86,7 +100,7 @@ class _MapStage:
     preserves_rows = True
     rewrites_offsets = False
 
-    def apply(self, state: Dict, carries, base_ts):
+    def apply(self, state: Dict, carries, base_ts, ctx):
         new_state = dict(state)
         if self.predicate is not None:
             new_state["valid"] = state["valid"] & self.predicate(state)
@@ -98,7 +112,7 @@ class _MapStage:
             ln = ln.astype(jnp.int32)
             new_state["view_start"] = state["view_start"] + st
             new_state["values"] = apply_postops(
-                _materialize_span(state["values"], st, ln), self.span_postops
+                materialize_span(state["values"], st, ln), self.span_postops
             )
             new_state["lengths"] = ln
         else:
@@ -110,14 +124,68 @@ class _MapStage:
         return new_state, carries
 
 
-def _materialize_span(values, start, lengths):
-    from fluvio_tpu.smartengine.tpu import pallas_kernels
+@dataclass
+class _ArrayMapStage:
+    """Fan-out explode (reference transform kind array_map,
+    transforms/mod.rs:24-52). Every output element is a contiguous
+    substring of its source record, so the stage emits (local_row,
+    rel_start, len) descriptors into ``ctx["fanout_cap"]`` capacity rows
+    via prefix-sum placement; view provenance and the source-row chain
+    compose through it, and byte materialization for downstream stages is
+    DCE'd when nothing reads it. Output offset/timestamp deltas are
+    "fresh" (zero relative to the source record's batch), synthesized
+    host-side from the src column."""
 
-    if pallas_kernels.pallas_active(values.shape[1]):
-        return pallas_kernels.extract_pallas(
-            values, start, lengths, interpret=pallas_kernels.interpret_mode()
+    mode: str  # "json_array" | "split"
+    sep: bytes
+
+    preserves_rows = False
+    rewrites_offsets = True
+
+    def apply(self, state: Dict, carries, base_ts, ctx):
+        cap = ctx["fanout_cap"]
+        if cap is None:
+            raise Unlowerable("array_map needs a fanout capacity (unsharded path)")
+        values, lengths, valid = state["values"], state["lengths"], state["valid"]
+        n = values.shape[0]
+        if self.mode == "json_array":
+            flag, sg, lg, ff, fs, fl, err = kernels.json_array_bounds(values, lengths)
+        else:
+            flag, sg, lg, ff, fs, fl, err = kernels.split_bounds(
+                values, lengths, self.sep
+            )
+        err_v = err & valid
+        # first-error masking: the failing record and everything after it
+        # contribute nothing (partial-output parity, engine.rs:159-161);
+        # the host spills the batch to the interpreter for the exact error
+        ridx = jnp.arange(n, dtype=jnp.int32)
+        first_err = jnp.min(jnp.where(err_v, ridx, jnp.int32(n)))
+        contributing = valid & (ridx < first_err)
+        total, local_row, rel_start, elen = kernels.fanout_scatter(
+            flag, sg, lg, ff, fs, fl, contributing, cap
         )
-    return kernels.extract_span(values, start, lengths)
+        lr = jnp.clip(local_row, 0, n - 1)
+        new_state: Dict = {}
+        new_state["valid"] = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(
+            total, jnp.int32(cap)
+        )
+        new_state["view_start"] = jnp.take(state["view_start"], lr) + rel_start
+        new_state["src_row"] = jnp.take(state["src_row"], lr)
+        new_state["lengths"] = elen
+        new_state["values"] = materialize_span(
+            jnp.take(values, lr, axis=0), rel_start, elen
+        )
+        new_state["keys"] = jnp.take(state["keys"], lr, axis=0)
+        new_state["key_lengths"] = jnp.take(state["key_lengths"], lr)
+        new_state["offset_deltas"] = jnp.zeros(
+            (cap,), state["offset_deltas"].dtype
+        )
+        new_state["timestamp_deltas"] = jnp.zeros(
+            (cap,), state["timestamp_deltas"].dtype
+        )
+        new_state["fan_total"] = total
+        new_state["fan_err"] = jnp.any(err_v)
+        return new_state, carries
 
 
 @dataclass
@@ -139,7 +207,7 @@ class _AggregateStage:
             return kernels.count_words(values, lengths)
         raise ValueError(self.kind)
 
-    def apply(self, state: Dict, carries, base_ts):
+    def apply(self, state: Dict, carries, base_ts, ctx):
         acc_in, win_in, has_in = carries[self.index]
         valid = state["valid"]
         op = _AGG_OP[self.kind]
@@ -202,7 +270,8 @@ class TpuChainExecutor:
         self._jit_ragged = jax.jit(
             self._chain_fn_ragged,
             static_argnames=(
-                "width", "kwidth", "has_keys", "has_offsets", "ts_mode"
+                "width", "kwidth", "has_keys", "has_offsets", "ts_mode",
+                "fanout_cap",
             ),
         )
         # do any stages write key columns? (drives D2H key download)
@@ -217,8 +286,10 @@ class TpuChainExecutor:
         # rebuilds output bytes from the slab it already holds — the D2H
         # link (the measured bottleneck: ~25 MB/s vs ~800 MB/s H2D on
         # this chip's tunnel) carries ~5x fewer bytes
+        self._fanout = any(isinstance(s, _ArrayMapStage) for s in stages)
+        self._cap_hint: Optional[int] = None
         self._viewable = not agg_configs and all(
-            isinstance(s, _FilterStage)
+            isinstance(s, (_FilterStage, _ArrayMapStage))
             or (
                 isinstance(s, _MapStage)
                 and s.span_fn is not None
@@ -290,14 +361,24 @@ class TpuChainExecutor:
                 elif isinstance(prog, dsl.AggregateProgram):
                     if prog.kind not in _AGG_OP:
                         raise Unlowerable(f"aggregate kind {prog.kind}")
+                    if prog.window_ms and any(
+                        isinstance(s, _ArrayMapStage) for s in stages
+                    ):
+                        # fan-out rows carry fresh (zero) timestamps, so a
+                        # windowed aggregate downstream has no window key
+                        raise Unlowerable("windowed aggregate after array_map")
                     idx = len(agg_configs)
                     agg_configs.append(
                         (prog.kind, prog.window_ms or None, config.initial_data)
                     )
                     stages.append(_AggregateStage(prog.kind, prog.window_ms or None, idx))
+                elif isinstance(prog, dsl.ArrayMapProgram):
+                    if prog.mode not in ("json_array", "split"):
+                        raise Unlowerable(f"array_map mode {prog.mode}")
+                    if any(isinstance(s, _ArrayMapStage) for s in stages):
+                        raise Unlowerable("one array_map per fused chain")
+                    stages.append(_ArrayMapStage(mode=prog.mode, sep=prog.sep))
                 else:
-                    # array_map fan-out lowering lands with the two-pass
-                    # capacity kernel; fall back to the python backend
                     return None
         except (Unlowerable, KeyError):
             return None
@@ -309,67 +390,87 @@ class TpuChainExecutor:
 
     # -- execution ----------------------------------------------------------
 
-    def _chain_fn(self, arrays: Dict, count, base_ts, carries):
+    def _chain_fn(self, arrays: Dict, count, base_ts, carries, fanout_cap=None):
         """Fused chain body. Returns (header, packed dict, carries).
 
         D2H is the scarce resource on the host link (~25 MB/s vs
-        ~800 MB/s H2D through the tunnel): the survivor set always ships
-        as a 1-bit-per-input-row bitmask (the host rebuilds survivor
-        indices and the untouched offset/timestamp columns from it), and
-        view-mode chains ship (start, length) descriptors instead of
-        value bytes — the host rebuilds outputs from the input slab it
-        already holds. ``packed``'s keys are static per executor config.
+        ~800 MB/s H2D through the tunnel), so outputs ship as the
+        smallest sufficient representation — ``packed``'s keys are
+        static per executor config:
+
+        - row-preserving chains ship the survivor set as a
+          1-bit-per-input-row bitmask (the host rebuilds survivor
+          indices and the untouched offset/timestamp columns from it);
+          fan-out chains ship an explicit compacted ``src_row`` column.
+        - view-mode chains ship (start, length) descriptors instead of
+          value bytes — the host rebuilds outputs from the input slab it
+          already holds.
+
+        Header layout: [count, max_value_len, max_key_len, fanout_error,
+        fanout_total]; a nonzero error spills the batch to the
+        interpreter, a total above capacity triggers a bigger-capacity
+        retry.
         """
         n = arrays["values"].shape[0]
         state = dict(arrays)
         state["valid"] = jnp.arange(n, dtype=jnp.int32) < count
         state["view_start"] = jnp.zeros((n,), dtype=jnp.int32)
+        state["src_row"] = jnp.arange(n, dtype=jnp.int32)
+        ctx = {"fanout_cap": fanout_cap}
         for stage in self.stages:
-            state, carries = stage.apply(state, carries, base_ts)
+            state, carries = stage.apply(state, carries, base_ts, ctx)
         valid = state["valid"]
         out_count = jnp.sum(valid.astype(jnp.int32))
-        packed: Dict = {}
-        if self._rebuild_offsets_from_src:
-            # host-side survivor recovery (view mode always qualifies:
-            # its stages are all row-preserving)
-            packed["mask"] = kernels.pack_mask(valid)
-        if self._viewable:
-            _, (cstart, clen) = kernels.compact_rows(
-                valid, state["view_start"], state["lengths"]
-            )
-            header = jnp.stack(
+        fan_err = state.get("fan_err", jnp.asarray(False))
+        fan_total = state.get("fan_total", jnp.int32(0))
+
+        def _header(max_v, max_k):
+            return jnp.stack(
                 [
                     out_count.astype(jnp.int64),
-                    jnp.max(clen).astype(jnp.int64),
-                    jnp.int64(0),
+                    max_v.astype(jnp.int64),
+                    max_k.astype(jnp.int64),
+                    fan_err.astype(jnp.int64),
+                    fan_total.astype(jnp.int64),
                 ]
             )
-            packed["span_start"] = cstart
-            packed["span_len"] = clen
-            return header, packed, carries
+
+        packed: Dict = {}
+        if self._viewable:
+            cols = [state["view_start"], state["lengths"]]
+            if self._fanout:
+                cols.append(state["src_row"])
+            _, compacted = kernels.compact_rows(valid, *cols)
+            packed["span_start"] = compacted[0]
+            packed["span_len"] = compacted[1]
+            if self._fanout:
+                packed["src_row"] = compacted[2]
+            else:
+                packed["mask"] = kernels.pack_mask(valid)
+            return _header(jnp.max(compacted[1]), jnp.int32(0)), packed, carries
         compact_cols = [
             state["values"],
             state["lengths"],
             state["keys"],
             state["key_lengths"],
         ]
-        if not self._rebuild_offsets_from_src:
+        if self._fanout:
+            compact_cols.append(state["src_row"])
+        elif not self._rebuild_offsets_from_src:
             compact_cols += [state["offset_deltas"], state["timestamp_deltas"]]
         _, compacted = kernels.compact_rows(valid, *compact_cols)
         packed["values"] = compacted[0]
         packed["lengths"] = compacted[1]
         packed["keys"] = compacted[2]
         packed["key_lengths"] = compacted[3]
-        if not self._rebuild_offsets_from_src:
+        if self._fanout:
+            packed["src_row"] = compacted[4]
+        elif not self._rebuild_offsets_from_src:
             packed["offset_deltas"] = compacted[4]
             packed["timestamp_deltas"] = compacted[5]
-        header = jnp.stack(
-            [
-                out_count.astype(jnp.int64),
-                jnp.max(packed["lengths"]).astype(jnp.int64),
-                jnp.max(packed["key_lengths"]).astype(jnp.int64),
-            ]
-        )
+        else:
+            packed["mask"] = kernels.pack_mask(valid)
+        header = _header(jnp.max(packed["lengths"]), jnp.max(packed["key_lengths"]))
         return header, packed, carries
 
     def _chain_fn_ragged(
@@ -389,6 +490,7 @@ class TpuChainExecutor:
         has_keys: bool,
         has_offsets: bool,
         ts_mode: str,
+        fanout_cap: Optional[int] = None,
     ):
         """Reconstruct the padded matrix on device from the flat upload.
 
@@ -434,9 +536,9 @@ class TpuChainExecutor:
             "offset_deltas": offset_deltas,
             "timestamp_deltas": timestamp_deltas,
         }
-        return self._chain_fn(arrays, count, base_ts, carries)
+        return self._chain_fn(arrays, count, base_ts, carries, fanout_cap)
 
-    def _dispatch(self, buf: RecordBuffer):
+    def _dispatch(self, buf: RecordBuffer, fanout_cap: Optional[int] = None):
         """Async-dispatch one batch.
 
         Values go up ragged (flat bytes + starts) and are re-padded on
@@ -492,6 +594,7 @@ class TpuChainExecutor:
             has_keys=has_keys,
             has_offsets=has_offsets,
             ts_mode=ts_mode,
+            fanout_cap=fanout_cap,
         )
         # keep aggregate state device-resident; host mirrors sync on demand
         self._device_carries = new_carries
@@ -536,6 +639,13 @@ class TpuChainExecutor:
         """
         hdr = jax.device_get(header)
         count, max_v, max_k = int(hdr[0]), int(hdr[1]), int(hdr[2])
+        if int(hdr[3]):
+            raise TpuSpill("array_map transform error: interpreter decides")
+        if self._fanout:
+            cap = packed["span_len" if self._viewable else "lengths"].shape[0]
+            total = int(hdr[4])
+            if total > cap:
+                raise _FanoutOverflow(total)
         width = buf.values.shape[1]
         len16 = width < (1 << 16)
 
@@ -548,16 +658,23 @@ class TpuChainExecutor:
                 st_col = st_col.astype(jnp.uint16)
                 ln_col = ln_col.astype(jnp.uint16)
             slices = [
-                packed["mask"],
                 lax.slice(st_col, (0,), (rows,)),
                 lax.slice(ln_col, (0,), (rows,)),
             ]
+            if self._fanout:
+                slices.append(lax.slice(packed["src_row"], (0,), (rows,)))
+            else:
+                slices.append(packed["mask"])
             for s in slices:
                 s.copy_to_host_async()
-            mask_h, st_h, ln_h = jax.device_get(slices)
-            src = np.flatnonzero(
-                np.unpackbits(mask_h, bitorder="little")[: buf.values.shape[0]]
-            )
+            host = jax.device_get(slices)
+            st_h, ln_h = host[0], host[1]
+            if self._fanout:
+                src = np.asarray(host[2][:count]).astype(np.int64)
+            else:
+                src = np.flatnonzero(
+                    np.unpackbits(host[2], bitorder="little")[: buf.values.shape[0]]
+                )[:count]
             st = st_h[:count].astype(np.int64)
             ln = ln_h[:count].astype(np.int32)
             vw = min(self._pad_slice(max(max_v, 1)), width)
@@ -565,7 +682,7 @@ class TpuChainExecutor:
             if count:
                 cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
                 gathered = buf.values[
-                    src[:count, None], np.clip(cols, 0, width - 1)
+                    src[:, None], np.clip(cols, 0, width - 1)
                 ]
                 keep = np.arange(vw, dtype=np.int32)[None, :] < ln[:, None]
                 gathered = np.where(keep, gathered, 0)
@@ -577,8 +694,8 @@ class TpuChainExecutor:
             if buf.has_keys():
                 out_keys = np.zeros((rows, buf.keys.shape[1]), dtype=np.uint8)
                 out_klens = np.full((rows,), -1, dtype=np.int32)
-                out_keys[:count] = buf.keys[src[:count]]
-                out_klens[:count] = buf.key_lengths[src[:count]]
+                out_keys[:count] = buf.keys[src]
+                out_klens[:count] = buf.key_lengths[src]
             else:
                 out_keys = np.zeros((rows, 1), dtype=np.uint8)
                 out_klens = np.full((rows,), -1, dtype=np.int32)
@@ -593,25 +710,34 @@ class TpuChainExecutor:
             if max_k > 0
             else 0
         )
+        # byte mode: output widths can exceed the input width (e.g.
+        # Concat), so the narrow-length cast keys off the OUTPUT matrix
+        out_len16 = packed["values"].shape[1] < (1 << 16)
         out_len_col = (
-            packed["lengths"].astype(jnp.uint16) if len16 else packed["lengths"]
+            packed["lengths"].astype(jnp.uint16) if out_len16 else packed["lengths"]
         )
         want_keys = buf.has_keys() or self._writes_keys
-        # the survivor bitmask crosses the link only when the host rebuilds
-        # off/ts columns from it; offset-rewriting chains ship the device
-        # columns instead and never need src
-        want_mask = self._rebuild_offsets_from_src
+        # survivor recovery: fan-out chains ship an explicit src column;
+        # row-preserving chains ship the 1-bit mask when the host rebuilds
+        # off/ts from it, or the device off/ts columns when a stage
+        # rewrote them
+        want_mask = self._rebuild_offsets_from_src and not self._fanout
+        want_dev_offsets = (
+            not self._rebuild_offsets_from_src and not self._fanout
+        )
         slices = [
             lax.slice(packed["values"], (0, 0), (rows, vw)),
             lax.slice(out_len_col, (0,), (rows,)),
         ]
-        if want_mask:
+        if self._fanout:
+            slices.append(lax.slice(packed["src_row"], (0,), (rows,)))
+        elif want_mask:
             slices.append(packed["mask"])
         if want_keys:
             slices.append(lax.slice(packed["key_lengths"], (0,), (rows,)))
             if kw:
                 slices.append(lax.slice(packed["keys"], (0, 0), (rows, kw)))
-        if not self._rebuild_offsets_from_src:
+        if want_dev_offsets:
             slices.append(lax.slice(packed["offset_deltas"], (0,), (rows,)))
             slices.append(lax.slice(packed["timestamp_deltas"], (0,), (rows,)))
         for s in slices:
@@ -620,9 +746,14 @@ class TpuChainExecutor:
         out_values, out_lengths = host[:2]
         out_lengths = out_lengths.astype(np.int32)
         pos = 2
-        mask_h = None
-        if want_mask:
-            mask_h = host[pos]
+        src = None
+        if self._fanout:
+            src = np.asarray(host[pos][:count]).astype(np.int64)
+            pos += 1
+        elif want_mask:
+            src = np.flatnonzero(
+                np.unpackbits(host[pos], bitorder="little")[: buf.values.shape[0]]
+            )
             pos += 1
         if want_keys:
             out_klens = host[pos]
@@ -631,7 +762,7 @@ class TpuChainExecutor:
         else:
             out_klens = np.full((rows,), -1, dtype=np.int32)
             out_keys = np.zeros((rows, 1), dtype=np.uint8)
-        if not self._rebuild_offsets_from_src:
+        if want_dev_offsets:
             out_off = np.asarray(host[pos]).astype(np.int32)
             out_ts = np.asarray(host[pos + 1]).astype(np.int64)
             out_off[count:] = 0
@@ -642,15 +773,18 @@ class TpuChainExecutor:
                 timestamp_deltas=out_ts, count=count,
                 base_offset=buf.base_offset, base_timestamp=buf.base_timestamp,
             )
-        src = np.flatnonzero(
-            np.unpackbits(mask_h, bitorder="little")[: buf.values.shape[0]]
-        )
         return self._assemble(buf, count, rows, out_values, out_lengths,
                               out_keys, out_klens, src)
 
     def _assemble(self, buf, count, rows, out_values, out_lengths, out_keys,
                   out_klens, src) -> RecordBuffer:
-        """Rebuild passthrough offset/timestamp columns from survivors."""
+        """Rebuild offset/timestamp columns from survivor source rows.
+
+        Row-preserving chains pass the source deltas through; fan-out
+        outputs are "fresh" — zero relative to their source record's
+        batch, i.e. the batch-rebase columns the broker attaches (zeros
+        at the engine surface, matching the interpreter's fresh
+        Records)."""
         src_c = np.clip(
             src[:count] if len(src) >= count else np.zeros(count, np.int64),
             0,
@@ -658,8 +792,14 @@ class TpuChainExecutor:
         )
         out_off = np.zeros((rows,), dtype=np.int32)
         out_ts = np.zeros((rows,), dtype=np.int64)
-        out_off[:count] = buf.offset_deltas[src_c]
-        out_ts[:count] = buf.timestamp_deltas[src_c]
+        if self._fanout:
+            if buf.fresh_offset_deltas is not None:
+                out_off[:count] = buf.fresh_offset_deltas[src_c]
+            if buf.fresh_timestamp_deltas is not None:
+                out_ts[:count] = buf.fresh_timestamp_deltas[src_c]
+        else:
+            out_off[:count] = buf.offset_deltas[src_c]
+            out_ts[:count] = buf.timestamp_deltas[src_c]
         return RecordBuffer(
             values=out_values,
             lengths=out_lengths,
@@ -672,10 +812,39 @@ class TpuChainExecutor:
             base_timestamp=buf.base_timestamp,
         )
 
+    def _fanout_cap(self, buf: RecordBuffer) -> Optional[int]:
+        if not self._fanout:
+            return None
+        rows = buf.values.shape[0]
+        return self._bucket_bytes(max(4 * rows, self._cap_hint or 0), 1024)
+
     def process_buffer(self, buf: RecordBuffer) -> RecordBuffer:
-        """Array-in/array-out path (bench + broker stream path)."""
-        header, packed = self._dispatch(buf)
-        return self._fetch(buf, header, packed)
+        """Array-in/array-out path (bench + broker stream path).
+
+        Fan-out chains run with a learned capacity; a batch whose exact
+        element total exceeds it retries once at the (bucketed) exact
+        capacity — aggregate device carries are restored first so the
+        retry cannot double-apply. Device-detected transform errors raise
+        `TpuSpill` (carries restored) for the interpreter to re-run with
+        exact error semantics.
+        """
+        prev_carries = self._device_carries
+        try:
+            header, packed = self._dispatch(buf, fanout_cap=self._fanout_cap(buf))
+            return self._fetch(buf, header, packed)
+        except _FanoutOverflow as o:
+            self._cap_hint = max(self._cap_hint or 0, o.total)
+            self._device_carries = prev_carries
+            cap = self._bucket_bytes(o.total, 1024)
+            header, packed = self._dispatch(buf, fanout_cap=cap)
+            try:
+                return self._fetch(buf, header, packed)
+            except _FanoutOverflow as e:  # pragma: no cover — total is exact
+                self._device_carries = prev_carries
+                raise TpuSpill(f"fanout overflow after retry: {e.total}")
+        except TpuSpill:
+            self._device_carries = prev_carries
+            raise
 
     def process_stream(self, bufs):
         """Pipelined generator: batch k+1 dispatches while k downloads.
@@ -683,14 +852,35 @@ class TpuChainExecutor:
         The broker's consume loop shape: sustained throughput is bounded by
         max(compute, transfer), not their sum.
         """
+        if self._fanout and self.agg_configs:
+            # overflow retry must roll carries back, which a pipelined
+            # stream cannot do once the next batch has dispatched
+            for buf in bufs:
+                yield self.process_buffer(buf)
+            return
+
+        def fetch(triple):
+            buf, header, packed = triple
+            try:
+                return self._fetch(buf, header, packed)
+            except _FanoutOverflow as o:
+                # stateless chain: redispatching one batch is safe
+                self._cap_hint = max(self._cap_hint or 0, o.total)
+                cap = self._bucket_bytes(o.total, 1024)
+                h2, p2 = self._dispatch(buf, fanout_cap=cap)
+                return self._fetch(buf, h2, p2)
+
         pending = None
         for buf in bufs:
-            dispatched = (buf, *self._dispatch(buf))
+            dispatched = (
+                buf,
+                *self._dispatch(buf, fanout_cap=self._fanout_cap(buf)),
+            )
             if pending is not None:
-                yield self._fetch(pending[0], pending[1], pending[2])
+                yield fetch(pending)
             pending = dispatched
         if pending is not None:
-            yield self._fetch(pending[0], pending[1], pending[2])
+            yield fetch(pending)
 
     def process(
         self, inp: SmartModuleInput, metrics: Optional[SmartModuleChainMetrics] = None

@@ -371,6 +371,225 @@ def count_words(values: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# array_map element bounds (fan-out engine)
+# ---------------------------------------------------------------------------
+#
+# Bounds kernels emit per-position grids: flag[N, W] marks an element
+# EMISSION position (ascending position = element order within the record)
+# carrying payload (start, len) — the element's span within the record's
+# value bytes. A per-record "final segment" triple covers the one element a
+# scan can only finalize at end-of-record. The fan-out stage scatters these
+# into capacity rows; outputs stay views of the input slab, so the whole
+# explode ships as (src, start, len) descriptors.
+
+_WS_BYTES = (9, 10, 11, 12, 13, 32)  # bytes.strip() whitespace set
+
+
+def _is_ws(c: jnp.ndarray) -> jnp.ndarray:
+    out = c == _WS_BYTES[0]
+    for w in _WS_BYTES[1:]:
+        out = out | (c == w)
+    return out
+
+
+def split_bounds(values: jnp.ndarray, lengths: jnp.ndarray, sep: bytes):
+    """Element bounds for ``value.split(sep)`` with empties dropped
+    (parity: python_backend ArrayMap split mode — bytes.split semantics:
+    non-overlapping left-to-right separator matches).
+
+    Returns (flag[N,W], start[N,W], elen[N,W], fflag[N], fstart[N],
+    felen[N], err[N]); err is always False for split mode.
+    """
+    n, width = values.shape
+    lengths = lengths.astype(jnp.int32)
+    c = values.astype(jnp.int32)
+    jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
+    inrec = jidx < lengths[:, None]
+    no_final = jnp.zeros((n,), dtype=bool)
+    zeros_n = jnp.zeros((n,), dtype=jnp.int32)
+    if len(sep) == 1:
+        m = (c == sep[0]) & inrec
+        prev_boundary = jnp.concatenate(
+            [jnp.ones((n, 1), dtype=bool), m[:, :-1]], axis=1
+        ) | (jidx == 0)
+        starts = inrec & ~m & prev_boundary
+        cond = m | ~inrec
+        nxt = _next_index_ge(cond, width)
+        elen = nxt - jidx
+        return (
+            starts,
+            jnp.broadcast_to(jidx, (n, width)),
+            jnp.where(starts, elen, 0),
+            no_final,
+            zeros_n,
+            zeros_n,
+            no_final,
+        )
+
+    # multi-byte separator: greedy left-to-right matches need a scan
+    L = len(sep)
+    match = jnp.ones((n, width), dtype=bool)
+    for i, b in enumerate(sep):
+        shifted = (
+            c[:, i:] if i == 0 else jnp.pad(c[:, i:], ((0, 0), (0, i)), constant_values=-1)
+        )
+        match = match & (shifted == b)
+    match = match & (jidx + L <= lengths[:, None])
+
+    def step(carry, xs):
+        skip, seg_start = carry
+        m_col, t = xs
+        is_sep = m_col & (t >= skip)
+        ln = t - seg_start
+        emit = is_sep & (ln > 0)
+        y = (emit, jnp.where(emit, seg_start, 0), jnp.where(emit, ln, 0))
+        skip = jnp.where(is_sep, t + L, skip)
+        seg_start = jnp.where(is_sep, t + L, seg_start)
+        return (skip, seg_start), y
+
+    carry0 = (jnp.zeros((n,), dtype=jnp.int32), jnp.zeros((n,), dtype=jnp.int32))
+    (skip, seg_start), ys = lax.scan(
+        step, carry0, (match.T, jnp.arange(width, dtype=jnp.int32))
+    )
+    flag, start_g, len_g = (y.T for y in ys)
+    flen = lengths - seg_start
+    fflag = flen > 0
+    return flag, start_g, len_g, fflag, seg_start, jnp.where(fflag, flen, 0), no_final
+
+
+def json_array_bounds(values: jnp.ndarray, lengths: jnp.ndarray):
+    """Element bounds for a top-level JSON array explode.
+
+    Bit-identical to `dsl.json_array_elements`: outer-whitespace strip,
+    ``[``/``]`` bracket check (err when absent), depth-0 comma split
+    respecting strings/escapes, per-segment whitespace trim, quote strip
+    on fully-quoted segments, empty segments dropped. Returns the same
+    7-tuple as `split_bounds` (final-segment slots unused; elements all
+    finalize at a comma or the closing bracket, both in-grid positions).
+    """
+    n, width = values.shape
+    lengths = lengths.astype(jnp.int32)
+    c = values.astype(jnp.int32)
+    jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
+    inrec = jidx < lengths[:, None]
+    ws = _is_ws(c)
+    nonws = ~ws & inrec
+    big = jnp.int32(width)
+    fa = jnp.min(jnp.where(nonws, jidx, big), axis=1)
+    fb = jnp.max(jnp.where(nonws, jidx, -1), axis=1)
+    fa_c = jnp.clip(fa, 0, width - 1)
+    fb_c = jnp.clip(fb, 0, width - 1)
+    open_b = jnp.take_along_axis(c, fa_c[:, None], axis=1)[:, 0]
+    close_b = jnp.take_along_axis(c, fb_c[:, None], axis=1)[:, 0]
+    err = (fa >= big) | (open_b != 0x5B) | (close_b != 0x5D) | (fb <= fa)
+
+    def step(carry, xs):
+        in_str, esc, depth, seg_fnw, seg_lnw, first_b, last_b = carry
+        col, ws_col, t = xs
+        body = (t > fa) & (t < fb) & ~err
+        closer = (t == fb) & ~err
+
+        # string/escape state (reference: backslash inside a string skips
+        # the next byte entirely)
+        consume = body & in_str & esc
+        set_esc = body & in_str & ~esc & (col == 0x5C)
+        s_close = body & in_str & ~esc & ~set_esc & (col == 0x22)
+        o_open = body & ~in_str & (col == 0x22)
+        o_up = body & ~in_str & ((col == 0x5B) | (col == 0x7B))
+        o_dn = body & ~in_str & ((col == 0x5D) | (col == 0x7D))
+        comma = body & ~in_str & (col == 0x2C) & (depth == 0)
+        boundary = comma | closer
+
+        # segment trim trackers skip the delimiter itself
+        upd = body & ~ws_col & ~comma
+        fresh = seg_fnw < 0
+        n_fnw = jnp.where(upd & fresh, t, seg_fnw)
+        n_first = jnp.where(upd & fresh, col, first_b)
+        n_lnw = jnp.where(upd, t, seg_lnw)
+        n_last = jnp.where(upd, col, last_b)
+
+        has = n_fnw >= 0
+        quoted = has & (n_first == 0x22) & (n_last == 0x22) & (n_lnw > n_fnw)
+        st = jnp.where(quoted, n_fnw + 1, n_fnw)
+        en = jnp.where(quoted, n_lnw - 1, n_lnw)
+        ln = en - st + 1
+        emit = boundary & has & (ln > 0)
+        y = (emit, jnp.where(emit, st, 0), jnp.where(emit, ln, 0))
+
+        n_in_str = jnp.where(s_close, False, jnp.where(o_open, True, in_str))
+        n_esc = jnp.where(body & in_str, set_esc, esc)
+        n_depth = depth + o_up.astype(jnp.int32) - o_dn.astype(jnp.int32)
+        reset = boundary
+        carry = (
+            n_in_str,
+            n_esc,
+            n_depth,
+            jnp.where(reset, -1, n_fnw),
+            jnp.where(reset, -1, n_lnw),
+            jnp.where(reset, 0, n_first),
+            jnp.where(reset, 0, n_last),
+        )
+        return carry, y
+
+    zeros_b = jnp.zeros((n,), dtype=bool)
+    zeros_i = jnp.zeros((n,), dtype=jnp.int32)
+    carry0 = (
+        zeros_b,
+        zeros_b,
+        zeros_i,
+        jnp.full((n,), -1, dtype=jnp.int32),
+        jnp.full((n,), -1, dtype=jnp.int32),
+        zeros_i,
+        zeros_i,
+    )
+    _, ys = lax.scan(
+        step, carry0, (c.T, ws.T, jnp.arange(width, dtype=jnp.int32))
+    )
+    flag, start_g, len_g = (y.T for y in ys)
+    return flag, start_g, len_g, zeros_b, zeros_i, zeros_i, err
+
+
+def fanout_scatter(
+    flag, start_g, len_g, fflag, fstart, flen, contributing, cap: int
+):
+    """Scatter element descriptors into ``cap`` output rows.
+
+    Placement: exclusive prefix sum of per-record element counts gives
+    each record's base row; elements order by emission position; the
+    final-segment slot lands after a record's grid elements. Returns
+    (total, local_row[cap], rel_start[cap], elen[cap]) — total is exact
+    (pre-cap), so the caller can detect overflow and retry with a larger
+    bucketed capacity.
+    """
+    n, width = flag.shape
+    flag = flag & contributing[:, None]
+    fflag = fflag & contributing
+    e_grid = jnp.sum(flag.astype(jnp.int32), axis=1)
+    e_row = e_grid + fflag.astype(jnp.int32)
+    base = jnp.cumsum(e_row) - e_row
+    total = jnp.sum(e_row)
+    idx_in_rec = jnp.cumsum(flag.astype(jnp.int32), axis=1) - flag.astype(jnp.int32)
+    rows = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None], (n, width)
+    )
+    tgt = jnp.where(flag, base[:, None] + idx_in_rec, cap)
+    out_row = jnp.zeros((cap,), dtype=jnp.int32).at[tgt.reshape(-1)].set(
+        rows.reshape(-1), mode="drop"
+    )
+    out_start = jnp.zeros((cap,), dtype=jnp.int32).at[tgt.reshape(-1)].set(
+        start_g.reshape(-1), mode="drop"
+    )
+    out_len = jnp.zeros((cap,), dtype=jnp.int32).at[tgt.reshape(-1)].set(
+        len_g.reshape(-1), mode="drop"
+    )
+    ftgt = jnp.where(fflag, base + e_grid, cap)
+    out_row = out_row.at[ftgt].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    out_start = out_start.at[ftgt].set(fstart, mode="drop")
+    out_len = out_len.at[ftgt].set(flen, mode="drop")
+    return total, out_row, out_start, out_len
+
+
+# ---------------------------------------------------------------------------
 # Segmented prefix scans (aggregate engine)
 # ---------------------------------------------------------------------------
 

@@ -55,6 +55,17 @@ def _json_span_fn(key: str):
     return span
 
 
+def materialize_span(values: jnp.ndarray, start: jnp.ndarray, lengths: jnp.ndarray):
+    """Per-record substring gather — single home for the pallas/XLA
+    extract dispatch shared by byte-mode JsonGet, view-stage
+    materialization, and the fan-out stage."""
+    if pallas_kernels.pallas_active(values.shape[1]):
+        return pallas_kernels.extract_pallas(
+            values, start, lengths, interpret=pallas_kernels.interpret_mode()
+        )
+    return kernels.extract_span(values, start, lengths)
+
+
 def lower_span(expr: dsl.Expr):
     """Descriptor lowering: ``(fn, postops)`` where ``fn(state) ->
     (start, length)`` within the CURRENT value bytes, or ``None`` when
@@ -163,13 +174,7 @@ def lower_expr(expr: dsl.Expr) -> Callable[[Dict[str, jnp.ndarray]], object]:
         def json_fn(s):
             v, l = inner(s)
             st, ln = span(v, l)
-            if pallas_kernels.pallas_active(v.shape[1]):
-                out = pallas_kernels.extract_pallas(
-                    v, st, ln, interpret=pallas_kernels.interpret_mode()
-                )
-            else:
-                out = kernels.extract_span(v, st, ln)
-            return out, ln
+            return materialize_span(v, st, ln), ln
 
         return json_fn
 

@@ -267,6 +267,7 @@ def _tpu_process_batches(
     from fluvio_tpu.protocol.compression import Compression, decompress
     from fluvio_tpu.smartengine import native_backend
     from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+    from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
     tpu = getattr(chain, "tpu_chain", None)
     if tpu is None or not batches:
@@ -337,17 +338,35 @@ def _tpu_process_batches(
     # row of the slice to its pow2 width
     if buf.values.nbytes > _MAX_STAGING_BYTES:
         return None
+    if tpu._fanout:
+        # fan-out outputs inherit their source batch's rebase deltas
+        # ("fresh" records, delta 0 relative to their own batch)
+        rows = buf.offset_deltas.shape[0]
+        fo = np.zeros(rows, dtype=np.int32)
+        ft = np.zeros(rows, dtype=np.int64)
+        pos = 0
+        for b, c in staged:
+            n_b = c["count"]
+            fo[pos : pos + n_b] = b.base_offset - base0
+            if ts0 >= 0:
+                ft[pos : pos + n_b] = b.header.first_timestamp - ts0
+            pos += n_b
+        buf.fresh_offset_deltas = fo
+        buf.fresh_timestamp_deltas = ft
 
     result = BatchProcessResult()
     last_batch = staged[-1][0]
     result.next_offset = last_batch.computed_last_offset()
-    outbuf = tpu.process_buffer(buf)
+    try:
+        outbuf = tpu.process_buffer(buf)
+    except TpuSpill:
+        return None  # interpreter path re-runs with exact error semantics
     n_out = outbuf.count
     # survivors keep their stored offsets (deltas are already rebased to
     # base0), so a consumer resuming mid-slice filters correctly
     out_deltas = outbuf.offset_deltas[:n_out].astype(np.int64)
     out_ts = outbuf.timestamp_deltas[:n_out].astype(np.int64)
-    if n_out and not tpu.agg_configs and max_bytes > 0:
+    if n_out and not tpu.agg_configs and not tpu._fanout and max_bytes > 0:
         # stateless chains honor max_bytes: keep the longest record prefix
         # whose encoded size fits (>= semantics: always keep one batch's
         # worth of progress by including at least the first record)
@@ -426,6 +445,8 @@ def process_batches(
         output = chain.process(inp, metrics)
         result.next_offset = batch.computed_last_offset()
         if output.successes:
+            # consume-path contract (parity with the TPU fast path and
+            # fluvio-spu batch.rs): survivors keep their stored offsets
             out_batch = Batch.from_records(
                 output.successes,
                 base_offset=batch.base_offset,
@@ -434,6 +455,7 @@ def process_batches(
                     if batch.header.first_timestamp != NO_TIMESTAMP
                     else None
                 ),
+                preserve_offsets=True,
             )
             # Cover the input batch's whole offset range: next fetch offset
             # is computed from last_offset_delta, which must reflect the

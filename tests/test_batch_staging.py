@@ -162,12 +162,17 @@ def _wire(result):
 
 
 def _flat_records(result):
-    """(value, key, abs_timestamp) per record across all output batches."""
+    """(value, key, abs_timestamp, abs_offset) per record across all
+    output batches — offset parity between the fast and per-record paths
+    is part of the contract (consumers resume by offset)."""
     out = []
     for b in result.records.batches:
         ts = b.header.first_timestamp
         for rec in b.memory_records():
-            out.append((rec.value, rec.key, ts + rec.timestamp_delta))
+            out.append(
+                (rec.value, rec.key, ts + rec.timestamp_delta,
+                 b.base_offset + rec.offset_delta)
+            )
     return out
 
 
@@ -233,7 +238,7 @@ class TestPipelinedProcessBatches:
             batches.append(Batch.decode(r, parse_records=False))
         tpu_chain = _chain("tpu", ("regex-filter", {"regex": "fluvio"}))
         fast = _tpu_process_batches(tpu_chain, batches, 10**9)
-        assert [t for _, _, t in _flat_records(fast)] == [1005, 2009]
+        assert [t for _, _, t, _ in _flat_records(fast)] == [1005, 2009]
 
     def test_falls_back_without_tpu_chain(self):
         py_chain = _chain("python", ("regex-filter", {"regex": "x"}))
