@@ -1,17 +1,24 @@
-"""North-star benchmark: regex-filter + json-map chain records/sec.
+"""North-star benchmark: SmartModule chain records/sec on the real chip.
 
-Runs the fused TPU SmartModule chain (BASELINE.md config #1+#2: regex
-filter then JSON field map) over 1M-record batches on the real chip and
-prints ONE JSON line:
+Runs ALL FIVE BASELINE.json configs over 1M-record batches:
 
-    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+  1. regex-filter                      (filter only)
+  2. regex-filter + json-map           (THE headline north-star chain)
+  3. aggregate (general form: sum over a JSON field via the monoid path)
+  4. array_map JSON-array explode
+  5. stateful windowed aggregate
 
-``vs_baseline`` is measured against this repo's per-record reference
-engine (the wasmtime-equivalent semantics backend) executing the same
-chain on the host CPU — the reference's own engine cannot run here (no
-Rust toolchain in the image; see BASELINE.md). Environment knobs:
-``BENCH_SMOKE=1`` shrinks shapes for a fast correctness pass;
-``BENCH_RECORDS=<n>`` overrides the batch size.
+and prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline",
+"configs"}`` where value/vs_baseline are the headline config #2 numbers
+and ``configs`` carries every config's records/sec + ratio.
+
+``vs_baseline`` is measured against this repo's native (C++) per-record
+engine executing the same chain on the host CPU from the wire-encoded
+slab — the reference's own wasmtime engine cannot run here (no Rust
+toolchain in the image; see BASELINE.md), and the compiled per-record
+loop is its execution model. Environment knobs: ``BENCH_SMOKE=1``
+shrinks shapes for a fast correctness pass; ``BENCH_RECORDS=<n>``
+overrides the batch size; ``BENCH_CONFIGS=2,4`` restricts the configs.
 """
 
 from __future__ import annotations
@@ -29,31 +36,21 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def build_chain(backend: str):
+def build_chain(backend: str, specs):
     from fluvio_tpu.models import lookup
     from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
 
     b = SmartEngine(backend=backend).builder()
-    b.add_smart_module(
-        SmartModuleConfig(params={"regex": "fluvio"}), lookup("regex-filter")
-    )
-    b.add_smart_module(SmartModuleConfig(params={"field": "name"}), lookup("json-map"))
+    for name, params in specs:
+        b.add_smart_module(SmartModuleConfig(params=params or {}), lookup(name))
     return b.initialize()
 
 
-def generate(n: int):
-    """1M-record corpus: ~half the names match the regex."""
+def _pack(values, ts=None):
+    """values -> RecordBuffer via one vectorized ragged copy."""
     from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
 
-    rng = np.random.default_rng(2024)
-    names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "redpanda", "flink"]
-    picks = rng.integers(0, len(names), size=n)
-    nums = rng.integers(0, 100000, size=n)
-    log(f"generating {n} records ...")
-    values = [
-        f'{{"name":"{names[picks[i]]}-{i & 1023}","n":{nums[i]}}}'.encode()
-        for i in range(n)
-    ]
+    n = len(values)
     widths = max(len(v) for v in values)
     width = 32
     while width < widths:
@@ -66,60 +63,117 @@ def generate(n: int):
     flat = np.frombuffer(b"".join(values), dtype=np.uint8)
     lens = np.array([len(v) for v in values], dtype=np.int32)
     starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
-    # ragged copy: one fancy-index assignment
     dst_rows = np.repeat(np.arange(n), lens)
     dst_cols = np.arange(flat.size) - np.repeat(starts, lens)
     arr[dst_rows, dst_cols] = flat
     lengths[:n] = lens
     buf = RecordBuffer.from_arrays(arr, lengths, count=n)
     buf.offset_deltas = np.arange(rows, dtype=np.int32)
-    return buf, values
+    if ts is not None:
+        tcol = np.zeros(rows, dtype=np.int64)
+        tcol[:n] = ts
+        buf.timestamp_deltas = tcol
+        buf.base_timestamp = 1_000_000
+    return buf
 
 
-def bench_tpu(buf, runs: int, passes: int = 3) -> tuple:
+def gen_json(n: int):
+    """JSON corpus: ~half the names match the regex (configs 1/2/3)."""
+    rng = np.random.default_rng(2024)
+    names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "redpanda", "flink"]
+    picks = rng.integers(0, len(names), size=n)
+    nums = rng.integers(0, 100000, size=n)
+    return [
+        f'{{"name":"{names[picks[i]]}-{i & 1023}","n":{nums[i]}}}'.encode()
+        for i in range(n)
+    ]
+
+
+def gen_arrays(n: int):
+    """JSON-array corpus, ~6 elements per record (config #4)."""
+    rng = np.random.default_rng(7)
+    nums = rng.integers(0, 10000, size=(n, 3))
+    return [
+        f'["a{i & 255}","b{nums[i][0]}",{nums[i][1]},{nums[i][2]},"x","y"]'.encode()
+        for i in range(n)
+    ]
+
+
+def gen_ints(n: int):
+    rng = np.random.default_rng(11)
+    nums = rng.integers(0, 1000, size=n)
+    return [str(nums[i]).encode() for i in range(n)]
+
+
+CONFIGS = {
+    "1_filter": {
+        "specs": [("regex-filter", {"regex": "fluvio"})],
+        "corpus": gen_json,
+    },
+    "2_filter_map": {
+        "specs": [
+            ("regex-filter", {"regex": "fluvio"}),
+            ("json-map", {"field": "name"}),
+        ],
+        "corpus": gen_json,
+    },
+    "3_aggregate": {
+        "specs": [("aggregate-field", {"field": "n", "combine": "add"})],
+        "corpus": gen_json,
+    },
+    "4_array_map": {
+        "specs": [("array-map-json", None)],
+        "corpus": gen_arrays,
+    },
+    "5_windowed": {
+        "specs": [("windowed-sum", {"kind": "sum_int", "window_ms": "1000"})],
+        "corpus": gen_ints,
+        "ts": lambda n: (np.arange(n, dtype=np.int64) * 7919) % 60_000,
+    },
+}
+
+
+def bench_tpu(chain, buf, runs: int, passes: int) -> tuple:
     import jax
 
-    chain = build_chain("tpu")
-    assert chain.backend_in_use == "tpu"
     executor = chain.tpu_chain
-    log("compiling + warmup ...")
     t0 = time.time()
     out = executor.process_buffer(buf)
-    log(f"first call (compile): {time.time()-t0:.2f}s; {out.count} records out")
+    log(f"  first call (compile): {time.time()-t0:.2f}s; {out.count} records out")
     # split: dispatch covers H2D + device compute; a full call adds the
     # descriptor D2H + host materialization. Attribution matters because
-    # the tunnel's D2H (~25 MB/s) is 30x slower than its H2D.
+    # the tunnel's D2H (~25 MB/s) is ~30x slower than its H2D.
     t0 = time.time()
-    header, packed = executor._dispatch(buf)
+    header, packed = executor._dispatch(buf, fanout_cap=executor._fanout_cap(buf))
     jax.block_until_ready((header, packed))
     dispatch = time.time() - t0
     t0 = time.time()
     out = executor.process_buffer(buf)
     single = time.time() - t0
     log(
-        f"single-batch: {single*1000:.0f}ms "
+        f"  single-batch {single*1000:.0f}ms "
         f"(dispatch H2D+compute {dispatch*1000:.0f}ms, "
         f"fetch D2H+materialize {max(single-dispatch,0)*1000:.0f}ms)"
     )
-    # sustained pipelined throughput (the consume-stream shape), several
-    # passes: the tunnel's bandwidth wanders, so report every pass and
-    # take the median across passes rather than trusting one number
+    # sustained pipelined throughput over several passes: the tunnel's
+    # bandwidth wanders, so report every pass and take the median across
+    # passes rather than trusting one number
     times = []
     for p in range(passes):
         t0 = time.time()
         for out in executor.process_stream(iter([buf] * runs)):
             pass
         times.append((time.time() - t0) / runs)
-        log(f"pass {p}: pipelined {times[-1]*1000:.0f}ms/batch")
+        log(f"  pass {p}: pipelined {times[-1]*1000:.0f}ms/batch")
     return out, times
 
 
-def bench_host_baseline(values, base_n: int, backend: str) -> float:
+def bench_host_baseline(specs, values, base_n: int, backend: str) -> float:
     """Per-record engine on a subset; returns records/sec.
 
     ``native`` is the honest wasmtime proxy (compiled C++ per-record
-    loops, the reference engine's execution model); ``python`` is the
-    interpreted floor.
+    loops from the wire-encoded slab, the reference engine's execution
+    model); ``python`` is the interpreted floor.
     """
     from fluvio_tpu.protocol.record import Record
     from fluvio_tpu.smartmodule import SmartModuleInput
@@ -127,24 +181,21 @@ def bench_host_baseline(values, base_n: int, backend: str) -> float:
     from fluvio_tpu.smartengine.engine import EngineError
 
     try:
-        chain = build_chain(backend)
+        chain = build_chain(backend, specs)
     except EngineError:
-        return 0.0  # e.g. no C++ toolchain for the native engine
+        return 0.0
     if backend == "native" and chain.backend_in_use != "native":
         return 0.0
     records = [Record(value=v) for v in values[:base_n]]
     for i, r in enumerate(records):
         r.offset_delta = i
     if backend == "native":
-        # wire-encoded slab: decode + transform run in compiled code,
-        # exactly the wasmtime-guest execution model (encode untimed,
-        # as the broker hands the engine already-encoded batches)
         from fluvio_tpu.protocol.codec import ByteWriter
 
         w = ByteWriter()
         for r in records:
             r.encode(w)
-        inp = SmartModuleInput(base_offset=0, raw_bytes=w.bytes())
+        inp = SmartModuleInput.from_raw(w.bytes(), base_n)
     else:
         inp = SmartModuleInput.from_records(records)
     t0 = time.time()
@@ -154,60 +205,93 @@ def bench_host_baseline(values, base_n: int, backend: str) -> float:
     return base_n / dt
 
 
-def verify_outputs(out_buf, values, check_n: int) -> None:
-    """Spot-check TPU outputs equal the reference engine's."""
+def verify_outputs(specs, values, ts, check_n: int) -> None:
+    """Fresh-chain spot-check: TPU outputs equal the reference engine's
+    (fresh chains on both sides so stateful accumulators start equal)."""
     from fluvio_tpu.protocol.record import Record
     from fluvio_tpu.smartmodule import SmartModuleInput
 
-    chain = build_chain("python")
-    records = [Record(value=v) for v in values[:check_n]]
-    for i, r in enumerate(records):
-        r.offset_delta = i
-    ref = chain.process(SmartModuleInput.from_records(records))
-    ref_values = [r.value for r in ref.successes]
-    got_values = []
-    i = 0
-    while len(got_values) < len(ref_values) and i < out_buf.count:
-        if out_buf.offset_deltas[i] < check_n:
-            got_values.append(
-                out_buf.values[i, : out_buf.lengths[i]].tobytes()
-            )
-        i += 1
-    assert got_values == ref_values, "TPU output diverged from reference engine"
-    log(f"verified first {len(ref_values)} outputs byte-equal to reference")
+    def run(backend):
+        chain = build_chain(backend, specs)
+        records = [Record(value=v) for v in values[:check_n]]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+            if ts is not None:
+                r.timestamp_delta = int(ts[i])
+        out = chain.process(
+            SmartModuleInput.from_records(records, 0, 1_000_000)
+        )
+        assert out.error is None
+        return [(r.value, r.key, r.offset_delta) for r in out.successes]
+
+    got, ref = run("tpu"), run("python")
+    assert got == ref, "TPU output diverged from reference engine"
+    log(f"  verified {len(ref)} outputs byte-equal to reference")
+
+
+def run_config(name: str, cfg: dict, n: int, smoke: bool) -> dict:
+    headline = name == "2_filter_map"
+    runs = (3 if smoke else 5) if headline else (2 if smoke else 3)
+    passes = 3 if headline else 2
+    base_n = min(n, 2000 if smoke else 20000)
+
+    log(f"[{name}] generating {n} records ...")
+    values = cfg["corpus"](n)
+    ts = cfg["ts"](n) if "ts" in cfg else None
+    buf = _pack(values, ts)
+
+    verify_outputs(cfg["specs"], values, ts, min(n, 512))
+    chain = build_chain("tpu", cfg["specs"])
+    assert chain.backend_in_use == "tpu", name
+    out, times = bench_tpu(chain, buf, runs, passes)
+
+    t_med = statistics.median(times)
+    tpu_rps = n / t_med
+    log(f"  tpu: {[f'{t*1000:.0f}ms' for t in times]} -> {tpu_rps:,.0f} records/s")
+
+    native_rps = bench_host_baseline(
+        cfg["specs"], values, min(n, base_n * 10), "native"
+    )
+    py_rps = 0.0
+    if not native_rps:
+        py_rps = bench_host_baseline(cfg["specs"], values, base_n, "python")
+    base_rps = native_rps or py_rps
+    log(
+        f"  {'native C++' if native_rps else 'python'} baseline: "
+        f"{base_rps:,.0f} records/s"
+    )
+    return {
+        "records_per_sec": round(tpu_rps),
+        "baseline_records_per_sec": round(base_rps),
+        "vs_baseline": round(tpu_rps / base_rps, 2) if base_rps else None,
+        "pass_ms": [round(t * 1000) for t in times],
+    }
 
 
 def main() -> None:
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     n = int(os.environ.get("BENCH_RECORDS", "20000" if smoke else "1000000"))
-    runs = 3 if smoke else 5
-    base_n = min(n, 2000 if smoke else 20000)
+    only = os.environ.get("BENCH_CONFIGS")
+    wanted = set(only.split(",")) if only else None
 
-    buf, values = generate(n)
-    out, times = bench_tpu(buf, runs)
-    verify_outputs(out, values, min(n, 512))
+    results = {}
+    for name, cfg in CONFIGS.items():
+        if wanted and name.split("_")[0] not in wanted and name not in wanted:
+            continue
+        results[name] = run_config(name, cfg, n, smoke)
 
-    t_med = statistics.median(times)
-    tpu_rps = n / t_med
-    log(f"tpu: {[f'{t*1000:.1f}ms' for t in times]} -> {tpu_rps:,.0f} records/s")
-
-    py_rps = bench_host_baseline(values, base_n, "python")
-    log(f"python engine baseline: {py_rps:,.0f} records/s ({base_n} records)")
-    native_rps = bench_host_baseline(values, min(n, base_n * 10), "native")
-    if native_rps:
-        log(
-            f"native (C++) engine baseline: {native_rps:,.0f} records/s "
-            f"(wasmtime-proxy denominator)"
-        )
-    base_rps = native_rps or py_rps
-
+    if not results:
+        log(f"no configs matched BENCH_CONFIGS={only!r}; known: {list(CONFIGS)}")
+        sys.exit(2)
+    headline = results.get("2_filter_map") or next(iter(results.values()))
     print(
         json.dumps(
             {
                 "metric": "smartmodule_chain_records_per_sec",
-                "value": round(tpu_rps),
+                "value": headline["records_per_sec"],
                 "unit": "records/s",
-                "vs_baseline": round(tpu_rps / base_rps, 2),
+                "vs_baseline": headline["vs_baseline"],
+                "configs": results,
             }
         )
     )

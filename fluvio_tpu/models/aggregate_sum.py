@@ -23,6 +23,27 @@ def _make(kind: str):
     return factory
 
 
+def _field_module() -> SmartModuleDef:
+    """General-form aggregate: reduce a JSON field with a chosen monoid.
+
+    ``field`` selects the top-level JSON field, ``combine`` the monoid
+    (add/max/min), ``window_ms`` an optional tumbling window — e.g.
+    max-by-price: ``params={"field": "price", "combine": "max"}``. This
+    is the reference's arbitrary user aggregate (aggregate.rs:22-101)
+    expressed as (contribution expr, associative combine), which is what
+    lets it lower to the TPU segmented scan instead of a per-record loop.
+    """
+    m = SmartModuleDef(name="aggregate-field")
+    m.dsl[SmartModuleKind.AGGREGATE] = dsl.AggregateProgram(
+        contribution=dsl.ParseInt(
+            arg=dsl.JsonGet(arg=dsl.Value(), key="@param:field=n")
+        ),
+        combine="@param:combine=add",
+        window_ms="@param:window_ms=0",
+    )
+    return m
+
+
 module = _make("sum_int")
 
 register("aggregate-sum", _make("sum_int"))
@@ -30,3 +51,4 @@ register("aggregate-count", _make("count"))
 register("word-count", _make("word_count"))
 register("aggregate-max", _make("max_int"))
 register("aggregate-min", _make("min_int"))
+register("aggregate-field", _field_module)

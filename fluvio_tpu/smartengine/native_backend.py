@@ -371,7 +371,22 @@ def lower_chain(entries: List[Tuple]) -> str:
         elif isinstance(program, dsl.AggregateProgram):
             window = program.window_ms if program.window_ms else -1
             seed = (config.initial_data or b"").hex()
-            lines.append(f"STEP AGGREGATE {program.kind} {window} {seed or '00'[:0]}")
+            if program.contribution is not None:
+                if program.combine not in dsl.AGGREGATE_COMBINES:
+                    raise LoweringError(
+                        f"aggregate combine {program.combine!r}"
+                    )
+                contrib: List[str] = []
+                _lower_expr(program.contribution, contrib)
+                lines.append(
+                    f"STEP AGGREGATE_EXPR {program.combine} {window} "
+                    f"{seed or '-'} {len(contrib)}"
+                )
+                lines.extend(contrib)
+            else:
+                lines.append(
+                    f"STEP AGGREGATE {program.kind} {window} {seed or '00'[:0]}"
+                )
         else:
             raise LoweringError(
                 f"cannot lower program {type(program).__name__} natively"

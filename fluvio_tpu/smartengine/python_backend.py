@@ -240,25 +240,42 @@ class PythonInstance:
     ) -> None:
         kind = program.kind
 
-        def init_acc() -> int:
-            if kind == "max_int":
-                return -(2**63)
-            if kind == "min_int":
-                return 2**63 - 1
-            return 0
+        if program.contribution is not None:
+            combine = program.combine
+            if combine not in dsl.AGGREGATE_COMBINES:
+                raise ValueError(f"unknown aggregate combine {combine!r}")
+            neutral = dsl.AGGREGATE_COMBINE_NEUTRAL[combine]
+            ops = {"add": lambda a, x: a + x, "max": max, "min": min}
+            comb = ops[combine]
 
-        def step(acc: int, rec: SmartModuleRecord) -> int:
-            if kind == "sum_int":
-                return acc + dsl.parse_int_prefix(rec.value)
-            if kind == "count":
-                return acc + 1
-            if kind == "word_count":
-                return acc + dsl.count_words(rec.value)
-            if kind == "max_int":
-                return max(acc, dsl.parse_int_prefix(rec.value))
-            if kind == "min_int":
-                return min(acc, dsl.parse_int_prefix(rec.value))
-            raise ValueError(f"unknown aggregate kind {kind!r}")
+            def init_acc() -> int:
+                return neutral
+
+            def step(acc: int, rec: SmartModuleRecord) -> int:
+                x = dsl.eval_expr(program.contribution, rec.value, rec.key)
+                return comb(acc, int(x))
+
+        else:
+
+            def init_acc() -> int:
+                if kind == "max_int":
+                    return -(2**63)
+                if kind == "min_int":
+                    return 2**63 - 1
+                return 0
+
+            def step(acc: int, rec: SmartModuleRecord) -> int:
+                if kind == "sum_int":
+                    return acc + dsl.parse_int_prefix(rec.value)
+                if kind == "count":
+                    return acc + 1
+                if kind == "word_count":
+                    return acc + dsl.count_words(rec.value)
+                if kind == "max_int":
+                    return max(acc, dsl.parse_int_prefix(rec.value))
+                if kind == "min_int":
+                    return min(acc, dsl.parse_int_prefix(rec.value))
+                raise ValueError(f"unknown aggregate kind {kind!r}")
 
         acc = dsl.parse_int_prefix(self.accumulator) if self.accumulator else init_acc()
         for rec in sm_records:

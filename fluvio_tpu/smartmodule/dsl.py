@@ -412,13 +412,30 @@ class ArrayMapProgram(Expr):
 
 
 AGGREGATE_KINDS = ("sum_int", "count", "word_count", "max_int", "min_int")
+AGGREGATE_COMBINES = ("add", "max", "min")  # associative monoids
+AGGREGATE_COMBINE_NEUTRAL = {"add": 0, "max": -(2**63), "min": 2**63 - 1}
 
 
 @_node
 @dataclass
 class AggregateProgram(Expr):
+    """Stateful reduction (ref transforms/aggregate.rs:22-101).
+
+    Two authoring forms:
+
+    - canned ``kind`` (the 5 classic reductions), or
+    - a user ``contribution`` int expression over the record combined
+      into the accumulator by an associative ``combine`` monoid —
+      e.g. max-by-json-field: ``contribution=ParseInt(JsonGet(Value(),
+      "price")), combine="max"``. Associativity is what lets every
+      backend (interpreter, native, TPU segmented scan) share exact
+      semantics; the canned kinds are just prebuilt instances.
+    """
+
     kind: str = "sum_int"
     window_ms: Optional[int] = None  # windowed materialized view when set
+    contribution: Optional[Expr] = None  # int expr over the record
+    combine: Optional[str] = None  # one of AGGREGATE_COMBINES
 
 
 # ---------------------------------------------------------------------------

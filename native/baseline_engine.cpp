@@ -231,8 +231,11 @@ struct Step {
     // array_map
     bool json_array_mode = true;
     std::string sep;
-    // aggregate
+    // aggregate: agg_kind is a canned kind, or (has_contrib) the
+    // combine monoid applied to the per-record contribution program
     std::string agg_kind;
+    bool has_contrib = false;
+    Program contrib;
     int64_t window_ms = -1;
     int64_t acc = 0;
     bool window_started = false;
@@ -449,9 +452,15 @@ struct Rec {
 };
 
 int64_t agg_init(const std::string& kind) {
-    if (kind == "max_int") return INT64_MIN;
-    if (kind == "min_int") return INT64_MAX;
+    if (kind == "max_int" || kind == "max") return INT64_MIN;
+    if (kind == "min_int" || kind == "min") return INT64_MAX;
     return 0;
+}
+
+int64_t agg_combine(const std::string& op, int64_t acc, int64_t x) {
+    if (op == "max") return x > acc ? x : acc;
+    if (op == "min") return x < acc ? x : acc;
+    return acc + x;  // add
 }
 
 int64_t agg_step(const std::string& kind, int64_t acc, const Rec& r) {
@@ -542,7 +551,13 @@ int64_t run_step(Chain& chain, Step& step, std::vector<Rec>& recs,
                         step.acc = agg_init(step.agg_kind);
                     }
                 }
-                step.acc = agg_step(step.agg_kind, step.acc, r);
+                if (step.has_contrib) {
+                    Val v = eval_program(chain, step.contrib, r.value,
+                                         r.has_key ? &r.key : nullptr);
+                    step.acc = agg_combine(step.agg_kind, step.acc, as_int(v));
+                } else {
+                    step.acc = agg_step(step.agg_kind, step.acc, r);
+                }
                 r.value = std::to_string(step.acc);
                 out.push_back(std::move(r));
             }
@@ -609,6 +624,16 @@ void* chain_create(const char* spec, char* err_buf, int err_len) {
             step.kind = StepKind::AGGREGATE;
             std::string acc_hex;
             ls >> step.agg_kind >> step.window_ms >> acc_hex;
+            std::string seed = from_hex(acc_hex);
+            step.acc = seed.empty() ? agg_init(step.agg_kind) : parse_int_prefix(seed);
+        } else if (kind == "AGGREGATE_EXPR") {
+            step.kind = StepKind::AGGREGATE;
+            step.has_contrib = true;
+            std::string acc_hex;
+            int n_contrib = 0;
+            ls >> step.agg_kind >> step.window_ms >> acc_hex >> n_contrib;
+            if (acc_hex == "-") acc_hex.clear();
+            if (n_contrib && !parse_program(in, n_contrib, *chain, step.contrib)) { ok = false; break; }
             std::string seed = from_hex(acc_hex);
             step.acc = seed.empty() ? agg_init(step.agg_kind) : parse_int_prefix(seed);
         } else {
