@@ -149,6 +149,14 @@ class PartitionConsumer:
             )
             if start >= end_at:
                 return
+        # ``position`` tracks the consume cursor: records below it were
+        # already delivered (a broker resuming mid-batch re-serves from
+        # the batch start — reference consumers skip client-side). Equal
+        # offsets are NOT skipped: array_map fan-out legitimately emits
+        # several records at one source offset, and a record at the
+        # cursor itself was never delivered (the cursor is the broker's
+        # next_filter_offset, one past the last served record).
+        position = start
         async for batch in self.stream_batches(
             offset, config, start=start, end_at=end_at
         ):
@@ -156,8 +164,8 @@ class PartitionConsumer:
             ts = batch.header.first_timestamp
             for rec in batch.memory_records():
                 abs_offset = base + rec.offset_delta
-                if abs_offset < start:
-                    continue  # skip records before the requested offset
+                if abs_offset < position:
+                    continue  # already delivered (or before the start)
                 yield ConsumerRecord(
                     partition=self.partition,
                     offset=abs_offset,
@@ -167,3 +175,4 @@ class PartitionConsumer:
                     key=rec.key,
                     value=rec.value,
                 )
+            position = max(position, batch.computed_last_offset())

@@ -850,8 +850,25 @@ class TpuChainExecutor:
         # 25% headroom over the observed density
         self._cap_ratio = max(self._cap_ratio, 1.25 * total / rows)
 
-    def process_buffer(self, buf: RecordBuffer) -> RecordBuffer:
-        """Array-in/array-out path (bench + broker stream path).
+    def dispatch_buffer(self, buf: RecordBuffer):
+        """Phase 1: stage + dispatch without blocking on results.
+
+        JAX dispatch is async, so the H2D transfer and device compute
+        proceed in the background; the returned handle feeds
+        `finish_buffer`. The broker's pipelined stream loop dispatches
+        slice k+1 here while slice k's results download and hit the
+        socket.
+        """
+        prev_carries = self._device_carries
+        header, packed = self._dispatch(buf, fanout_cap=self._fanout_cap(buf))
+        return (prev_carries, header, packed)
+
+    def discard_dispatch(self, handle) -> None:
+        """Drop a speculative dispatch, restoring pre-dispatch carries."""
+        self._device_carries = handle[0]
+
+    def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
+        """Phase 2: block on results and materialize the output buffer.
 
         Fan-out chains run with a learned capacity; a batch whose exact
         element total exceeds it retries once at the (bucketed) exact
@@ -860,9 +877,8 @@ class TpuChainExecutor:
         `TpuSpill` (carries restored) for the interpreter to re-run with
         exact error semantics.
         """
-        prev_carries = self._device_carries
+        prev_carries, header, packed = handle
         try:
-            header, packed = self._dispatch(buf, fanout_cap=self._fanout_cap(buf))
             return self._fetch(buf, header, packed)
         except _FanoutOverflow as o:
             self._learn_cap(buf, o.total)
@@ -877,6 +893,10 @@ class TpuChainExecutor:
         except TpuSpill:
             self._device_carries = prev_carries
             raise
+
+    def process_buffer(self, buf: RecordBuffer) -> RecordBuffer:
+        """Array-in/array-out path (bench + broker stream path)."""
+        return self.finish_buffer(buf, self.dispatch_buffer(buf))
 
     def process_stream(self, bufs):
         """Pipelined generator: batch k+1 dispatches while k downloads.

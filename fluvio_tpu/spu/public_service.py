@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import weakref
 from typing import Dict, Optional
 
 from fluvio_tpu.protocol.api import (
@@ -45,12 +46,17 @@ from fluvio_tpu.spu.context import GlobalContext
 from fluvio_tpu.spu.replica import LeaderReplicaState
 from fluvio_tpu.spu.smart_chain import (
     BatchProcessResult,
+    PendingSlice,
     SmartModuleResolutionError,
     apply_chain,
     build_chain,
     chain_look_back,
     ensure_dedup_chain,
     process_batches,
+    process_batches_per_record,
+    tpu_finish,
+    tpu_pipelinable,
+    tpu_stage_dispatch,
 )
 from fluvio_tpu.smartengine.engine import EngineError, SmartModuleChainInitError
 from fluvio_tpu.smartmodule.types import SmartModuleInput
@@ -331,6 +337,42 @@ def start_stream_fetch(
     task.add_done_callback(_cleanup)
 
 
+_warmed_chains: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _schedule_chain_warmup(chain) -> None:
+    """Compile the chain's jit machinery off the hot path.
+
+    First-touch XLA compilation stalls the first consume by tens of
+    seconds; warming a tiny buffer at chain attach populates the jit
+    dispatch path and the persistent compile cache concurrently with the
+    stream's initial offset wait (the first real shape bucket may still
+    compile, but the fixed per-chain costs are paid early). Stateful
+    chains are skipped: a warmup record would race the device carries.
+    """
+    tpu = getattr(chain, "tpu_chain", None)
+    if tpu is None or tpu.agg_configs or chain in _warmed_chains:
+        return
+    _warmed_chains.add(chain)
+
+    def _warm() -> None:
+        try:
+            from fluvio_tpu.protocol.record import Record
+            from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+
+            records = [Record(value=b"[1]"), Record(value=b"[2]")]
+            for i, r in enumerate(records):
+                r.offset_delta = i
+            tpu.process_buffer(RecordBuffer.from_records(records))
+        except Exception:  # noqa: BLE001 — warmup is best-effort
+            logger.debug("chain warmup failed", exc_info=True)
+
+    try:
+        asyncio.get_running_loop().run_in_executor(None, _warm)
+    except RuntimeError:  # no loop (sync callers): warm inline
+        _warm()
+
+
 class StreamFetchHandler:
     """One push stream: select loop over data / acks / end.
 
@@ -401,6 +443,9 @@ class StreamFetchHandler:
                 )
                 return
 
+        if chain is not None:
+            _schedule_chain_warmup(chain)
+
         # clamp the starting offset into the valid window (stream_fetch.rs
         # resolves the requested offset against [start, bound])
         info = leader.offsets()
@@ -409,6 +454,9 @@ class StreamFetchHandler:
 
         end_wait = asyncio.ensure_future(self.conn.end.wait())
         try:
+            if chain is not None and tpu_pipelinable(chain):
+                await self._run_pipelined(leader, chain, end_wait, current)
+                return
             while not self.conn.end.is_set() and not self._ended:
                 bound = leader.read_bound(req.isolation)
                 if current < bound:
@@ -432,6 +480,122 @@ class StreamFetchHandler:
                     return
         finally:
             end_wait.cancel()
+
+    async def _run_pipelined(self, leader, chain, end_wait, current: int) -> None:
+        """Dispatch-ahead stream loop for stateless TPU chains.
+
+        Slice k+1 is read, staged, and dispatched (JAX dispatch is async:
+        H2D + device compute proceed in the background) BEFORE slice k's
+        results are downloaded, encoded, and pushed — so the device works
+        under the socket send and the consumer's ack wait instead of
+        after them. Speculation is safe because `tpu_pipelinable` chains
+        carry no device state to roll back; a max_bytes truncation (the
+        consume point moved) just discards the speculative dispatch.
+        """
+        req = self.req
+        pending: Optional[PendingSlice] = None
+        while not self.conn.end.is_set() and not self._ended:
+            planned = pending.planned_next if pending is not None else current
+            nxt: Optional[PendingSlice] = None
+            nxt_batches = None
+            read_from = planned
+            if planned < leader.read_bound(req.isolation):
+                try:
+                    rslice = leader.read_records(
+                        planned, req.max_bytes, req.isolation
+                    )
+                except FluvioError as e:
+                    info = leader.offsets()
+                    await self._send_error(
+                        e.code, hw=info.hw, log_start=info.start_offset
+                    )
+                    return
+                if rslice.file_slice is not None and rslice.next_offset is not None:
+                    nxt_batches = rslice.decode_batches(parse_records=False)
+                    nxt = tpu_stage_dispatch(
+                        chain, nxt_batches, self.metrics, start_offset=planned
+                    )
+
+            if pending is not None:
+                result = tpu_finish(chain, pending, req.max_bytes, self.metrics)
+                if result is None:
+                    # rare decline: rerun this slice on the per-record path
+                    # (directly — re-entering process_batches would
+                    # re-dispatch the failed slice and double-count)
+                    result = process_batches_per_record(
+                        chain, pending.batches, req.max_bytes, self.metrics
+                    )
+                sent_next = await self._push_processed(leader, result)
+                if self._ended:
+                    return
+                truncated = sent_next != pending.planned_next
+                pending = None
+                if truncated and nxt is not None:
+                    # the speculative slice read from the wrong offset
+                    chain.tpu_chain.discard_dispatch(nxt.handle)
+                    nxt = None
+                    nxt_batches = None
+                await self._wait_for_ack(sent_next, end_wait)
+                current = sent_next
+                if truncated:
+                    continue
+
+            if nxt is not None:
+                pending = nxt
+                continue
+            if nxt_batches is not None:
+                # staging declined this slice: serial per-record path
+                result = process_batches(
+                    chain, nxt_batches, req.max_bytes, self.metrics,
+                    start_offset=read_from,
+                )
+                sent_next = await self._push_processed(leader, result)
+                if self._ended:
+                    return
+                sent_next = max(sent_next, read_from)
+                if sent_next > current:
+                    await self._wait_for_ack(sent_next, end_wait)
+                    current = sent_next
+                continue
+
+            # no pending, no data: wait for the log to advance
+            listener = leader.offset_publisher(req.isolation).change_listener()
+            if leader.read_bound(req.isolation) > current:
+                continue
+            listen = asyncio.ensure_future(listener.listen())
+            done, _ = await asyncio.wait(
+                [listen, end_wait], return_when=asyncio.FIRST_COMPLETED
+            )
+            if end_wait in done:
+                listen.cancel()
+                return
+
+    async def _push_processed(self, leader, result: BatchProcessResult) -> int:
+        """Send one processed-slice response; returns the next offset."""
+        info = leader.offsets()
+        partition = FetchablePartitionResponse(
+            partition_index=self.req.partition,
+            high_watermark=info.hw,
+            log_start_offset=info.start_offset,
+            next_filter_offset=result.next_offset,
+            records=result.records,
+        )
+        if result.error is not None:
+            partition.error_code = ErrorCode.SMARTMODULE_RUNTIME_ERROR
+            partition.error_message = str(result.error)
+            self._ended = True  # reference ends the stream on transform error
+        resp = StreamFetchResponse(
+            topic=self.req.topic,
+            partition_index=self.req.partition,
+            stream_id=self.stream_id,
+            partition=partition,
+        )
+        await self.sink.send_response(
+            ResponseMessage(self.correlation_id, resp), self.version
+        )
+        nbytes = sum(b.write_size() for b in result.records.batches)
+        self.ctx.metrics.outbound.add(result.records.total_records(), nbytes)
+        return result.next_offset
 
     async def _wait_for_ack(self, target: int, end_wait: asyncio.Future) -> None:
         """Backpressure: hold the next push until the consumer acks."""
@@ -488,31 +652,10 @@ class StreamFetchHandler:
         # columnar buffers natively; the per-record path parses on demand.
         batches = rslice.decode_batches(parse_records=False)
         result: BatchProcessResult = process_batches(
-            chain, batches, req.max_bytes, self.metrics
+            chain, batches, req.max_bytes, self.metrics, start_offset=offset
         )
-        partition = FetchablePartitionResponse(
-            partition_index=req.partition,
-            high_watermark=info.hw,
-            log_start_offset=info.start_offset,
-            next_filter_offset=result.next_offset,
-            records=result.records,
-        )
-        if result.error is not None:
-            partition.error_code = ErrorCode.SMARTMODULE_RUNTIME_ERROR
-            partition.error_message = str(result.error)
-            self._ended = True  # reference ends the stream on transform error
-        resp = StreamFetchResponse(
-            topic=req.topic,
-            partition_index=req.partition,
-            stream_id=self.stream_id,
-            partition=partition,
-        )
-        await self.sink.send_response(
-            ResponseMessage(self.correlation_id, resp), self.version
-        )
-        nbytes = sum(b.write_size() for b in result.records.batches)
-        self.ctx.metrics.outbound.add(result.records.total_records(), nbytes)
-        return max(result.next_offset, offset)
+        sent_next = await self._push_processed(leader, result)
+        return max(sent_next, offset)
 
     async def _send_error(
         self,

@@ -31,11 +31,14 @@ def _varint_sizes(x: np.ndarray) -> np.ndarray:
     return nb
 
 
-def _encoded_record_sizes(outbuf, deltas: np.ndarray, ts: np.ndarray) -> np.ndarray:
-    """Per-record wire sizes (parity: protocol.record.Record.write_size)."""
+def _encoded_record_sizes_at(
+    outbuf, drop: int, deltas: np.ndarray, ts: np.ndarray
+) -> np.ndarray:
+    """Per-record wire sizes (parity: protocol.record.Record.write_size)
+    for output rows [drop, drop+len(deltas))."""
     n = len(deltas)
-    vlens = outbuf.lengths[:n].astype(np.int64)
-    klens_raw = outbuf.key_lengths[:n].astype(np.int64)
+    vlens = outbuf.lengths[drop : drop + n].astype(np.int64)
+    klens_raw = outbuf.key_lengths[drop : drop + n].astype(np.int64)
     has_key = klens_raw >= 0
     klens = np.maximum(klens_raw, 0)
     inner = (
@@ -239,35 +242,52 @@ class BatchProcessResult:
     error: Optional[SmartModuleTransformRuntimeError] = None
 
 
-def _tpu_process_batches(
+@dataclass
+class PendingSlice:
+    """A read slice staged + dispatched to the device, results pending."""
+
+    batches: List[Batch]
+    buf: object  # RecordBuffer
+    handle: object  # executor dispatch handle
+    planned_next: int  # next offset assuming no max_bytes truncation
+    total_raw: int
+    base0: int
+    ts0: int
+    read_from: Optional[int] = None  # consume cursor (drop outputs below)
+
+
+def _decline(metrics, reason: str):
+    if metrics is not None:
+        metrics.add_fallback(reason)
+    return None
+
+
+def tpu_pipelinable(chain) -> bool:
+    """Safe for speculative dispatch-ahead: stateless, row-preserving
+    chains only (no carries to roll back when a speculative slice is
+    discarded, no fan-out overflow retries)."""
+    tpu = getattr(chain, "tpu_chain", None)
+    return tpu is not None and not tpu.agg_configs and not tpu._fanout
+
+
+def tpu_stage_dispatch(
     chain: SmartModuleChainInstance,
     batches: List[Batch],
-    max_bytes: int,
     metrics=None,
-) -> Optional[BatchProcessResult]:
-    """Coalesced TPU fast path for the stream-fetch hot loop.
+    start_offset: Optional[int] = None,
+) -> Optional[PendingSlice]:
+    """Phase 1 of the TPU fast path: stage a read slice into columnar
+    buffers through the native parser (no per-record Python objects),
+    coalesce it into ONE device dispatch, and return without blocking.
 
-    Stored record slabs go straight to RecordBuffer columns through the
-    native parser (no per-record Python objects), the whole read slice
-    runs as ONE device dispatch (`TpuChainExecutor.process_buffer`), and
-    output batches are re-assembled at the byte level by the native
-    encoder. Cross-slice overlap (dispatch slice k+1 while slice k
-    downloads) lives in the stream-fetch handler's pipelined loop, not
-    here. Falls back to the per-record path (returns None) when the
-    chain has no TPU executor, the native library is unavailable, or a
-    batch's slab disagrees with its header.
-
-    Wire/offset semantics match `process_batches`: each output batch
-    spans its input batch's offset range with sequentially re-deltaed
-    records. Aggregate chains always deliver every processed batch —
-    device carries have already advanced, so dropping computed outputs
-    would double-count on refetch; stateless chains honor the max_bytes
-    cutoff exactly like the per-record path.
+    Returns None (counting the decline reason) when the chain has no TPU
+    executor, the native library is unavailable, a batch's slab
+    disagrees with its header, or a staging guard trips — the caller
+    falls back to the per-record path for this slice.
     """
     from fluvio_tpu.protocol.compression import Compression, decompress
     from fluvio_tpu.smartengine import native_backend
     from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
-    from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
     tpu = getattr(chain, "tpu_chain", None)
     if tpu is None or not batches:
@@ -277,22 +297,20 @@ def _tpu_process_batches(
     for batch in batches:
         raw = batch.raw_records
         if raw is None:
-            return None
+            return _decline(metrics, "no-raw-records")
         if batch.header.compression() != Compression.NONE:
             raw = decompress(batch.header.compression(), raw)
         cols = native_backend.decode_record_columns(raw)
-        if (
-            cols is None
-            or cols["count"] != batch.records_len()
-            or cols["parsed"] != len(raw)
-        ):
-            return None
+        if cols is None:
+            return _decline(metrics, "no-native-decoder")
+        if cols["count"] != batch.records_len() or cols["parsed"] != len(raw):
+            return _decline(metrics, "malformed-slab")
         staged.append((batch, cols))
         total_raw += len(raw)
     # the per-record path's input-size guard (engine.py StoreMemoryExceeded)
     engine = getattr(chain, "engine", None)
     if engine is not None and total_raw > engine.store_max_memory:
-        return None  # the per-record path raises the typed error
+        return _decline(metrics, "store-memory")  # per-record path raises
 
     # Coalesce the whole read slice into ONE device dispatch: per-batch
     # dispatches pay fixed host<->device round trips that dwarf a 16k-record
@@ -302,7 +320,8 @@ def _tpu_process_batches(
     ts0 = staged[0][0].header.first_timestamp
     ts_list = [b.header.first_timestamp for b, _ in staged]
     if any(t < 0 for t in ts_list) and any(t >= 0 for t in ts_list):
-        return None  # mixed absent/present base timestamps: rebase undefined
+        # mixed absent/present base timestamps: rebase undefined
+        return _decline(metrics, "mixed-base-timestamps")
     merged = {
         "count": sum(c["count"] for _, c in staged),
         "val_flat": np.concatenate([c["val_flat"] for _, c in staged]),
@@ -333,11 +352,11 @@ def _tpu_process_batches(
             merged, base_offset=base0, base_timestamp=ts0
         )
     except ValueError:  # value wider than MAX_WIDTH: per-record path
-        return None
+        return _decline(metrics, "record-too-wide")
     # dense-staging amplification guard: one huge value would pad every
     # row of the slice to its pow2 width
     if buf.values.nbytes > _MAX_STAGING_BYTES:
-        return None
+        return _decline(metrics, "staging-cap")
     if tpu._fanout:
         # fan-out outputs inherit their source batch's rebase deltas
         # ("fresh" records, delta 0 relative to their own batch)
@@ -354,23 +373,75 @@ def _tpu_process_batches(
         buf.fresh_offset_deltas = fo
         buf.fresh_timestamp_deltas = ft
 
+    handle = tpu.dispatch_buffer(buf)
+    return PendingSlice(
+        batches=batches,
+        buf=buf,
+        handle=handle,
+        planned_next=staged[-1][0].computed_last_offset(),
+        total_raw=total_raw,
+        base0=base0,
+        ts0=ts0,
+        read_from=start_offset,
+    )
+
+
+def tpu_finish(
+    chain: SmartModuleChainInstance,
+    pending: PendingSlice,
+    max_bytes: int,
+    metrics=None,
+) -> Optional[BatchProcessResult]:
+    """Phase 2: block on the device results and re-assemble output
+    batches at the byte level with the native encoder.
+
+    Wire/offset semantics match `process_batches`: survivors keep their
+    stored offsets rebased to the slice's first batch. Aggregate chains
+    always deliver every processed batch — device carries have already
+    advanced, so dropping computed outputs would double-count on
+    refetch; stateless chains honor the max_bytes cutoff exactly like
+    the per-record path. Returns None (with carries restored by the
+    executor) when the device signalled a transform error — the
+    interpreter re-runs the slice for exact error semantics.
+    """
+    from fluvio_tpu.smartengine import native_backend
+    from fluvio_tpu.smartengine.tpu.executor import TpuSpill
+
+    tpu = chain.tpu_chain
+    base0, ts0 = pending.base0, pending.ts0
     result = BatchProcessResult()
-    last_batch = staged[-1][0]
-    result.next_offset = last_batch.computed_last_offset()
+    result.next_offset = pending.planned_next
     try:
-        outbuf = tpu.process_buffer(buf)
+        outbuf = tpu.finish_buffer(pending.buf, pending.handle)
     except TpuSpill:
-        return None  # interpreter path re-runs with exact error semantics
+        return _decline(metrics, "transform-error-spill")
     n_out = outbuf.count
     # survivors keep their stored offsets (deltas are already rebased to
     # base0), so a consumer resuming mid-slice filters correctly
     out_deltas = outbuf.offset_deltas[:n_out].astype(np.int64)
     out_ts = outbuf.timestamp_deltas[:n_out].astype(np.int64)
-    if n_out and not tpu.agg_configs and not tpu._fanout and max_bytes > 0:
+    drop = 0
+    stateless = not tpu.agg_configs and not tpu._fanout
+    if (
+        stateless
+        and n_out
+        and pending.read_from is not None
+        and pending.read_from > base0
+    ):
+        # resuming mid-batch: outputs below the consume cursor were
+        # already served in a previous (truncated) response — drop them
+        # so the stream always advances (survivor deltas are ascending)
+        drop = int(
+            np.searchsorted(out_deltas, pending.read_from - base0, side="left")
+        )
+        out_deltas = out_deltas[drop:]
+        out_ts = out_ts[drop:]
+        n_out -= drop
+    if n_out and stateless and max_bytes > 0:
         # stateless chains honor max_bytes: keep the longest record prefix
         # whose encoded size fits (>= semantics: always keep one batch's
         # worth of progress by including at least the first record)
-        sizes = _encoded_record_sizes(outbuf, out_deltas, out_ts)
+        sizes = _encoded_record_sizes_at(outbuf, drop, out_deltas, out_ts)
         cum = np.cumsum(sizes)
         keep = int(np.searchsorted(cum, max_bytes, side="left")) + 1
         if keep < n_out:
@@ -378,17 +449,21 @@ def _tpu_process_batches(
             result.next_offset = base0 + int(out_deltas[n_out - 1]) + 1
     if n_out:
         cols = outbuf.to_columns()
+        vo = cols["val_off"]
+        ko = cols["key_off"]
+        v0 = int(vo[drop])
+        k0 = int(ko[drop])
         raw_out = native_backend.encode_record_columns(
-            cols["val_flat"][: int(cols["val_off"][n_out])],
-            cols["val_off"][: n_out + 1],
-            cols["key_flat"][: int(cols["key_off"][n_out])],
-            cols["key_off"][: n_out + 1],
-            cols["key_present"][:n_out],
+            cols["val_flat"][v0 : int(vo[drop + n_out])],
+            vo[drop : drop + n_out + 1] - v0,
+            cols["key_flat"][k0 : int(ko[drop + n_out])],
+            ko[drop : drop + n_out + 1] - k0,
+            cols["key_present"][drop : drop + n_out],
             out_deltas[:n_out],
             out_ts[:n_out],
         )
         if raw_out is None:
-            return None
+            return _decline(metrics, "encode-failed")
         out_batch = Batch(
             base_offset=base0,
             raw_records=raw_out,
@@ -404,12 +479,32 @@ def _tpu_process_batches(
     # metrics only after the last possible fallback return: the per-record
     # path re-counts bytes_in when this path bails out
     if metrics is not None:
-        metrics.add_bytes_in(total_raw)
-        metrics.add_fuel_used(buf.count * max(len(tpu.stages), 1))
+        metrics.add_bytes_in(pending.total_raw)
+        metrics.add_fuel_used(pending.buf.count * max(len(tpu.stages), 1))
         metrics.add_records_out(n_out)
+        metrics.add_fastpath()
     if tpu.agg_configs:
         tpu._ensure_host_state()
     return result
+
+
+def _tpu_process_batches(
+    chain: SmartModuleChainInstance,
+    batches: List[Batch],
+    max_bytes: int,
+    metrics=None,
+    start_offset: Optional[int] = None,
+) -> Optional[BatchProcessResult]:
+    """Coalesced TPU fast path, serial form: stage+dispatch then finish.
+
+    The stream-fetch handler's pipelined loop uses the two phases
+    directly so slice k+1 dispatches while slice k downloads and hits
+    the socket.
+    """
+    pending = tpu_stage_dispatch(chain, batches, metrics, start_offset)
+    if pending is None:
+        return None
+    return tpu_finish(chain, pending, max_bytes, metrics)
 
 
 def process_batches(
@@ -417,22 +512,38 @@ def process_batches(
     batches: List[Batch],
     max_bytes: int,
     metrics=None,
+    start_offset: Optional[int] = None,
 ) -> BatchProcessResult:
     """Run stored batches through the chain, re-batch the outputs.
 
     Per input batch (parity: batch.rs:41-140): records -> SmartModuleInput
     (base offset/timestamp from the batch header) -> chain.process -> output
     Batch spanning the *input* batch's offset range, so consumers advance
-    their offsets past filtered-out records. Output records are re-deltaed
-    sequentially. Stops at max_bytes or on the first transform error
-    (partial output is kept, matching engine.rs:159-161).
+    their offsets past filtered-out records. Survivors keep their stored
+    offsets; batches re-served on a mid-batch resume are deduplicated by
+    the consumer's cursor (the fast path additionally drops already-
+    served outputs below ``start_offset``). Stops at max_bytes or on the
+    first transform error (partial output kept, engine.rs:159-161).
 
-    Chains with a TPU executor take `_tpu_process_batches`'s pipelined
+    Chains with a TPU executor take `_tpu_process_batches`'s coalesced
     batch-level path when the native codecs are available.
     """
-    fast = _tpu_process_batches(chain, batches, max_bytes, metrics)
+    fast = _tpu_process_batches(chain, batches, max_bytes, metrics, start_offset)
     if fast is not None:
         return fast
+    return process_batches_per_record(chain, batches, max_bytes, metrics)
+
+
+def process_batches_per_record(
+    chain: SmartModuleChainInstance,
+    batches: List[Batch],
+    max_bytes: int,
+    metrics=None,
+) -> BatchProcessResult:
+    """The interpreting per-batch loop (exact reference semantics);
+    also the direct target for slices the fast path already declined —
+    re-entering `process_batches` would re-stage and re-dispatch the
+    failed slice and double-count the fallback metrics."""
     result = BatchProcessResult()
     total_bytes = 0
     for batch in batches:

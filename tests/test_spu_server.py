@@ -399,3 +399,69 @@ class TestMultiplexing:
             await client.close()
 
         loop.run_until_complete(run())
+
+
+DOUBLE_MAP_SM = b"""
+@smartmodule.map(dsl=dsl.MapProgram(
+    value=dsl.Concat(args=[dsl.Value(), dsl.Value()])))
+def m(record):
+    return record.value + record.value
+"""
+
+
+class TestPipelinedStream:
+    """The dispatch-ahead stream loop (stateless TPU chains)."""
+
+    def test_multi_slice_stream_through_chain(self, spu):
+        server, loop = spu
+
+        async def run():
+            # several produce rounds -> several stored batches/slices
+            for r in range(4):
+                await produce_values(
+                    server.public_addr,
+                    [f"keep-{r}-{i}".encode() for i in range(20)]
+                    + [f"drop-{r}-{i}".encode() for i in range(10)],
+                )
+            cfg = ConsumerConfig(
+                disable_continuous=True,
+                smartmodules=[
+                    adhoc(FILTER_SM, kind=SmartModuleInvocationKind.FILTER)
+                ],
+            )
+            records = await consume_values(server.public_addr, config=cfg)
+            values = [r.value for r in records]
+            assert len(values) == 80
+            expect = [
+                f"keep-{r}-{i}".encode() for r in range(4) for i in range(20)
+            ]
+            assert values == expect
+            # survivors keep their stored offsets
+            offsets = [r.offset for r in records]
+            assert offsets == sorted(offsets)
+            assert offsets[0] == 0 and offsets[-1] == 3 * 30 + 19
+            m = server.ctx.metrics.smartmodule
+            assert m.fastpath_slices > 0
+            assert m.fallback_slices == 0
+        loop.run_until_complete(run())
+
+    def test_truncation_discards_speculative_slice(self, spu):
+        """A byte-doubling map makes output > max_bytes, forcing the
+        max_bytes cutoff mid-slice — the pipelined loop must discard its
+        speculative dispatch and re-read from the true consume point."""
+        server, loop = spu
+
+        async def run():
+            values = [b"x" * 100 for _ in range(50)]
+            await produce_values(server.public_addr, values)
+            cfg = ConsumerConfig(
+                disable_continuous=True,
+                max_bytes=600,  # output slices ~2x input: forces cutoffs
+                smartmodules=[
+                    adhoc(DOUBLE_MAP_SM, kind=SmartModuleInvocationKind.MAP)
+                ],
+            )
+            records = await consume_values(server.public_addr, config=cfg)
+            assert [r.value for r in records] == [b"x" * 200] * 50
+            assert [r.offset for r in records] == list(range(50))
+        loop.run_until_complete(run())
