@@ -220,3 +220,9 @@ def current_cluster_endpoint(path: Optional[str] = None) -> str:
     """Resolve the active profile's SC endpoint (Fluvio::connect with no addr)."""
     cf = ConfigFile.load(path)
     return cf.config.current_cluster().endpoint
+
+
+def current_cluster(path: Optional[str] = None) -> FluvioClusterConfig:
+    """The active profile's cluster entry (endpoint + TLS policy)."""
+    cf = ConfigFile.load(path)
+    return cf.config.current_cluster()

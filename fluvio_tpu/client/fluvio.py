@@ -29,9 +29,11 @@ class SpuPool:
         self,
         default_addr: Optional[str] = None,
         metadata: Optional[MetadataStores] = None,
+        tls=None,
     ):
         self._default_addr = default_addr
         self._metadata = metadata
+        self._tls = tls  # client TlsPolicy applied to every SPU dial
         self._sockets: Dict[str, VersionedSerialSocket] = {}
 
     async def addr_for(self, topic: str, partition: int) -> str:
@@ -60,7 +62,7 @@ class SpuPool:
             if sock is not None and not sock.is_stale:
                 return sock
             try:
-                sock = await VersionedSerialSocket.connect(addr)
+                sock = await VersionedSerialSocket.connect(addr, tls=self._tls)
                 self._sockets[addr] = sock
                 return sock
             except OSError as e:
@@ -93,28 +95,32 @@ class Fluvio:
         self._sc_addr = sc_addr
 
     @classmethod
-    async def connect(cls, addr: Optional[str] = None) -> "Fluvio":
+    async def connect(cls, addr: Optional[str] = None, tls=None) -> "Fluvio":
         """Connect to a cluster: an SC public endpoint or a lone SPU.
 
-        With no address, the active profile's endpoint is used
-        (parity: Fluvio::connect -> ConfigFile, fluvio.rs:56).
+        With no address, the active profile's endpoint AND TLS policy
+        are used (parity: Fluvio::connect -> ConfigFile, fluvio.rs:56;
+        TLS fields config/tls.rs).
         """
         if addr is None:
-            from fluvio_tpu.client.config import current_cluster_endpoint
+            from fluvio_tpu.client.config import current_cluster
 
-            addr = current_cluster_endpoint()
-        socket = await VersionedSerialSocket.connect(addr)
+            cluster = current_cluster()
+            addr = cluster.endpoint
+            if tls is None and cluster.tls.mode != "disabled":
+                tls = cluster.tls
+        socket = await VersionedSerialSocket.connect(addr, tls=tls)
         if socket.versions.lookup_version(AdminApiKey.CREATE) is not None:
             metadata = MetadataStores(socket)
             await metadata.start()
             return cls(
-                SpuPool(metadata=metadata),
+                SpuPool(metadata=metadata, tls=tls),
                 metadata=metadata,
                 sc_socket=socket,
                 sc_addr=addr,
             )
         await socket.close()
-        pool = SpuPool(default_addr=addr)
+        pool = SpuPool(default_addr=addr, tls=tls)
         await pool.socket_for("", 0)  # eager validation + version negotiation
         return cls(pool)
 

@@ -24,6 +24,7 @@ from fluvio_tpu.sc.controllers import (
 )
 from fluvio_tpu.sc.services import ScPrivateService, ScPublicService
 from fluvio_tpu.transport.service import FluvioApiServer
+from fluvio_tpu.transport.tls import ServerTlsConfig, server_ssl
 
 DEFAULT_PUBLIC_PORT = 9003
 DEFAULT_PRIVATE_PORT = 9004
@@ -41,6 +42,8 @@ class ScConfig:
     # JSON file; default is allow-all RootAuthorization
     read_only: bool = False
     auth_policy_path: Optional[str] = None
+    # public-endpoint TLS; client certs feed x509 identity (fluvio-auth)
+    tls: ServerTlsConfig = field(default_factory=ServerTlsConfig)
 
 
 class ScServer:
@@ -66,7 +69,10 @@ class ScServer:
         self.partition_controller = PartitionController(self.ctx)
         self.spu_controller = SpuController(self.ctx)
         self.public_server = FluvioApiServer(
-            self.config.public_addr, ScPublicService(), self.ctx
+            self.config.public_addr,
+            ScPublicService(),
+            self.ctx,
+            ssl_context=server_ssl(self.config.tls),
         )
         self.private_server = FluvioApiServer(
             self.config.private_addr, ScPrivateService(), self.ctx

@@ -6,6 +6,7 @@ from dataclasses import dataclass, field
 
 from fluvio_tpu.smartengine.engine import DEFAULT_STORE_MAX_MEMORY
 from fluvio_tpu.storage.config import ReplicaConfig
+from fluvio_tpu.transport.tls import ServerTlsConfig
 from fluvio_tpu.types import SPU_PUBLIC_PORT, SpuId
 
 
@@ -29,6 +30,12 @@ class SpuConfig:
     # metrics unix-socket endpoint (monitoring.rs); None = disabled,
     # "" = FLUVIO_METRIC_SPU env or the default path
     monitoring_path: str | None = None
+    # retention cleaner pass period (cleaner.rs:20 `CLEANING_INTERVAL`);
+    # <= 0 disables the background task
+    cleaner_interval_seconds: float = 30.0
+    # public-endpoint TLS (the reference fronts the SPU with a TLS proxy,
+    # fluvio-spu/src/start.rs:97-118; here the endpoint terminates TLS)
+    tls: ServerTlsConfig = field(default_factory=ServerTlsConfig)
 
     def __post_init__(self) -> None:
         if self.replication.base_dir in (".", ""):

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from fluvio_tpu.spu.cleaner_controller import CleanerController
 from fluvio_tpu.spu.config import SpuConfig
 from fluvio_tpu.spu.context import GlobalContext
 from fluvio_tpu.spu.follower import FollowersController
@@ -18,6 +19,7 @@ from fluvio_tpu.spu.monitoring import MonitoringServer
 from fluvio_tpu.spu.public_service import SpuPublicService
 from fluvio_tpu.spu.sc_dispatcher import ScDispatcher
 from fluvio_tpu.transport.service import FluvioApiServer
+from fluvio_tpu.transport.tls import server_ssl
 
 
 class SpuServer:
@@ -25,7 +27,10 @@ class SpuServer:
         self.config = config
         self.ctx = GlobalContext(config)
         self.public_server = FluvioApiServer(
-            config.public_addr, SpuPublicService(), self.ctx
+            config.public_addr,
+            SpuPublicService(),
+            self.ctx,
+            ssl_context=server_ssl(config.tls),
         )
         self.internal_server: Optional[FluvioApiServer] = (
             FluvioApiServer(config.private_addr, SpuInternalService(), self.ctx)
@@ -41,6 +46,9 @@ class SpuServer:
             MonitoringServer(self.ctx, config.monitoring_path or None)
             if config.monitoring_path is not None
             else None
+        )
+        self.cleaner = CleanerController(
+            self.ctx, config.cleaner_interval_seconds
         )
 
     @property
@@ -65,6 +73,7 @@ class SpuServer:
         if self.internal_server is not None:
             await self.internal_server.start()
         self.followers_controller.start()
+        self.cleaner.start()
         if self.sc_dispatcher is not None:
             self.sc_dispatcher.start()
         if self.monitoring is not None:
@@ -78,6 +87,7 @@ class SpuServer:
             await self.monitoring.stop()
         if self.sc_dispatcher is not None:
             await self.sc_dispatcher.stop()
+        await self.cleaner.stop()
         await self.followers_controller.stop()
         if self.internal_server is not None:
             await self.internal_server.stop()

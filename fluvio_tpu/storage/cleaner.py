@@ -17,11 +17,26 @@ class Cleaner:
     def __init__(self, replica: FileReplica):
         self.replica = replica
 
-    def clean(self, now_ms: int | None = None) -> List[int]:
-        """Run one cleaning pass; returns removed segment base offsets."""
+    def clean(self, now_ms: int | None = None, unlink: bool = True) -> List[int]:
+        """Run one cleaning pass; returns removed segment base offsets.
+
+        ``unlink=False`` detaches segments from the replica (new reads
+        can no longer reach them) but leaves the files on disk and
+        returns via `detached` — callers with in-flight path-based file
+        slices defer the unlink until those reads have drained.
+        """
         config = self.replica.config
         now = int(time.time() * 1000) if now_ms is None else now_ms
         removed: List[int] = []
+        self.detached: List[object] = []
+
+        def shed(base: int) -> None:
+            seg = self.replica.prev_segments.pop(base)
+            if unlink:
+                seg.remove_files()
+            else:
+                self.detached.append(seg)
+            removed.append(base)
 
         # age-based
         cutoff = now - config.retention_seconds * 1000
@@ -29,9 +44,7 @@ class Cleaner:
             seg = self.replica.prev_segments[base]
             newest = seg.newest_timestamp()
             if newest != -1 and newest < cutoff:
-                seg.remove_files()
-                del self.replica.prev_segments[base]
-                removed.append(base)
+                shed(base)
             else:
                 break  # segments are time-ordered
 
@@ -45,7 +58,5 @@ class Cleaner:
             for base in sorted(self.replica.prev_segments):
                 if total_size() <= config.max_partition_size:
                     break
-                seg = self.replica.prev_segments.pop(base)
-                seg.remove_files()
-                removed.append(base)
+                shed(base)
         return removed

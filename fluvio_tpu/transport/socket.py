@@ -48,6 +48,18 @@ class FluvioSocket:
         info = self.writer.get_extra_info("peername")
         return f"{info[0]}:{info[1]}" if info else "<unknown>"
 
+    def peer_cert(self) -> Optional[dict]:
+        """The peer's TLS certificate (None on plaintext / no client cert).
+
+        Feeds x509 identity extraction (auth/identity.py) on TLS servers
+        configured with client-certificate verification.
+        """
+        ssl_obj = self.writer.get_extra_info("ssl_object")
+        if ssl_obj is None:
+            return None
+        cert = ssl_obj.getpeercert()
+        return cert or None
+
     def set_stale(self) -> None:
         self._stale = True
 
@@ -120,10 +132,18 @@ class FluvioStream:
         return ByteReader(payload)
 
 
-async def connect(addr: str) -> FluvioSocket:
-    """Connect to ``host:port``."""
+async def connect(addr: str, tls=None) -> FluvioSocket:
+    """Connect to ``host:port`` (``tls``: a client `TlsPolicy`)."""
+    from fluvio_tpu.transport.tls import client_ssl
+
     host, port_s = addr.rsplit(":", 1)
-    reader, writer = await asyncio.open_connection(host, int(port_s))
+    ctx, sni = client_ssl(tls)
+    if ctx is None:
+        reader, writer = await asyncio.open_connection(host, int(port_s))
+    else:
+        reader, writer = await asyncio.open_connection(
+            host, int(port_s), ssl=ctx, server_hostname=sni or host
+        )
     return FluvioSocket(reader, writer)
 
 

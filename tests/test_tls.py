@@ -1,0 +1,216 @@
+"""TLS transport + x509 identity (parity: fluvio/src/config/tls.rs,
+fluvio-auth/src/x509/).
+
+Loopback: a throwaway CA signs a server cert (CN=localhost) and a client
+cert (CN=alice, O=admins); the SPU terminates TLS on its public endpoint
+and the client connects with a verified TlsPolicy. Covers produce/consume
+through TLS end-to-end, anonymous mode, rejection of plaintext clients,
+and identity extraction from the client certificate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+
+import pytest
+
+cryptography = pytest.importorskip("cryptography")
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import NameOID
+
+from fluvio_tpu.auth.identity import Identity
+from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset, TlsPolicy
+from fluvio_tpu.spu import SpuConfig, SpuServer
+from fluvio_tpu.storage.config import ReplicaConfig
+from fluvio_tpu.transport.tls import ServerTlsConfig
+
+
+def _key():
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _name(cn: str, org: str | None = None):
+    attrs = [x509.NameAttribute(NameOID.COMMON_NAME, cn)]
+    if org:
+        attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, org))
+    return x509.Name(attrs)
+
+
+def _cert(subject, issuer, subject_key, issuer_key, ca=False, san=None):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    b = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(issuer)
+        .public_key(subject_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=ca, path_length=None), critical=True)
+    )
+    if san:
+        b = b.add_extension(
+            x509.SubjectAlternativeName([x509.DNSName(san)]), critical=False
+        )
+    return b.sign(issuer_key, hashes.SHA256())
+
+
+def _write(tmp, name, obj, private=False):
+    p = tmp / name
+    if private:
+        p.write_bytes(
+            obj.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+    else:
+        p.write_bytes(obj.public_bytes(serialization.Encoding.PEM))
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tls")
+    ca_key = _key()
+    ca_cert = _cert(_name("test-ca"), _name("test-ca"), ca_key, ca_key, ca=True)
+    srv_key = _key()
+    srv_cert = _cert(
+        _name("localhost"), _name("test-ca"), srv_key, ca_key, san="localhost"
+    )
+    cli_key = _key()
+    cli_cert = _cert(
+        _name("alice", "admins"), _name("test-ca"), cli_key, ca_key
+    )
+    return {
+        "ca": _write(tmp, "ca.crt", ca_cert),
+        "server_cert": _write(tmp, "server.crt", srv_cert),
+        "server_key": _write(tmp, "server.key", srv_key, private=True),
+        "client_cert": _write(tmp, "client.crt", cli_cert),
+        "client_key": _write(tmp, "client.key", cli_key, private=True),
+    }
+
+
+def _tls_spu(tmp_path, certs, require_client_cert=False):
+    config = SpuConfig(
+        id=6001,
+        public_addr="127.0.0.1:0",
+        log_base_dir=str(tmp_path),
+        replication=ReplicaConfig(base_dir=str(tmp_path)),
+        tls=ServerTlsConfig(
+            enabled=True,
+            server_cert=certs["server_cert"],
+            server_key=certs["server_key"],
+            ca_cert=certs["ca"],
+            require_client_cert=require_client_cert,
+        ),
+    )
+    return SpuServer(config)
+
+
+def _addr(server):
+    # bind address is 127.0.0.1; dial by the cert's DNS name
+    return "localhost:" + server.public_addr.rsplit(":", 1)[1]
+
+
+class TestTlsTransport:
+    def test_verified_roundtrip(self, tmp_path, certs):
+        async def run():
+            server = _tls_spu(tmp_path, certs)
+            await server.start()
+            server.ctx.create_replica("topic", 0)
+            policy = TlsPolicy(mode="verified", ca_cert=certs["ca"], domain="localhost")
+            client = await Fluvio.connect(_addr(server), tls=policy)
+            producer = await client.topic_producer("topic")
+            futs = [await producer.send(None, f"tls-{i}".encode()) for i in range(20)]
+            await producer.flush()
+            for f in futs:
+                await f.wait()
+            consumer = await client.partition_consumer("topic", 0)
+            got = []
+            async for r in consumer.stream(
+                Offset.beginning(), ConsumerConfig(disable_continuous=True)
+            ):
+                got.append(r.value)
+            assert got == [f"tls-{i}".encode() for i in range(20)]
+            await client.close()
+            await server.stop()
+
+        asyncio.run(run())
+
+    def test_anonymous_mode(self, tmp_path, certs):
+        async def run():
+            server = _tls_spu(tmp_path, certs)
+            await server.start()
+            server.ctx.create_replica("topic", 0)
+            client = await Fluvio.connect(
+                _addr(server), tls=TlsPolicy(mode="anonymous")
+            )
+            producer = await client.topic_producer("topic")
+            fut = await producer.send(None, b"anon")
+            await producer.flush()
+            await fut.wait()
+            await client.close()
+            await server.stop()
+
+        asyncio.run(run())
+
+    def test_plaintext_client_rejected(self, tmp_path, certs):
+        async def run():
+            server = _tls_spu(tmp_path, certs)
+            await server.start()
+            with pytest.raises((ConnectionError, OSError, asyncio.TimeoutError)):
+                await asyncio.wait_for(
+                    Fluvio.connect(_addr(server)), timeout=3
+                )
+            await server.stop()
+
+        asyncio.run(run())
+
+    def test_client_cert_identity(self, tmp_path, certs):
+        """Server with client-cert verification attests x509 identity."""
+        seen = {}
+
+        async def run():
+            server = _tls_spu(tmp_path, certs, require_client_cert=True)
+            # intercept the service to capture the socket's identity
+            service = server.public_server.service
+            orig = service.respond
+
+            async def spy(ctx, socket):
+                seen["identity"] = Identity.from_socket(socket)
+                await orig(ctx, socket)
+
+            service.respond = spy
+            await server.start()
+            server.ctx.create_replica("topic", 0)
+            policy = TlsPolicy(
+                mode="verified",
+                ca_cert=certs["ca"],
+                domain="localhost",
+                client_cert=certs["client_cert"],
+                client_key=certs["client_key"],
+            )
+            client = await Fluvio.connect(_addr(server), tls=policy)
+            producer = await client.topic_producer("topic")
+            fut = await producer.send(None, b"hello")
+            await producer.flush()
+            await fut.wait()
+            await client.close()
+            await server.stop()
+
+        asyncio.run(run())
+        ident = seen["identity"]
+        assert ident.principal == "alice"
+        assert ident.scopes == ["admins"]
+
+    def test_identity_without_cert_is_anonymous(self):
+        assert Identity.from_peer_cert(None).principal == "anonymous"
+        cert = {"subject": ((("commonName", "bob"),), (("organizationName", "ops"),))}
+        ident = Identity.from_peer_cert(cert)
+        assert ident.principal == "bob" and ident.scopes == ["ops"]
