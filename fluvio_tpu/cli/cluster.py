@@ -29,12 +29,20 @@ def add_cluster_parser(sub: argparse._SubParsersAction) -> None:
     start.add_argument("--sc-port", type=int, default=0)
     start.add_argument("--skip-checks", action="store_true")
     start.add_argument("--profile", default="local")
+    start.add_argument("--k8", action="store_true",
+                       help="install on Kubernetes (CRDs + SC operator)")
+    start.add_argument("--namespace", default="default")
+    start.add_argument("--k8-server", default="",
+                       help="apiserver URL (default: in-cluster env)")
     start.set_defaults(fn=cluster_start)
 
     delete = csub.add_parser("delete", help="tear the local cluster down")
     delete.add_argument("--data-dir", default=DEFAULT_DATA_DIR)
     delete.add_argument("--keep-data", action="store_true")
     delete.add_argument("--profile", default="local")
+    delete.add_argument("--k8", action="store_true")
+    delete.add_argument("--namespace", default="default")
+    delete.add_argument("--k8-server", default="")
     delete.set_defaults(fn=cluster_delete)
 
     status = csub.add_parser("status", help="report cluster health")
@@ -50,7 +58,25 @@ def add_cluster_parser(sub: argparse._SubParsersAction) -> None:
     diag.set_defaults(fn=cluster_diagnostics)
 
 
+def _k8_api(args):
+    from fluvio_tpu.k8s import HttpK8sApi
+
+    if args.k8_server:
+        return HttpK8sApi(args.k8_server)
+    return HttpK8sApi.in_cluster()
+
+
 async def cluster_start(args) -> int:
+    if getattr(args, "k8", False):
+        from fluvio_tpu.cluster.k8 import K8InstallConfig, install_k8
+
+        applied = await install_k8(
+            _k8_api(args), K8InstallConfig(namespace=args.namespace)
+        )
+        for name in applied:
+            print(f"applied {name}")
+        return 0
+
     from fluvio_tpu.cluster.local import LocalConfig, LocalInstaller
 
     installer = LocalInstaller(
@@ -72,6 +98,13 @@ async def cluster_start(args) -> int:
 
 
 async def cluster_delete(args) -> int:
+    if getattr(args, "k8", False):
+        from fluvio_tpu.cluster.k8 import K8InstallConfig, delete_k8
+
+        await delete_k8(_k8_api(args), K8InstallConfig(namespace=args.namespace))
+        print("k8 cluster objects deleted")
+        return 0
+
     from fluvio_tpu.cluster.delete import delete_local_cluster
 
     if delete_local_cluster(args.data_dir, args.keep_data, args.profile):

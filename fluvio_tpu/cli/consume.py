@@ -29,6 +29,12 @@ def add_consume_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument("topic")
     p.add_argument("-p", "--partition", type=int, default=0)
     p.add_argument(
+        "-A",
+        "--all-partitions",
+        action="store_true",
+        help="consume from every partition of the topic (merged stream)",
+    )
+    p.add_argument(
         "-B", "--beginning", action="store_true", help="start from offset 0"
     )
     p.add_argument(
@@ -135,7 +141,16 @@ async def consume(args) -> int:
     client = await connect(args)
     seen = 0
     try:
-        consumer = await client.partition_consumer(args.topic, args.partition)
+        if args.all_partitions:
+            from fluvio_tpu.client import PartitionSelectionStrategy
+
+            consumer = await client.consumer(
+                PartitionSelectionStrategy.all(args.topic)
+            )
+        else:
+            consumer = await client.partition_consumer(
+                args.topic, args.partition
+            )
         async for record in consumer.stream(offset, config):
             _print_record(record, args)
             seen += 1

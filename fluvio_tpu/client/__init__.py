@@ -23,5 +23,7 @@ from fluvio_tpu.client.producer import (  # noqa: F401
 from fluvio_tpu.client.consumer import (  # noqa: F401
     ConsumerConfig,
     ConsumerRecord,
+    MultiplePartitionConsumer,
     PartitionConsumer,
+    PartitionSelectionStrategy,
 )

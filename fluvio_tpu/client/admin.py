@@ -125,6 +125,24 @@ class FluvioAdmin:
         spec = SmartModuleSpec.from_source(source, name=name)
         return await self.create(name, SmartModuleSpec.KIND, spec.to_dict())
 
+    async def create_spu_group(
+        self, name: str, replicas: int = 1, min_id: int = 0
+    ) -> AdminStatus:
+        from fluvio_tpu.metadata.spg import SpuGroupSpec
+
+        spec = SpuGroupSpec(replicas=replicas, min_id=min_id)
+        return await self.create(name, SpuGroupSpec.KIND, spec.to_dict())
+
+    async def delete_spu_group(self, name: str) -> AdminStatus:
+        from fluvio_tpu.metadata.spg import SpuGroupSpec
+
+        return await self.delete(name, SpuGroupSpec.KIND)
+
+    async def list_spu_groups(self) -> List[MetadataStoreObject]:
+        from fluvio_tpu.metadata.spg import SpuGroupSpec
+
+        return await self.list(SpuGroupSpec.KIND)
+
     @staticmethod
     def object_kind(kind: str) -> type:
         return spec_type_for(kind)

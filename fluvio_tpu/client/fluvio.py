@@ -157,6 +157,29 @@ class Fluvio:
         socket = await self._pool.socket_for(topic, partition)
         return PartitionConsumer(topic, partition, socket)
 
+    async def consumer(self, strategy) -> "MultiplePartitionConsumer":
+        """Multi-partition consumer from a `PartitionSelectionStrategy`
+        (parity: Fluvio::consumer, consumer.rs:590-720). ``all`` resolves
+        the partition set from the cluster metadata mirror (a lone-SPU
+        connection has no metadata: pass explicit partitions instead)."""
+        from fluvio_tpu.client.consumer import MultiplePartitionConsumer
+
+        partitions = strategy.partitions
+        if partitions is None:
+            if self._metadata is None:
+                raise ValueError(
+                    "PartitionSelectionStrategy.all needs an SC connection; "
+                    "use .multiple() with explicit partitions on a lone SPU"
+                )
+            count = await self._metadata.wait_partition_count(strategy.topic)
+            if count is None:
+                raise ValueError(f"unknown topic {strategy.topic!r}")
+            partitions = list(range(count))
+        consumers = [
+            await self.partition_consumer(strategy.topic, p) for p in partitions
+        ]
+        return MultiplePartitionConsumer(consumers)
+
     async def close(self) -> None:
         if self._metadata is not None:
             await self._metadata.stop()

@@ -1,0 +1,208 @@
+"""K8s cluster install (parity: fluvio-cluster/src/start/k8.rs).
+
+Design difference from the reference's helm-driven install: the
+installer renders the chart-equivalent manifests itself (CRDs, the SC
+Deployment + Services, RBAC) and applies them through the same `K8sApi`
+the operator uses — `kubectl`/helm are not required, and a `FakeK8sApi`
+makes the whole install path testable without a cluster.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from fluvio_tpu.k8s.api import K8sApi
+
+GROUP = "fluvio.infinyon.com"
+CRD_KINDS = [
+    ("Topic", "topics"),
+    ("Partition", "partitions"),
+    ("Spu", "spus"),
+    ("SpuGroup", "spugroups"),
+    ("SmartModule", "smartmodules"),
+    ("TableFormat", "tableformats"),
+]
+DEFAULT_SC_IMAGE = "fluvio-tpu/sc:latest"
+SC_PUBLIC_PORT = 9003
+SC_PRIVATE_PORT = 9004
+
+
+@dataclass
+class K8InstallConfig:
+    namespace: str = "default"
+    image: str = DEFAULT_SC_IMAGE
+
+
+def crd_manifest(kind: str, plural: str) -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "scope": "Namespaced",
+            "names": {
+                "kind": kind,
+                "plural": plural,
+                "singular": kind.lower(),
+            },
+            "versions": [
+                {
+                    "name": "v1",
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def sc_deployment_manifest(cfg: K8InstallConfig) -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": "fluvio-sc",
+            "namespace": cfg.namespace,
+            "labels": {"app": "fluvio-sc"},
+        },
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "fluvio-sc"}},
+            "template": {
+                "metadata": {"labels": {"app": "fluvio-sc"}},
+                "spec": {
+                    "serviceAccountName": "fluvio-sc",
+                    "containers": [
+                        {
+                            "name": "sc",
+                            "image": cfg.image,
+                            "command": ["python", "-m", "fluvio_tpu.run", "sc"],
+                            "args": ["--k8", "--namespace", cfg.namespace],
+                            "ports": [
+                                {"containerPort": SC_PUBLIC_PORT},
+                                {"containerPort": SC_PRIVATE_PORT},
+                            ],
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def sc_service_manifest(cfg: K8InstallConfig) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": "fluvio-sc-public", "namespace": cfg.namespace},
+        "spec": {
+            "selector": {"app": "fluvio-sc"},
+            "ports": [{"name": "public", "port": SC_PUBLIC_PORT}],
+        },
+    }
+
+
+def rbac_manifests(cfg: K8InstallConfig) -> List[dict]:
+    """ServiceAccount + Role + RoleBinding for the SC operator: CRD
+    read/write in the fluvio group plus StatefulSet/Service management."""
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": "fluvio-sc", "namespace": cfg.namespace},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "Role",
+            "metadata": {"name": "fluvio-sc", "namespace": cfg.namespace},
+            "rules": [
+                {
+                    "apiGroups": [GROUP],
+                    "resources": ["*"],
+                    "verbs": ["*"],
+                },
+                {
+                    "apiGroups": ["apps"],
+                    "resources": ["statefulsets"],
+                    "verbs": ["*"],
+                },
+                {
+                    "apiGroups": [""],
+                    "resources": ["services"],
+                    "verbs": ["*"],
+                },
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {"name": "fluvio-sc", "namespace": cfg.namespace},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "Role",
+                "name": "fluvio-sc",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "fluvio-sc",
+                    "namespace": cfg.namespace,
+                }
+            ],
+        },
+    ]
+
+
+def render_manifests(cfg: K8InstallConfig) -> List[dict]:
+    out = [crd_manifest(kind, plural) for kind, plural in CRD_KINDS]
+    out.extend(rbac_manifests(cfg))
+    out.append(sc_deployment_manifest(cfg))
+    out.append(sc_service_manifest(cfg))
+    return out
+
+
+def _path_for(manifest: dict, namespace: str) -> str:
+    api_version = manifest["apiVersion"]
+    kind = manifest["kind"]
+    plural = {
+        "CustomResourceDefinition": "customresourcedefinitions",
+        "Deployment": "deployments",
+        "Service": "services",
+        "StatefulSet": "statefulsets",
+        "ServiceAccount": "serviceaccounts",
+        "Role": "roles",
+        "RoleBinding": "rolebindings",
+    }.get(kind, kind.lower() + "s")
+    if api_version == "v1":
+        return f"api/v1/namespaces/{namespace}/{plural}"
+    group_version = api_version  # e.g. apps/v1
+    if kind == "CustomResourceDefinition":
+        return f"apis/{group_version}/{plural}"  # cluster-scoped
+    return f"apis/{group_version}/namespaces/{namespace}/{plural}"
+
+
+async def install_k8(api: K8sApi, cfg: K8InstallConfig | None = None) -> List[str]:
+    """Apply CRDs + SC deployment/service; returns applied object names."""
+    cfg = cfg or K8InstallConfig()
+    applied = []
+    for manifest in render_manifests(cfg):
+        await api.apply(_path_for(manifest, cfg.namespace), manifest)
+        applied.append(f"{manifest['kind']}/{manifest['metadata']['name']}")
+    return applied
+
+
+async def delete_k8(api: K8sApi, cfg: K8InstallConfig | None = None) -> None:
+    cfg = cfg or K8InstallConfig()
+    for manifest in render_manifests(cfg):
+        await api.delete(
+            _path_for(manifest, cfg.namespace), manifest["metadata"]["name"]
+        )

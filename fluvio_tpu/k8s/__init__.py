@@ -1,0 +1,9 @@
+"""Kubernetes integration: typed API client + fake for tests."""
+
+from fluvio_tpu.k8s.api import (  # noqa: F401
+    FakeK8sApi,
+    HttpK8sApi,
+    K8sApi,
+    K8sApiError,
+    kube_context_from_env,
+)

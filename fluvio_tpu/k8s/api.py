@@ -1,0 +1,256 @@
+"""Minimal Kubernetes API client.
+
+Capability parity: the reference's `k8-client` crate as used by
+fluvio-stream-dispatcher/src/metadata/k8.rs and fluvio-sc/src/k8/ —
+namespaced resource CRUD + a change-wakeup watch, which is all the SC's
+operator mode needs. The verbs are pluggable: `HttpK8sApi` speaks to a
+real apiserver (in-cluster service-account env or explicit endpoint),
+`FakeK8sApi` is an in-memory apiserver-shaped store used by tests and
+dry runs — controllers and the metadata backend are exercised against
+the same interface either way.
+
+Objects are plain manifest dicts ({apiVersion, kind, metadata, spec,
+status}); resources are addressed by a ``resource path`` like
+``apis/fluvio.infinyon.com/v1/namespaces/default/topics``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import ssl
+from typing import Dict, List, Optional
+
+
+class K8sApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class K8sApi:
+    """Namespaced-resource verbs over manifest dicts."""
+
+    async def get(self, resource: str, name: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    async def list(self, resource: str) -> List[dict]:
+        raise NotImplementedError
+
+    async def apply(self, resource: str, obj: dict) -> dict:
+        """Create-or-replace by ``metadata.name`` (server-side apply shape)."""
+        raise NotImplementedError
+
+    async def patch_status(self, resource: str, name: str, status: dict) -> dict:
+        raise NotImplementedError
+
+    async def delete(self, resource: str, name: str) -> None:
+        raise NotImplementedError
+
+    async def watch_changed(self, resource: str, timeout: float) -> bool:
+        """Block up to ``timeout`` for a change hint on the resource."""
+        await asyncio.sleep(timeout)
+        return False
+
+
+class FakeK8sApi(K8sApi):
+    """In-memory apiserver-shaped store.
+
+    Implements the semantics controllers depend on: resourceVersion
+    bumping, create-or-replace apply, status subresource patch, delete,
+    and change wake-ups. Tests drive the SC's K8s mode end-to-end
+    against this without a cluster.
+    """
+
+    def __init__(self) -> None:
+        self._store: Dict[str, Dict[str, dict]] = {}
+        self._version = 0
+        self._events: Dict[str, asyncio.Event] = {}
+
+    def _bucket(self, resource: str) -> Dict[str, dict]:
+        return self._store.setdefault(resource, {})
+
+    def _notify(self, resource: str) -> None:
+        self._version += 1
+        ev = self._events.get(resource)
+        if ev is not None:
+            ev.set()
+
+    async def get(self, resource: str, name: str) -> Optional[dict]:
+        obj = self._bucket(resource).get(name)
+        return json.loads(json.dumps(obj)) if obj is not None else None
+
+    async def list(self, resource: str) -> List[dict]:
+        return [json.loads(json.dumps(o)) for o in self._bucket(resource).values()]
+
+    async def apply(self, resource: str, obj: dict) -> dict:
+        name = obj.get("metadata", {}).get("name")
+        if not name:
+            raise K8sApiError(422, "metadata.name is required")
+        obj = json.loads(json.dumps(obj))
+        prev = self._bucket(resource).get(name)
+        if prev is not None and "status" not in obj and "status" in prev:
+            obj["status"] = prev["status"]  # apply does not clear status
+        self._version += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._version)
+        self._bucket(resource)[name] = obj
+        self._notify(resource)
+        return json.loads(json.dumps(obj))
+
+    async def patch_status(self, resource: str, name: str, status: dict) -> dict:
+        obj = self._bucket(resource).get(name)
+        if obj is None:
+            raise K8sApiError(404, f"{resource}/{name} not found")
+        obj["status"] = json.loads(json.dumps(status))
+        self._version += 1
+        obj["metadata"]["resourceVersion"] = str(self._version)
+        self._notify(resource)
+        return json.loads(json.dumps(obj))
+
+    async def delete(self, resource: str, name: str) -> None:
+        self._bucket(resource).pop(name, None)
+        self._notify(resource)
+
+    async def watch_changed(self, resource: str, timeout: float) -> bool:
+        ev = self._events.setdefault(resource, asyncio.Event())
+        if ev.is_set():
+            ev.clear()
+            return True
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            ev.clear()
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+
+def kube_context_from_env() -> dict:
+    """In-cluster service-account context (the operator deployment path)."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+    token = ""
+    token_path = f"{sa}/token"
+    if os.path.exists(token_path):
+        with open(token_path) as f:
+            token = f.read().strip()
+    return {
+        "server": f"https://{host}:{port}",
+        "token": token,
+        "ca_cert": f"{sa}/ca.crt" if os.path.exists(f"{sa}/ca.crt") else "",
+    }
+
+
+class HttpK8sApi(K8sApi):
+    """Real apiserver transport (stdlib http.client in worker threads).
+
+    The SC's K8s run mode constructs this from the in-cluster service
+    account (or an explicit server/token). The verb surface matches
+    `FakeK8sApi`, so everything above the transport is cluster-tested by
+    the fake.
+    """
+
+    def __init__(self, server: str, token: str = "", ca_cert: str = ""):
+        self.server = server.rstrip("/")
+        self.token = token
+        self.ca_cert = ca_cert
+
+    @classmethod
+    def in_cluster(cls) -> "HttpK8sApi":
+        ctx = kube_context_from_env()
+        return cls(ctx["server"], ctx["token"], ctx["ca_cert"])
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 content_type: str = "application/json"):
+        import http.client
+        from urllib.parse import urlparse
+
+        u = urlparse(self.server)
+        if u.scheme == "https":
+            ctx = ssl.create_default_context()
+            if self.ca_cert:
+                ctx.load_verify_locations(self.ca_cert)
+            conn = http.client.HTTPSConnection(
+                u.hostname, u.port or 443, context=ctx, timeout=30
+            )
+        else:
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port or 80, timeout=30
+            )
+        headers = {"Accept": "application/json", "Content-Type": content_type}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        try:
+            conn.request(
+                method,
+                "/" + path.lstrip("/"),
+                json.dumps(body) if body is not None else None,
+                headers,
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 404:
+                return None
+            if resp.status >= 400:
+                raise K8sApiError(resp.status, data.decode("utf-8", "replace"))
+            return json.loads(data) if data else {}
+        finally:
+            conn.close()
+
+    async def _call(self, *args, **kw):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self._request(*args, **kw)
+        )
+
+    async def get(self, resource: str, name: str) -> Optional[dict]:
+        return await self._call("GET", f"{resource}/{name}")
+
+    async def list(self, resource: str) -> List[dict]:
+        out = await self._call("GET", resource)
+        return (out or {}).get("items", [])
+
+    async def apply(self, resource: str, obj: dict) -> dict:
+        name = obj["metadata"]["name"]
+        existing = await self.get(resource, name)
+        if existing is None:
+            return await self._call("POST", resource, obj)
+        obj.setdefault("metadata", {})["resourceVersion"] = existing[
+            "metadata"
+        ].get("resourceVersion", "")
+        return await self._call("PUT", f"{resource}/{name}", obj)
+
+    async def patch_status(self, resource: str, name: str, status: dict) -> dict:
+        return await self._call(
+            "PATCH",
+            f"{resource}/{name}/status",
+            {"status": status},
+            content_type="application/merge-patch+json",
+        )
+
+    async def delete(self, resource: str, name: str) -> None:
+        await self._call("DELETE", f"{resource}/{name}")
+
+    async def watch_changed(self, resource: str, timeout: float) -> bool:
+        """Poll the collection's resourceVersion (cheap LIST with limit=1)
+        and report a change only when it moved — a constant True here
+        would stampede every dispatcher into full resyncs."""
+        if not hasattr(self, "_seen_rv"):
+            self._seen_rv: dict = {}
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            try:
+                out = await self._call("GET", f"{resource}?limit=1")
+                rv = (out or {}).get("metadata", {}).get("resourceVersion", "")
+            except Exception:  # noqa: BLE001 — transient apiserver errors
+                rv = None
+            if rv is not None and rv != self._seen_rv.get(resource):
+                changed = resource in self._seen_rv
+                self._seen_rv[resource] = rv
+                if changed:
+                    return True
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                return False
+            await asyncio.sleep(min(remaining, 2.0))

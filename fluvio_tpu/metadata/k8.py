@@ -1,0 +1,70 @@
+"""K8s CRD metadata backend.
+
+Capability parity: fluvio-stream-dispatcher/src/metadata/k8.rs — the
+`MetadataClient` impl whose source of truth is Kubernetes custom
+resources: one CRD per spec kind under the ``fluvio.infinyon.com``
+group, object key = metadata.name, spec/status mapped onto the CR's
+spec/status subtrees. The SC's K8s run mode plugs this into the same
+`MetadataDispatcher` the local-file backend uses (start.rs:22-62 run
+modes); everything above the client is backend-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from fluvio_tpu.k8s.api import K8sApi
+from fluvio_tpu.metadata.client import MetadataClient
+from fluvio_tpu.stream_model.core import MetadataStoreObject
+
+GROUP = "fluvio.infinyon.com"
+VERSION = "v1"
+
+
+def resource_path(spec_type: type, namespace: str) -> str:
+    plural = spec_type.KIND.lower() + "s"
+    return f"apis/{GROUP}/{VERSION}/namespaces/{namespace}/{plural}"
+
+
+def to_manifest(obj: MetadataStoreObject, namespace: str) -> dict:
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": type(obj.spec).LABEL,
+        "metadata": {"name": obj.key, "namespace": namespace},
+        "spec": obj.spec.to_dict(),
+        "status": obj.status.to_dict(),
+    }
+
+
+def from_manifest(spec_type: type, manifest: dict) -> MetadataStoreObject:
+    status_cls = spec_type.STATUS
+    obj = MetadataStoreObject(
+        key=manifest["metadata"]["name"],
+        spec=spec_type.from_dict(manifest.get("spec") or {}),
+        status=status_cls.from_dict(manifest.get("status") or {}),
+    )
+    return obj
+
+
+class K8sMetadataClient(MetadataClient):
+    def __init__(self, api: K8sApi, namespace: str = "default"):
+        self.api = api
+        self.namespace = namespace
+
+    def _path(self, spec_type: type) -> str:
+        return resource_path(spec_type, self.namespace)
+
+    async def retrieve_items(self, spec_type: type) -> List[MetadataStoreObject]:
+        manifests = await self.api.list(self._path(spec_type))
+        return [from_manifest(spec_type, m) for m in manifests]
+
+    async def apply(self, obj: MetadataStoreObject) -> None:
+        await self.api.apply(
+            self._path(type(obj.spec)), to_manifest(obj, self.namespace)
+        )
+
+    async def delete_item(self, spec_type: type, key: str) -> None:
+        await self.api.delete(self._path(spec_type), key)
+
+    async def watch_changed(self, spec_type: type, timeout: float) -> bool:
+        return await self.api.watch_changed(self._path(spec_type), timeout)

@@ -25,22 +25,65 @@ def build_parser() -> argparse.ArgumentParser:
     sc.add_argument("--read-only", action="store_true")
     sc.add_argument("--auth-policy", help="BasicRbacPolicy JSON file")
     sc.add_argument("--port-file", help="write bound addresses here as JSON")
+    sc.add_argument(
+        "--k8",
+        action="store_true",
+        help="K8s operator mode: CRD metadata + SPG reconcilers",
+    )
+    sc.add_argument("--namespace", default="default")
+    sc.add_argument(
+        "--k8-server", default="", help="apiserver URL (default: in-cluster env)"
+    )
 
     spu = sub.add_parser("spu", help="run a streaming processing unit")
-    spu.add_argument("-i", "--id", type=int, required=True)
+    spu.add_argument(
+        "-i",
+        "--id",
+        type=int,
+        help="SPU id (or derive via --min-id + the pod ordinal)",
+    )
+    spu.add_argument(
+        "--min-id",
+        type=int,
+        help="derive the id as min-id + this pod's StatefulSet ordinal "
+        "(trailing -<n> of the hostname)",
+    )
     spu.add_argument("-p", "--public-addr", default="127.0.0.1:0")
     spu.add_argument("-v", "--private-addr", default="127.0.0.1:0")
     spu.add_argument("--sc-addr", default="", help="SC private endpoint")
-    spu.add_argument("--log-dir", default="/tmp/fluvio-tpu")
+    spu.add_argument("--log-dir", "--log-base-dir", dest="log_dir",
+                     default="/tmp/fluvio-tpu")
     spu.add_argument("--engine", default="auto", choices=["auto", "python", "tpu"])
     spu.add_argument("--monitoring-path", help="metrics unix-socket path")
     spu.add_argument("--port-file", help="write bound addresses here as JSON")
     return parser
 
 
+def resolve_spu_id(args, hostname: str) -> int:
+    """Explicit --id, or min-id + StatefulSet pod ordinal (spg pods get
+    stable identity through their hostname's trailing ``-<n>``)."""
+    if args.id is not None:
+        return args.id
+    if args.min_id is None:
+        raise SystemExit("spu needs --id or --min-id")
+    tail = hostname.rsplit("-", 1)[-1]
+    if not tail.isdigit():
+        raise SystemExit(
+            f"--min-id needs an ordinal hostname (got {hostname!r})"
+        )
+    return args.min_id + int(tail)
+
+
 async def run_sc(args) -> None:
     from fluvio_tpu.sc.start import ScConfig, ScServer
 
+    k8_api = None
+    if args.k8:
+        from fluvio_tpu.k8s import HttpK8sApi
+
+        k8_api = (
+            HttpK8sApi(args.k8_server) if args.k8_server else HttpK8sApi.in_cluster()
+        )
     server = ScServer(
         ScConfig(
             public_addr=args.public_addr,
@@ -48,6 +91,8 @@ async def run_sc(args) -> None:
             metadata_dir=args.metadata_dir,
             read_only=args.read_only,
             auth_policy_path=args.auth_policy,
+            k8_api=k8_api,
+            k8_namespace=args.namespace,
         )
     )
     await server.start()
@@ -60,11 +105,13 @@ async def run_sc(args) -> None:
 
 
 async def run_spu(args) -> None:
+    import socket as _socket
+
     from fluvio_tpu.spu import SpuConfig, SpuServer
     from fluvio_tpu.storage.config import ReplicaConfig
 
     config = SpuConfig(
-        id=args.id,
+        id=resolve_spu_id(args, _socket.gethostname()),
         public_addr=args.public_addr,
         private_addr=args.private_addr,
         sc_addr=args.sc_addr,

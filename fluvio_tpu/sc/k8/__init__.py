@@ -1,0 +1,11 @@
+"""SC Kubernetes operator mode (parity: fluvio-sc/src/k8/)."""
+
+from fluvio_tpu.sc.k8.controllers import (  # noqa: F401
+    K8SpuController,
+    SpgStatefulsetController,
+)
+from fluvio_tpu.sc.k8.objects import (  # noqa: F401
+    spg_service_manifest,
+    spg_statefulset_manifest,
+    spu_name,
+)

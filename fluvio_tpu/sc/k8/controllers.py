@@ -1,0 +1,164 @@
+"""K8s operator controllers.
+
+Capability parity: fluvio-sc/src/k8/controllers/ —
+
+- `SpgStatefulsetController` (spg_stateful.rs:304): reconciles each
+  SpuGroup in the SC store into a StatefulSet + headless Service on the
+  apiserver, and tears them down when the group disappears.
+- `K8SpuController` (spu_controller.rs:274): derives one SpuSpec per
+  group replica (id = min_id + ordinal, endpoints = the pod's stable
+  DNS name through the headless service) so the rest of the control
+  plane — scheduler, partition controller, election — works unchanged
+  on K8s; groups flip to ``reserved`` once all their SPU specs are
+  materialized in the store (id reservation — pod liveness is the SPU
+  health controller's concern).
+
+Both run the store-listener loop shape the local controllers use; the
+apiserver side goes through the pluggable `K8sApi` (the fake in tests,
+HTTP in a cluster).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from fluvio_tpu.k8s.api import K8sApi
+from fluvio_tpu.metadata.spg import SpuGroupStatus
+from fluvio_tpu.metadata.spu import Endpoint, SpuSpec, SpuType
+from fluvio_tpu.sc.context import ScContext
+from fluvio_tpu.sc.k8.objects import (
+    SPU_PRIVATE_PORT,
+    SPU_PUBLIC_PORT,
+    spg_service_manifest,
+    spg_statefulset_manifest,
+)
+from fluvio_tpu.stream_model.core import MetadataStoreObject
+
+logger = logging.getLogger(__name__)
+
+
+class _StoreLoopController:
+    """Listen on one StoreContext; re-run sync_once on every change."""
+
+    def __init__(self, ctx: ScContext, store):
+        self.ctx = ctx
+        self.store = store
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(
+            self._run(), name=type(self).__name__
+        )
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        listener = self.store.store.change_listener()
+        while True:
+            try:
+                await self.sync_once()
+            except Exception:  # noqa: BLE001 — reconcile must keep running
+                logger.exception("%s sync failed", type(self).__name__)
+            if not listener.has_change():
+                await listener.listen()
+            listener.set_current()
+
+    async def sync_once(self) -> None:
+        raise NotImplementedError
+
+
+class SpgStatefulsetController(_StoreLoopController):
+    def __init__(self, ctx: ScContext, api: K8sApi, sc_private_addr: str,
+                 namespace: str = "default"):
+        super().__init__(ctx, ctx.spgs)
+        self.api = api
+        self.sc_private_addr = sc_private_addr
+        self.namespace = namespace
+        self._sts_path = f"apis/apps/v1/namespaces/{namespace}/statefulsets"
+        self._svc_path = f"api/v1/namespaces/{namespace}/services"
+
+    async def sync_once(self) -> None:
+        groups = {o.key: o for o in self.ctx.spgs.store.values()}
+        for key, obj in groups.items():
+            sts = spg_statefulset_manifest(
+                key, obj.spec, self.sc_private_addr, self.namespace
+            )
+            existing = await self.api.get(self._sts_path, sts["metadata"]["name"])
+            if existing is None or existing.get("spec") != sts["spec"]:
+                logger.info("reconciling statefulset for spg %s", key)
+                await self.api.apply(self._sts_path, sts)
+            svc = spg_service_manifest(key, self.namespace)
+            if await self.api.get(self._svc_path, svc["metadata"]["name"]) is None:
+                await self.api.apply(self._svc_path, svc)
+        # garbage-collect objects whose group is gone; only touch objects
+        # this operator owns (app=fluvio-spu), never foreign workloads
+        # that happen to carry a generic "group" label
+        for sts in await self.api.list(self._sts_path):
+            name = sts["metadata"]["name"]
+            labels = sts.get("metadata", {}).get("labels", {})
+            if labels.get("app") != "fluvio-spu":
+                continue
+            group = labels.get("group")
+            if group is not None and group not in groups:
+                logger.info("removing statefulset %s (spg deleted)", name)
+                await self.api.delete(self._sts_path, name)
+                await self.api.delete(self._svc_path, name)
+
+
+class K8SpuController(_StoreLoopController):
+    def __init__(self, ctx: ScContext, namespace: str = "default"):
+        super().__init__(ctx, ctx.spgs)
+        self.namespace = namespace
+
+    def _pod_host(self, group: str, index: int) -> str:
+        svc = f"fluvio-spg-{group}"
+        return f"{svc}-{index}.{svc}.{self.namespace}.svc.cluster.local"
+
+    async def sync_once(self) -> None:
+        want = {}
+        for obj in self.ctx.spgs.store.values():
+            for i in range(obj.spec.replicas):
+                spu_id = obj.spec.min_id + i
+                host = self._pod_host(obj.key, i)
+                want[str(spu_id)] = MetadataStoreObject(
+                    key=str(spu_id),
+                    spec=SpuSpec(
+                        id=spu_id,
+                        spu_type=SpuType.MANAGED,
+                        public_endpoint=Endpoint(host=host, port=SPU_PUBLIC_PORT),
+                        private_endpoint=Endpoint(host=host, port=SPU_PRIVATE_PORT),
+                    ),
+                )
+        existing = {o.key: o for o in self.ctx.spus.store.values()}
+        for key, obj in want.items():
+            prev = existing.get(key)
+            if prev is None or prev.spec != obj.spec:
+                await self.ctx.spus.apply(obj)
+        # remove managed SPUs whose group/ordinal no longer exists
+        # (custom SPUs registered externally are untouched)
+        for key, obj in existing.items():
+            if key not in want and obj.spec.spu_type == SpuType.MANAGED:
+                await self.ctx.spus.delete(key)
+        # groups whose SPU specs all exist in the STORE are reserved
+        # (id reservation, spg/spec.rs semantics; online-ness is the SPU
+        # controller's concern) — read back the store, not `want`, so a
+        # failed apply keeps the group un-reserved
+        spu_keys = {o.key for o in self.ctx.spus.store.values()}
+        for obj in self.ctx.spgs.store.values():
+            ids = [str(obj.spec.min_id + i) for i in range(obj.spec.replicas)]
+            if (
+                all(i in spu_keys for i in ids)
+                and obj.status.resolution != "reserved"
+            ):
+                await self.ctx.spgs.update_status(
+                    obj.key, SpuGroupStatus(resolution="reserved")
+                )

@@ -1,0 +1,114 @@
+"""SPU-group -> Kubernetes object manifests.
+
+Capability parity: fluvio-sc/src/k8/objects/ + the generation half of
+k8/controllers/spg_stateful.rs — an SpuGroup materializes as one
+StatefulSet (ordered pod identity supplies stable SPU ids and DNS
+names) plus one headless Service for the per-pod addresses. Design
+difference from the reference's helm-heavy install: manifests are
+rendered directly by the operator, so the only external dependency is
+the apiserver itself.
+"""
+
+from __future__ import annotations
+
+DEFAULT_IMAGE = "fluvio-tpu/spu:latest"
+SPU_PUBLIC_PORT = 9005
+SPU_PRIVATE_PORT = 9006
+
+
+def spu_name(group: str, index: int) -> str:
+    return f"fluvio-spg-{group}-{index}"
+
+
+def spg_service_manifest(group: str, namespace: str = "default") -> dict:
+    """Headless service: stable per-pod DNS for peer + client routing."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"fluvio-spg-{group}",
+            "namespace": namespace,
+            "labels": {"app": "fluvio-spu", "group": group},
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"app": "fluvio-spu", "group": group},
+            "ports": [
+                {"name": "public", "port": SPU_PUBLIC_PORT},
+                {"name": "private", "port": SPU_PRIVATE_PORT},
+            ],
+        },
+    }
+
+
+def spg_statefulset_manifest(
+    group: str,
+    spec,
+    sc_private_addr: str,
+    namespace: str = "default",
+    image: str = DEFAULT_IMAGE,
+) -> dict:
+    """StatefulSet for an SpuGroupSpec (spg_stateful.rs shape)."""
+    storage = spec.spu_config.storage_size or (10 << 30)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {
+            "name": f"fluvio-spg-{group}",
+            "namespace": namespace,
+            "labels": {"app": "fluvio-spu", "group": group},
+        },
+        "spec": {
+            "serviceName": f"fluvio-spg-{group}",
+            "replicas": spec.replicas,
+            "selector": {
+                "matchLabels": {"app": "fluvio-spu", "group": group}
+            },
+            "template": {
+                "metadata": {
+                    "labels": {"app": "fluvio-spu", "group": group}
+                },
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "spu",
+                            "image": image,
+                            "command": ["python", "-m", "fluvio_tpu.run", "spu"],
+                            # per-pod id = min_id + StatefulSet ordinal,
+                            # derived from the pod hostname by the run host
+                            "args": [
+                                "--sc-addr",
+                                sc_private_addr,
+                                "--min-id",
+                                str(spec.min_id),
+                                "--public-addr",
+                                f"0.0.0.0:{SPU_PUBLIC_PORT}",
+                                "--private-addr",
+                                f"0.0.0.0:{SPU_PRIVATE_PORT}",
+                                "--log-base-dir",
+                                spec.spu_config.log_base_dir or "/var/lib/fluvio",
+                            ],
+                            "ports": [
+                                {"containerPort": SPU_PUBLIC_PORT},
+                                {"containerPort": SPU_PRIVATE_PORT},
+                            ],
+                            "volumeMounts": [
+                                {"name": "data", "mountPath": "/var/lib/fluvio"}
+                            ],
+                        }
+                    ]
+                },
+            },
+            "volumeClaimTemplates": [
+                {
+                    "metadata": {"name": "data"},
+                    "spec": {
+                        "accessModes": ["ReadWriteOnce"],
+                        "resources": {
+                            "requests": {"storage": str(storage)}
+                        },
+                    },
+                }
+            ],
+        },
+    }

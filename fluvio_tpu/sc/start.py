@@ -44,6 +44,11 @@ class ScConfig:
     auth_policy_path: Optional[str] = None
     # public-endpoint TLS; client certs feed x509 identity (fluvio-auth)
     tls: ServerTlsConfig = field(default_factory=ServerTlsConfig)
+    # K8s operator run mode (parity: sc start.rs K8s mode): a K8sApi
+    # makes CRDs the metadata source of truth and runs the SPG
+    # StatefulSet/Service reconcilers; None = local/in-memory modes
+    k8_api: Optional[object] = None
+    k8_namespace: str = "default"
 
 
 class ScServer:
@@ -63,7 +68,14 @@ class ScServer:
         self.ctx = ScContext(authorization=authorization)
         self.metadata_client: Optional[MetadataClient] = None
         self.dispatchers: List[MetadataDispatcher] = []
-        if self.config.metadata_dir is not None:
+        self.k8_controllers: List = []
+        if self.config.k8_api is not None:
+            from fluvio_tpu.metadata.k8 import K8sMetadataClient
+
+            self.metadata_client = K8sMetadataClient(
+                self.config.k8_api, self.config.k8_namespace
+            )
+        elif self.config.metadata_dir is not None:
             self.metadata_client = LocalMetadataClient(self.config.metadata_dir)
         self.topic_controller = TopicController(self.ctx)
         self.partition_controller = PartitionController(self.ctx)
@@ -109,8 +121,25 @@ class ScServer:
         self.spu_controller.start()
         await self.private_server.start()
         await self.public_server.start()
+        if self.config.k8_api is not None:
+            from fluvio_tpu.sc.k8 import K8SpuController, SpgStatefulsetController
+
+            self.k8_controllers = [
+                SpgStatefulsetController(
+                    self.ctx,
+                    self.config.k8_api,
+                    self.private_addr,
+                    self.config.k8_namespace,
+                ),
+                K8SpuController(self.ctx, self.config.k8_namespace),
+            ]
+            for c in self.k8_controllers:
+                c.start()
 
     async def stop(self) -> None:
+        for c in self.k8_controllers:
+            await c.stop()
+        self.k8_controllers = []
         await self.public_server.stop()
         await self.private_server.stop()
         await self.topic_controller.stop()

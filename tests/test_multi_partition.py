@@ -1,0 +1,182 @@
+"""MultiplePartitionConsumer + PartitionSelectionStrategy
+(parity: fluvio/src/consumer.rs:590-720).
+
+Full cluster (SC + SPU over the private API), a 2-partition topic, and a
+merged consume stream — including through a SmartModule chain.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from fluvio_tpu.client import (
+    ConsumerConfig,
+    Fluvio,
+    Offset,
+    PartitionSelectionStrategy,
+)
+from fluvio_tpu.metadata.topic import TopicSpec
+from fluvio_tpu.schema.smartmodule import (
+    SmartModuleInvocation,
+    SmartModuleInvocationKind,
+    SmartModuleInvocationWasm,
+)
+
+from test_sc import boot_cluster, shutdown_cluster
+
+FILTER_SM = b"""
+@smartmodule.filter(dsl=dsl.FilterProgram(
+    predicate=dsl.Contains(arg=dsl.Value(), literal=b"keep")))
+def fil(record):
+    return b"keep" in record.value
+"""
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def _wait_replicas(spu, topic, partitions):
+    for _ in range(100):
+        if all(spu.ctx.leader_for(topic, p) is not None for p in partitions):
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("replicas never provisioned")
+
+
+async def _setup(tmp_path, n_values=40):
+    sc, admin, spus = await boot_cluster(tmp_path)
+    await admin.create_topic("multi", TopicSpec.computed(2))
+    await _wait_replicas(spus[0], "multi", [0, 1])
+    client = await Fluvio.connect(sc.public_addr)
+    producer = await client.topic_producer("multi")
+    futs = [
+        await producer.send(f"k{i}".encode(), f"keep-{i:03d}".encode())
+        for i in range(n_values)
+    ]
+    await producer.flush()
+    metas = [await f.wait() for f in futs]
+    return sc, admin, spus, client, metas
+
+
+class TestMultiPartitionConsumer:
+    def test_all_partitions_merged_stream(self, tmp_path):
+        async def body():
+            sc, admin, spus, client, metas = await _setup(tmp_path)
+            try:
+                consumer = await client.consumer(
+                    PartitionSelectionStrategy.all("multi")
+                )
+                assert len(consumer.consumers) == 2
+                got = []
+                async for r in consumer.stream(
+                    Offset.beginning(), ConsumerConfig(disable_continuous=True)
+                ):
+                    got.append(r)
+                assert sorted(r.value for r in got) == sorted(
+                    f"keep-{i:03d}".encode() for i in range(40)
+                )
+                # both partitions contributed and per-partition order held
+                parts = {r.partition for r in got}
+                assert parts == {0, 1}
+                for p in parts:
+                    offs = [r.offset for r in got if r.partition == p]
+                    assert offs == sorted(offs)
+            finally:
+                await client.close()
+                await shutdown_cluster(sc, admin, spus)
+
+        run(body())
+
+    def test_explicit_partition_subset(self, tmp_path):
+        async def body():
+            sc, admin, spus, client, metas = await _setup(tmp_path)
+            try:
+                consumer = await client.consumer(
+                    PartitionSelectionStrategy.multiple("multi", [1])
+                )
+                got = [
+                    r
+                    async for r in consumer.stream(
+                        Offset.beginning(),
+                        ConsumerConfig(disable_continuous=True),
+                    )
+                ]
+                assert got and all(r.partition == 1 for r in got)
+            finally:
+                await client.close()
+                await shutdown_cluster(sc, admin, spus)
+
+        run(body())
+
+    def test_merged_stream_through_chain(self, tmp_path):
+        async def body():
+            sc, admin, spus, client, metas = await _setup(tmp_path)
+            try:
+                # poison a few records that the chain must drop
+                producer = await client.topic_producer("multi")
+                futs = [
+                    await producer.send(f"p{i}".encode(), f"drop-{i}".encode())
+                    for i in range(6)
+                ]
+                await producer.flush()
+                for f in futs:
+                    await f.wait()
+                cfg = ConsumerConfig(
+                    disable_continuous=True,
+                    smartmodules=[
+                        SmartModuleInvocation(
+                            wasm=SmartModuleInvocationWasm.adhoc(FILTER_SM),
+                            kind=SmartModuleInvocationKind.FILTER,
+                        )
+                    ],
+                )
+                consumer = await client.consumer(
+                    PartitionSelectionStrategy.all("multi")
+                )
+                got = [
+                    r.value
+                    async for r in consumer.stream(Offset.beginning(), cfg)
+                ]
+                assert sorted(got) == sorted(
+                    f"keep-{i:03d}".encode() for i in range(40)
+                )
+            finally:
+                await client.close()
+                await shutdown_cluster(sc, admin, spus)
+
+        run(body())
+
+    def test_all_requires_metadata(self, tmp_path):
+        """A lone-SPU connection cannot resolve 'all partitions'."""
+        from fluvio_tpu.spu import SpuConfig, SpuServer
+        from fluvio_tpu.storage.config import ReplicaConfig
+
+        async def body():
+            config = SpuConfig(
+                id=7001,
+                public_addr="127.0.0.1:0",
+                log_base_dir=str(tmp_path),
+                replication=ReplicaConfig(base_dir=str(tmp_path)),
+            )
+            server = SpuServer(config)
+            await server.start()
+            server.ctx.create_replica("t", 0)
+            client = await Fluvio.connect(server.public_addr)
+            with pytest.raises(ValueError):
+                await client.consumer(PartitionSelectionStrategy.all("t"))
+            # explicit partitions still work without an SC
+            consumer = await client.consumer(
+                PartitionSelectionStrategy.multiple("t", [0])
+            )
+            assert len(consumer.consumers) == 1
+            await client.close()
+            await server.stop()
+
+        run(body())
