@@ -233,21 +233,32 @@ class HttpK8sApi(K8sApi):
         await self._call("DELETE", f"{resource}/{name}")
 
     async def watch_changed(self, resource: str, timeout: float) -> bool:
-        """Poll the collection's resourceVersion (cheap LIST with limit=1)
-        and report a change only when it moved — a constant True here
-        would stampede every dispatcher into full resyncs."""
-        if not hasattr(self, "_seen_rv"):
-            self._seen_rv: dict = {}
+        """Poll a per-collection fingerprint and report a change only
+        when it moved. The fingerprint is the set of item (name,
+        resourceVersion) pairs — NOT the list's metadata.resourceVersion,
+        which on a real apiserver is the cluster-global etcd revision and
+        moves on every unrelated change (node leases, other workloads),
+        which would stampede every dispatcher into constant resyncs."""
+        if not hasattr(self, "_seen_fp"):
+            self._seen_fp: dict = {}
         deadline = asyncio.get_running_loop().time() + timeout
         while True:
             try:
-                out = await self._call("GET", f"{resource}?limit=1")
-                rv = (out or {}).get("metadata", {}).get("resourceVersion", "")
+                items = await self.list(resource)
+                fp = tuple(
+                    sorted(
+                        (
+                            it.get("metadata", {}).get("name", ""),
+                            it.get("metadata", {}).get("resourceVersion", ""),
+                        )
+                        for it in items
+                    )
+                )
             except Exception:  # noqa: BLE001 — transient apiserver errors
-                rv = None
-            if rv is not None and rv != self._seen_rv.get(resource):
-                changed = resource in self._seen_rv
-                self._seen_rv[resource] = rv
+                fp = None
+            if fp is not None and fp != self._seen_fp.get(resource):
+                changed = resource in self._seen_fp
+                self._seen_fp[resource] = fp
                 if changed:
                     return True
             remaining = deadline - asyncio.get_running_loop().time()

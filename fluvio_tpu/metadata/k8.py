@@ -27,12 +27,14 @@ def resource_path(spec_type: type, namespace: str) -> str:
 
 
 def to_manifest(obj: MetadataStoreObject, namespace: str) -> dict:
+    # no status subtree here: the CRDs enable the status subresource, so
+    # a real apiserver DROPS status carried on a main-resource PUT —
+    # status goes through patch_status separately (see K8sMetadataClient)
     return {
         "apiVersion": f"{GROUP}/{VERSION}",
         "kind": type(obj.spec).LABEL,
         "metadata": {"name": obj.key, "namespace": namespace},
         "spec": obj.spec.to_dict(),
-        "status": obj.status.to_dict(),
     }
 
 
@@ -59,9 +61,10 @@ class K8sMetadataClient(MetadataClient):
         return [from_manifest(spec_type, m) for m in manifests]
 
     async def apply(self, obj: MetadataStoreObject) -> None:
-        await self.api.apply(
-            self._path(type(obj.spec)), to_manifest(obj, self.namespace)
-        )
+        path = self._path(type(obj.spec))
+        await self.api.apply(path, to_manifest(obj, self.namespace))
+        # persist status through the subresource (a PUT can't carry it)
+        await self.api.patch_status(path, obj.key, obj.status.to_dict())
 
     async def delete_item(self, spec_type: type, key: str) -> None:
         await self.api.delete(self._path(spec_type), key)

@@ -1,0 +1,312 @@
+"""Multi-device engine mode: the fused chain under `jax.shard_map`.
+
+Capability parity: this is the engine's production multi-chip path (the
+"Engine multi-chip sharding" row of the component inventory). The
+GSPMD-traced path in `mesh.py` proves sharded equivalence but must
+trace with pallas disabled (GSPMD cannot partition `pallas_call`);
+`shard_map` places the SAME stage pipeline on each device with the
+byte-level pallas kernels active per shard, and the only cross-shard
+traffic is what the semantics require: the aggregate carry chain and
+window propagation ride explicit `all_gather` prefix fixups
+(kernels.assoc_scan_with_prefix) over ICI, everything else is
+row-local. Selected by ``SmartEngine(mesh_devices=N)`` /
+``SpuConfig.smart_engine.mesh_devices``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fluvio_tpu.parallel.mesh import RECORD_AXIS, make_record_mesh
+from fluvio_tpu.smartengine.tpu import kernels
+from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer, apply_postops_host
+
+try:  # jax>=0.4.35 exposes shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+class ShardedChainExecutor:
+    """Row-sharded executor with the single-device executor's surface.
+
+    Supports row-preserving chains (filters / span or byte maps /
+    aggregates). Fan-out (array_map) stays on the single-device
+    executor: per-shard capacity scatter needs its own design.
+    """
+
+    def __init__(self, executor, n_devices: int, devices=None):
+        devs = list(devices if devices is not None else jax.devices())
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"mesh_devices={n_devices} but only {len(devs)} jax devices"
+            )
+        if executor._fanout:
+            raise ValueError("array_map chains are not sharded yet")
+        self.executor = executor
+        self.n = n_devices
+        self.mesh = make_record_mesh(n_devices, devices=devs)
+        self._jit_cache: Dict = {}
+
+    # -- traced step ---------------------------------------------------------
+
+    def _local_step(self, arrays: Dict, count, base_ts, carries):
+        ex = self.executor
+        ax = RECORD_AXIS
+        n_local = arrays["values"].shape[0]
+        g0 = lax.axis_index(ax) * n_local
+        gidx = g0 + jnp.arange(n_local, dtype=jnp.int32)
+        state = dict(arrays)
+        state["valid"] = gidx < count
+        state["view_start"] = jnp.zeros((n_local,), dtype=jnp.int32)
+        state["src_row"] = gidx
+        ctx = {"fanout_cap": None, "axis_name": ax, "g0": g0}
+        for stage in ex.stages:
+            state, carries = stage.apply(state, carries, base_ts, ctx)
+        valid = state["valid"]
+        cnt = jnp.sum(valid.astype(jnp.int32))
+
+        def header(max_v, max_k):
+            return jnp.stack(
+                [
+                    cnt.astype(jnp.int64),
+                    max_v.astype(jnp.int64),
+                    max_k.astype(jnp.int64),
+                    jnp.int64(0),
+                    jnp.int64(0),
+                ]
+            )[None, :]
+
+        packed: Dict = {"mask": kernels.pack_mask(valid)}
+        if ex._viewable:
+            _, (cstart, clen) = kernels.compact_rows(
+                valid, state["view_start"], state["lengths"]
+            )
+            packed["span_start"] = cstart
+            packed["span_len"] = clen
+            return header(jnp.max(clen), jnp.int32(0)), packed, carries
+        _, compacted = kernels.compact_rows(
+            valid,
+            state["values"],
+            state["lengths"],
+            state["keys"],
+            state["key_lengths"],
+        )
+        packed["values"] = compacted[0]
+        packed["lengths"] = compacted[1]
+        packed["keys"] = compacted[2]
+        packed["key_lengths"] = compacted[3]
+        return (
+            header(jnp.max(compacted[1]), jnp.max(compacted[3])),
+            packed,
+            carries,
+        )
+
+    def _jitted(self, arrays: Dict):
+        key = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in arrays.items()))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            row = P(RECORD_AXIS)
+            mat = P(RECORD_AXIS, None)
+            rep = P()
+            in_specs = (
+                {k: (mat if v.ndim == 2 else row) for k, v in arrays.items()},
+                rep,
+                rep,
+                jax.tree_util.tree_map(lambda _: rep, self._carries()),
+            )
+            out_specs = (
+                row,  # per-shard (1, 5) headers stack to (n, 5)
+                self._packed_specs(),
+                jax.tree_util.tree_map(lambda _: rep, self._carries()),
+            )
+            fn = jax.jit(
+                _shard_map(
+                    self._local_step,
+                    mesh=self.mesh,
+                    in_specs=in_specs,
+                    out_specs=out_specs,
+                    check_vma=False,
+                )
+            )
+            self._jit_cache[key] = fn
+        return fn
+
+    def _packed_specs(self):
+        row = P(RECORD_AXIS)
+        mat = P(RECORD_AXIS, None)
+        if self.executor._viewable:
+            return {"mask": row, "span_start": row, "span_len": row}
+        return {
+            "mask": row,
+            "values": mat,
+            "lengths": row,
+            "keys": mat,
+            "key_lengths": row,
+        }
+
+    # -- execution -----------------------------------------------------------
+
+    def _carries(self):
+        return tuple(
+            (jnp.int64(acc), jnp.int64(win), jnp.asarray(has))
+            for acc, win, has in self.executor.carries
+        )
+
+    def _padded_arrays(self, buf: RecordBuffer) -> Dict[str, np.ndarray]:
+        rows = buf.values.shape[0]
+        need = max(self.n * 8, rows)
+        if need % self.n:
+            need += self.n - (need % self.n)
+        pad = need - rows
+
+        def pad_rows(a, fill=0):
+            if pad == 0:
+                return a
+            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, widths, constant_values=fill)
+
+        return {
+            "values": pad_rows(buf.values),
+            "lengths": pad_rows(buf.lengths),
+            "keys": pad_rows(buf.keys),
+            "key_lengths": pad_rows(buf.key_lengths, fill=-1),
+            "offset_deltas": pad_rows(buf.offset_deltas),
+            "timestamp_deltas": pad_rows(buf.timestamp_deltas),
+        }
+
+    def dispatch_buffer(self, buf: RecordBuffer):
+        arrays = self._padded_arrays(buf)
+        sharded = {
+            k: jax.device_put(
+                v,
+                NamedSharding(
+                    self.mesh, P(RECORD_AXIS, None) if v.ndim == 2 else P(RECORD_AXIS)
+                ),
+            )
+            for k, v in arrays.items()
+        }
+        fn = self._jitted(sharded)
+        header, packed, new_carries = fn(
+            sharded,
+            jnp.int32(buf.count),
+            jnp.int64(buf.base_timestamp),
+            self._carries(),
+        )
+        return (new_carries, header, packed)
+
+    def discard_dispatch(self, handle) -> None:
+        pass  # carries commit in finish_buffer; nothing dispatched to undo
+
+    def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
+        new_carries, header, packed = handle
+        ex = self.executor
+        hdrs = np.asarray(jax.device_get(header))  # (n_shards, 5)
+        counts = hdrs[:, 0].astype(np.int64)
+        total = int(counts.sum())
+        n_rows = buf.values.shape[0]
+        shard_rows = None
+
+        host = jax.device_get(packed)
+        mask = np.asarray(host["mask"])
+        src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
+        width = buf.values.shape[1]
+
+        if ex._viewable:
+            starts = np.asarray(host["span_start"])
+            lens = np.asarray(host["span_len"])
+            shard_rows = starts.shape[0] // self.n
+            st = np.concatenate(
+                [
+                    starts[s * shard_rows : s * shard_rows + counts[s]]
+                    for s in range(self.n)
+                ]
+            ).astype(np.int64)
+            ln = np.concatenate(
+                [
+                    lens[s * shard_rows : s * shard_rows + counts[s]]
+                    for s in range(self.n)
+                ]
+            ).astype(np.int32)
+            vw = int(max(int(hdrs[:, 1].max()), 1))
+            vw = min(ex._pad_slice(vw), width)
+            rows_out = min(ex._bucket_bytes(max(total, 1), 8), max(n_rows, 8))
+            out_values = np.zeros((rows_out, vw), dtype=np.uint8)
+            if total:
+                cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
+                gathered = buf.values[
+                    src[:total, None], np.clip(cols, 0, width - 1)
+                ]
+                keep = np.arange(vw, dtype=np.int32)[None, :] < ln[:, None]
+                out_values[:total] = apply_postops_host(
+                    np.where(keep, gathered, 0), ex._view_postops
+                )
+            out_lengths = np.zeros((rows_out,), dtype=np.int32)
+            out_lengths[:total] = ln
+            if buf.has_keys():
+                out_keys = np.zeros((rows_out, buf.keys.shape[1]), np.uint8)
+                out_klens = np.full((rows_out,), -1, np.int32)
+                out_keys[:total] = buf.keys[src[:total]]
+                out_klens[:total] = buf.key_lengths[src[:total]]
+            else:
+                out_keys = np.zeros((rows_out, 1), np.uint8)
+                out_klens = np.full((rows_out,), -1, np.int32)
+        else:
+            values = np.asarray(host["values"])
+            lengths = np.asarray(host["lengths"])
+            keys = np.asarray(host["keys"])
+            klens = np.asarray(host["key_lengths"])
+            shard_rows = values.shape[0] // self.n
+
+            def concat_counts(a):
+                return np.concatenate(
+                    [
+                        a[s * shard_rows : s * shard_rows + counts[s]]
+                        for s in range(self.n)
+                    ]
+                )
+
+            rows_out = min(ex._bucket_bytes(max(total, 1), 8), max(n_rows, 8))
+            cv = concat_counts(values)
+            out_values = np.zeros((rows_out, values.shape[1]), np.uint8)
+            out_values[:total] = cv
+            out_lengths = np.zeros((rows_out,), np.int32)
+            out_lengths[:total] = concat_counts(lengths)
+            out_keys = np.zeros((rows_out, keys.shape[1]), np.uint8)
+            out_keys[:total] = concat_counts(keys)
+            out_klens = np.full((rows_out,), -1, np.int32)
+            out_klens[:total] = concat_counts(klens)
+
+        out_off = np.zeros((rows_out,), np.int32)
+        out_ts = np.zeros((rows_out,), np.int64)
+        src_c = np.clip(src[:total], 0, buf.offset_deltas.shape[0] - 1)
+        out_off[:total] = buf.offset_deltas[src_c]
+        out_ts[:total] = buf.timestamp_deltas[src_c]
+
+        # commit carries: host mirror stays authoritative across calls
+        if ex.agg_configs:
+            hostc = jax.device_get(new_carries)
+            ex.carries = [(int(a), int(w), bool(h)) for a, w, h in hostc]
+            ex._device_carries = None
+            ex._sync_instances()
+
+        return RecordBuffer(
+            values=out_values,
+            lengths=out_lengths,
+            keys=out_keys,
+            key_lengths=out_klens,
+            offset_deltas=out_off,
+            timestamp_deltas=out_ts,
+            count=total,
+            base_offset=buf.base_offset,
+            base_timestamp=buf.base_timestamp,
+        )
+
+    def process_buffer(self, buf: RecordBuffer) -> RecordBuffer:
+        return self.finish_buffer(buf, self.dispatch_buffer(buf))

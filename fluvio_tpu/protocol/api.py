@@ -186,6 +186,12 @@ class ApiVersionsResponse(Encodable):
                 return k.max_version
         return None
 
+    def lookup_range(self, api_key: int) -> "ApiVersionKey | None":
+        for k in self.api_keys:
+            if k.api_key == api_key:
+                return k
+        return None
+
 
 @dataclass
 class ApiVersionsRequest(ApiRequest):

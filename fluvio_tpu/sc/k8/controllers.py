@@ -87,7 +87,13 @@ class SpgStatefulsetController(_StoreLoopController):
         self._svc_path = f"api/v1/namespaces/{namespace}/services"
 
     async def sync_once(self) -> None:
-        groups = {o.key: o for o in self.ctx.spgs.store.values()}
+        # invalid groups (id-range conflicts, flagged by K8SpuController)
+        # get no workloads — and any they already had are collected below
+        groups = {
+            o.key: o
+            for o in self.ctx.spgs.store.values()
+            if o.status.resolution != "invalid"
+        }
         for key, obj in groups.items():
             sts = spg_statefulset_manifest(
                 key, obj.spec, self.sc_private_addr, self.namespace
@@ -124,11 +130,25 @@ class K8SpuController(_StoreLoopController):
         return f"{svc}-{index}.{svc}.{self.namespace}.svc.cluster.local"
 
     async def sync_once(self) -> None:
+        # deterministic claim order (group key); a group whose id range
+        # collides with an earlier group's reservation is INVALID — never
+        # silently last-writer-wins two pods onto one SPU id
         want = {}
-        for obj in self.ctx.spgs.store.values():
+        claimed: dict = {}
+        invalid: dict = {}
+        for obj in sorted(self.ctx.spgs.store.values(), key=lambda o: o.key):
+            ids = [str(obj.spec.min_id + i) for i in range(obj.spec.replicas)]
+            clash = next((i for i in ids if i in claimed), None)
+            if clash is not None:
+                invalid[obj.key] = (
+                    f"spu id {clash} already reserved by group "
+                    f"{claimed[clash]!r}"
+                )
+                continue
             for i in range(obj.spec.replicas):
                 spu_id = obj.spec.min_id + i
                 host = self._pod_host(obj.key, i)
+                claimed[str(spu_id)] = obj.key
                 want[str(spu_id)] = MetadataStoreObject(
                     key=str(spu_id),
                     spec=SpuSpec(
@@ -148,12 +168,22 @@ class K8SpuController(_StoreLoopController):
         for key, obj in existing.items():
             if key not in want and obj.spec.spu_type == SpuType.MANAGED:
                 await self.ctx.spus.delete(key)
-        # groups whose SPU specs all exist in the STORE are reserved
-        # (id reservation, spg/spec.rs semantics; online-ness is the SPU
-        # controller's concern) — read back the store, not `want`, so a
-        # failed apply keeps the group un-reserved
+        # conflicting groups surface as invalid; groups whose SPU specs
+        # all exist in the STORE are reserved (id reservation,
+        # spg/spec.rs semantics; online-ness is the SPU controller's
+        # concern) — read back the store, not `want`, so a failed apply
+        # keeps the group un-reserved
         spu_keys = {o.key for o in self.ctx.spus.store.values()}
         for obj in self.ctx.spgs.store.values():
+            if obj.key in invalid:
+                if obj.status.resolution != "invalid":
+                    await self.ctx.spgs.update_status(
+                        obj.key,
+                        SpuGroupStatus(
+                            resolution="invalid", reason=invalid[obj.key]
+                        ),
+                    )
+                continue
             ids = [str(obj.spec.min_id + i) for i in range(obj.spec.replicas)]
             if (
                 all(i in spu_keys for i in ids)

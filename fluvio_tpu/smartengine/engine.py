@@ -17,6 +17,8 @@ Backend selection replaces the reference's single wasmtime runtime:
 
 from __future__ import annotations
 
+import logging
+
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, List, Optional
 
@@ -30,6 +32,8 @@ from fluvio_tpu.smartmodule.types import (
 from fluvio_tpu.smartengine.config import Lookback, SmartModuleConfig
 from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
 from fluvio_tpu.smartengine.python_backend import PythonInstance
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_STORE_MAX_MEMORY = 1 << 30  # 1 GB input bound, parity: engine.rs:24
 
@@ -60,6 +64,9 @@ class SmartEngine:
 
     backend: str = "python"  # python | tpu | auto
     store_max_memory: int = DEFAULT_STORE_MAX_MEMORY
+    # multi-device engine mode: shard chains over an n-device record
+    # mesh via shard_map (0/1 = single device)
+    mesh_devices: int = 0
 
     def builder(self) -> "SmartModuleChainBuilder":
         return SmartModuleChainBuilder(engine=self)
@@ -119,6 +126,13 @@ class SmartModuleChainBuilder:
                 tpu_chain = None
             if tpu_chain is not None:
                 tpu_chain.attach(instances)
+                if engine.mesh_devices and engine.mesh_devices > 1:
+                    try:
+                        tpu_chain.enable_sharded(engine.mesh_devices)
+                    except ValueError as e:
+                        # not enough devices / unshardable chain: stay on
+                        # the single-device executor rather than failing
+                        logger.warning("sharded engine mode unavailable: %s", e)
             if tpu_chain is None and backend == "tpu":
                 raise EngineError(
                     "backend='tpu' requires every module in the chain to "

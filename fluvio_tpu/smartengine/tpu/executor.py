@@ -226,6 +226,13 @@ class _AggregateStage:
         else:
             w = jnp.zeros(x.shape[0], dtype=jnp.int64)
 
+        ax = ctx.get("axis_name")
+        if ax is not None:
+            return self._apply_sharded(
+                state, carries, ctx, valid, xm, w, acc_in, win_in, has_in,
+                neutral, ax,
+            )
+
         # prepend the carry as a virtual row
         x_all = jnp.concatenate([jnp.where(has_in, acc_in, neutral)[None], xm])
         w_all = jnp.concatenate([win_in[None], w])
@@ -242,6 +249,54 @@ class _AggregateStage:
         new_acc = kernels.last_true_value(valid_all, scan, acc_in)
         new_win = kernels.last_true_value(valid_all, w_all, win_in)
         new_has = has_in | jnp.any(valid)
+
+        new_state = dict(state)
+        v, l = kernels.int_to_ascii(out_vals)
+        new_state["values"], new_state["lengths"] = v, l.astype(jnp.int32)
+        if self.window_ms:
+            kv, kl = kernels.int_to_ascii(w)
+            new_state["keys"], new_state["key_lengths"] = kv, kl.astype(jnp.int32)
+        new_carries = list(carries)
+        new_carries[self.index] = (new_acc, new_win, new_has)
+        return new_state, tuple(new_carries)
+
+    def _apply_sharded(
+        self, state, carries, ctx, valid, xm, w, acc_in, win_in, has_in,
+        neutral, ax,
+    ):
+        """The same math under `shard_map`: the virtual carry row becomes
+        the PREFIX element of explicit cross-shard associative scans
+        (kernels.assoc_scan_with_prefix), which is bit-equal for the
+        integer monoids — and keeps pallas kernels active inside each
+        shard, which GSPMD tracing cannot.
+        """
+        g0 = ctx["g0"]
+        op_fn = kernels._AGG_OPS[self.op][1]
+
+        def prop_combine(a, b):
+            ha, wa = a
+            hb, wb = b
+            return ha | hb, jnp.where(hb, wb, wa)
+
+        # prev window per row = fold over (carry + all earlier global rows)
+        (prevhas, prevw), _ = kernels.assoc_scan_with_prefix(
+            prop_combine, (valid, w), (has_in, win_in), ax
+        )
+        reset = valid & (~prevhas | (w != prevw))
+
+        def seg_combine(a, b):
+            fa, va = a
+            fb, vb = b
+            return fa | fb, jnp.where(fb, vb, op_fn(va, vb))
+
+        prefix = (has_in, jnp.where(has_in, acc_in, neutral))
+        _, (_, out_vals) = kernels.assoc_scan_with_prefix(
+            seg_combine, (reset, xm), prefix, ax
+        )
+
+        new_acc = kernels.global_last_true(valid, out_vals, acc_in, g0, ax)
+        new_win = kernels.global_last_true(valid, w, win_in, g0, ax)
+        new_has = has_in | kernels.global_any(valid, ax)
 
         new_state = dict(state)
         v, l = kernels.int_to_ascii(out_vals)
@@ -292,6 +347,7 @@ class TpuChainExecutor:
         # this chip's tunnel) carries ~5x fewer bytes
         self._fanout = any(isinstance(s, _ArrayMapStage) for s in stages)
         self._cap_ratio: float = 0.0  # learned fan-out elements per source row
+        self._sharded = None  # multi-device delegate (enable_sharded)
         self._viewable = not agg_configs and all(
             isinstance(s, (_FilterStage, _ArrayMapStage))
             or (
@@ -850,6 +906,16 @@ class TpuChainExecutor:
         # 25% headroom over the observed density
         self._cap_ratio = max(self._cap_ratio, 1.25 * total / rows)
 
+    def enable_sharded(self, n_devices: int, devices=None) -> None:
+        """Switch this chain to the multi-device engine mode: the same
+        stage pipeline under `shard_map` over an ``n_devices`` record
+        mesh, pallas kernels active per shard. Raises ValueError when
+        the chain or the device set cannot shard (caller decides whether
+        that is fatal)."""
+        from fluvio_tpu.parallel.sharded import ShardedChainExecutor
+
+        self._sharded = ShardedChainExecutor(self, n_devices, devices)
+
     def dispatch_buffer(self, buf: RecordBuffer):
         """Phase 1: stage + dispatch without blocking on results.
 
@@ -859,12 +925,17 @@ class TpuChainExecutor:
         slice k+1 here while slice k's results download and hit the
         socket.
         """
+        if self._sharded is not None:
+            return self._sharded.dispatch_buffer(buf)
         prev_carries = self._device_carries
         header, packed = self._dispatch(buf, fanout_cap=self._fanout_cap(buf))
         return (prev_carries, header, packed)
 
     def discard_dispatch(self, handle) -> None:
         """Drop a speculative dispatch, restoring pre-dispatch carries."""
+        if self._sharded is not None:
+            self._sharded.discard_dispatch(handle)
+            return
         self._device_carries = handle[0]
 
     def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
@@ -877,6 +948,8 @@ class TpuChainExecutor:
         `TpuSpill` (carries restored) for the interpreter to re-run with
         exact error semantics.
         """
+        if self._sharded is not None:
+            return self._sharded.finish_buffer(buf, handle)
         prev_carries, header, packed = handle
         try:
             return self._fetch(buf, header, packed)
@@ -904,35 +977,27 @@ class TpuChainExecutor:
         The broker's consume loop shape: sustained throughput is bounded by
         max(compute, transfer), not their sum.
         """
-        if self._fanout and self.agg_configs:
-            # overflow retry must roll carries back, which a pipelined
-            # stream cannot do once the next batch has dispatched
+        if self.agg_configs and (self._fanout or self._sharded is not None):
+            # serialized: fan-out overflow retry must roll carries back
+            # (impossible once the next batch dispatched), and the
+            # sharded executor commits carries to the host mirror only at
+            # finish — a dispatch-ahead would read stale state
             for buf in bufs:
                 yield self.process_buffer(buf)
             return
 
-        def fetch(triple):
-            buf, header, packed = triple
-            try:
-                return self._fetch(buf, header, packed)
-            except _FanoutOverflow as o:
-                # stateless chain: redispatching one batch is safe
-                self._learn_cap(buf, o.total)
-                cap = self._bucket_bytes(o.total, 1024)
-                h2, p2 = self._dispatch(buf, fanout_cap=cap)
-                return self._fetch(buf, h2, p2)
-
+        # two-phase pipeline through the delegating API (single-device OR
+        # sharded mesh): finish_buffer handles overflow retry internally,
+        # which is safe here — stateless chains have no carries to roll
+        # back, and aggregate chains without fan-out cannot overflow
         pending = None
         for buf in bufs:
-            dispatched = (
-                buf,
-                *self._dispatch(buf, fanout_cap=self._fanout_cap(buf)),
-            )
+            handle = self.dispatch_buffer(buf)
             if pending is not None:
-                yield fetch(pending)
-            pending = dispatched
+                yield self.finish_buffer(pending[0], pending[1])
+            pending = (buf, handle)
         if pending is not None:
-            yield fetch(pending)
+            yield self.finish_buffer(pending[0], pending[1])
 
     def process(
         self, inp: SmartModuleInput, metrics: Optional[SmartModuleChainMetrics] = None

@@ -641,6 +641,77 @@ def propagate_last_valid(
     return filled, has
 
 
+def assoc_scan_with_prefix(combine, elems, prefix, axis_name=None):
+    """(exclusive, inclusive) associative scans seeded by ``prefix``.
+
+    ``elems``/``prefix`` are tuples of arrays/scalars. With ``axis_name``
+    the scan spans the sharded row axis: each shard scans locally, shard
+    totals are all-gathered, and every shard folds (prefix + the totals
+    of the shards before it) into its local results — the standard
+    inter-block prefix fixup, exact for the integer monoids the engine
+    uses. This is how aggregate state crosses shards under `shard_map`
+    while pallas kernels stay active inside each shard (GSPMD tracing
+    cannot partition `pallas_call`; explicit collectives can).
+    """
+    local_incl = lax.associative_scan(combine, elems)
+    if axis_name is not None:
+        totals = tuple(a[-1] for a in local_incl)
+        gathered = tuple(lax.all_gather(t, axis_name) for t in totals)
+        gathered = tuple(
+            jnp.concatenate([jnp.asarray(p)[None], g])
+            for p, g in zip(prefix, gathered)
+        )
+        g_incl = lax.associative_scan(combine, gathered)
+        i = lax.axis_index(axis_name)
+        shard_prefix = tuple(g[i] for g in g_incl)
+    else:
+        shard_prefix = tuple(jnp.asarray(p) for p in prefix)
+    bcast = tuple(p[None] for p in shard_prefix)
+    incl = combine(
+        tuple(jnp.broadcast_to(b, a.shape) for b, a in zip(bcast, local_incl)),
+        local_incl,
+    )
+    shifted = tuple(a[:-1] for a in local_incl)
+    if shifted[0].shape[0]:
+        tail = combine(
+            tuple(
+                jnp.broadcast_to(b, a.shape) for b, a in zip(bcast, shifted)
+            ),
+            shifted,
+        )
+        excl = tuple(
+            jnp.concatenate([p[None], t]) for p, t in zip(shard_prefix, tail)
+        )
+    else:
+        excl = tuple(p[None] for p in shard_prefix)
+    return excl, incl
+
+
+def global_last_true(flags, values, fallback, g0, axis_name=None):
+    """Value at the globally-last True flag, else fallback.
+
+    ``g0`` is this shard's first global row index; with ``axis_name`` the
+    winner is picked across shards by all-gathered (index, value) pairs.
+    """
+    n = flags.shape[0]
+    li = jnp.max(jnp.where(flags, jnp.arange(n, dtype=jnp.int32), -1))
+    val = values[jnp.clip(li, 0, n - 1)]
+    gli = jnp.where(li >= 0, g0 + li, jnp.int32(-1))
+    if axis_name is None:
+        return jnp.where(gli >= 0, val, fallback)
+    glis = lax.all_gather(gli, axis_name)
+    vals = lax.all_gather(val, axis_name)
+    best = jnp.argmax(glis)
+    return jnp.where(jnp.max(glis) >= 0, vals[best], fallback)
+
+
+def global_any(flag, axis_name=None):
+    local = jnp.any(flag)
+    if axis_name is None:
+        return local
+    return jnp.any(lax.all_gather(local, axis_name))
+
+
 def compact_rows(mask: jnp.ndarray, *arrays: jnp.ndarray):
     """Scatter surviving rows to the front; returns (count, packed arrays).
 

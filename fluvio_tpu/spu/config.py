@@ -14,6 +14,9 @@ from fluvio_tpu.types import SPU_PUBLIC_PORT, SpuId
 class SmartEngineConfig:
     backend: str = "auto"  # python | tpu | auto
     store_max_memory: int = DEFAULT_STORE_MAX_MEMORY
+    # multi-device engine mode: chains shard over an n-device record
+    # mesh via shard_map (0/1 = single device)
+    mesh_devices: int = 0
 
 
 @dataclass

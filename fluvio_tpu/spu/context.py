@@ -62,6 +62,7 @@ class GlobalContext:
         self.engine = SmartEngine(
             backend=config.smart_engine.backend,
             store_max_memory=config.smart_engine.store_max_memory,
+            mesh_devices=config.smart_engine.mesh_devices,
         )
         self.metrics = SpuMetrics()
 

@@ -1,7 +1,11 @@
-"""Version-pinned serial socket (parity: fluvio-socket/src/versioned.rs:218).
+"""Version-negotiating serial socket (parity: fluvio-socket/src/versioned.rs:218).
 
 Performs ApiVersions negotiation once per connection, then sends every
-request at the highest version the server supports for its api key.
+request at the highest version inside the INTERSECTION of the client's
+[MIN_API_VERSION, MAX_API_VERSION] and the server's advertised
+[min_version, max_version] for that api key — so a newer client talks
+down to an older broker (and vice versa), and disjoint ranges fail with
+a typed error instead of an undecodable frame.
 """
 
 from __future__ import annotations
@@ -14,8 +18,10 @@ from fluvio_tpu.transport.socket import FluvioSocket, connect
 
 
 class VersionMismatch(Exception):
-    def __init__(self, api_key: int):
-        super().__init__(f"server does not support api key {api_key}")
+    def __init__(self, api_key: int, detail: str = ""):
+        super().__init__(
+            detail or f"server does not support api key {api_key}"
+        )
         self.api_key = api_key
 
 
@@ -38,10 +44,18 @@ class VersionedSerialSocket:
         return cls(multiplexer, versions)
 
     def lookup_version(self, request: ApiRequest) -> int:
-        v = self.versions.lookup_version(request.API_KEY)
-        if v is None:
+        rng = self.versions.lookup_range(request.API_KEY)
+        if rng is None:
             raise VersionMismatch(request.API_KEY)
-        return min(v, request.MAX_API_VERSION)
+        v = min(rng.max_version, request.MAX_API_VERSION)
+        if v < rng.min_version or v < request.MIN_API_VERSION:
+            raise VersionMismatch(
+                request.API_KEY,
+                f"api {request.API_KEY}: client supports "
+                f"[{request.MIN_API_VERSION}, {request.MAX_API_VERSION}], "
+                f"server supports [{rng.min_version}, {rng.max_version}]",
+            )
+        return v
 
     async def send_receive(self, request: ApiRequest):
         return await self.multiplexer.send_and_receive(

@@ -239,3 +239,50 @@ class TestK8Install:
         container = dep["spec"]["template"]["spec"]["containers"][0]
         args = build_parser().parse_args(["sc", *container["args"]])
         assert args.k8 and args.namespace == "flv"
+
+
+class TestIdConflicts:
+    def test_overlapping_spg_ranges_flag_invalid(self, tmp_path):
+        async def body():
+            api = FakeK8sApi()
+            sc = ScServer(ScConfig(k8_api=api, k8_namespace="flv"))
+            await sc.start()
+            try:
+                admin = await FluvioAdmin.connect(sc.public_addr)
+                await admin.create_spu_group("alpha", replicas=3, min_id=0)
+                await admin.create_spu_group("beta", replicas=3, min_id=1)
+                ok = await _wait(
+                    lambda: {
+                        o.key: o.status.resolution
+                        for o in sc.ctx.spgs.store.values()
+                    }
+                    == {"alpha": "reserved", "beta": "invalid"}
+                )
+                assert ok, {
+                    o.key: o.status.resolution
+                    for o in sc.ctx.spgs.store.values()
+                }
+                beta = next(
+                    o for o in sc.ctx.spgs.store.values() if o.key == "beta"
+                )
+                assert "already reserved" in beta.status.reason
+                # only alpha's SPUs exist; no last-writer-wins on ids 1-2
+                spus = sorted(
+                    sc.ctx.spus.store.values(), key=lambda o: o.spec.id
+                )
+                assert [s.spec.id for s in spus] == [0, 1, 2]
+                assert all(
+                    "alpha" in s.spec.public_endpoint.host for s in spus
+                )
+                # and the invalid group gets no workloads
+                sts_path = "apis/apps/v1/namespaces/flv/statefulsets"
+                for _ in range(40):
+                    if await api.get(sts_path, "fluvio-spg-beta") is None:
+                        break
+                    await asyncio.sleep(0.05)
+                assert await api.get(sts_path, "fluvio-spg-beta") is None
+                await admin.close()
+            finally:
+                await sc.stop()
+
+        run(body())

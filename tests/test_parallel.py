@@ -225,3 +225,144 @@ def test_windowed_carry_continuity_sharded():
     m2 = _run_sharded(ex_b, buf2, mesh, m1[2])
     _assert_equal(s1, m1)
     _assert_equal(s2, m2)
+
+
+def _engine_chain(mesh_devices, *specs, pallas=None):
+    """Chain through the PUBLIC config surface (SmartEngine mesh_devices)."""
+    b = SmartEngine(backend="tpu", mesh_devices=mesh_devices).builder()
+    for name, params in specs:
+        b.add_smart_module(SmartModuleConfig(params=params or {}), lookup(name))
+    return b.initialize()
+
+
+class TestShardedEngineMode:
+    """shard_map engine mode: config-selected, pallas active per shard,
+    bit-equal to the single-device executor through the full dispatch
+    path (ragged staging on the single side, sharded puts on the other)."""
+
+    def _run_both(self, specs, values, timestamps=None, base_ts=1000):
+        from fluvio_tpu.smartmodule import SmartModuleInput
+
+        single = _engine_chain(0, *specs)
+        sharded = _engine_chain(N_DEV, *specs)
+        assert sharded.tpu_chain._sharded is not None, "mesh mode not engaged"
+
+        def records():
+            from fluvio_tpu.protocol.record import Record
+
+            out = []
+            for i, v in enumerate(values):
+                r = Record(value=v)
+                r.offset_delta = i
+                if timestamps:
+                    r.timestamp_delta = timestamps[i]
+                out.append(r)
+            return out
+
+        a = single.process(SmartModuleInput.from_records(records(), 0, base_ts))
+        b = sharded.process(SmartModuleInput.from_records(records(), 0, base_ts))
+        ka = [(r.value, r.key, r.offset_delta, r.timestamp_delta) for r in a.successes]
+        kb = [(r.value, r.key, r.offset_delta, r.timestamp_delta) for r in b.successes]
+        assert ka == kb
+        return single, sharded, ka
+
+    def test_north_star_chain_config_selected(self):
+        _, sharded, out = self._run_both(
+            [("regex-filter", {"regex": "fluvio"}), ("json-map", {"field": "name"})],
+            _north_star_values(200),
+        )
+        assert len(out) > 0
+        assert sharded.tpu_chain._viewable  # descriptor mode survives sharding
+
+    def test_pallas_kernels_active_per_shard(self, monkeypatch):
+        """The sharded trace must invoke the pallas span kernel (GSPMD
+        tracing can't; shard_map can)."""
+        import fluvio_tpu.smartengine.tpu.pallas_kernels as pk
+
+        monkeypatch.setenv("FLUVIO_TPU_PALLAS", "interpret")
+        calls = {"n": 0}
+        orig = pk.json_get_span_pallas
+
+        def spy(*a, **kw):
+            calls["n"] += 1
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(pk, "json_get_span_pallas", spy)
+        self._run_both(
+            [("json-map", {"field": "name"})], _north_star_values(64)
+        )
+        assert calls["n"] > 0
+
+    def test_aggregate_cross_shard_carry(self):
+        single, sharded, out = self._run_both(
+            [("aggregate-sum", None)],
+            [str(i).encode() for i in range(100)],
+        )
+        assert out[-1][0] == str(sum(range(100))).encode()
+        # carries identical after the run
+        sharded.tpu_chain._ensure_host_state()
+        single.tpu_chain._ensure_host_state()
+        assert sharded.tpu_chain.carries == single.tpu_chain.carries
+
+    def test_windowed_aggregate_across_shards(self):
+        self._run_both(
+            [("windowed-sum", {"kind": "sum_int", "window_ms": "100"})],
+            [str(i + 1).encode() for i in range(96)],
+            timestamps=[i * 40 for i in range(96)],
+            base_ts=1_000_000,
+        )
+
+    def test_carry_continuity_across_batches(self):
+        from fluvio_tpu.protocol.record import Record
+        from fluvio_tpu.smartmodule import SmartModuleInput
+
+        single = _engine_chain(0, ("aggregate-field", {"field": "n", "combine": "max"}))
+        sharded = _engine_chain(N_DEV, ("aggregate-field", {"field": "n", "combine": "max"}))
+        for lo in (0, 50):
+            values = [
+                f'{{"n":{(i * 37) % 91}}}'.encode() for i in range(lo, lo + 50)
+            ]
+            recs = lambda: [Record(value=v) for v in values]  # noqa: E731
+            a = single.process(SmartModuleInput.from_records(recs()))
+            b = sharded.process(SmartModuleInput.from_records(recs()))
+            assert [r.value for r in a.successes] == [r.value for r in b.successes]
+
+    def test_broker_fast_path_through_sharded_mode(self, tmp_path):
+        """SPU config selects the mesh; the stream-fetch fast path runs
+        through the sharded executor."""
+        import asyncio
+
+        from fluvio_tpu.protocol.codec import ByteReader, ByteWriter
+        from fluvio_tpu.protocol.record import Batch, Record
+        from fluvio_tpu.smartengine import native_backend
+        from fluvio_tpu.spu.smart_chain import process_batches
+
+        if native_backend.load_library() is None:
+            pytest.skip("no native toolchain")
+        chain = _engine_chain(
+            N_DEV,
+            ("regex-filter", {"regex": "fluvio"}),
+            ("json-map", {"field": "name"}),
+        )
+        assert chain.tpu_chain._sharded is not None
+        records = [Record(value=v) for v in _north_star_values(48)]
+        w = ByteWriter()
+        for i, r in enumerate(records):
+            r.offset_delta = i
+            r.encode(w)
+        batch = Batch(base_offset=0, raw_records=w.bytes(), raw_record_count=48)
+        batch.header.first_timestamp = 1000
+        batch.header.last_offset_delta = 47
+        fast = process_batches(chain, [batch], 1 << 20)
+        slow_chain = _engine_chain(
+            0,
+            ("regex-filter", {"regex": "fluvio"}),
+            ("json-map", {"field": "name"}),
+        )
+        slow = process_batches(slow_chain, [batch], 1 << 20)
+        flat = lambda res: [  # noqa: E731
+            (r.value, b.base_offset + r.offset_delta)
+            for b in res.records.batches
+            for r in b.memory_records()
+        ]
+        assert flat(fast) == flat(slow)

@@ -555,3 +555,78 @@ class TestRetentionCleaner:
             loop.run_until_complete(run())
         finally:
             loop.close()
+
+
+class TestVersionNegotiation:
+    """Per-API range negotiation (versioned.rs:218): the pinned version is
+    the highest in the intersection of client and server ranges."""
+
+    def test_lookup_picks_intersection_max(self):
+        from fluvio_tpu.protocol.api import ApiVersionKey, ApiVersionsResponse
+        from fluvio_tpu.transport.versioned import (
+            VersionedSerialSocket,
+            VersionMismatch,
+        )
+
+        versions = ApiVersionsResponse(
+            api_keys=[ApiVersionKey(FetchRequest.API_KEY, 0, 1)]
+        )
+        sock = VersionedSerialSocket(multiplexer=None, versions=versions)
+        # client max above server max -> talk down to the server's max
+        assert FetchRequest.MAX_API_VERSION >= 1
+        assert sock.lookup_version(FetchRequest()) == 1
+
+    def test_disjoint_ranges_raise_typed_error(self):
+        from fluvio_tpu.protocol.api import ApiVersionKey, ApiVersionsResponse
+        from fluvio_tpu.transport.versioned import (
+            VersionedSerialSocket,
+            VersionMismatch,
+        )
+
+        # server only speaks versions newer than the client can encode
+        future = FetchRequest.MAX_API_VERSION + 5
+        versions = ApiVersionsResponse(
+            api_keys=[ApiVersionKey(FetchRequest.API_KEY, future, future + 1)]
+        )
+        sock = VersionedSerialSocket(multiplexer=None, versions=versions)
+        import pytest as _pytest
+
+        with _pytest.raises(VersionMismatch) as e:
+            sock.lookup_version(FetchRequest())
+        assert "server supports" in str(e.value)
+
+    def test_unknown_api_key_raises(self):
+        from fluvio_tpu.protocol.api import ApiVersionsResponse
+        from fluvio_tpu.transport.versioned import (
+            VersionedSerialSocket,
+            VersionMismatch,
+        )
+
+        sock = VersionedSerialSocket(
+            multiplexer=None, versions=ApiVersionsResponse(api_keys=[])
+        )
+        import pytest as _pytest
+
+        with _pytest.raises(VersionMismatch):
+            sock.lookup_version(FetchRequest())
+
+    def test_old_version_client_against_live_server(self, spu):
+        """A 'downgraded' client (server table doctored to max=0) still
+        produces and consumes — the wire stays compatible at v0."""
+        server, loop = spu
+
+        async def run():
+            from fluvio_tpu.protocol.api import ApiVersionKey
+            from fluvio_tpu.transport.versioned import VersionedSerialSocket
+
+            sock = await VersionedSerialSocket.connect(server.public_addr)
+            # doctor the negotiated table: pretend the server is old
+            for k in sock.versions.api_keys:
+                k.max_version = 0
+            resp = await sock.send_receive(
+                FetchRequest(topic="topic", partition=0, fetch_offset=0)
+            )
+            assert resp.partition.error_code == ErrorCode.NONE
+            await sock.close()
+
+        loop.run_until_complete(run())
