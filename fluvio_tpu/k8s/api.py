@@ -163,7 +163,8 @@ class HttpK8sApi(K8sApi):
         return cls(ctx["server"], ctx["token"], ctx["ca_cert"])
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
-                 content_type: str = "application/json"):
+                 content_type: str = "application/json",
+                 accept: str = "application/json"):
         import http.client
         from urllib.parse import urlparse
 
@@ -179,7 +180,7 @@ class HttpK8sApi(K8sApi):
             conn = http.client.HTTPConnection(
                 u.hostname, u.port or 80, timeout=30
             )
-        headers = {"Accept": "application/json", "Content-Type": content_type}
+        headers = {"Accept": accept, "Content-Type": content_type}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         try:
@@ -207,8 +208,13 @@ class HttpK8sApi(K8sApi):
     async def get(self, resource: str, name: str) -> Optional[dict]:
         return await self._call("GET", f"{resource}/{name}")
 
-    async def list(self, resource: str) -> List[dict]:
-        out = await self._call("GET", resource)
+    async def list(self, resource: str, metadata_only: bool = False) -> List[dict]:
+        accept = (
+            "application/json;as=PartialObjectMetadataList;g=meta.k8s.io;v=v1"
+            if metadata_only
+            else "application/json"
+        )
+        out = await self._call("GET", resource, accept=accept)
         return (out or {}).get("items", [])
 
     async def apply(self, resource: str, obj: dict) -> dict:
@@ -244,7 +250,9 @@ class HttpK8sApi(K8sApi):
         deadline = asyncio.get_running_loop().time() + timeout
         while True:
             try:
-                items = await self.list(resource)
+                # metadata-only list: the fingerprint needs names +
+                # resourceVersions, not every object body
+                items = await self.list(resource, metadata_only=True)
                 fp = tuple(
                     sorted(
                         (

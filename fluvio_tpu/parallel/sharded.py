@@ -91,6 +91,16 @@ class ShardedChainExecutor:
             packed["span_start"] = cstart
             packed["span_len"] = clen
             return header(jnp.max(clen), jnp.int32(0)), packed, carries
+        if ex._int_output:
+            windowed = bool(ex.stages[-1].window_ms)
+            cols = [state["agg_out_int"]]
+            if windowed:
+                cols.append(state["agg_win_int"])
+            _, compacted = kernels.compact_rows(valid, *cols)
+            packed["agg_int"] = compacted[0]
+            if windowed:
+                packed["agg_win"] = compacted[1]
+            return header(jnp.int32(0), jnp.int32(0)), packed, carries
         _, compacted = kernels.compact_rows(
             valid,
             state["values"],
@@ -141,8 +151,14 @@ class ShardedChainExecutor:
     def _packed_specs(self):
         row = P(RECORD_AXIS)
         mat = P(RECORD_AXIS, None)
-        if self.executor._viewable:
+        ex = self.executor
+        if ex._viewable:
             return {"mask": row, "span_start": row, "span_len": row}
+        if ex._int_output:
+            out = {"mask": row, "agg_int": row}
+            if bool(ex.stages[-1].window_ms):
+                out["agg_win"] = row
+            return out
         return {
             "mask": row,
             "values": mat,
@@ -161,9 +177,11 @@ class ShardedChainExecutor:
 
     def _padded_arrays(self, buf: RecordBuffer) -> Dict[str, np.ndarray]:
         rows = buf.values.shape[0]
-        need = max(self.n * 8, rows)
-        if need % self.n:
-            need += self.n - (need % self.n)
+        # shards must hold a multiple of 8 rows: each shard's survivor
+        # bitmask packs to whole bytes, and the concatenated per-shard
+        # masks must line up with global row numbering bit-for-bit
+        step = self.n * 8
+        need = max(step, ((rows + step - 1) // step) * step)
         pad = need - rows
 
         def pad_rows(a, fill=0):
@@ -204,6 +222,34 @@ class ShardedChainExecutor:
     def discard_dispatch(self, handle) -> None:
         pass  # carries commit in finish_buffer; nothing dispatched to undo
 
+    def _shard_slices(self, arr, counts, vw: int = 0):
+        """Per-shard row slices bounded by that shard's survivor count
+        (bucketed), sliced device-side so the D2H link never carries the
+        padded remainder of each shard's block."""
+        from jax import lax as jlax
+
+        ex = self.executor
+        shard_rows = arr.shape[0] // self.n
+        out = []
+        for s in range(self.n):
+            rows = min(ex._bucket_bytes(max(int(counts[s]), 1), 8), shard_rows)
+            if arr.ndim == 2:
+                w = min(vw or arr.shape[1], arr.shape[1])
+                out.append(
+                    jlax.slice(arr, (s * shard_rows, 0), (s * shard_rows + rows, w))
+                )
+            else:
+                out.append(
+                    jlax.slice(arr, (s * shard_rows,), (s * shard_rows + rows,))
+                )
+        return out
+
+    @staticmethod
+    def _concat_counts(parts, counts):
+        return np.concatenate(
+            [np.asarray(p)[: int(c)] for p, c in zip(parts, counts)]
+        )
+
     def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
         new_carries, header, packed = handle
         ex = self.executor
@@ -211,32 +257,23 @@ class ShardedChainExecutor:
         counts = hdrs[:, 0].astype(np.int64)
         total = int(counts.sum())
         n_rows = buf.values.shape[0]
-        shard_rows = None
-
-        host = jax.device_get(packed)
-        mask = np.asarray(host["mask"])
-        src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
         width = buf.values.shape[1]
+        rows_out = min(ex._bucket_bytes(max(total, 1), 8), max(n_rows, 8))
+
+        mask = np.asarray(jax.device_get(packed["mask"]))
+        src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
 
         if ex._viewable:
-            starts = np.asarray(host["span_start"])
-            lens = np.asarray(host["span_len"])
-            shard_rows = starts.shape[0] // self.n
-            st = np.concatenate(
-                [
-                    starts[s * shard_rows : s * shard_rows + counts[s]]
-                    for s in range(self.n)
-                ]
-            ).astype(np.int64)
-            ln = np.concatenate(
-                [
-                    lens[s * shard_rows : s * shard_rows + counts[s]]
-                    for s in range(self.n)
-                ]
-            ).astype(np.int32)
+            st_parts = jax.device_get(
+                self._shard_slices(packed["span_start"], counts)
+            )
+            ln_parts = jax.device_get(
+                self._shard_slices(packed["span_len"], counts)
+            )
+            st = self._concat_counts(st_parts, counts).astype(np.int64)
+            ln = self._concat_counts(ln_parts, counts).astype(np.int32)
             vw = int(max(int(hdrs[:, 1].max()), 1))
             vw = min(ex._pad_slice(vw), width)
-            rows_out = min(ex._bucket_bytes(max(total, 1), 8), max(n_rows, 8))
             out_values = np.zeros((rows_out, vw), dtype=np.uint8)
             if total:
                 cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
@@ -257,31 +294,81 @@ class ShardedChainExecutor:
             else:
                 out_keys = np.zeros((rows_out, 1), np.uint8)
                 out_klens = np.full((rows_out,), -1, np.int32)
-        else:
-            values = np.asarray(host["values"])
-            lengths = np.asarray(host["lengths"])
-            keys = np.asarray(host["keys"])
-            klens = np.asarray(host["key_lengths"])
-            shard_rows = values.shape[0] // self.n
-
-            def concat_counts(a):
-                return np.concatenate(
-                    [
-                        a[s * shard_rows : s * shard_rows + counts[s]]
-                        for s in range(self.n)
-                    ]
+        elif ex._int_output:
+            windowed = bool(ex.stages[-1].window_ms)
+            ints = self._concat_counts(
+                jax.device_get(self._shard_slices(packed["agg_int"], counts)),
+                counts,
+            ).astype(np.int64)
+            mat, lens = ex._ints_to_ascii_host(ints)
+            vw = min(
+                ex._pad_slice(max(int(lens.max()) if total else 1, 1)), 32
+            )
+            out_values = np.zeros((rows_out, vw), dtype=np.uint8)
+            out_lengths = np.zeros((rows_out,), dtype=np.int32)
+            if total:
+                w = min(vw, mat.shape[1])
+                out_values[:total, :w] = mat[:, :w]
+                out_lengths[:total] = lens
+            if windowed:
+                wins = self._concat_counts(
+                    jax.device_get(
+                        self._shard_slices(packed["agg_win"], counts)
+                    ),
+                    counts,
+                ).astype(np.int64)
+                kmat, klens = ex._ints_to_ascii_host(wins)
+                kw = min(
+                    ex._pad_slice(max(int(klens.max()) if total else 1, 1)), 32
                 )
-
-            rows_out = min(ex._bucket_bytes(max(total, 1), 8), max(n_rows, 8))
-            cv = concat_counts(values)
-            out_values = np.zeros((rows_out, values.shape[1]), np.uint8)
-            out_values[:total] = cv
+                out_keys = np.zeros((rows_out, kw), dtype=np.uint8)
+                out_klens = np.full((rows_out,), -1, np.int32)
+                if total:
+                    w = min(kw, kmat.shape[1])
+                    out_keys[:total, :w] = kmat[:, :w]
+                    out_klens[:total] = klens
+            elif buf.has_keys():
+                out_keys = np.zeros((rows_out, buf.keys.shape[1]), np.uint8)
+                out_klens = np.full((rows_out,), -1, np.int32)
+                if total:
+                    out_keys[:total] = buf.keys[src[:total]]
+                    out_klens[:total] = buf.key_lengths[src[:total]]
+            else:
+                out_keys = np.zeros((rows_out, 1), np.uint8)
+                out_klens = np.full((rows_out,), -1, np.int32)
+        else:
+            vw = min(
+                ex._pad_slice(max(int(hdrs[:, 1].max()), 1)),
+                packed["values"].shape[1],
+            )
+            kw = min(
+                ex._pad_slice(max(int(hdrs[:, 2].max()), 1)),
+                packed["keys"].shape[1],
+            )
+            out_values = np.zeros((rows_out, vw), np.uint8)
+            out_values[:total] = self._concat_counts(
+                jax.device_get(
+                    self._shard_slices(packed["values"], counts, vw)
+                ),
+                counts,
+            )
             out_lengths = np.zeros((rows_out,), np.int32)
-            out_lengths[:total] = concat_counts(lengths)
-            out_keys = np.zeros((rows_out, keys.shape[1]), np.uint8)
-            out_keys[:total] = concat_counts(keys)
+            out_lengths[:total] = self._concat_counts(
+                jax.device_get(self._shard_slices(packed["lengths"], counts)),
+                counts,
+            )
+            out_keys = np.zeros((rows_out, kw), np.uint8)
+            out_keys[:total] = self._concat_counts(
+                jax.device_get(self._shard_slices(packed["keys"], counts, kw)),
+                counts,
+            )
             out_klens = np.full((rows_out,), -1, np.int32)
-            out_klens[:total] = concat_counts(klens)
+            out_klens[:total] = self._concat_counts(
+                jax.device_get(
+                    self._shard_slices(packed["key_lengths"], counts)
+                ),
+                counts,
+            )
 
         out_off = np.zeros((rows_out,), np.int32)
         out_ts = np.zeros((rows_out,), np.int64)

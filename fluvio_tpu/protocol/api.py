@@ -181,10 +181,8 @@ class ApiVersionsResponse(Encodable):
         return cls(api_keys=keys, platform_version=platform_version)
 
     def lookup_version(self, api_key: int) -> Version | None:
-        for k in self.api_keys:
-            if k.api_key == api_key:
-                return k.max_version
-        return None
+        rng = self.lookup_range(api_key)
+        return rng.max_version if rng is not None else None
 
     def lookup_range(self, api_key: int) -> "ApiVersionKey | None":
         for k in self.api_keys:

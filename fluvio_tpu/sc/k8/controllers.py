@@ -130,13 +130,19 @@ class K8SpuController(_StoreLoopController):
         return f"{svc}-{index}.{svc}.{self.namespace}.svc.cluster.local"
 
     async def sync_once(self) -> None:
-        # deterministic claim order (group key); a group whose id range
-        # collides with an earlier group's reservation is INVALID — never
-        # silently last-writer-wins two pods onto one SPU id
+        # claim order: already-RESERVED groups first (a running group must
+        # never lose its ids to a later conflicting create), then key
+        # order for determinism among new groups; a group whose id range
+        # collides with an earlier claim is INVALID — never silently
+        # last-writer-wins two pods onto one SPU id
         want = {}
         claimed: dict = {}
         invalid: dict = {}
-        for obj in sorted(self.ctx.spgs.store.values(), key=lambda o: o.key):
+        ordered = sorted(
+            self.ctx.spgs.store.values(),
+            key=lambda o: (0 if o.status.resolution == "reserved" else 1, o.key),
+        )
+        for obj in ordered:
             ids = [str(obj.spec.min_id + i) for i in range(obj.spec.replicas)]
             clash = next((i for i in ids if i in claimed), None)
             if clash is not None:
