@@ -36,7 +36,9 @@ class K8sApi:
     async def get(self, resource: str, name: str) -> Optional[dict]:
         raise NotImplementedError
 
-    async def list(self, resource: str) -> List[dict]:
+    async def list(self, resource: str, metadata_only: bool = False) -> List[dict]:
+        """``metadata_only`` returns items trimmed to their metadata
+        (PartialObjectMetadata shape) — the watch fingerprint path."""
         raise NotImplementedError
 
     async def apply(self, resource: str, obj: dict) -> dict:
@@ -82,8 +84,11 @@ class FakeK8sApi(K8sApi):
         obj = self._bucket(resource).get(name)
         return json.loads(json.dumps(obj)) if obj is not None else None
 
-    async def list(self, resource: str) -> List[dict]:
-        return [json.loads(json.dumps(o)) for o in self._bucket(resource).values()]
+    async def list(self, resource: str, metadata_only: bool = False) -> List[dict]:
+        items = [json.loads(json.dumps(o)) for o in self._bucket(resource).values()]
+        if metadata_only:
+            return [{"metadata": o.get("metadata", {})} for o in items]
+        return items
 
     async def apply(self, resource: str, obj: dict) -> dict:
         name = obj.get("metadata", {}).get("name")

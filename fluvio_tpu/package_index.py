@@ -1,0 +1,275 @@
+"""Version/target package index for CLI self-install and fvm.
+
+Capability parity: the `fluvio-package-index` crate —
+
+- `Target` (target.rs:32): platform triples with current-platform
+  detection and alias normalization (gnu -> musl on linux).
+- `PackageId` (package_id.rs): ``[registry/]group/name[:version]``
+  parsing with the fluvio defaults.
+- `Package`/`Release` (package.rs:14,162): an ordered release list where
+  each release records which targets have published artifacts;
+  `latest_release_for_target` resolves what an installer should fetch.
+- The index itself (lib.rs): a JSON document the registry serves (here:
+  also loadable from a local file, which is what the test/offline path
+  and fvm use).
+"""
+
+from __future__ import annotations
+
+import json
+import platform as _platform
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+
+class PackageIndexError(Exception):
+    pass
+
+
+# -- targets ----------------------------------------------------------------
+
+KNOWN_TARGETS = (
+    "x86_64-unknown-linux-musl",
+    "x86_64-apple-darwin",
+    "aarch64-unknown-linux-musl",
+    "aarch64-apple-darwin",
+    "arm-unknown-linux-gnueabihf",
+    "armv7-unknown-linux-gnueabihf",
+)
+
+_ALIASES = {
+    # the reference folds gnu builds onto the musl artifact (target.rs:67)
+    "x86_64-unknown-linux-gnu": "x86_64-unknown-linux-musl",
+    "aarch64-unknown-linux-gnu": "aarch64-unknown-linux-musl",
+}
+
+
+@dataclass(frozen=True)
+class Target:
+    triple: str
+
+    @classmethod
+    def parse(cls, s: str) -> "Target":
+        s = _ALIASES.get(s, s)
+        if s not in KNOWN_TARGETS:
+            raise PackageIndexError(f"unknown target {s!r}")
+        return cls(s)
+
+    @classmethod
+    def current(cls) -> "Target":
+        arch = _platform.machine().lower()
+        arch = {"amd64": "x86_64", "arm64": "aarch64"}.get(arch, arch)
+        system = _platform.system().lower()
+        if system == "linux":
+            if arch.startswith("armv7"):
+                return cls.parse("armv7-unknown-linux-gnueabihf")
+            if arch.startswith("arm") and arch != "aarch64":
+                return cls.parse("arm-unknown-linux-gnueabihf")
+            return cls.parse(f"{arch}-unknown-linux-musl")
+        if system == "darwin":
+            return cls.parse(f"{arch}-apple-darwin")
+        raise PackageIndexError(f"unsupported platform {system}/{arch}")
+
+    def __str__(self) -> str:
+        return self.triple
+
+
+# -- package ids ------------------------------------------------------------
+
+DEFAULT_REGISTRY = "https://packages.fluvio.io/v1/"
+DEFAULT_GROUP = "fluvio"
+
+_ID_RE = re.compile(
+    r"^(?:(?P<registry>https?://[^ ]+?)/)?"
+    r"(?:(?P<group>[A-Za-z0-9_-]+)/)?"
+    r"(?P<name>[A-Za-z0-9_-]+)"
+    r"(?::(?P<version>[^:]+))?$"
+)
+
+
+@dataclass(frozen=True)
+class PackageId:
+    """``[registry/]group/name[:version]`` (package_id.rs)."""
+
+    name: str
+    group: str = DEFAULT_GROUP
+    registry: str = DEFAULT_REGISTRY
+    version: Optional[str] = None
+
+    @classmethod
+    def parse(cls, s: str) -> "PackageId":
+        m = _ID_RE.match(s.strip())
+        if not m or not m.group("name"):
+            raise PackageIndexError(f"invalid package id {s!r}")
+        return cls(
+            name=m.group("name"),
+            group=m.group("group") or DEFAULT_GROUP,
+            registry=m.group("registry") or DEFAULT_REGISTRY,
+            version=m.group("version"),
+        )
+
+    def __str__(self) -> str:
+        base = f"{self.group}/{self.name}"
+        return f"{base}:{self.version}" if self.version else base
+
+
+# -- versions ---------------------------------------------------------------
+
+def _version_key(v: str):
+    """Semver ordering; a prerelease (e.g. ``-alpha.1``) sorts below the
+    plain version, and numeric prerelease identifiers compare as numbers
+    (``alpha.2`` < ``alpha.10``) per semver / version.rs semantics."""
+    core, _, pre = v.partition("-")
+    nums = tuple(int(p) for p in core.split(".") if p.isdigit())
+    pre_parts = tuple(
+        (0, int(p), "") if p.isdigit() else (1, 0, p)
+        for p in pre.split(".")
+    ) if pre else ()
+    return (nums, pre == "", pre_parts)
+
+
+def is_prerelease(v: str) -> bool:
+    return "-" in v
+
+
+# -- package + releases -----------------------------------------------------
+
+@dataclass
+class Release:
+    """One published version and the targets it has artifacts for
+    (package.rs:162)."""
+
+    version: str
+    targets: List[str] = field(default_factory=list)
+
+    def add_target(self, target: Target) -> None:
+        if target.triple not in self.targets:
+            self.targets.append(target.triple)
+
+    def target_exists(self, target: Target) -> bool:
+        return target.triple in self.targets
+
+    def to_dict(self) -> dict:
+        return {"version": self.version, "targets": list(self.targets)}
+
+
+@dataclass
+class Package:
+    """A named artifact's release history (package.rs:14)."""
+
+    name: str
+    group: str = DEFAULT_GROUP
+    kind: str = "binary"  # binary | library
+    releases: List[Release] = field(default_factory=list)
+
+    def add_release(self, version: str, target: Target) -> Release:
+        for r in self.releases:
+            if r.version == version:
+                r.add_target(target)
+                return r
+        r = Release(version=version, targets=[target.triple])
+        self.releases.append(r)
+        self.releases.sort(key=lambda r: _version_key(r.version))
+        return r
+
+    def latest_release(self, prerelease: bool = False) -> Release:
+        for r in reversed(self.releases):
+            if prerelease or not is_prerelease(r.version):
+                return r
+        raise PackageIndexError(f"package {self.name!r} has no releases")
+
+    def latest_release_for_target(
+        self, target: Target, prerelease: bool = False
+    ) -> Release:
+        """What an installer should fetch (package.rs:66)."""
+        for r in reversed(self.releases):
+            if not prerelease and is_prerelease(r.version):
+                continue
+            if r.target_exists(target):
+                return r
+        raise PackageIndexError(
+            f"package {self.name!r} has no release for target {target}"
+        )
+
+    def releases_for_target(self, target: Target) -> List[Release]:
+        return [r for r in self.releases if r.target_exists(target)]
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "group": self.group,
+            "kind": self.kind,
+            "releases": [r.to_dict() for r in self.releases],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Package":
+        return cls(
+            name=d["name"],
+            group=d.get("group", DEFAULT_GROUP),
+            kind=d.get("kind", "binary"),
+            releases=[
+                Release(version=r["version"], targets=list(r.get("targets", [])))
+                for r in d.get("releases", [])
+            ],
+        )
+
+
+@dataclass
+class PackageIndex:
+    """The registry's index document (lib.rs), loadable from a local
+    file for offline/test use and fvm."""
+
+    packages: Dict[str, Package] = field(default_factory=dict)
+
+    @staticmethod
+    def _key(group: str, name: str) -> str:
+        return f"{group}/{name}"
+
+    def add(self, package: Package) -> None:
+        self.packages[self._key(package.group, package.name)] = package
+
+    def find(self, pid: PackageId) -> Package:
+        pkg = self.packages.get(self._key(pid.group, pid.name))
+        if pkg is None:
+            raise PackageIndexError(f"unknown package {pid}")
+        return pkg
+
+    def resolve(
+        self, pid: PackageId, target: Optional[Target] = None,
+        prerelease: bool = False,
+    ) -> Release:
+        """Package id (+target) -> the release to install: the pinned
+        version when the id carries one, else the latest with artifacts
+        for the target."""
+        pkg = self.find(pid)
+        target = target or Target.current()
+        if pid.version is not None:
+            for r in pkg.releases:
+                if r.version == pid.version:
+                    if not r.target_exists(target):
+                        raise PackageIndexError(
+                            f"{pid} has no artifact for {target}"
+                        )
+                    return r
+            raise PackageIndexError(f"{pid} not found")
+        return pkg.latest_release_for_target(target, prerelease)
+
+    def to_dict(self) -> dict:
+        return {
+            "version": "1.0",
+            "packages": [p.to_dict() for p in self.packages.values()],
+        }
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self.to_dict(), indent=2))
+
+    @classmethod
+    def load(cls, path: str | Path) -> "PackageIndex":
+        data = json.loads(Path(path).read_text())
+        idx = cls()
+        for p in data.get("packages", []):
+            idx.add(Package.from_dict(p))
+        return idx

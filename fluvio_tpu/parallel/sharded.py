@@ -300,16 +300,7 @@ class ShardedChainExecutor:
                 jax.device_get(self._shard_slices(packed["agg_int"], counts)),
                 counts,
             ).astype(np.int64)
-            mat, lens = ex._ints_to_ascii_host(ints)
-            vw = min(
-                ex._pad_slice(max(int(lens.max()) if total else 1, 1)), 32
-            )
-            out_values = np.zeros((rows_out, vw), dtype=np.uint8)
-            out_lengths = np.zeros((rows_out,), dtype=np.int32)
-            if total:
-                w = min(vw, mat.shape[1])
-                out_values[:total, :w] = mat[:, :w]
-                out_lengths[:total] = lens
+            wins = None
             if windowed:
                 wins = self._concat_counts(
                     jax.device_get(
@@ -317,25 +308,9 @@ class ShardedChainExecutor:
                     ),
                     counts,
                 ).astype(np.int64)
-                kmat, klens = ex._ints_to_ascii_host(wins)
-                kw = min(
-                    ex._pad_slice(max(int(klens.max()) if total else 1, 1)), 32
-                )
-                out_keys = np.zeros((rows_out, kw), dtype=np.uint8)
-                out_klens = np.full((rows_out,), -1, np.int32)
-                if total:
-                    w = min(kw, kmat.shape[1])
-                    out_keys[:total, :w] = kmat[:, :w]
-                    out_klens[:total] = klens
-            elif buf.has_keys():
-                out_keys = np.zeros((rows_out, buf.keys.shape[1]), np.uint8)
-                out_klens = np.full((rows_out,), -1, np.int32)
-                if total:
-                    out_keys[:total] = buf.keys[src[:total]]
-                    out_klens[:total] = buf.key_lengths[src[:total]]
-            else:
-                out_keys = np.zeros((rows_out, 1), np.uint8)
-                out_klens = np.full((rows_out,), -1, np.int32)
+            out_values, out_lengths, out_keys, out_klens = (
+                ex._int_output_columns(buf, ints, wins, src, rows_out, total)
+            )
         else:
             vw = min(
                 ex._pad_slice(max(int(hdrs[:, 1].max()), 1)),

@@ -904,22 +904,11 @@ class TpuChainExecutor:
         lens = (mat != 0).sum(axis=1).astype(np.int32)  # digits have no NULs
         return mat, lens
 
-    def _fetch_ints(self, buf: RecordBuffer, count: int, packed) -> RecordBuffer:
-        """Int-output D2H: survivor mask + raw int64 column(s); the host
-        renders decimals (and window keys) itself."""
-        windowed = bool(self.stages[-1].window_ms)
-        n_c = packed["agg_int"].shape[0]
-        rows = min(self._bucket_bytes(max(count, 1), 8), n_c)
-        slices = [packed["mask"], lax.slice(packed["agg_int"], (0,), (rows,))]
-        if windowed:
-            slices.append(lax.slice(packed["agg_win"], (0,), (rows,)))
-        for s in slices:
-            s.copy_to_host_async()
-        host = jax.device_get(slices)
-        src = np.flatnonzero(
-            np.unpackbits(host[0], bitorder="little")[: buf.values.shape[0]]
-        )
-        ints = np.asarray(host[1][:count]).astype(np.int64)
+    def _int_output_columns(self, buf, ints, wins, src, rows: int, count: int):
+        """Shared host assembly for int-output chains (single-device AND
+        sharded): render decimals, window keys (``wins``; None when
+        unwindowed), or pass input keys through — one implementation so
+        both engine modes stay bit-identical by construction."""
         mat, lens = self._ints_to_ascii_host(ints)
         vw = min(self._pad_slice(max(int(lens.max()) if count else 1, 1)), 32)
         out_values = np.zeros((rows, vw), dtype=np.uint8)
@@ -928,8 +917,7 @@ class TpuChainExecutor:
             w = min(vw, mat.shape[1])
             out_values[:count, :w] = mat[:, :w]
             out_lengths[:count] = lens
-        if windowed:
-            wins = np.asarray(host[2][:count]).astype(np.int64)
+        if wins is not None:
             kmat, klens = self._ints_to_ascii_host(wins)
             kw = min(self._pad_slice(max(int(klens.max()) if count else 1, 1)), 32)
             out_keys = np.zeros((rows, kw), dtype=np.uint8)
@@ -947,6 +935,28 @@ class TpuChainExecutor:
         else:
             out_keys = np.zeros((rows, 1), dtype=np.uint8)
             out_klens = np.full((rows,), -1, dtype=np.int32)
+        return out_values, out_lengths, out_keys, out_klens
+
+    def _fetch_ints(self, buf: RecordBuffer, count: int, packed) -> RecordBuffer:
+        """Int-output D2H: survivor mask + raw int64 column(s); the host
+        renders decimals (and window keys) itself."""
+        windowed = bool(self.stages[-1].window_ms)
+        n_c = packed["agg_int"].shape[0]
+        rows = min(self._bucket_bytes(max(count, 1), 8), n_c)
+        slices = [packed["mask"], lax.slice(packed["agg_int"], (0,), (rows,))]
+        if windowed:
+            slices.append(lax.slice(packed["agg_win"], (0,), (rows,)))
+        for s in slices:
+            s.copy_to_host_async()
+        host = jax.device_get(slices)
+        src = np.flatnonzero(
+            np.unpackbits(host[0], bitorder="little")[: buf.values.shape[0]]
+        )
+        ints = np.asarray(host[1][:count]).astype(np.int64)
+        wins = np.asarray(host[2][:count]).astype(np.int64) if windowed else None
+        out_values, out_lengths, out_keys, out_klens = self._int_output_columns(
+            buf, ints, wins, src, rows, count
+        )
         return self._assemble(buf, count, rows, out_values, out_lengths,
                               out_keys, out_klens, src)
 

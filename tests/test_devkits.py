@@ -330,3 +330,93 @@ class TestFvmAndChannel:
         assert fvm_main(["list"]) == 0
         out = capsys.readouterr().out
         assert "* 0.2.0" in out
+
+
+class TestPackageIndex:
+    """Version/target index (fluvio-package-index: package_id.rs,
+    target.rs, package.rs)."""
+
+    def test_target_parse_and_aliases(self):
+        from fluvio_tpu.package_index import PackageIndexError, Target
+
+        assert Target.parse("x86_64-unknown-linux-musl").triple.endswith("musl")
+        # gnu folds onto the musl artifact (target.rs:67)
+        assert Target.parse("x86_64-unknown-linux-gnu").triple.endswith("musl")
+        assert Target.current().triple  # resolvable on this host
+        import pytest as _pytest
+
+        with _pytest.raises(PackageIndexError):
+            Target.parse("riscv64-unknown-none")
+
+    def test_package_id_parse(self):
+        from fluvio_tpu.package_index import DEFAULT_GROUP, PackageId
+
+        pid = PackageId.parse("fluvio/fluvio:0.11.0")
+        assert (pid.group, pid.name, pid.version) == ("fluvio", "fluvio", "0.11.0")
+        bare = PackageId.parse("smdk")
+        assert bare.group == DEFAULT_GROUP and bare.version is None
+        reg = PackageId.parse("https://example.com/v1/acme/tool:1.2.3")
+        assert reg.registry.startswith("https://example.com")
+        assert (reg.group, reg.name, reg.version) == ("acme", "tool", "1.2.3")
+
+    def test_release_resolution_per_target(self):
+        from fluvio_tpu.package_index import (
+            Package,
+            PackageId,
+            PackageIndex,
+            PackageIndexError,
+            Target,
+        )
+
+        linux = Target.parse("x86_64-unknown-linux-musl")
+        mac = Target.parse("aarch64-apple-darwin")
+        pkg = Package(name="fluvio")
+        pkg.add_release("0.9.0", linux)
+        pkg.add_release("0.9.0", mac)
+        pkg.add_release("0.10.0", linux)  # mac artifact never published
+        pkg.add_release("0.11.0-alpha.1", linux)  # prerelease
+
+        assert pkg.latest_release().version == "0.10.0"
+        assert pkg.latest_release(prerelease=True).version == "0.11.0-alpha.1"
+        assert pkg.latest_release_for_target(linux).version == "0.10.0"
+        # target without the newest artifact falls back to its newest
+        assert pkg.latest_release_for_target(mac).version == "0.9.0"
+
+        idx = PackageIndex()
+        idx.add(pkg)
+        assert idx.resolve(PackageId.parse("fluvio/fluvio"), linux).version == "0.10.0"
+        pinned = idx.resolve(PackageId.parse("fluvio/fluvio:0.9.0"), mac)
+        assert pinned.version == "0.9.0"
+        import pytest as _pytest
+
+        with _pytest.raises(PackageIndexError):
+            idx.resolve(PackageId.parse("fluvio/fluvio:0.10.0"), mac)
+
+    def test_index_roundtrip(self, tmp_path):
+        from fluvio_tpu.package_index import (
+            Package,
+            PackageId,
+            PackageIndex,
+            Target,
+        )
+
+        linux = Target.parse("x86_64-unknown-linux-musl")
+        idx = PackageIndex()
+        pkg = Package(name="fluvio-tpu")
+        pkg.add_release("0.1.0", linux)
+        idx.add(pkg)
+        path = tmp_path / "index.json"
+        idx.save(path)
+        loaded = PackageIndex.load(path)
+        rel = loaded.resolve(PackageId.parse("fluvio/fluvio-tpu"), linux)
+        assert rel.version == "0.1.0" and rel.target_exists(linux)
+
+    def test_numeric_prerelease_ordering(self):
+        from fluvio_tpu.package_index import Package, Target
+
+        linux = Target.parse("x86_64-unknown-linux-musl")
+        pkg = Package(name="fluvio")
+        for v in ("0.11.0-alpha.2", "0.11.0-alpha.10", "0.11.0-alpha.1"):
+            pkg.add_release(v, linux)
+        # numeric prerelease identifiers compare as numbers (semver)
+        assert pkg.latest_release(prerelease=True).version == "0.11.0-alpha.10"
