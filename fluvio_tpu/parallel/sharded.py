@@ -260,16 +260,35 @@ class ShardedChainExecutor:
         width = buf.values.shape[1]
         rows_out = min(ex._bucket_bytes(max(total, 1), 8), max(n_rows, 8))
 
-        mask = np.asarray(jax.device_get(packed["mask"]))
-        src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
+        # one async fetch for every column: all shard slices start their
+        # D2H copies concurrently (same pattern as the single-device
+        # _fetch) instead of one blocking round-trip per column
+        def _fetch_all(*column_groups):
+            cols = [packed["mask"]]
+            for group in column_groups:
+                cols.extend(group)
+            for c in cols:
+                c.copy_to_host_async()
+            host = jax.device_get(cols)
+            mask_h = np.asarray(host[0])
+            groups, pos = [], 1
+            for group in column_groups:
+                groups.append(host[pos : pos + len(group)])
+                pos += len(group)
+            return mask_h, groups
 
         if ex._viewable:
-            st_parts = jax.device_get(
-                self._shard_slices(packed["span_start"], counts)
+            # span descriptors are width-bounded: ship them at the same
+            # narrow dtype the single-device fetch uses (uint8/uint16)
+            mask, (st_parts, ln_parts) = _fetch_all(
+                self._shard_slices(
+                    ex._narrow_static(packed["span_start"], width), counts
+                ),
+                self._shard_slices(
+                    ex._narrow_static(packed["span_len"], width + 1), counts
+                ),
             )
-            ln_parts = jax.device_get(
-                self._shard_slices(packed["span_len"], counts)
-            )
+            src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
             st = self._concat_counts(st_parts, counts).astype(np.int64)
             ln = self._concat_counts(ln_parts, counts).astype(np.int32)
             vw = int(max(int(hdrs[:, 1].max()), 1))
@@ -296,18 +315,17 @@ class ShardedChainExecutor:
                 out_klens = np.full((rows_out,), -1, np.int32)
         elif ex._int_output:
             windowed = bool(ex.stages[-1].window_ms)
-            ints = self._concat_counts(
-                jax.device_get(self._shard_slices(packed["agg_int"], counts)),
-                counts,
-            ).astype(np.int64)
-            wins = None
+            groups = [self._shard_slices(packed["agg_int"], counts)]
             if windowed:
-                wins = self._concat_counts(
-                    jax.device_get(
-                        self._shard_slices(packed["agg_win"], counts)
-                    ),
-                    counts,
-                ).astype(np.int64)
+                groups.append(self._shard_slices(packed["agg_win"], counts))
+            mask, got = _fetch_all(*groups)
+            src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
+            ints = self._concat_counts(got[0], counts).astype(np.int64)
+            wins = (
+                self._concat_counts(got[1], counts).astype(np.int64)
+                if windowed
+                else None
+            )
             out_values, out_lengths, out_keys, out_klens = (
                 ex._int_output_columns(buf, ints, wins, src, rows_out, total)
             )
@@ -320,30 +338,26 @@ class ShardedChainExecutor:
                 ex._pad_slice(max(int(hdrs[:, 2].max()), 1)),
                 packed["keys"].shape[1],
             )
+            mask, got = _fetch_all(
+                self._shard_slices(packed["values"], counts, vw),
+                self._shard_slices(
+                    ex._narrow_static(
+                        packed["lengths"], packed["values"].shape[1] + 1
+                    ),
+                    counts,
+                ),
+                self._shard_slices(packed["keys"], counts, kw),
+                self._shard_slices(packed["key_lengths"], counts),
+            )
+            src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
             out_values = np.zeros((rows_out, vw), np.uint8)
-            out_values[:total] = self._concat_counts(
-                jax.device_get(
-                    self._shard_slices(packed["values"], counts, vw)
-                ),
-                counts,
-            )
+            out_values[:total] = self._concat_counts(got[0], counts)
             out_lengths = np.zeros((rows_out,), np.int32)
-            out_lengths[:total] = self._concat_counts(
-                jax.device_get(self._shard_slices(packed["lengths"], counts)),
-                counts,
-            )
+            out_lengths[:total] = self._concat_counts(got[1], counts)
             out_keys = np.zeros((rows_out, kw), np.uint8)
-            out_keys[:total] = self._concat_counts(
-                jax.device_get(self._shard_slices(packed["keys"], counts, kw)),
-                counts,
-            )
+            out_keys[:total] = self._concat_counts(got[2], counts)
             out_klens = np.full((rows_out,), -1, np.int32)
-            out_klens[:total] = self._concat_counts(
-                jax.device_get(
-                    self._shard_slices(packed["key_lengths"], counts)
-                ),
-                counts,
-            )
+            out_klens[:total] = self._concat_counts(got[3], counts)
 
         out_off = np.zeros((rows_out,), np.int32)
         out_ts = np.zeros((rows_out,), np.int64)
