@@ -271,16 +271,19 @@ class ShardedChainExecutor:
                 c.copy_to_host_async()
             host = jax.device_get(cols)
             mask_h = np.asarray(host[0])
+            src_h = np.flatnonzero(
+                np.unpackbits(mask_h, bitorder="little")[:n_rows]
+            )
             groups, pos = [], 1
             for group in column_groups:
                 groups.append(host[pos : pos + len(group)])
                 pos += len(group)
-            return mask_h, groups
+            return src_h, groups
 
         if ex._viewable:
             # span descriptors are width-bounded: ship them at the same
             # narrow dtype the single-device fetch uses (uint8/uint16)
-            mask, (st_parts, ln_parts) = _fetch_all(
+            src, (st_parts, ln_parts) = _fetch_all(
                 self._shard_slices(
                     ex._narrow_static(packed["span_start"], width), counts
                 ),
@@ -288,7 +291,6 @@ class ShardedChainExecutor:
                     ex._narrow_static(packed["span_len"], width + 1), counts
                 ),
             )
-            src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
             st = self._concat_counts(st_parts, counts).astype(np.int64)
             ln = self._concat_counts(ln_parts, counts).astype(np.int32)
             vw = int(max(int(hdrs[:, 1].max()), 1))
@@ -318,8 +320,7 @@ class ShardedChainExecutor:
             groups = [self._shard_slices(packed["agg_int"], counts)]
             if windowed:
                 groups.append(self._shard_slices(packed["agg_win"], counts))
-            mask, got = _fetch_all(*groups)
-            src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
+            src, got = _fetch_all(*groups)
             ints = self._concat_counts(got[0], counts).astype(np.int64)
             wins = (
                 self._concat_counts(got[1], counts).astype(np.int64)
@@ -338,7 +339,7 @@ class ShardedChainExecutor:
                 ex._pad_slice(max(int(hdrs[:, 2].max()), 1)),
                 packed["keys"].shape[1],
             )
-            mask, got = _fetch_all(
+            src, got = _fetch_all(
                 self._shard_slices(packed["values"], counts, vw),
                 self._shard_slices(
                     ex._narrow_static(
@@ -349,7 +350,6 @@ class ShardedChainExecutor:
                 self._shard_slices(packed["keys"], counts, kw),
                 self._shard_slices(packed["key_lengths"], counts),
             )
-            src = np.flatnonzero(np.unpackbits(mask, bitorder="little")[:n_rows])
             out_values = np.zeros((rows_out, vw), np.uint8)
             out_values[:total] = self._concat_counts(got[0], counts)
             out_lengths = np.zeros((rows_out,), np.int32)

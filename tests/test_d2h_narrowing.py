@@ -121,20 +121,18 @@ class TestWindowedTiers:
         chain_mods = [("windowed-sum", {"kind": "sum_int", "window_ms": "10"})]
         tc = _chain("tpu", *chain_mods)
         pc = _chain("python", *chain_mods)
-        records = []
-        for i in range(30):
-            r = Record(value=str(500 + i).encode())
-            r.offset_delta = i
-            r.timestamp_delta = i * 4  # crosses a window every ~3 records
-            records.append(r)
-        t_out = tc.process(SmartModuleInput.from_records(records, 0, 1000))
-        records2 = []
-        for i in range(30):
-            r = Record(value=str(500 + i).encode())
-            r.offset_delta = i
-            r.timestamp_delta = i * 4
-            records2.append(r)
-        p_out = pc.process(SmartModuleInput.from_records(records2, 0, 1000))
+
+        def mk():
+            out = []
+            for i in range(30):
+                r = Record(value=str(500 + i).encode())
+                r.offset_delta = i
+                r.timestamp_delta = i * 4  # crosses a window every ~3 records
+                out.append(r)
+            return out
+
+        t_out = tc.process(SmartModuleInput.from_records(mk(), 0, 1000))
+        p_out = pc.process(SmartModuleInput.from_records(mk(), 0, 1000))
         assert [(r.value, r.key) for r in t_out.successes] == [
             (r.value, r.key) for r in p_out.successes
         ]
