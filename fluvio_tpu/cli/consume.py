@@ -4,7 +4,9 @@ Capability parity: fluvio-cli/src/client/consume/mod.rs — offset flags
 (-B/--beginning, -H/--head, -T/--tail, --start, -e/--end-offset), -d to
 stop at log end, -n max records, partition selection, the SmartModule
 flag family, key display, and output formats (dynamic/text/json plus a
-`--format` template with {{key}}/{{value}}/{{offset}} substitution).
+`--format` template with {{key}}/{{value}}/{{offset}} substitution, and
+`table`/`full-table` rendering JSON records through an optional named
+TableFormat — consume/{record_format.rs,table_format.rs}).
 """
 
 from __future__ import annotations
@@ -61,12 +63,17 @@ def add_consume_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument(
         "-O",
         "--output",
-        choices=["dynamic", "text", "json", "raw"],
+        choices=["dynamic", "text", "json", "raw", "table", "full-table"],
         default="dynamic",
     )
     p.add_argument(
         "--format",
         help="per-record template, e.g. '{{offset}}: {{key}} -> {{value}}'",
+    )
+    p.add_argument(
+        "--table-format",
+        metavar="NAME",
+        help="named TableFormat whose columns lay out table output",
     )
     add_smartmodule_args(p)
     add_connection_args(p)
@@ -91,6 +98,97 @@ def _resolve_offset(args) -> Offset:
     if args.start is not None:
         return Offset.absolute(args.start)
     return Offset.end()
+
+
+class _TablePrinter:
+    """Streaming table renderer for JSON-object records.
+
+    Parity: fluvio-cli/src/client/consume/{record_format.rs,
+    table_format.rs} — `table` appends one aligned row per record;
+    `full-table` upserts by the TableFormat's primary-key columns and
+    re-prints a row when its key re-appears (the reference renders a
+    live TUI grid; a line-oriented CLI prints the updated row). Columns
+    come from a named TableFormat spec when given, else from the first
+    record's top-level keys. Non-JSON records fall back to plain text.
+    """
+
+    def __init__(self, columns=None, primary=None, upsert=False):
+        self.columns = columns  # [(header, dotted key path)]
+        self.primary = primary or []
+        self.upsert = upsert
+        self.widths = None
+        self.seen = set()  # primary-key tuples only; rows are not retained
+
+    @staticmethod
+    def from_spec(spec, upsert: bool) -> "_TablePrinter":
+        cols, primary = [], []
+        raw = spec.get("columns", []) if isinstance(spec, dict) else spec.columns
+        for c in raw:
+            get = (lambda k, d=None: c.get(k, d)) if isinstance(c, dict) else (
+                lambda k, d=None: getattr(c, k, d)
+            )
+            path = get("key_path", "") or get("keyPath", "")
+            # a primary key still keys the upsert when its column is hidden
+            if get("primary_key", False) or get("primaryKey", False):
+                primary.append(path)
+            if get("display", True) is False:
+                continue
+            cols.append((get("header") or path, path))
+        return _TablePrinter(cols or None, primary, upsert)
+
+    @staticmethod
+    def _lookup(obj, path: str) -> str:
+        cur = obj
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return ""
+            cur = cur[part]
+        if isinstance(cur, (dict, list)):
+            return json.dumps(cur, ensure_ascii=False)
+        return "" if cur is None else str(cur)
+
+    def print_record(self, value: bytes) -> None:
+        try:
+            obj = json.loads(value)
+        except ValueError:
+            obj = None
+        if not isinstance(obj, dict):
+            print(value.decode("utf-8", "replace"))
+            return
+        if self.columns is None:
+            self.columns = [(k, k) for k in obj.keys()]
+        cells = [self._lookup(obj, path) for _, path in self.columns]
+        if self.widths is None:
+            self.widths = [
+                max(len(h), len(c), 4) for (h, _), c in zip(self.columns, cells)
+            ]
+            print(self._row([h for h, _ in self.columns]))
+            print(self._row(["-" * w for w in self.widths]))
+        marker = ""
+        if self.upsert and self.primary:
+            key = tuple(self._lookup(obj, p) for p in self.primary)
+            marker = " *" if key in self.seen else ""
+            self.seen.add(key)
+        print(self._row(cells) + marker)
+
+    def _row(self, cells) -> str:
+        return " | ".join(
+            c.ljust(w) for c, w in zip(cells, self.widths)
+        ).rstrip()
+
+
+async def _table_printer(client, args) -> _TablePrinter:
+    upsert = args.output == "full-table"
+    if not args.table_format:
+        return _TablePrinter(upsert=upsert)
+    admin = await client.admin()
+    try:
+        objs = await admin.list("tableformat", [args.table_format])
+    finally:
+        await admin.close()
+    if not objs:
+        raise CliError(f"tableformat \"{args.table_format}\" not found")
+    return _TablePrinter.from_spec(objs[0].spec, upsert)
 
 
 def _print_record(record, args) -> None:
@@ -141,6 +239,9 @@ async def consume(args) -> int:
     client = await connect(args)
     seen = 0
     try:
+        table = None
+        if args.output in ("table", "full-table"):
+            table = await _table_printer(client, args)
         if args.all_partitions:
             from fluvio_tpu.client import PartitionSelectionStrategy
 
@@ -152,7 +253,10 @@ async def consume(args) -> int:
                 args.topic, args.partition
             )
         async for record in consumer.stream(offset, config):
-            _print_record(record, args)
+            if table is not None:
+                table.print_record(record.value)
+            else:
+                _print_record(record, args)
             seen += 1
             if args.num_records and seen >= args.num_records:
                 break

@@ -94,6 +94,39 @@ class TestClusterE2E:
         out = capsys.readouterr().out
         assert out.splitlines() == ["[k1] keep a", "[k2] keep b"]
 
+        # JSON records through table output with a named TableFormat
+        jrows = tmp_path / "rows.txt"
+        jrows.write_bytes(
+            b'{"name":"a","meta":{"n":1},"hide":"x"}\n'
+            b'{"name":"b","meta":{"n":2},"hide":"y"}\n'
+        )
+        assert main(["produce", "smoke", "--file", str(jrows)]) == 0
+        tf = tmp_path / "tf.yaml"
+        tf.write_text(
+            "name: fmt\n"
+            "columns:\n"
+            "  - key_path: name\n"
+            "    header: NAME\n"
+            "    primary_key: true\n"
+            "  - key_path: meta.n\n"
+            "  - key_path: hide\n"
+            "    display: false\n"
+        )
+        assert main(["tableformat", "create", "--config", str(tf)]) == 0
+        capsys.readouterr()  # drop the creation confirmation line
+        assert (
+            main(
+                ["consume", "smoke", "--start", "5", "-d", "-O", "table",
+                 "--table-format", "fmt"]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out.splitlines()
+        assert out[0].split() == ["NAME", "|", "meta.n"]
+        assert out[2].split() == ["a", "|", "1"]
+        assert out[3].split() == ["b", "|", "2"]
+        assert not any("hide" in line or "x" in line for line in out)
+
         # status healthy, then delete tears everything down
         assert main(["cluster", "status", "--data-dir", data]) == 0
         assert main(["cluster", "delete", "--data-dir", data]) == 0
@@ -129,3 +162,63 @@ class TestArgValidation:
     def test_version(self, capsys):
         assert main(["version"]) == 0
         assert "fluvio-tpu" in capsys.readouterr().out
+
+
+class TestTablePrinter:
+    def test_infers_columns_and_aligns(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        t = _TablePrinter()
+        t.print_record(b'{"name":"alpha","n":1}')
+        t.print_record(b'{"name":"b","n":22}')
+        out = capsys.readouterr().out.splitlines()
+        assert out[0].split() == ["name", "|", "n"]
+        assert out[2].startswith("alpha | 1")
+        assert out[3].startswith("b")
+
+    def test_non_json_falls_back_to_text(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        t = _TablePrinter()
+        t.print_record(b"plain words")
+        assert capsys.readouterr().out == "plain words\n"
+
+    def test_full_table_upsert_marks_replays(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        t = _TablePrinter(
+            columns=[("K", "k"), ("V", "v")], primary=["k"], upsert=True
+        )
+        t.print_record(b'{"k":"x","v":1}')
+        t.print_record(b'{"k":"x","v":2}')
+        t.print_record(b'{"k":"y","v":3}')
+        rows = capsys.readouterr().out.splitlines()[2:]
+        assert not rows[0].endswith("*")
+        assert rows[1].endswith("*")  # same primary key re-appeared
+        assert not rows[2].endswith("*")
+
+    def test_hidden_primary_key_still_keys_upserts(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        spec = {
+            "columns": [
+                {"key_path": "id", "primary_key": True, "display": False},
+                {"key_path": "name"},
+            ]
+        }
+        t = _TablePrinter.from_spec(spec, upsert=True)
+        assert t.primary == ["id"]
+        t.print_record(b'{"id":1,"name":"a"}')
+        t.print_record(b'{"id":1,"name":"b"}')
+        rows = capsys.readouterr().out.splitlines()[2:]
+        assert not rows[0].endswith("*")
+        assert rows[1].endswith("*")
+        assert "id" not in " ".join(rows)  # hidden column stays hidden
+
+    def test_nested_path_and_missing_keys(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        t = _TablePrinter(columns=[("A", "a.b"), ("C", "c")])
+        t.print_record(b'{"a":{"b":[1,2]},"other":0}')
+        out = capsys.readouterr().out.splitlines()
+        assert "[1, 2]" in out[2]
