@@ -1,7 +1,7 @@
 """`consume` subcommand.
 
 Capability parity: fluvio-cli/src/client/consume/mod.rs — offset flags
-(-B/--beginning, -H/--head, -T/--tail, --start, -e/--end-offset), -d to
+(-B/--beginning, -H/--head, -T/--tail, --start, --end), -d to
 stop at log end, -n max records, partition selection, the SmartModule
 flag family, key display, and output formats (dynamic/text/json plus a
 `--format` template with {{key}}/{{value}}/{{offset}} substitution, and
@@ -46,6 +46,12 @@ def add_consume_parser(sub: argparse._SubParsersAction) -> None:
         "-T", "--tail", type=int, metavar="N", help="start N back from the end"
     )
     p.add_argument("--start", type=int, metavar="OFFSET", help="absolute offset")
+    p.add_argument(
+        "--end",
+        type=int,
+        metavar="OFFSET",
+        help="stop once the record at this offset has been printed",
+    )
     p.add_argument(
         "-d",
         "--disable-continuous",
@@ -113,11 +119,26 @@ class _TablePrinter:
     """
 
     def __init__(self, columns=None, primary=None, upsert=False):
-        self.columns = columns  # [(header, dotted key path)]
-        self.primary = primary or []
+        # columns normalize to (header, path parts tuple, fixed width);
+        # None means "infer from the first record" while [] is a spec
+        # that hid every column (and must NOT fall back to inference)
+        self.columns = (
+            None if columns is None else [self._norm(c) for c in columns]
+        )
+        self.primary = [self._parts(p) for p in (primary or [])]
         self.upsert = upsert
         self.widths = None
         self.seen = set()  # primary-key tuples only; rows are not retained
+
+    @staticmethod
+    def _parts(path) -> tuple:
+        return tuple(path.split(".")) if isinstance(path, str) else tuple(path)
+
+    @classmethod
+    def _norm(cls, col) -> tuple:
+        header, path = col[0], col[1]
+        width = col[2] if len(col) > 2 else None
+        return (header, cls._parts(path), width)
 
     @staticmethod
     def from_spec(spec, upsert: bool) -> "_TablePrinter":
@@ -133,13 +154,13 @@ class _TablePrinter:
                 primary.append(path)
             if get("display", True) is False:
                 continue
-            cols.append((get("header") or path, path))
-        return _TablePrinter(cols or None, primary, upsert)
+            cols.append((get("header") or path, path, get("width")))
+        return _TablePrinter(cols, primary, upsert)
 
     @staticmethod
-    def _lookup(obj, path: str) -> str:
+    def _lookup(obj, parts: tuple) -> str:
         cur = obj
-        for part in path.split("."):
+        for part in parts:
             if not isinstance(cur, dict) or part not in cur:
                 return ""
             cur = cur[part]
@@ -156,13 +177,19 @@ class _TablePrinter:
             print(value.decode("utf-8", "replace"))
             return
         if self.columns is None:
-            self.columns = [(k, k) for k in obj.keys()]
-        cells = [self._lookup(obj, path) for _, path in self.columns]
+            # inferred columns address TOP-LEVEL keys verbatim: a key
+            # containing "." is one key, not a nested path
+            self.columns = [(k, (k,), None) for k in obj.keys()]
+        cells = [
+            self._lookup(obj, parts)[: width or None]
+            for _, parts, width in self.columns
+        ]
         if self.widths is None:
             self.widths = [
-                max(len(h), len(c), 4) for (h, _), c in zip(self.columns, cells)
+                width or max(len(h), len(c), 4)
+                for (h, _, width), c in zip(self.columns, cells)
             ]
-            print(self._row([h for h, _ in self.columns]))
+            print(self._row([h for h, _, _ in self.columns]))
             print(self._row(["-" * w for w in self.widths]))
         marker = ""
         if self.upsert and self.primary:
@@ -224,6 +251,8 @@ def _print_record(record, args) -> None:
 
 async def consume(args) -> int:
     offset = _resolve_offset(args)
+    if args.end is not None and args.start is not None and args.end < args.start:
+        raise CliError("end offset must be >= the start offset")
     config = ConsumerConfig(
         isolation=(
             Isolation.READ_COMMITTED
@@ -259,6 +288,9 @@ async def consume(args) -> int:
                 _print_record(record, args)
             seen += 1
             if args.num_records and seen >= args.num_records:
+                break
+            if args.end is not None and record.offset >= args.end:
+                print("End-offset has been reached; exiting", file=sys.stderr)
                 break
     except KeyboardInterrupt:
         pass

@@ -94,6 +94,11 @@ class TestClusterE2E:
         out = capsys.readouterr().out
         assert out.splitlines() == ["[k1] keep a", "[k2] keep b"]
 
+        # end-offset stops after printing the record at that offset
+        assert main(["consume", "smoke", "-B", "--end", "1"]) == 0
+        out = capsys.readouterr().out
+        assert out.splitlines() == ["keep me", "drop me"]
+
         # JSON records through table output with a named TableFormat
         jrows = tmp_path / "rows.txt"
         jrows.write_bytes(
@@ -139,6 +144,13 @@ class TestArgValidation:
         rc = main(["consume", "t", "-B", "--start", "5", "--sc", "127.0.0.1:1"])
         assert rc == 1
         assert "pick one of" in capsys.readouterr().err
+
+    def test_end_before_start_error(self, cli_env, capsys):
+        rc = main(
+            ["consume", "t", "--start", "5", "--end", "3", "--sc", "127.0.0.1:1"]
+        )
+        assert rc == 1
+        assert "end offset" in capsys.readouterr().err
 
     def test_exclusive_smartmodule_flags(self, cli_env, capsys, tmp_path):
         f = tmp_path / "x.yaml"
@@ -207,7 +219,7 @@ class TestTablePrinter:
             ]
         }
         t = _TablePrinter.from_spec(spec, upsert=True)
-        assert t.primary == ["id"]
+        assert t.primary == [("id",)]
         t.print_record(b'{"id":1,"name":"a"}')
         t.print_record(b'{"id":1,"name":"b"}')
         rows = capsys.readouterr().out.splitlines()[2:]
@@ -222,3 +234,30 @@ class TestTablePrinter:
         t.print_record(b'{"a":{"b":[1,2]},"other":0}')
         out = capsys.readouterr().out.splitlines()
         assert "[1, 2]" in out[2]
+
+    def test_all_hidden_spec_never_infers(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        spec = {
+            "columns": [{"key_path": "id", "primary_key": True, "display": False}]
+        }
+        t = _TablePrinter.from_spec(spec, upsert=True)
+        t.print_record(b'{"id":7,"secret":"leak"}')
+        assert "leak" not in capsys.readouterr().out
+
+    def test_inferred_dotted_key_is_one_key(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        t = _TablePrinter()
+        t.print_record(b'{"user.name":"alice"}')
+        out = capsys.readouterr().out.splitlines()
+        assert "alice" in out[2]
+
+    def test_spec_width_fixes_column(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        spec = {"columns": [{"key_path": "v", "width": 3}]}
+        t = _TablePrinter.from_spec(spec, upsert=False)
+        t.print_record(b'{"v":"longvalue"}')
+        out = capsys.readouterr().out.splitlines()
+        assert out[2] == "lon"
