@@ -155,7 +155,10 @@ class _TablePrinter:
             if get("display", True) is False:
                 continue
             cols.append((get("header") or path, path, get("width")))
-        return _TablePrinter(cols, primary, upsert)
+        # a spec with NO columns infers from the first record; a spec
+        # whose columns are all hidden renders nothing (never infer —
+        # inference would leak the very fields the spec hid)
+        return _TablePrinter(cols if raw else None, primary, upsert)
 
     @staticmethod
     def _lookup(obj, parts: tuple) -> str:
@@ -181,15 +184,17 @@ class _TablePrinter:
             # containing "." is one key, not a nested path
             self.columns = [(k, (k,), None) for k in obj.keys()]
         cells = [
-            self._lookup(obj, parts)[: width or None]
+            self._lookup(obj, parts)[slice(None, width)]
             for _, parts, width in self.columns
         ]
         if self.widths is None:
             self.widths = [
-                width or max(len(h), len(c), 4)
+                width if width is not None else max(len(h), len(c), 4)
                 for (h, _, width), c in zip(self.columns, cells)
             ]
-            print(self._row([h for h, _, _ in self.columns]))
+            # headers truncate to a fixed column width like data cells do
+            print(self._row([h[:w] for (h, _, _), w in
+                             zip(self.columns, self.widths)]))
             print(self._row(["-" * w for w in self.widths]))
         marker = ""
         if self.upsert and self.primary:

@@ -45,6 +45,12 @@ def add_produce_parser(sub: argparse._SubParsersAction) -> None:
     )
     p.add_argument("--linger", type=int, metavar="MS", help="batch linger ms")
     p.add_argument("--batch-size", type=int, metavar="BYTES")
+    p.add_argument(
+        "--delivery-semantic",
+        choices=["at-least-once", "at-most-once"],
+        default="at-least-once",
+        help="retry failed sends (at-least-once) or drop them (at-most-once)",
+    )
     add_smartmodule_args(p)
     add_connection_args(p)
     p.set_defaults(fn=produce)
@@ -55,6 +61,7 @@ async def produce(args) -> int:
     config = ProducerConfig(
         compression=Compression[args.compression.upper()],
         smartmodules=invocations,
+        delivery=args.delivery_semantic,
     )
     if args.linger is not None:
         config.linger_ms = args.linger

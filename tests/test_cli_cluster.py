@@ -256,8 +256,30 @@ class TestTablePrinter:
     def test_spec_width_fixes_column(self, capsys):
         from fluvio_tpu.cli.consume import _TablePrinter
 
-        spec = {"columns": [{"key_path": "v", "width": 3}]}
+        spec = {
+            "columns": [
+                {"key_path": "v", "header": "identifier", "width": 3},
+                {"key_path": "w"},
+            ]
+        }
         t = _TablePrinter.from_spec(spec, upsert=False)
-        t.print_record(b'{"v":"longvalue"}')
+        t.print_record(b'{"v":"longvalue","w":"ok"}')
         out = capsys.readouterr().out.splitlines()
-        assert out[2] == "lon"
+        assert out[0] == "ide | w"  # header truncates to the fixed width
+        assert out[2] == "lon | ok"
+
+    def test_spec_without_columns_infers(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        t = _TablePrinter.from_spec({"name": "empty"}, upsert=False)
+        t.print_record(b'{"a":1}')
+        out = capsys.readouterr().out.splitlines()
+        assert out[0].split() == ["a"] and out[2].split() == ["1"]
+
+    def test_width_zero_renders_empty_cell(self, capsys):
+        from fluvio_tpu.cli.consume import _TablePrinter
+
+        spec = {"columns": [{"key_path": "v", "width": 0}]}
+        t = _TablePrinter.from_spec(spec, upsert=False)
+        t.print_record(b'{"v":"hidden-by-width"}')
+        assert "hidden-by-width" not in capsys.readouterr().out
