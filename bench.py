@@ -417,6 +417,34 @@ def run_broker_e2e(n: int, smoke: bool, engine_rps: float) -> dict:
     return asyncio.run(run())
 
 
+def _probe_device() -> bool:
+    """Time-boxed subprocess probe of the real chip.
+
+    When the axon tunnel is down, the first jax device operation blocks
+    forever in a silent retry loop — in THIS process that would hang the
+    whole bench before any budget logic runs. A dead probe turns into an
+    honest zero-value JSON line instead of an infinite hang.
+    """
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp;"
+                "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready();"
+                "print('probe-ok')",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "300")),
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    return proc.returncode == 0 and "probe-ok" in proc.stdout
+
+
 def main() -> None:
     if os.environ.get("BENCH_CPU") == "1":
         # hermetic smoke runs: the axon sitecustomize pins jax_platforms
@@ -426,6 +454,20 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    elif not _probe_device():
+        log("device probe failed: TPU tunnel unreachable")
+        print(
+            json.dumps(
+                {
+                    "metric": "smartmodule_chain_records_per_sec",
+                    "value": 0,
+                    "unit": "records/s",
+                    "vs_baseline": 0,
+                    "error": "tpu tunnel unreachable (device probe timed out)",
+                }
+            )
+        )
+        sys.exit(1)
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     n = int(os.environ.get("BENCH_RECORDS", "20000" if smoke else "1000000"))
     only = os.environ.get("BENCH_CONFIGS")

@@ -183,8 +183,10 @@ class _TablePrinter:
             # inferred columns address TOP-LEVEL keys verbatim: a key
             # containing "." is one key, not a nested path
             self.columns = [(k, (k,), None) for k in obj.keys()]
+        if not self.columns:
+            return  # every column hidden: render nothing, not blank lines
         cells = [
-            self._lookup(obj, parts)[slice(None, width)]
+            self._lookup(obj, parts)[:width]
             for _, parts, width in self.columns
         ]
         if self.widths is None:
