@@ -180,6 +180,11 @@ class _TablePrinter:
             print(value.decode("utf-8", "replace"))
             return
         if self.columns is None:
+            if not obj:
+                # a field-less record can't seed inference; print a blank
+                # row and keep waiting for a record with keys
+                print()
+                return
             # inferred columns address TOP-LEVEL keys verbatim: a key
             # containing "." is one key, not a nested path
             self.columns = [(k, (k,), None) for k in obj.keys()]

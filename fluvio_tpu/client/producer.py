@@ -151,6 +151,7 @@ class PartitionProducer:
         self.config = config
         self._current: Optional[_PendingBatch] = None
         self._queue: List[_PendingBatch] = []
+        self._inflight: List[_PendingBatch] = []
         self._wake = asyncio.Event()
         self._closed = False
         self._task = asyncio.ensure_future(self._run())
@@ -175,14 +176,24 @@ class PartitionProducer:
             self._wake.set()
 
     async def flush(self) -> None:
+        """Wait until every sealed batch resolves; the FIRST delivery
+        failure re-raises here (parity: the reference's flush returns the
+        error, producer_fail/mod.rs asserts it). Per-record futures carry
+        the same error for callers that track them individually."""
         self._seal_current()
-        pending = list(self._queue)
+        # in-flight batches (popped by _run, awaiting their ack inside
+        # _send) count: "every sealed batch resolves" includes them
+        pending = list(self._inflight) + list(self._queue)
         self._wake.set()
+        first_err: Optional[FluvioError] = None
         for batch in pending:
             try:
                 await asyncio.shield(batch.future)
-            except FluvioError:
-                pass
+            except FluvioError as e:
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
 
     async def _run(self) -> None:
         linger = self.config.linger_ms / 1000
@@ -201,7 +212,11 @@ class PartitionProducer:
                 self._wake.clear()
                 continue
             batches, self._queue = self._queue, []
-            await self._send(batches)
+            self._inflight = batches
+            try:
+                await self._send(batches)
+            finally:
+                self._inflight = []
 
     async def _send(self, pending: List[_PendingBatch]) -> None:
         record_set = RecordSet()
@@ -272,7 +287,14 @@ class PartitionProducer:
             await asyncio.sleep(delay_ms / 1000)
 
     async def close(self) -> None:
-        await self.flush()
+        # teardown must not leak the background task: a delivery failure
+        # during the final drain is already on the record futures (and on
+        # any explicit flush() the caller made) — swallow it here so the
+        # cancel below always runs
+        try:
+            await self.flush()
+        except FluvioError:
+            pass
         self._closed = True
         self._task.cancel()
         try:

@@ -3,8 +3,9 @@
 Capability parity: fluvio-test/src/tests/ — smoke (produce->consume with
 checksum verification), concurrent, multiple_partitions, batching,
 reconnection, longevity (bounded), election (kill the leader SPU,
-verify re-election and continued service), and self_test (harness
-validation, makefiles/test.mk:52-57).
+verify re-election and continued service), producer_fail (offset
+sequencing, then a dead leader surfaces a clean flush error), and
+self_test (harness validation, makefiles/test.mk:52-57).
 """
 
 from __future__ import annotations
@@ -12,6 +13,7 @@ from __future__ import annotations
 import asyncio
 
 from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset
+from fluvio_tpu.protocol.error import FluvioError
 from fluvio_tpu.testing.driver import TestDriver
 from fluvio_tpu.testing.runner import TestEnv, fluvio_test
 
@@ -163,11 +165,71 @@ async def longevity(env: TestEnv) -> None:
         await driver.close()
 
 
+@fluvio_test(timeout_s=90)
+async def producer_fail(env: TestEnv) -> None:
+    """Offsets are sequential under load, and a producer whose leader SPU
+    dies surfaces a clean send/flush error instead of hanging
+    (tests/producer_fail/mod.rs: 1000 sends -> offset check -> terminate
+    SPU -> flush must fail)."""
+    from fluvio_tpu.client import ProducerConfig
+    from fluvio_tpu.client.producer import RetryPolicy
+
+    client = await Fluvio.connect(env.sc_addr)
+    admin = None
+    try:
+        admin = await client.admin()
+        await admin.create_topic("pfail-test")
+        # bounded retry: the post-kill flush must error promptly, not
+        # back off forever
+        producer = await client.topic_producer(
+            "pfail-test",
+            config=ProducerConfig(
+                linger_ms=10,
+                retry_policy=RetryPolicy(max_retries=2, initial_delay_ms=20),
+            ),
+        )
+        futs = [await producer.send(None, b"v%d" % i) for i in range(200)]
+        await producer.flush()
+        for i, fut in enumerate(futs):
+            meta = await fut.wait()
+            assert meta.offset == i, (meta.offset, i)
+
+        parts = await admin.list("partition")
+        leader = next(p for p in parts if p.key == "pfail-test-0").spec.leader
+        env.kill_spu(leader)
+        # SIGKILL races the next ack on loopback: wait until the SPU's
+        # public socket actually refuses before producing into it
+        target = next(s for s in env.spus if s["id"] == leader)["public"]
+        host, port = target.rsplit(":", 1)
+        for _ in range(200):
+            try:
+                _, w = await asyncio.open_connection(host, int(port))
+                w.close()
+                await asyncio.sleep(0.05)
+            except OSError:
+                break
+        else:
+            raise AssertionError("SPU socket still accepting after kill")
+
+        try:
+            await producer.send(None, b"after-kill")
+            await producer.flush()
+        except FluvioError:
+            pass  # the clean delivery error is the expected shape
+        else:
+            raise AssertionError("flush succeeded against a dead SPU")
+    finally:
+        if admin is not None:
+            await admin.close()
+        await client.close()
+
+
 @fluvio_test(timeout_s=120, min_spu=2)
 async def election(env: TestEnv) -> None:
     """Kill the leader SPU; the SC re-elects and service continues
     (tests/election/mod.rs:138)."""
     client = await Fluvio.connect(env.sc_addr)
+    admin = None
     try:
         admin = await client.admin()
         from fluvio_tpu.metadata.topic import TopicSpec
@@ -223,6 +285,7 @@ async def election(env: TestEnv) -> None:
             if len(got) >= 2:
                 break
         assert got == [b"pre-failover", b"post-failover"]
-        await admin.close()
     finally:
+        if admin is not None:
+            await admin.close()
         await client.close()

@@ -91,6 +91,7 @@ class TestBlackBoxRunner:
             "batching",
             "reconnection",
             "multiple-partitions",
+            "producer-fail",
             "self-check",
         ):
             assert name in tests, name
@@ -132,7 +133,9 @@ class TestBlackBoxRunner:
             sc_addr=state["sc_public"], spus=state["spus"], data_dir=data_dir
         )
         try:
-            for name in ("self-check", "smoke", "election"):
+            # kill-based suites run LAST (the cluster is shared): election
+            # downs one of the two SPUs, producer-fail downs the survivor
+            for name in ("self-check", "smoke", "election", "producer-fail"):
                 result = run_test(name, env)
                 assert result.ok, f"{name}: {result.detail}"
         finally:
