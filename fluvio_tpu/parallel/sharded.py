@@ -176,7 +176,7 @@ class ShardedChainExecutor:
         )
 
     def _padded_arrays(self, buf: RecordBuffer) -> Dict[str, np.ndarray]:
-        rows = buf.values.shape[0]
+        rows = buf.rows
         # shards must hold a multiple of 8 rows: each shard's survivor
         # bitmask packs to whole bytes, and the concatenated per-shard
         # masks must line up with global row numbering bit-for-bit
@@ -191,7 +191,7 @@ class ShardedChainExecutor:
             return np.pad(a, widths, constant_values=fill)
 
         return {
-            "values": pad_rows(buf.values),
+            "values": pad_rows(buf.dense_values()),
             "lengths": pad_rows(buf.lengths),
             "keys": pad_rows(buf.keys),
             "key_lengths": pad_rows(buf.key_lengths, fill=-1),
@@ -256,8 +256,8 @@ class ShardedChainExecutor:
         hdrs = np.asarray(jax.device_get(header))  # (n_shards, 5)
         counts = hdrs[:, 0].astype(np.int64)
         total = int(counts.sum())
-        n_rows = buf.values.shape[0]
-        width = buf.values.shape[1]
+        n_rows = buf.rows
+        width = buf.width
         rows_out = min(ex._bucket_bytes(max(total, 1), 8), max(n_rows, 8))
 
         # one async fetch for every column: all shard slices start their
@@ -298,7 +298,7 @@ class ShardedChainExecutor:
             out_values = np.zeros((rows_out, vw), dtype=np.uint8)
             if total:
                 cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
-                gathered = buf.values[
+                gathered = buf.dense_values()[
                     src[:total, None], np.clip(cols, 0, width - 1)
                 ]
                 keep = np.arange(vw, dtype=np.int32)[None, :] < ln[:, None]

@@ -60,6 +60,13 @@ class RecordColumns(ctypes.Structure):
     ]
 
 
+class RecordColumnsV2(ctypes.Structure):
+    _fields_ = [
+        ("base", RecordColumns),
+        ("val_len", ctypes.POINTER(ctypes.c_int64)),  # exact lengths
+    ]
+
+
 class EncodedRecords(ctypes.Structure):
     _fields_ = [
         ("data", ctypes.POINTER(ctypes.c_uint8)),
@@ -156,6 +163,13 @@ def load_library():
         lib.decode_record_columns.restype = ctypes.POINTER(RecordColumns)
         lib.decode_record_columns.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         lib.record_columns_free.argtypes = [ctypes.POINTER(RecordColumns)]
+        lib.decode_record_columns_v2.restype = ctypes.POINTER(RecordColumnsV2)
+        lib.decode_record_columns_v2.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.record_columns_v2_free.argtypes = [ctypes.POINTER(RecordColumnsV2)]
         lib.encode_record_columns.restype = ctypes.POINTER(EncodedRecords)
         lib.encode_record_columns.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),
@@ -210,6 +224,44 @@ def decode_record_columns(raw: bytes):
         }
     finally:
         lib.record_columns_free(c)
+
+
+def decode_record_columns_aligned(raw: bytes):
+    """Slab -> columns with the value flat written at 4-aligned offsets —
+    exactly the TPU engine's ragged upload form, so staging needs no
+    re-pad/re-flatten pass. ``val_off`` holds aligned starts (count + 1,
+    last = total aligned bytes, zero gap bytes) and ``val_len`` the exact
+    lengths. The alignment is fixed at 4: `RecordBuffer.from_flat` and
+    the device's cumsum-of-aligned-lengths starts both assume it. Same
+    malformed-slab contract as `decode_record_columns` (check
+    ``parsed``)."""
+    lib = load_library()
+    if lib is None:
+        return None
+    c2 = lib.decode_record_columns_v2(raw, len(raw), 4)
+    try:
+        cc = c2.contents.base
+        n = int(cc.count)
+        val_off = _ptr_array(cc.val_off, n + 1, np.int64)
+        key_off = _ptr_array(cc.key_off, n + 1, np.int64)
+        return {
+            "count": n,
+            "parsed": int(cc.parsed),
+            "val_off": val_off,
+            "val_len": _ptr_array(c2.contents.val_len, n, np.int64),
+            "val_flat": _ptr_array(
+                cc.val_flat, int(val_off[-1]) if n else 0, np.uint8
+            ),
+            "key_off": key_off,
+            "key_flat": _ptr_array(
+                cc.key_flat, int(key_off[-1]) if n else 0, np.uint8
+            ),
+            "key_present": _ptr_array(cc.key_present, n, np.uint8),
+            "off_delta": _ptr_array(cc.off_delta, n, np.int64),
+            "ts_delta": _ptr_array(cc.ts_delta, n, np.int64),
+        }
+    finally:
+        lib.record_columns_v2_free(c2)
 
 
 def encode_record_columns(

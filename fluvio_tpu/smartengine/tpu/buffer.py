@@ -55,7 +55,7 @@ class RecordBuffer:
     - ``count``: live rows (rows >= count are padding)
     """
 
-    values: np.ndarray
+    values: Optional[np.ndarray]
     lengths: np.ndarray
     keys: np.ndarray
     key_lengths: np.ndarray
@@ -70,9 +70,44 @@ class RecordBuffer:
     # single-input engine surface)
     fresh_offset_deltas: Optional[np.ndarray] = None
     fresh_timestamp_deltas: Optional[np.ndarray] = None
-    # cached ragged (flat) form of `values` for transfer-thin H2D staging
+    # cached ragged (flat) form of `values` for transfer-thin H2D staging.
+    # A FLAT-BACKED buffer (`values is None`, `from_flat`) holds ONLY this
+    # form — the upload path never builds the padded matrix at all, and
+    # `_width`/`_rows` carry the bucketed shape the matrix would have.
     _flat: Optional[np.ndarray] = None
     _starts: Optional[np.ndarray] = None
+    _width: int = 0
+    _rows: int = 0
+
+    @property
+    def width(self) -> int:
+        """Bucketed value-matrix width (valid in both backing modes)."""
+        return self.values.shape[1] if self.values is not None else self._width
+
+    @property
+    def rows(self) -> int:
+        return self.values.shape[0] if self.values is not None else self._rows
+
+    def dense_values(self) -> np.ndarray:
+        """The padded matrix; materialized on demand for flat-backed
+        buffers (slow-path consumers only — the TPU hot path never calls
+        this)."""
+        if self.values is None:
+            rows, width = self._rows, self._width
+            values = np.zeros((rows, width), dtype=np.uint8)
+            flat, starts = self._flat, self._starts
+            if len(flat):  # all-empty values (tombstones): zeros already
+                mask = (
+                    np.arange(width, dtype=np.int32)[None, :]
+                    < self.lengths[:, None]
+                )
+                idx = (
+                    starts.astype(np.int64)[:, None]
+                    + np.arange(width, dtype=np.int64)[None, :]
+                )
+                values[mask] = flat[np.clip(idx, 0, len(flat) - 1)][mask]
+            self.values = values
+        return self.values
 
     def ragged_values(self) -> Tuple[np.ndarray, np.ndarray]:
         """(flat, starts): concatenated live bytes + per-row start index.
@@ -84,7 +119,9 @@ class RecordBuffer:
         device re-pad can gather whole i32 words — a 4x cheaper gather
         than per-byte on TPU. The device derives the starts from a cumsum
         of the aligned lengths; they are returned here for host-side
-        consumers. Cached: stream benches reuse the same buffer.
+        consumers. Cached: stream benches reuse the same buffer, and
+        flat-backed buffers are BORN in this form (the native decoder
+        emits the 4-aligned flat directly).
         """
         if self._flat is None:
             width = self.values.shape[1]
@@ -250,6 +287,71 @@ class RecordBuffer:
             base_timestamp=base_timestamp,
         )
 
+    @classmethod
+    def from_flat(
+        cls,
+        cols: dict,
+        base_offset: int = 0,
+        base_timestamp: int = NO_TIMESTAMP,
+    ) -> "RecordBuffer":
+        """Adopt the aligned-decode columns (broker fast path, zero-copy
+        staging).
+
+        ``cols`` is the dict from
+        `native_backend.decode_record_columns_aligned`: the value flat is
+        already in the engine's 4-aligned ragged upload form, so this
+        buffer is flat-backed — the padded matrix is never built unless a
+        slow-path consumer asks (`dense_values`).
+        """
+        n = cols["count"]
+        rows = _next_pow2(max(n, 1), MIN_ROWS)
+        val_len = cols["val_len"]
+        max_v = int(val_len.max()) if n else 0
+        width = _next_pow2(max(max_v, 1), MIN_WIDTH)
+        if width > MAX_WIDTH:
+            raise ValueError(f"record value of {max_v} bytes exceeds {MAX_WIDTH}")
+        lengths = np.zeros(rows, dtype=np.int32)
+        lengths[:n] = val_len.astype(np.int32)
+        starts = np.zeros(rows, dtype=np.int32)
+        starts[:n] = cols["val_off"][:-1].astype(np.int32)
+        # padding rows "start" at the end of the flat with length 0
+        starts[n:] = np.int32(cols["val_off"][-1]) if n else 0
+
+        key_present = cols["key_present"].astype(bool)
+        key_lengths = np.full(rows, -1, dtype=np.int32)
+        if n and key_present.any():
+            key_off = cols["key_off"]
+            klive = (key_off[1:] - key_off[:-1]).astype(np.int32)
+            key_lengths[:n] = np.where(key_present, klive, -1)
+            kwidth = _next_pow2(max(int(klive.max()), 1), MIN_WIDTH)
+            keys = np.zeros((rows, kwidth), dtype=np.uint8)
+            kmask = (
+                np.arange(kwidth, dtype=np.int32)[None, :]
+                < np.maximum(key_lengths, 0)[:, None]
+            )
+            keys[kmask] = cols["key_flat"]
+        else:
+            keys = np.zeros((rows, MIN_WIDTH), dtype=np.uint8)
+        offset_deltas = np.zeros(rows, dtype=np.int32)
+        offset_deltas[:n] = cols["off_delta"].astype(np.int32)
+        timestamp_deltas = np.zeros(rows, dtype=np.int64)
+        timestamp_deltas[:n] = cols["ts_delta"]
+        return cls(
+            values=None,
+            lengths=lengths,
+            keys=keys,
+            key_lengths=key_lengths,
+            offset_deltas=offset_deltas,
+            timestamp_deltas=timestamp_deltas,
+            count=n,
+            base_offset=base_offset,
+            base_timestamp=base_timestamp,
+            _flat=np.asarray(cols["val_flat"], dtype=np.uint8),
+            _starts=starts,
+            _width=width,
+            _rows=rows,
+        )
+
     def to_columns(self) -> dict:
         """Exact (unaligned) columnar form of the live rows — the input
         shape of `native_backend.encode_record_columns`."""
@@ -257,9 +359,10 @@ class RecordBuffer:
         lengths = self.lengths[:n].astype(np.int64)
         val_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(lengths, out=val_off[1:])
-        width = self.values.shape[1]
+        values = self.dense_values()
+        width = values.shape[1]
         mask = np.arange(width, dtype=np.int32)[None, :] < lengths[:, None]
-        val_flat = self.values[:n][mask]
+        val_flat = values[:n][mask]
         key_present = (self.key_lengths[:n] >= 0).astype(np.uint8)
         klens = np.maximum(self.key_lengths[:n], 0).astype(np.int64)
         key_off = np.zeros(n + 1, dtype=np.int64)
@@ -282,7 +385,7 @@ class RecordBuffer:
 
     def to_records(self) -> List[Record]:
         out: List[Record] = []
-        values = self.values
+        values = self.dense_values()
         keys = self.keys
         for i in range(self.count):
             vlen = int(self.lengths[i])
@@ -299,4 +402,4 @@ class RecordBuffer:
 
     def shape_key(self) -> Tuple[int, int, int]:
         """(rows, value width, key width) — the jit-cache bucket."""
-        return (self.values.shape[0], self.values.shape[1], self.keys.shape[1])
+        return (self.rows, self.width, self.keys.shape[1])

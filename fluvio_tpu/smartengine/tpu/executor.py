@@ -689,7 +689,7 @@ class TpuChainExecutor:
         # lengths ride the link narrow (u16) whenever the width allows
         lengths_up = (
             buf.lengths.astype(np.uint16)
-            if buf.values.shape[1] < (1 << 16)
+            if buf.width < (1 << 16)
             else buf.lengths
         )
         header, packed, new_carries = self._jit_ragged(
@@ -702,7 +702,7 @@ class TpuChainExecutor:
             jnp.int32(buf.count),
             jnp.int64(buf.base_timestamp),
             carries,
-            width=buf.values.shape[1],
+            width=buf.width,
             kwidth=buf.keys.shape[1],
             has_keys=has_keys,
             has_offsets=has_offsets,
@@ -817,7 +817,7 @@ class TpuChainExecutor:
             total = int(hdr[4])
             if total > cap:
                 raise _FanoutOverflow(total)
-        width = buf.values.shape[1]
+        width = buf.width
 
         def _src_col():
             if src_delta is not None:
@@ -851,18 +851,32 @@ class TpuChainExecutor:
                 src = _src_decode(host[2])
             else:
                 src = np.flatnonzero(
-                    np.unpackbits(host[2], bitorder="little")[: buf.values.shape[0]]
+                    np.unpackbits(host[2], bitorder="little")[: buf.rows]
                 )[:count]
             st = st_h[:count].astype(np.int64)
             ln = ln_h[:count].astype(np.int32)
             vw = min(self._pad_slice(max(max_v, 1)), width)
             out_values = np.zeros((rows, vw), dtype=np.uint8)
             if count:
-                cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
-                gathered = buf.values[
-                    src[:, None], np.clip(cols, 0, width - 1)
-                ]
                 keep = np.arange(vw, dtype=np.int32)[None, :] < ln[:, None]
+                if buf.values is None:
+                    # flat-backed buffer: slice views straight out of the
+                    # aligned flat (never builds the padded matrix)
+                    flat, starts = buf.ragged_values()
+                    if len(flat):
+                        base = starts.astype(np.int64)[src] + st
+                        cols = (
+                            base[:, None]
+                            + np.arange(vw, dtype=np.int64)[None, :]
+                        )
+                        gathered = flat[np.clip(cols, 0, len(flat) - 1)]
+                    else:  # all-empty values: every view is empty
+                        gathered = np.zeros((count, vw), dtype=np.uint8)
+                else:
+                    cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
+                    gathered = buf.values[
+                        src[:, None], np.clip(cols, 0, width - 1)
+                    ]
                 gathered = np.where(keep, gathered, 0)
                 out_values[:count] = apply_postops_host(
                     gathered, self._view_postops
@@ -932,7 +946,7 @@ class TpuChainExecutor:
             pos += 1
         elif want_mask:
             src = np.flatnonzero(
-                np.unpackbits(host[pos], bitorder="little")[: buf.values.shape[0]]
+                np.unpackbits(host[pos], bitorder="little")[: buf.rows]
             )
             pos += 1
         if want_keys:
@@ -1033,7 +1047,7 @@ class TpuChainExecutor:
             s.copy_to_host_async()
         host = jax.device_get(slices)
         src = np.flatnonzero(
-            np.unpackbits(host[0], bitorder="little")[: buf.values.shape[0]]
+            np.unpackbits(host[0], bitorder="little")[: buf.rows]
         )
         ints = (
             self._delta_decode(host[1], scal[1], count)
@@ -1095,12 +1109,12 @@ class TpuChainExecutor:
         not an absolute row count, so small batches stay small)."""
         if not self._fanout:
             return None
-        rows = buf.values.shape[0]
+        rows = buf.rows
         ratio = max(self._cap_ratio, 4.0)
         return self._bucket_bytes(max(int(ratio * rows), 1024), 1024)
 
     def _learn_cap(self, buf: RecordBuffer, total: int) -> None:
-        rows = max(buf.values.shape[0], 1)
+        rows = max(buf.rows, 1)
         # 25% headroom over the observed density
         self._cap_ratio = max(self._cap_ratio, 1.25 * total / rows)
 

@@ -300,7 +300,7 @@ def tpu_stage_dispatch(
             return _decline(metrics, "no-raw-records")
         if batch.header.compression() != Compression.NONE:
             raw = decompress(batch.header.compression(), raw)
-        cols = native_backend.decode_record_columns(raw)
+        cols = native_backend.decode_record_columns_aligned(raw)
         if cols is None:
             return _decline(metrics, "no-native-decoder")
         if cols["count"] != batch.records_len() or cols["parsed"] != len(raw):
@@ -324,7 +324,10 @@ def tpu_stage_dispatch(
         return _decline(metrics, "mixed-base-timestamps")
     merged = {
         "count": sum(c["count"] for _, c in staged),
+        # per-batch flats are 4-aligned (every record padded to 4), so a
+        # straight concat preserves alignment for the whole slice
         "val_flat": np.concatenate([c["val_flat"] for _, c in staged]),
+        "val_len": np.concatenate([c["val_len"] for _, c in staged]),
         "key_flat": np.concatenate([c["key_flat"] for _, c in staged]),
         "key_present": np.concatenate([c["key_present"] for _, c in staged]),
     }
@@ -348,14 +351,15 @@ def tpu_stage_dispatch(
         [np.concatenate(key_offs), np.array([k_base], dtype=np.int64)]
     )
     try:
-        buf = RecordBuffer.from_columns(
+        buf = RecordBuffer.from_flat(
             merged, base_offset=base0, base_timestamp=ts0
         )
     except ValueError:  # value wider than MAX_WIDTH: per-record path
         return _decline(metrics, "record-too-wide")
-    # dense-staging amplification guard: one huge value would pad every
-    # row of the slice to its pow2 width
-    if buf.values.nbytes > _MAX_STAGING_BYTES:
+    # dense-amplification guard: one huge value would pad every row of
+    # the DEVICE-side re-padded matrix (rows x width in HBM) to its pow2
+    # width — the host stays flat-backed either way
+    if buf.rows * buf.width > _MAX_STAGING_BYTES:
         return _decline(metrics, "staging-cap")
     if tpu._fanout:
         # fan-out outputs inherit their source batch's rebase deltas

@@ -829,10 +829,38 @@ struct RecordColumns {
     int64_t* ts_delta;
 };
 
-RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len) {
+// Thin wrapper over the v2 parser at align=1 (exact offsets, compact
+// flat) — ONE parse loop serves both decoders, so wire-format or
+// bounds-check fixes cannot desynchronize them.
+RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len);
+
+void record_columns_free(RecordColumns* c) {
+    if (!c) return;
+    std::free(c->val_flat);
+    std::free(c->val_off);
+    std::free(c->key_flat);
+    std::free(c->key_off);
+    std::free(c->key_present);
+    std::free(c->off_delta);
+    std::free(c->ts_delta);
+    delete c;
+}
+
+// v2: val_flat written at `align`-aligned offsets so it IS the engine's
+// ragged upload form (no host-side re-pad / re-flatten pass). val_off
+// holds the aligned starts (count + 1, last = total aligned bytes) and
+// val_len the exact per-record lengths. Keys/deltas identical to v1.
+struct RecordColumnsV2 {
+    RecordColumns base;
+    int64_t* val_len;  // count (exact lengths; val_off is aligned)
+};
+
+RecordColumnsV2* decode_record_columns_v2(const uint8_t* raw, int64_t raw_len,
+                                          int64_t align) {
+    if (align <= 0) align = 1;
     struct View { int64_t voff, vlen, koff, klen, od, td; bool has_key; };
     std::vector<View> views;
-    int64_t pos = 0, total_v = 0, total_k = 0, good = 0;
+    int64_t pos = 0, total_va = 0, total_k = 0, good = 0;
     while (pos < raw_len) {
         int64_t rec_start = pos;
         int64_t inner = 0;
@@ -863,26 +891,31 @@ RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len) {
         v.vlen = vlen;
         pos = end;  // skip record headers
         good = pos;
-        total_v += vlen;
+        total_va += (vlen + align - 1) & ~(align - 1);
         views.push_back(v);
     }
-    auto* c = new RecordColumns();
+    auto* c2 = new RecordColumnsV2();
+    RecordColumns* c = &c2->base;
     int64_t n = (int64_t)views.size();
     c->count = n;
     c->parsed = good;
-    c->val_flat = (uint8_t*)std::malloc(total_v ? total_v : 1);
+    // calloc: the alignment gap bytes must be zero (they ride the H2D
+    // link inside the flat and the device masks by exact length)
+    c->val_flat = (uint8_t*)std::calloc(total_va ? total_va : 1, 1);
     c->val_off = (int64_t*)std::malloc((n + 1) * sizeof(int64_t));
     c->key_flat = (uint8_t*)std::malloc(total_k ? total_k : 1);
     c->key_off = (int64_t*)std::malloc((n + 1) * sizeof(int64_t));
     c->key_present = (uint8_t*)std::malloc(n ? n : 1);
     c->off_delta = (int64_t*)std::malloc(n ? n * sizeof(int64_t) : 8);
     c->ts_delta = (int64_t*)std::malloc(n ? n * sizeof(int64_t) : 8);
+    c2->val_len = (int64_t*)std::malloc(n ? n * sizeof(int64_t) : 8);
     int64_t vo = 0, ko = 0;
     for (int64_t i = 0; i < n; i++) {
         const View& v = views[(size_t)i];
         c->val_off[i] = vo;
+        c2->val_len[i] = v.vlen;
         std::memcpy(c->val_flat + vo, raw + v.voff, (size_t)v.vlen);
-        vo += v.vlen;
+        vo += (v.vlen + align - 1) & ~(align - 1);
         c->key_off[i] = ko;
         if (v.has_key) {
             std::memcpy(c->key_flat + ko, raw + v.koff, (size_t)v.klen);
@@ -894,19 +927,28 @@ RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len) {
     }
     c->val_off[n] = vo;
     c->key_off[n] = ko;
-    return c;
+    return c2;
 }
 
-void record_columns_free(RecordColumns* c) {
-    if (!c) return;
-    std::free(c->val_flat);
-    std::free(c->val_off);
-    std::free(c->key_flat);
-    std::free(c->key_off);
-    std::free(c->key_present);
-    std::free(c->off_delta);
-    std::free(c->ts_delta);
-    delete c;
+void record_columns_v2_free(RecordColumnsV2* c2) {
+    if (!c2) return;
+    std::free(c2->base.val_flat);
+    std::free(c2->base.val_off);
+    std::free(c2->base.key_flat);
+    std::free(c2->base.key_off);
+    std::free(c2->base.key_present);
+    std::free(c2->base.off_delta);
+    std::free(c2->base.ts_delta);
+    std::free(c2->val_len);
+    delete c2;
+}
+
+RecordColumns* decode_record_columns(const uint8_t* raw, int64_t raw_len) {
+    RecordColumnsV2* c2 = decode_record_columns_v2(raw, raw_len, 1);
+    auto* c = new RecordColumns(c2->base);  // steal the column pointers
+    std::free(c2->val_len);
+    delete c2;
+    return c;
 }
 
 struct EncodedRecords {
