@@ -228,6 +228,32 @@ class RecordBuffer:
         )
 
     @classmethod
+    def _stage_meta_columns(cls, cols: dict, rows: int, n: int):
+        """Shared key/offset/timestamp staging for the two native-decode
+        constructors (one implementation: a key-handling fix cannot land
+        in one and miss the other)."""
+        key_present = cols["key_present"].astype(bool)
+        key_lengths = np.full(rows, -1, dtype=np.int32)
+        if n and key_present.any():
+            key_off = cols["key_off"]
+            klive = (key_off[1:] - key_off[:-1]).astype(np.int32)
+            key_lengths[:n] = np.where(key_present, klive, -1)
+            kwidth = _next_pow2(max(int(klive.max()), 1), MIN_WIDTH)
+            keys = np.zeros((rows, kwidth), dtype=np.uint8)
+            kmask = (
+                np.arange(kwidth, dtype=np.int32)[None, :]
+                < np.maximum(key_lengths, 0)[:, None]
+            )
+            keys[kmask] = cols["key_flat"]
+        else:
+            keys = np.zeros((rows, MIN_WIDTH), dtype=np.uint8)
+        offset_deltas = np.zeros(rows, dtype=np.int32)
+        offset_deltas[:n] = cols["off_delta"].astype(np.int32)
+        timestamp_deltas = np.zeros(rows, dtype=np.int64)
+        timestamp_deltas[:n] = cols["ts_delta"]
+        return keys, key_lengths, offset_deltas, timestamp_deltas
+
+    @classmethod
     def from_columns(
         cls,
         cols: dict,
@@ -255,26 +281,9 @@ class RecordBuffer:
         mask = np.arange(width, dtype=np.int32)[None, :] < lengths[:, None]
         values[mask] = cols["val_flat"]
 
-        key_present = cols["key_present"].astype(bool)
-        key_lengths = np.full(rows, -1, dtype=np.int32)
-        if n and key_present.any():
-            key_off = cols["key_off"]
-            klive = (key_off[1:] - key_off[:-1]).astype(np.int32)
-            key_lengths[:n] = np.where(key_present, klive, -1)
-            max_k = int(klive.max())
-            kwidth = _next_pow2(max(max_k, 1), MIN_WIDTH)
-            keys = np.zeros((rows, kwidth), dtype=np.uint8)
-            kmask = (
-                np.arange(kwidth, dtype=np.int32)[None, :]
-                < np.maximum(key_lengths, 0)[:, None]
-            )
-            keys[kmask] = cols["key_flat"]
-        else:
-            keys = np.zeros((rows, MIN_WIDTH), dtype=np.uint8)
-        offset_deltas = np.zeros(rows, dtype=np.int32)
-        offset_deltas[:n] = cols["off_delta"].astype(np.int32)
-        timestamp_deltas = np.zeros(rows, dtype=np.int64)
-        timestamp_deltas[:n] = cols["ts_delta"]
+        keys, key_lengths, offset_deltas, timestamp_deltas = (
+            cls._stage_meta_columns(cols, rows, n)
+        )
         return cls(
             values=values,
             lengths=lengths,
@@ -317,25 +326,9 @@ class RecordBuffer:
         # padding rows "start" at the end of the flat with length 0
         starts[n:] = np.int32(cols["val_off"][-1]) if n else 0
 
-        key_present = cols["key_present"].astype(bool)
-        key_lengths = np.full(rows, -1, dtype=np.int32)
-        if n and key_present.any():
-            key_off = cols["key_off"]
-            klive = (key_off[1:] - key_off[:-1]).astype(np.int32)
-            key_lengths[:n] = np.where(key_present, klive, -1)
-            kwidth = _next_pow2(max(int(klive.max()), 1), MIN_WIDTH)
-            keys = np.zeros((rows, kwidth), dtype=np.uint8)
-            kmask = (
-                np.arange(kwidth, dtype=np.int32)[None, :]
-                < np.maximum(key_lengths, 0)[:, None]
-            )
-            keys[kmask] = cols["key_flat"]
-        else:
-            keys = np.zeros((rows, MIN_WIDTH), dtype=np.uint8)
-        offset_deltas = np.zeros(rows, dtype=np.int32)
-        offset_deltas[:n] = cols["off_delta"].astype(np.int32)
-        timestamp_deltas = np.zeros(rows, dtype=np.int64)
-        timestamp_deltas[:n] = cols["ts_delta"]
+        keys, key_lengths, offset_deltas, timestamp_deltas = (
+            cls._stage_meta_columns(cols, rows, n)
+        )
         return cls(
             values=None,
             lengths=lengths,

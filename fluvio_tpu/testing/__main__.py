@@ -39,7 +39,21 @@ def main(argv=None) -> int:
             print(f"{name}  (timeout {test.timeout_s}s, min_spu {test.min_spu})")
         return 0
 
-    names = sorted(tests) if args.all else ([args.test] if args.test else [])
+    # destructive (SPU-killing) suites run LAST against the shared
+    # cluster — and among themselves, higher min_spu first, before
+    # earlier kills deplete the SPUs they need
+    names = (
+        sorted(
+            tests,
+            key=lambda n: (
+                tests[n].destructive,
+                -tests[n].min_spu if tests[n].destructive else 0,
+                n,
+            ),
+        )
+        if args.all
+        else ([args.test] if args.test else [])
+    )
     if not names:
         parser.error("pass a test name, --all, or --list")
 

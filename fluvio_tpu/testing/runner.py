@@ -27,6 +27,10 @@ class RegisteredTest:
     fn: Callable  # async fn(driver_factory, env) -> None
     timeout_s: float = 60.0
     min_spu: int = 1
+    # kills cluster processes: a shared-cluster runner must schedule these
+    # AFTER every non-destructive test (and higher min_spu first among
+    # themselves, before earlier kills deplete the SPUs they need)
+    destructive: bool = False
 
 
 @dataclass
@@ -60,13 +64,15 @@ class TestResult:
     detail: str = ""
 
 
-def fluvio_test(timeout_s: float = 60.0, min_spu: int = 1):
+def fluvio_test(timeout_s: float = 60.0, min_spu: int = 1,
+                destructive: bool = False):
     """Register a black-box test (the `#[fluvio_test]` analog)."""
 
     def wrap(fn: Callable) -> Callable:
         name = fn.__name__.replace("_", "-")
         _REGISTRY[name] = RegisteredTest(
-            name=name, fn=fn, timeout_s=timeout_s, min_spu=min_spu
+            name=name, fn=fn, timeout_s=timeout_s, min_spu=min_spu,
+            destructive=destructive,
         )
         return fn
 

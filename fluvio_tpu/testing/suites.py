@@ -165,7 +165,7 @@ async def longevity(env: TestEnv) -> None:
         await driver.close()
 
 
-@fluvio_test(timeout_s=90)
+@fluvio_test(timeout_s=90, destructive=True)
 async def producer_fail(env: TestEnv) -> None:
     """Offsets are sequential under load, and a producer whose leader SPU
     dies surfaces a clean send/flush error instead of hanging
@@ -224,7 +224,7 @@ async def producer_fail(env: TestEnv) -> None:
         await client.close()
 
 
-@fluvio_test(timeout_s=120, min_spu=2)
+@fluvio_test(timeout_s=120, min_spu=2, destructive=True)
 async def election(env: TestEnv) -> None:
     """Kill the leader SPU; the SC re-elects and service continues
     (tests/election/mod.rs:138)."""

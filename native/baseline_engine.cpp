@@ -857,7 +857,8 @@ struct RecordColumnsV2 {
 
 RecordColumnsV2* decode_record_columns_v2(const uint8_t* raw, int64_t raw_len,
                                           int64_t align) {
-    if (align <= 0) align = 1;
+    // the rounding below is mask-based: align must be a power of two
+    if (align <= 0 || (align & (align - 1)) != 0) align = 1;
     struct View { int64_t voff, vlen, koff, klen, od, td; bool has_key; };
     std::vector<View> views;
     int64_t pos = 0, total_va = 0, total_k = 0, good = 0;
