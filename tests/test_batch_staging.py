@@ -397,3 +397,35 @@ class TestAlignedDecode:
         fast = process_batches(fast_chain, _shallow_batches(groups, [0]), 1 << 20)
         slow = process_batches(slow_chain, _shallow_batches(groups, [0]), 1 << 20)
         assert _flat_records(fast) == _flat_records(slow)
+
+    def test_fuzz_random_shapes_parity(self):
+        rng = np.random.default_rng(31)
+        for trial in range(20):
+            n = int(rng.integers(1, 40))
+            records = []
+            for i in range(n):
+                vlen = int(rng.integers(0, 120))
+                v = bytes(rng.integers(0, 256, size=vlen, dtype=np.uint8))
+                r = Record(value=v)
+                if rng.random() < 0.5:
+                    klen = int(rng.integers(0, 20))
+                    r.key = bytes(rng.integers(0, 256, size=klen, dtype=np.uint8))
+                r.timestamp_delta = int(rng.integers(0, 10000))
+                records.append(r)
+            raw = _encode_records(records)
+            v1 = RecordBuffer.from_columns(
+                native_backend.decode_record_columns(raw)
+            )
+            v2 = RecordBuffer.from_flat(
+                native_backend.decode_record_columns_aligned(raw)
+            )
+            a = [(r.value, r.key, r.offset_delta, r.timestamp_delta)
+                 for r in v1.to_records()]
+            b = [(r.value, r.key, r.offset_delta, r.timestamp_delta)
+                 for r in v2.to_records()]
+            assert a == b, trial
+            f1, s1 = v1.ragged_values()
+            f2, s2 = v2.ragged_values()
+            assert np.array_equal(f1, f2) and np.array_equal(
+                s1[:n], s2[:n]
+            ), trial
