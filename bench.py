@@ -370,7 +370,9 @@ def run_broker_e2e(n: int, smoke: bool, engine_rps: float) -> dict:
 
         cfg = ConsumerConfig(
             disable_continuous=True,
-            max_bytes=4 << 20,
+            # big read slices: each slice is ONE coalesced device dispatch,
+            # so slice size sets the compute/transfer amortization
+            max_bytes=16 << 20,
             smartmodules=[
                 SmartModuleInvocation(
                     wasm=SmartModuleInvocationWasm.adhoc(NORTH_STAR_FILTER_SM),

@@ -1,0 +1,115 @@
+"""Randomized chain-composition fuzz: TPU vs interpreter equivalence.
+
+The targeted suites pin each transform kind; this sweep composes random
+chains from the module registry over mixed corpora (valid JSON objects,
+arrays, garbage, empties) and asserts full output parity — successes
+(value/key/offset/timestamp) AND first-error parity (engine.rs:159-161
+partial-output semantics) — between the fused TPU executor and the
+per-record reference backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fluvio_tpu.models import lookup
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+from fluvio_tpu.smartengine.engine import EngineError
+from fluvio_tpu.smartmodule import SmartModuleInput
+
+# (name, params) pools a chain is drawn from; stage 1 pools exclude
+# terminal aggregates so multi-stage draws stay lowerable more often
+_TRANSFORMS = [
+    ("regex-filter", {"regex": "flu"}),
+    ("regex-filter", {"regex": "[0-9]+"}),
+    ("regex-filter", {"regex": "zz"}),  # drops everything
+    ("json-map", {"field": "name"}),
+    ("json-map", {"field": "n"}),
+    ("json-map", {"field": "missing"}),
+    ("array-map-json", None),
+]
+_TAILS = [
+    ("aggregate-count", None),
+    ("aggregate-sum", None),
+    ("aggregate-field", {"field": "n", "combine": "add"}),
+    ("aggregate-field", {"field": "n", "combine": "max"}),
+    None,  # no tail
+]
+
+
+def _corpus(rng) -> list:
+    out = []
+    for i in range(int(rng.integers(4, 50))):
+        roll = rng.random()
+        if roll < 0.45:
+            name = ["fluvio", "kafka", "flume", "x"][int(rng.integers(0, 4))]
+            out.append(f'{{"name":"{name}-{i}","n":{int(rng.integers(0, 500))}}}')
+        elif roll < 0.65:
+            k = int(rng.integers(0, 5))
+            out.append("[" + ",".join(str(int(rng.integers(0, 99))) for _ in range(k)) + "]")
+        elif roll < 0.8:
+            out.append(str(int(rng.integers(0, 10**6))))
+        elif roll < 0.9:
+            out.append("")
+        else:
+            out.append("not json at all")
+    return [v.encode() for v in out]
+
+
+def _records(values):
+    out = []
+    for i, v in enumerate(values):
+        r = Record(value=v)
+        r.offset_delta = i
+        r.timestamp_delta = i * 2
+        out.append(r)
+    return out
+
+
+def _build(backend, specs):
+    b = SmartEngine(backend=backend).builder()
+    for name, params in specs:
+        b.add_smart_module(SmartModuleConfig(params=params or {}), lookup(name))
+    return b.initialize()
+
+
+class TestRandomChainFuzz:
+    def test_random_compositions(self):
+        rng = np.random.default_rng(97)
+        ran = 0
+        for trial in range(16):
+            depth = int(rng.integers(1, 3))
+            specs = [
+                _TRANSFORMS[int(rng.integers(0, len(_TRANSFORMS)))]
+                for _ in range(depth)
+            ]
+            tail = _TAILS[int(rng.integers(0, len(_TAILS)))]
+            if tail is not None:
+                specs = specs + [tail]
+            try:
+                tc = _build("tpu", specs)
+            except EngineError:
+                continue  # unlowerable composition: auto mode would interpret
+            pc = _build("python", specs)
+            values = _corpus(rng)
+            t_out = tc.process(
+                SmartModuleInput.from_records(_records(values), 7, 1000)
+            )
+            p_out = pc.process(
+                SmartModuleInput.from_records(_records(values), 7, 1000)
+            )
+            tv = [
+                (r.value, r.key, r.offset_delta, r.timestamp_delta)
+                for r in t_out.successes
+            ]
+            pv = [
+                (r.value, r.key, r.offset_delta, r.timestamp_delta)
+                for r in p_out.successes
+            ]
+            assert tv == pv, (trial, specs)
+            te = None if t_out.error is None else (t_out.error.offset, t_out.error.kind)
+            pe = None if p_out.error is None else (p_out.error.offset, p_out.error.kind)
+            assert te == pe, (trial, specs)
+            ran += 1
+        assert ran >= 8, f"only {ran} compositions actually lowered"
