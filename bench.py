@@ -157,7 +157,9 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     log(
         f"  single-batch {single*1000:.0f}ms "
         f"(dispatch H2D+compute {dispatch*1000:.0f}ms, "
-        f"fetch D2H+materialize {max(single-dispatch,0)*1000:.0f}ms)"
+        f"fetch D2H+materialize {max(single-dispatch,0)*1000:.0f}ms; "
+        f"link bytes up {executor.last_h2d_bytes/1e6:.1f}MB "
+        f"down {executor.last_d2h_bytes/1e6:.2f}MB)"
     )
     # sustained pipelined throughput over several passes: the tunnel's
     # bandwidth wanders, so report every pass and take the median across

@@ -201,6 +201,7 @@ class ShardedChainExecutor:
 
     def dispatch_buffer(self, buf: RecordBuffer):
         arrays = self._padded_arrays(buf)
+        self.executor.last_h2d_bytes += sum(v.nbytes for v in arrays.values())
         sharded = {
             k: jax.device_put(
                 v,
@@ -267,9 +268,9 @@ class ShardedChainExecutor:
             cols = [packed["mask"]]
             for group in column_groups:
                 cols.extend(group)
-            for c in cols:
-                c.copy_to_host_async()
-            host = jax.device_get(cols)
+            # the executor's single download point: byte accounting rides
+            # along for sharded batches too
+            host = ex._download(cols)
             mask_h = np.asarray(host[0])
             src_h = np.flatnonzero(
                 np.unpackbits(mask_h, bitorder="little")[:n_rows]

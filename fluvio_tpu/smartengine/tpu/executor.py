@@ -355,6 +355,12 @@ class TpuChainExecutor:
         self._fanout = any(isinstance(s, _ArrayMapStage) for s in stages)
         self._cap_ratio: float = 0.0  # learned fan-out elements per source row
         self._sharded = None  # multi-device delegate (enable_sharded)
+        # link-byte accounting for the LAST processed batch (observability
+        # + bench attribution): H2D counts the staged uploads, D2H the
+        # downloaded result arrays. Byte counts are hardware-independent —
+        # the same arrays cross the link on CPU and on the real chip.
+        self.last_h2d_bytes = 0
+        self.last_d2h_bytes = 0
         self._viewable = not agg_configs and all(
             isinstance(s, (_FilterStage, _ArrayMapStage))
             or (
@@ -711,6 +717,13 @@ class TpuChainExecutor:
         )
         # keep aggregate state device-resident; host mirrors sync on demand
         self._device_carries = new_carries
+        self.last_h2d_bytes += (
+            flat.nbytes
+            + lengths_up.nbytes
+            + (buf.keys.nbytes + buf.key_lengths.nbytes if has_keys else 0)
+            + (buf.offset_deltas.nbytes if has_offsets else 0)
+            + (ts_up.nbytes if ts_up is not None else 0)
+        )
         return header, packed
 
     def _ensure_host_state(self) -> None:
@@ -771,6 +784,18 @@ class TpuChainExecutor:
     def _delta_decode(raw: np.ndarray, base: int, count: int) -> np.ndarray:
         vals = np.cumsum(raw[:count].astype(np.int64))
         return vals + base
+
+    def _download(self, slices):
+        """Start every D2H copy, block once, account the bytes — the ONE
+        point where result arrays leave the device (the sharded fetch
+        routes through it too, so the counters cannot silently miss a
+        path). Accumulates: a batch whose fetch runs twice (fan-out
+        capacity retry) reports its total traffic."""
+        for s in slices:
+            s.copy_to_host_async()
+        host = jax.device_get(slices)
+        self.last_d2h_bytes += 64 + sum(np.asarray(a).nbytes for a in host)
+        return host
 
     def _fetch(self, buf: RecordBuffer, header, packed) -> RecordBuffer:
         """Minimal-D2H materialization.
@@ -843,9 +868,7 @@ class TpuChainExecutor:
                 slices.append(lax.slice(_src_col(), (0,), (rows,)))
             else:
                 slices.append(packed["mask"])
-            for s in slices:
-                s.copy_to_host_async()
-            host = jax.device_get(slices)
+            host = self._download(slices)
             st_h, ln_h = host[0], host[1]
             if self._fanout:
                 src = _src_decode(host[2])
@@ -934,9 +957,7 @@ class TpuChainExecutor:
         if want_dev_offsets:
             slices.append(lax.slice(packed["offset_deltas"], (0,), (rows,)))
             slices.append(lax.slice(packed["timestamp_deltas"], (0,), (rows,)))
-        for s in slices:
-            s.copy_to_host_async()
-        host = jax.device_get(slices)
+        host = self._download(slices)
         out_values, out_lengths = host[:2]
         out_lengths = out_lengths.astype(np.int32)
         pos = 2
@@ -1043,9 +1064,7 @@ class TpuChainExecutor:
         if windowed:
             w_col, w_is_delta = _pick(packed["agg_win"], w_d, scal[2])
             slices.append(lax.slice(w_col, (0,), (rows,)))
-        for s in slices:
-            s.copy_to_host_async()
-        host = jax.device_get(slices)
+        host = self._download(slices)
         src = np.flatnonzero(
             np.unpackbits(host[0], bitorder="little")[: buf.rows]
         )
@@ -1137,6 +1156,8 @@ class TpuChainExecutor:
         slice k+1 here while slice k's results download and hit the
         socket.
         """
+        self.last_h2d_bytes = 0
+        self.last_d2h_bytes = 0
         if self._sharded is not None:
             return self._sharded.dispatch_buffer(buf)
         prev_carries = self._device_carries
