@@ -201,7 +201,7 @@ class ShardedChainExecutor:
 
     def dispatch_buffer(self, buf: RecordBuffer):
         arrays = self._padded_arrays(buf)
-        self.executor.last_h2d_bytes += sum(v.nbytes for v in arrays.values())
+        self.executor.h2d_bytes_total += sum(v.nbytes for v in arrays.values())
         sharded = {
             k: jax.device_put(
                 v,

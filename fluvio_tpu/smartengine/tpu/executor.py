@@ -355,12 +355,14 @@ class TpuChainExecutor:
         self._fanout = any(isinstance(s, _ArrayMapStage) for s in stages)
         self._cap_ratio: float = 0.0  # learned fan-out elements per source row
         self._sharded = None  # multi-device delegate (enable_sharded)
-        # link-byte accounting for the LAST processed batch (observability
-        # + bench attribution): H2D counts the staged uploads, D2H the
-        # downloaded result arrays. Byte counts are hardware-independent —
-        # the same arrays cross the link on CPU and on the real chip.
-        self.last_h2d_bytes = 0
-        self.last_d2h_bytes = 0
+        # CUMULATIVE link-byte totals since executor creation
+        # (observability + bench attribution; read deltas around a batch
+        # for per-batch numbers — totals stay correct under the pipelined
+        # stream loop where dispatch k+1 interleaves with fetch k). Byte
+        # counts are hardware-independent: the same arrays cross the link
+        # on CPU and on the real chip.
+        self.h2d_bytes_total = 0
+        self.d2h_bytes_total = 0
         self._viewable = not agg_configs and all(
             isinstance(s, (_FilterStage, _ArrayMapStage))
             or (
@@ -717,7 +719,7 @@ class TpuChainExecutor:
         )
         # keep aggregate state device-resident; host mirrors sync on demand
         self._device_carries = new_carries
-        self.last_h2d_bytes += (
+        self.h2d_bytes_total += (
             flat.nbytes
             + lengths_up.nbytes
             + (buf.keys.nbytes + buf.key_lengths.nbytes if has_keys else 0)
@@ -794,7 +796,7 @@ class TpuChainExecutor:
         for s in slices:
             s.copy_to_host_async()
         host = jax.device_get(slices)
-        self.last_d2h_bytes += 64 + sum(np.asarray(a).nbytes for a in host)
+        self.d2h_bytes_total += 64 + sum(np.asarray(a).nbytes for a in host)
         return host
 
     def _fetch(self, buf: RecordBuffer, header, packed) -> RecordBuffer:
@@ -1156,8 +1158,6 @@ class TpuChainExecutor:
         slice k+1 here while slice k's results download and hit the
         socket.
         """
-        self.last_h2d_bytes = 0
-        self.last_d2h_bytes = 0
         if self._sharded is not None:
             return self._sharded.dispatch_buffer(buf)
         prev_carries = self._device_carries
