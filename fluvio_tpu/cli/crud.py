@@ -45,6 +45,11 @@ def add_topic_parser(sub: argparse._SubParsersAction) -> None:
     create.add_argument("-r", "--replication", type=int, default=1)
     create.add_argument("-i", "--ignore-rack-assignment", action="store_true")
     create.add_argument("--retention-time", type=int, metavar="SECONDS")
+    create.add_argument(
+        "--compression-type",
+        choices=["any", "none", "gzip", "snappy", "lz4", "zstd"],
+        help="compression producers must use for this topic",
+    )
     create.add_argument("--segment-size", type=int, metavar="BYTES")
     create.add_argument("--max-partition-size", type=int, metavar="BYTES")
     create.add_argument(
@@ -83,6 +88,8 @@ async def topic_create(args) -> int:
     )
     if args.retention_time is not None:
         spec.retention_seconds = args.retention_time
+    if args.compression_type is not None:
+        spec.compression_type = args.compression_type
     if args.segment_size is not None or args.max_partition_size is not None:
         from fluvio_tpu.metadata.topic import TopicStorageConfig
 

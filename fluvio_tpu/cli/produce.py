@@ -41,7 +41,7 @@ def add_produce_parser(sub: argparse._SubParsersAction) -> None:
     p.add_argument(
         "--compression",
         choices=["none", "gzip", "snappy", "lz4", "zstd"],
-        default="none",
+        help="record batch codec (unset: the topic's compression_type decides)",
     )
     p.add_argument("--linger", type=int, metavar="MS", help="batch linger ms")
     p.add_argument("--batch-size", type=int, metavar="BYTES")
@@ -59,7 +59,9 @@ def add_produce_parser(sub: argparse._SubParsersAction) -> None:
 async def produce(args) -> int:
     invocations = build_invocations(args)
     config = ProducerConfig(
-        compression=Compression[args.compression.upper()],
+        compression=(
+            Compression[args.compression.upper()] if args.compression else None
+        ),
         smartmodules=invocations,
         delivery=args.delivery_semantic,
     )

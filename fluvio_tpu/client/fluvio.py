@@ -147,6 +147,16 @@ class Fluvio:
                 num_partitions = count
             else:
                 num_partitions = 1
+        if self._metadata is not None:
+            # wait for the topic to land in the watch mirror: policy
+            # enforcement must not be a race against the create
+            spec = await self._metadata.wait_topic_spec(topic)
+            if spec is not None:
+                from fluvio_tpu.client.producer import resolve_topic_compression
+
+                config = resolve_topic_compression(
+                    getattr(spec, "compression_type", "any"), config
+                )
 
         async def socket_factory(partition: int = 0):
             return await self._pool.socket_for(topic, partition)

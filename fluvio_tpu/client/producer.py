@@ -69,7 +69,10 @@ class RetryPolicy:
 class ProducerConfig:
     batch_size: int = DEFAULT_BATCH_SIZE
     linger_ms: int = DEFAULT_LINGER_MS
-    compression: Compression = Compression.NONE
+    # None = "unset": the topic's compression_type decides (a topic set
+    # to a specific codec adopts it; an EXPLICIT conflicting setting
+    # errors — fluvio/src/producer resolution semantics)
+    compression: Optional[Compression] = None
     isolation: Isolation = Isolation.READ_UNCOMMITTED
     timeout_ms: int = 1500
     max_request_size: int = 1 << 20
@@ -81,6 +84,34 @@ class ProducerConfig:
     def __post_init__(self) -> None:
         if self.delivery not in ("at-least-once", "at-most-once"):
             raise ValueError(f"unknown delivery semantic {self.delivery!r}")
+
+
+def resolve_topic_compression(
+    topic_compression: str, config: Optional["ProducerConfig"]
+) -> "ProducerConfig":
+    """Resolve the producer's compression against the topic's
+    ``compression_type`` (parity: the reference producer refuses a
+    producer codec that conflicts with the topic policy; topic "any"
+    keeps the producer's choice). Never mutates the caller's config —
+    a shared ProducerConfig must not leak one topic's codec into the
+    next producer built from it."""
+    import dataclasses
+
+    config = config or ProducerConfig()
+    topic_c = (topic_compression or "any").lower()
+    if topic_c == "any":
+        return config
+    try:
+        want = Compression.parse(topic_c)
+    except ValueError as e:
+        raise FluvioError(ErrorCode.OTHER, str(e)) from None
+    if config.compression is None or config.compression == want:
+        return dataclasses.replace(config, compression=want)
+    raise FluvioError(
+        ErrorCode.OTHER,
+        f"producer compression {config.compression.name.lower()!r} conflicts "
+        f"with the topic's compression_type {topic_c!r}",
+    )
 
 
 @dataclass
@@ -222,7 +253,10 @@ class PartitionProducer:
         record_set = RecordSet()
         for p in pending:
             record_set.add(
-                Batch.from_records(p.records, compression=self.config.compression)
+                Batch.from_records(
+                    p.records,
+                    compression=self.config.compression or Compression.NONE,
+                )
             )
         request = ProduceRequest(
             isolation=self.config.isolation,

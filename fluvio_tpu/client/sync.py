@@ -132,6 +132,27 @@ class MetadataStores:
                     task.cancel()
             listener.set_current()
 
+    async def wait_topic_spec(self, topic: str, timeout: float = 5.0):
+        """Topic spec once it lands in the mirror (None = unknown) — the
+        producer's compression-policy lookup must not race the watch
+        stream right after a create."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        listener = self.topics.store.change_listener()
+        while True:
+            tobj = self.topics.store.value(topic)
+            if tobj is not None:
+                return tobj.spec
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                return None
+            task = asyncio.ensure_future(listener.listen())
+            try:
+                await asyncio.wait((task,), timeout=remaining)
+            finally:
+                if not task.done():
+                    task.cancel()
+            listener.set_current()
+
     async def wait_for_leader(
         self, topic: str, partition: int, timeout: float = 10.0
     ) -> Optional[str]:

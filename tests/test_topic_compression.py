@@ -1,0 +1,116 @@
+"""Topic compression_type resolution on the produce path
+(parity: TopicSpec.compression_type, topic/spec.rs; the reference
+producer adopts the topic codec and refuses a conflicting explicit one).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset
+from fluvio_tpu.client.producer import (
+    ProducerConfig,
+    resolve_topic_compression,
+)
+from fluvio_tpu.metadata.topic import TopicSpec
+from fluvio_tpu.protocol.compression import Compression
+from fluvio_tpu.protocol.error import FluvioError
+
+from test_sc import boot_cluster, shutdown_cluster
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class TestResolution:
+    def test_any_keeps_producer_choice(self):
+        cfg = resolve_topic_compression("any", ProducerConfig(compression=Compression.GZIP))
+        assert cfg.compression == Compression.GZIP
+        assert resolve_topic_compression("any", None).compression is None
+
+    def test_specific_adopted_when_unset(self):
+        cfg = resolve_topic_compression("gzip", ProducerConfig())
+        assert cfg.compression == Compression.GZIP
+
+    def test_matching_explicit_ok(self):
+        cfg = resolve_topic_compression(
+            "gzip", ProducerConfig(compression=Compression.GZIP)
+        )
+        assert cfg.compression == Compression.GZIP
+
+    def test_conflict_raises(self):
+        with pytest.raises(FluvioError) as e:
+            resolve_topic_compression(
+                "gzip", ProducerConfig(compression=Compression.ZSTD)
+            )
+        assert "conflicts" in str(e.value)
+
+    def test_caller_config_never_mutated(self):
+        shared = ProducerConfig(batch_size=123)
+        out = resolve_topic_compression("gzip", shared)
+        assert out.compression == Compression.GZIP and out.batch_size == 123
+        assert shared.compression is None  # reusable on the next topic
+
+    def test_invalid_topic_codec_is_typed_error(self):
+        with pytest.raises(FluvioError) as e:
+            resolve_topic_compression("britli", ProducerConfig())
+        assert "unknown compression" in str(e.value)
+
+
+class TestEndToEnd:
+    def test_topic_codec_rides_produce_and_consume(self, tmp_path):
+        async def body():
+            sc, admin, spus = await boot_cluster(tmp_path)
+            spec = TopicSpec.computed(1)
+            spec.compression_type = "gzip"
+            await admin.create_topic("gz", spec)
+            for _ in range(100):
+                if spus[0].ctx.leader_for("gz", 0) is not None:
+                    break
+                await asyncio.sleep(0.05)
+            client = await Fluvio.connect(sc.public_addr)
+            try:
+                # unset producer adopts gzip from the topic spec
+                producer = await client.topic_producer("gz")
+                fut = await producer.send(None, b"compressed-payload" * 10)
+                await producer.flush()
+                await fut.wait()
+                await producer.close()
+
+                # stored batch is actually gzip on disk
+                from fluvio_tpu.schema.spu import Isolation
+
+                leader = spus[0].ctx.leader_for("gz", 0)
+                rslice = leader.read_records(
+                    0, 1 << 20, Isolation.READ_UNCOMMITTED
+                )
+                batches = rslice.decode_batches(parse_records=False)
+                assert batches[0].header.compression() == Compression.GZIP
+
+                # consumers read it back transparently
+                consumer = await client.partition_consumer("gz", 0)
+                got = [
+                    r.value
+                    async for r in consumer.stream(
+                        Offset.beginning(), ConsumerConfig(disable_continuous=True)
+                    )
+                ]
+                assert got == [b"compressed-payload" * 10]
+
+                # an explicitly conflicting producer codec is refused
+                with pytest.raises(FluvioError):
+                    await client.topic_producer(
+                        "gz", config=ProducerConfig(compression=Compression.ZSTD)
+                    )
+            finally:
+                await client.close()
+                await shutdown_cluster(sc, admin, spus)
+
+        run(body())
