@@ -466,6 +466,9 @@ def _build_output(results: dict, extra_error: str = "") -> tuple:
     return out, (1 if degraded else 0)
 
 
+_BSTART = _T0  # budget clock; reset after a successful device probe
+
+
 def _arm_watchdog(results: dict, budget: float) -> dict:
     """Hard-deadline guard for a tunnel that dies MID-RUN.
 
@@ -477,7 +480,7 @@ def _arm_watchdog(results: dict, budget: float) -> dict:
     """
     import threading
 
-    deadline = _T0 + budget * 1.6 + 300
+    deadline = _BSTART + budget * 1.6 + 300
     state = {"done": False}
 
     def watch() -> None:
@@ -503,7 +506,7 @@ def _arm_watchdog(results: dict, budget: float) -> dict:
     return state
 
 
-def _probe_device() -> bool:
+def _probe_device_once(timeout: float) -> bool:
     """Time-boxed subprocess probe of the real chip.
 
     When the axon tunnel is down, the first jax device operation blocks
@@ -524,11 +527,33 @@ def _probe_device() -> bool:
             ],
             capture_output=True,
             text=True,
-            timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "300")),
+            timeout=timeout,
         )
     except (subprocess.TimeoutExpired, OSError):
         return False
     return proc.returncode == 0 and "probe-ok" in proc.stdout
+
+
+def _probe_device() -> bool:
+    """Re-probe in a loop: the tunnel comes and goes (it was dead at the
+    exact capture moment of round 3 and alive hours later), so one failed
+    probe must not forfeit the round's only perf number. Spend up to
+    BENCH_PROBE_BUDGET (default 600s) retrying with short per-attempt
+    timeouts before emitting the honest zero."""
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET", "600"))
+    per_try = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    deadline = _T0 + budget
+    attempt = 0
+    while True:
+        attempt += 1
+        left = deadline - time.time()
+        if _probe_device_once(min(per_try, max(left, 30))):
+            log(f"device probe ok (attempt {attempt})")
+            return True
+        log(f"device probe attempt {attempt} failed; {max(left, 0):.0f}s probe budget left")
+        if time.time() + 20 >= deadline:
+            return False
+        time.sleep(15)
 
 
 def main() -> None:
@@ -554,6 +579,10 @@ def main() -> None:
             )
         )
         sys.exit(1)
+    else:
+        # probe retries must not eat the measurement budget
+        global _BSTART
+        _BSTART = time.time()
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     n = int(os.environ.get("BENCH_RECORDS", "20000" if smoke else "1000000"))
     only = os.environ.get("BENCH_CONFIGS")
@@ -572,7 +601,7 @@ def main() -> None:
         have_good = any(
             "error" not in v and "skipped" not in v for v in results.values()
         )
-        if have_good and time.time() - _T0 > budget:
+        if have_good and time.time() - _BSTART > budget:
             # skip only once ONE config has a real number: a driver run
             # must always carry at least one measurement, however slow
             # the tunnel (and a failed headline must not skip the rest)
@@ -581,7 +610,7 @@ def main() -> None:
             continue
         try:
             results[name] = run_config(
-                name, CONFIGS[name], n, smoke, deadline=_T0 + budget
+                name, CONFIGS[name], n, smoke, deadline=_BSTART + budget
             )
         except Exception as e:  # noqa: BLE001 — one config must not lose the run
             traceback.print_exc(file=sys.stderr)
@@ -594,7 +623,7 @@ def main() -> None:
 
     good = {k: v for k, v in results.items() if "error" not in v and "skipped" not in v}
     if os.environ.get("BENCH_BROKER", "1") == "1" and "2_filter_map" in good:
-        if time.time() - _T0 > budget * 1.2:
+        if time.time() - _BSTART > budget * 1.2:
             log(f"[broker_e2e] skipped: BENCH_BUDGET={budget:.0f}s exhausted")
             results["broker_e2e"] = {"skipped": "budget"}
         else:

@@ -211,8 +211,10 @@ class _TablePrinter:
         print(self._row(cells) + marker)
 
     def _row(self, cells) -> str:
+        # truncate to the frozen width: inferred columns would otherwise
+        # overflow (and misalign) on a later record with a longer cell
         return " | ".join(
-            c.ljust(w) for c, w in zip(cells, self.widths)
+            c[:w].ljust(w) for c, w in zip(cells, self.widths)
         ).rstrip()
 
 
@@ -299,10 +301,12 @@ async def consume(args) -> int:
             else:
                 _print_record(record, args)
             seen += 1
-            if args.num_records and seen >= args.num_records:
-                break
+            # end-offset first: when both limits trip on the same record
+            # the reference still prints the end-offset notice
             if args.end is not None and record.offset >= args.end:
                 print("End-offset has been reached; exiting", file=sys.stderr)
+                break
+            if args.num_records and seen >= args.num_records:
                 break
     except KeyboardInterrupt:
         pass

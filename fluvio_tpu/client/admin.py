@@ -31,6 +31,21 @@ class AdminError(Exception):
         self.status = status
 
 
+async def list_objects(
+    socket: VersionedSerialSocket,
+    kind: str,
+    name_filters: Optional[List[str]] = None,
+) -> List[MetadataStoreObject]:
+    """One LIST round-trip on an SC socket (shared by FluvioAdmin and
+    the client metadata mirror's authoritative lookups)."""
+    resp = await socket.send_receive(
+        ListRequest(kind=kind, name_filters=list(name_filters or []))
+    )
+    if resp.error_code.value != 0:
+        raise RuntimeError(resp.error_message or resp.error_code.name)
+    return [o.to_store_object() for o in resp.objects]
+
+
 class FluvioAdmin:
     def __init__(self, socket: VersionedSerialSocket):
         self._socket = socket
@@ -70,12 +85,7 @@ class FluvioAdmin:
     async def list(
         self, kind: str, name_filters: Optional[List[str]] = None
     ) -> List[MetadataStoreObject]:
-        resp = await self._socket.send_receive(
-            ListRequest(kind=kind, name_filters=name_filters or [])
-        )
-        if resp.error_code.value != 0:
-            raise RuntimeError(resp.error_message or resp.error_code.name)
-        return [o.to_store_object() for o in resp.objects]
+        return await list_objects(self._socket, kind, name_filters)
 
     async def watch(self, kind: str, queue_len: int = 10):
         """AsyncResponse of WatchResponse pushes (first = full sync)."""

@@ -139,24 +139,49 @@ class Fluvio:
         num_partitions: Optional[int] = None,
         config: Optional[ProducerConfig] = None,
     ) -> TopicProducer:
-        if num_partitions is None:
-            if self._metadata is not None:
-                count = await self._metadata.wait_partition_count(topic)
-                if count is None:
-                    raise ValueError(f"unknown topic {topic!r}")
-                num_partitions = count
-            else:
-                num_partitions = 1
+        # resolve the topic spec once: it carries both the partition
+        # count (default num_partitions) and the compression policy.
+        # Peek the watch mirror, then ask the SC store authoritatively —
+        # one round-trip on the already-open SC socket settles
+        # present-vs-absent without racing the mirror after a create and
+        # without stalling the constructor on an absent topic.
+        spec = None
         if self._metadata is not None:
-            # wait for the topic to land in the watch mirror: policy
-            # enforcement must not be a race against the create
-            spec = await self._metadata.wait_topic_spec(topic)
-            if spec is not None:
-                from fluvio_tpu.client.producer import resolve_topic_compression
+            tobj = self._metadata.topics.store.value(topic)
+            if tobj is not None:
+                spec = tobj.spec
+            else:
+                from fluvio_tpu.metadata.topic import TopicSpec
 
-                config = resolve_topic_compression(
-                    getattr(spec, "compression_type", "any"), config
-                )
+                try:
+                    listed = await self._metadata.list(TopicSpec.KIND, [topic])
+                except Exception:
+                    # an SC that cannot serve LIST (older version range,
+                    # ACL) must not break producing: degrade to the
+                    # mirror wait for the count and skip the policy,
+                    # exactly the pre-LIST behavior
+                    listed = None
+                if listed is not None:
+                    spec = listed[0].spec if listed else None
+                    if spec is None and num_partitions is None:
+                        raise ValueError(f"unknown topic {topic!r}")
+                elif num_partitions is None:
+                    count = await self._metadata.wait_partition_count(topic)
+                    if count is None:
+                        raise ValueError(f"unknown topic {topic!r}")
+                    num_partitions = count
+        if num_partitions is None:
+            if spec is not None:
+                rs = spec.replicas
+                num_partitions = len(rs.maps) if rs.is_assigned() else rs.partitions
+            else:
+                num_partitions = 1  # lone-SPU connection: no metadata
+        if spec is not None:
+            from fluvio_tpu.client.producer import resolve_topic_compression
+
+            config = resolve_topic_compression(
+                getattr(spec, "compression_type", "any"), config
+            )
 
         async def socket_factory(partition: int = 0):
             return await self._pool.socket_for(topic, partition)

@@ -92,6 +92,15 @@ class MetadataStores:
 
     # -- lookups -------------------------------------------------------------
 
+    async def list(self, kind: str, name_filters=None):
+        """Authoritative List RPC on the SC socket — the store itself,
+        not the (possibly lagging) watch mirror. Lets callers settle
+        present-vs-absent in one round-trip instead of waiting out a
+        mirror timeout."""
+        from fluvio_tpu.client.admin import list_objects
+
+        return await list_objects(self._socket, kind, name_filters)
+
     def leader_addr(self, topic: str, partition: int) -> Optional[str]:
         pobj = self.partitions.store.value(partition_key(topic, partition))
         if pobj is None:
@@ -121,27 +130,6 @@ class MetadataStores:
             count = self.partition_count(topic)
             if count is not None:
                 return count
-            remaining = deadline - asyncio.get_running_loop().time()
-            if remaining <= 0:
-                return None
-            task = asyncio.ensure_future(listener.listen())
-            try:
-                await asyncio.wait((task,), timeout=remaining)
-            finally:
-                if not task.done():
-                    task.cancel()
-            listener.set_current()
-
-    async def wait_topic_spec(self, topic: str, timeout: float = 5.0):
-        """Topic spec once it lands in the mirror (None = unknown) — the
-        producer's compression-policy lookup must not race the watch
-        stream right after a create."""
-        deadline = asyncio.get_running_loop().time() + timeout
-        listener = self.topics.store.change_listener()
-        while True:
-            tobj = self.topics.store.value(topic)
-            if tobj is not None:
-                return tobj.spec
             remaining = deadline - asyncio.get_running_loop().time()
             if remaining <= 0:
                 return None

@@ -819,8 +819,14 @@ class TpuChainExecutor:
         int_probe = None
         if self._fanout:
             d, mx, b = self._delta_probe(packed["src_row"], header[0])
-            hdr, mx, b = jax.device_get([header, mx, b])
-            if int(mx) < (1 << 8):
+            # the uint8 cast is only lossless for non-negative deltas;
+            # src_row is non-decreasing after compaction by construction,
+            # but verify per batch (signed min) rather than assume — a
+            # negative delta < 256 in magnitude would otherwise wrap
+            # silently and corrupt survivor row indices
+            mn = jnp.min(d)
+            hdr, mx, mn, b = jax.device_get([header, mx, mn, b])
+            if int(mx) < (1 << 8) and int(mn) >= 0:
                 src_delta = (d.astype(jnp.uint8), int(b))
         elif self._int_output:
             # the delta-probe scalars ride the header sync — one blocking
