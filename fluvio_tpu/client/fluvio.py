@@ -145,12 +145,10 @@ class Fluvio:
         # one round-trip on the already-open SC socket settles
         # present-vs-absent without racing the mirror after a create and
         # without stalling the constructor on an absent topic.
-        spec = None
+        tobj = None
         if self._metadata is not None:
             tobj = self._metadata.topics.store.value(topic)
-            if tobj is not None:
-                spec = tobj.spec
-            else:
+            if tobj is None:
                 from fluvio_tpu.metadata.topic import TopicSpec
 
                 try:
@@ -162,18 +160,21 @@ class Fluvio:
                     # exactly the pre-LIST behavior
                     listed = None
                 if listed is not None:
-                    spec = listed[0].spec if listed else None
-                    if spec is None and num_partitions is None:
+                    tobj = listed[0] if listed else None
+                    if tobj is None and num_partitions is None:
                         raise ValueError(f"unknown topic {topic!r}")
                 elif num_partitions is None:
                     count = await self._metadata.wait_partition_count(topic)
                     if count is None:
                         raise ValueError(f"unknown topic {topic!r}")
                     num_partitions = count
+        spec = tobj.spec if tobj is not None else None
         if num_partitions is None:
-            if spec is not None:
-                rs = spec.replicas
-                num_partitions = len(rs.maps) if rs.is_assigned() else rs.partitions
+            if tobj is not None:
+                # provisioned count (status) over the spec's request: a
+                # mid-provisioning topic must not route to leaderless
+                # partitions (same derivation the mirror lookup uses)
+                num_partitions = MetadataStores.count_from_topic_object(tobj)
             else:
                 num_partitions = 1  # lone-SPU connection: no metadata
         if spec is not None:

@@ -110,15 +110,21 @@ class MetadataStores:
             return None
         return sobj.spec.public_endpoint.addr
 
-    def partition_count(self, topic: str) -> Optional[int]:
-        tobj = self.topics.store.value(topic)
-        if tobj is None:
-            return None
+    @staticmethod
+    def count_from_topic_object(tobj) -> int:
+        """Partition count of a topic store object: provisioned partitions
+        (status.replica_map) when present, else the spec's request."""
         rm = tobj.status.replica_map
         if rm:
             return len(rm)
         rs = tobj.spec.replicas
         return len(rs.maps) if rs.is_assigned() else rs.partitions
+
+    def partition_count(self, topic: str) -> Optional[int]:
+        tobj = self.topics.store.value(topic)
+        if tobj is None:
+            return None
+        return self.count_from_topic_object(tobj)
 
     async def wait_partition_count(
         self, topic: str, timeout: float = 5.0

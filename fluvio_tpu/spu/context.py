@@ -7,6 +7,7 @@ shared (by reference) with every service handler.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, Optional
 
 from fluvio_tpu.smartengine.engine import SmartEngine
@@ -65,6 +66,11 @@ class GlobalContext:
             mesh_devices=config.smart_engine.mesh_devices,
         )
         self.metrics = SpuMetrics()
+        # stateless stream chains keyed by invocation fingerprint (LRU):
+        # rebuilding a chain per stream-fetch re-traces and re-loads the
+        # executor's jit machinery (~hundreds of ms per stream even with
+        # the persistent XLA cache hot) — see smart_chain.acquire_stream_chain
+        self.stream_chains: "OrderedDict[str, object]" = OrderedDict()
 
     def create_replica(
         self,

@@ -49,6 +49,7 @@ from fluvio_tpu.spu.smart_chain import (
     PendingSlice,
     SmartModuleResolutionError,
     apply_chain,
+    acquire_stream_chain,
     build_chain,
     chain_look_back,
     ensure_dedup_chain,
@@ -427,7 +428,9 @@ class StreamFetchHandler:
         chain = None
         if req.smartmodules:
             try:
-                chain = build_chain(req.smartmodules, self.ctx, version=self.version)
+                chain = acquire_stream_chain(
+                    req.smartmodules, self.ctx, version=self.version
+                )
                 await chain_look_back(chain, leader)
             except (
                 SmartModuleResolutionError,
@@ -532,7 +535,7 @@ class StreamFetchHandler:
                 pending = None
                 if truncated and nxt is not None:
                     # the speculative slice read from the wrong offset
-                    chain.tpu_chain.discard_dispatch(nxt.handle)
+                    nxt.discard(chain.tpu_chain)
                     nxt = None
                     nxt_batches = None
                 await self._wait_for_ack(sent_next, end_wait)

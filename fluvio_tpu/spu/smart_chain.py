@@ -10,6 +10,7 @@ a max_bytes cutoff (batch.rs:41-140).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import time
 from dataclasses import dataclass, field
@@ -19,6 +20,34 @@ import numpy as np
 
 # dense staging cap for the coalesced fast path (bytes of padded values)
 _MAX_STAGING_BYTES = int(os.environ.get("FLUVIO_TPU_MAX_STAGING", 1 << 29))
+
+# records per device dispatch on the stateless fast path; a 16 MB read
+# slice of short records becomes ~4-15 concurrently-in-flight dispatches
+_DISPATCH_CHUNK_ROWS = int(os.environ.get("FLUVIO_TPU_DISPATCH_CHUNK", 65536))
+
+
+def _slice_columns(cols: dict, lo: int, hi: int) -> dict:
+    """Record-range view [lo, hi) of merged aligned-decode columns.
+
+    val_flat/val_off keep the decoder's 4-aligned form (from_flat adopts
+    them zero-copy); key_flat/key_off are exact-packed. All slices are
+    numpy views — chunking adds no copies to staging.
+    """
+    if lo == 0 and hi == cols["count"]:
+        return cols
+    v0, v1 = int(cols["val_off"][lo]), int(cols["val_off"][hi])
+    k0, k1 = int(cols["key_off"][lo]), int(cols["key_off"][hi])
+    return {
+        "count": hi - lo,
+        "val_flat": cols["val_flat"][v0:v1],
+        "val_len": cols["val_len"][lo:hi],
+        "val_off": cols["val_off"][lo : hi + 1] - v0,
+        "key_flat": cols["key_flat"][k0:k1],
+        "key_off": cols["key_off"][lo : hi + 1] - k0,
+        "key_present": cols["key_present"][lo:hi],
+        "off_delta": cols["off_delta"][lo:hi],
+        "ts_delta": cols["ts_delta"][lo:hi],
+    }
 
 
 def _varint_sizes(x: np.ndarray) -> np.ndarray:
@@ -158,6 +187,81 @@ def build_chain(
     return builder.initialize()
 
 
+_STREAM_CHAIN_CACHE_MAX = 32
+
+
+def acquire_stream_chain(
+    invocations: List[SmartModuleInvocation],
+    ctx: GlobalContext,
+    version: Optional[int] = None,
+) -> SmartModuleChainInstance:
+    """build_chain with an SPU-level cache for STATELESS chains.
+
+    Every stream-fetch request builds its chain from wire invocations
+    (matching the reference, which instantiates the wasm store per
+    stream, engine.rs:135-185). For this engine that rebuild is not
+    cheap: a fresh executor re-traces its jitted chain function and
+    reloads the XLA executable for each shape bucket — hundreds of ms
+    per stream even with the persistent compile cache hot, which
+    dominated the broker end-to-end benchmark. Pure DSL chains with no
+    device state make sharing sound:
+
+    - no aggregate carries (nothing crosses calls),
+    - no lookback (nothing seeded per replica),
+    - the TPU backend is in use (the DSL program is the semantic spec;
+      dispatch handles are explicit, so interleaved slices from
+      concurrent streams on one executor do not interact).
+
+    Anything else — stateful, lookback-seeded, python-only — gets a
+    fresh chain per stream exactly as before.
+    """
+    key_parts = [str(version)]
+    cacheable = True
+    for inv in invocations:
+        if inv.lookback() is not None:
+            cacheable = False
+            break
+        payload = (
+            inv.wasm.payload
+            if inv.wasm.tag == SmartModuleInvocationWasm.ADHOC
+            else ctx.smartmodules.get(inv.wasm.name)
+        )
+        if payload is None:  # unresolved predefined: let build_chain raise
+            cacheable = False
+            break
+        if isinstance(payload, str):  # in-process adhoc sources
+            payload = payload.encode()
+        elif not isinstance(payload, (bytes, bytearray, memoryview)):
+            cacheable = False  # in-process module object: no stable key
+            break
+        key_parts.append(
+            "%d:%s:%s:%r" % (
+                int(inv.kind),
+                hashlib.sha256(payload).hexdigest(),
+                inv.accumulator.hex(),
+                sorted((inv.params or {}).items()),
+            )
+        )
+    key = "|".join(key_parts)
+    if cacheable:
+        chain = ctx.stream_chains.get(key)
+        if chain is not None:
+            ctx.stream_chains.move_to_end(key)
+            return chain
+    chain = build_chain(invocations, ctx, version)
+    tpu = getattr(chain, "tpu_chain", None)
+    if (
+        cacheable
+        and tpu is not None
+        and not tpu.agg_configs
+        and chain.backend_in_use == "tpu"
+    ):
+        ctx.stream_chains[key] = chain
+        while len(ctx.stream_chains) > _STREAM_CHAIN_CACHE_MAX:
+            ctx.stream_chains.popitem(last=False)
+    return chain
+
+
 async def ensure_dedup_chain(ctx: GlobalContext, leader: LeaderReplicaState) -> None:
     """Lazily attach the topic's dedup filter chain to a leader replica.
 
@@ -244,16 +348,26 @@ class BatchProcessResult:
 
 @dataclass
 class PendingSlice:
-    """A read slice staged + dispatched to the device, results pending."""
+    """A read slice staged + dispatched to the device, results pending.
+
+    ``chunks`` holds (RecordBuffer, dispatch handle) pairs in slice
+    order. Stateless chains split a large slice into several dispatches
+    (all in flight at once — see the chunking note in
+    `tpu_stage_dispatch`); stateful/fan-out chains always stage exactly
+    one chunk."""
 
     batches: List[Batch]
-    buf: object  # RecordBuffer
-    handle: object  # executor dispatch handle
+    chunks: List[tuple]  # [(RecordBuffer, executor dispatch handle)]
     planned_next: int  # next offset assuming no max_bytes truncation
     total_raw: int
     base0: int
     ts0: int
+    count: int  # staged input records across all chunks
     read_from: Optional[int] = None  # consume cursor (drop outputs below)
+
+    def discard(self, tpu) -> None:
+        for _, handle in self.chunks:
+            tpu.discard_dispatch(handle)
 
 
 def _decline(metrics, reason: str):
@@ -287,7 +401,7 @@ def tpu_stage_dispatch(
     """
     from fluvio_tpu.protocol.compression import Compression, decompress
     from fluvio_tpu.smartengine import native_backend
-    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+    from fluvio_tpu.smartengine.tpu.buffer import MAX_WIDTH, RecordBuffer
 
     tpu = getattr(chain, "tpu_chain", None)
     if tpu is None or not batches:
@@ -350,44 +464,120 @@ def tpu_stage_dispatch(
     merged["key_off"] = np.concatenate(
         [np.concatenate(key_offs), np.array([k_base], dtype=np.int64)]
     )
-    try:
-        buf = RecordBuffer.from_flat(
-            merged, base_offset=base0, base_timestamp=ts0
-        )
-    except ValueError:  # value wider than MAX_WIDTH: per-record path
+    # Chunked dispatch (stateless chains): one huge slice is one device
+    # call with ZERO overlap — host staging, device compute, and result
+    # materialization run strictly serially. Splitting into fixed-size
+    # record chunks and dispatching them ALL up front keeps every chunk
+    # in flight while the first one downloads/encodes, so the slice's
+    # wall time approaches max(host, device) instead of the sum. Equal
+    # chunk sizes reuse one compiled shape bucket. Stateful chains chain
+    # their carries through dispatch order (safe), but fan-out capacity
+    # retries and aggregate delta-fetches are tuned for one dispatch —
+    # keep those single-chunk.
+    n_total = merged["count"]
+    chunk_rows = _DISPATCH_CHUNK_ROWS
+    stateless = not tpu.agg_configs and not tpu._fanout
+    if stateless and n_total > chunk_rows * 3 // 2:
+        bounds = list(range(0, n_total, chunk_rows)) + [n_total]
+        if bounds[-1] == bounds[-2]:
+            bounds.pop()
+    else:
+        bounds = [0, n_total]  # n_total == 0 still stages one empty chunk
+    # whole-slice width guard BEFORE any dispatch: a too-wide record
+    # declines the slice without leaving earlier chunks' device work
+    # abandoned mid-flight
+    if n_total and int(merged["val_len"].max()) > MAX_WIDTH:
         return _decline(metrics, "record-too-wide")
-    # dense-amplification guard: one huge value would pad every row of
-    # the DEVICE-side re-padded matrix (rows x width in HBM) to its pow2
-    # width — the host stays flat-backed either way
-    if buf.rows * buf.width > _MAX_STAGING_BYTES:
-        return _decline(metrics, "staging-cap")
-    if tpu._fanout:
-        # fan-out outputs inherit their source batch's rebase deltas
-        # ("fresh" records, delta 0 relative to their own batch)
-        rows = buf.offset_deltas.shape[0]
-        fo = np.zeros(rows, dtype=np.int32)
-        ft = np.zeros(rows, dtype=np.int64)
-        pos = 0
-        for b, c in staged:
-            n_b = c["count"]
-            fo[pos : pos + n_b] = b.base_offset - base0
-            if ts0 >= 0:
-                ft[pos : pos + n_b] = b.header.first_timestamp - ts0
-            pos += n_b
-        buf.fresh_offset_deltas = fo
-        buf.fresh_timestamp_deltas = ft
-
-    handle = tpu.dispatch_buffer(buf)
+    chunks: List[tuple] = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        part = _slice_columns(merged, lo, hi)
+        try:
+            buf = RecordBuffer.from_flat(
+                part, base_offset=base0, base_timestamp=ts0
+            )
+        except ValueError:  # value wider than MAX_WIDTH: per-record path
+            return _decline(metrics, "record-too-wide")
+        # dense-amplification guard: one huge value would pad every
+        # row of the DEVICE-side re-padded matrix (rows x width in
+        # HBM) to its pow2 width — the host stays flat-backed either way
+        if buf.rows * buf.width > _MAX_STAGING_BYTES:
+            return _decline(metrics, "staging-cap")
+        if tpu._fanout:
+            # fan-out outputs inherit their source batch's rebase
+            # deltas ("fresh" records, delta 0 relative to their own
+            # batch); fan-out is always single-chunk so the staged
+            # batch walk covers the whole slice
+            rows = buf.offset_deltas.shape[0]
+            fo = np.zeros(rows, dtype=np.int32)
+            ft = np.zeros(rows, dtype=np.int64)
+            pos = 0
+            for b, c in staged:
+                n_b = c["count"]
+                fo[pos : pos + n_b] = b.base_offset - base0
+                if ts0 >= 0:
+                    ft[pos : pos + n_b] = b.header.first_timestamp - ts0
+                pos += n_b
+            buf.fresh_offset_deltas = fo
+            buf.fresh_timestamp_deltas = ft
+        chunks.append((buf, tpu.dispatch_buffer(buf)))
     return PendingSlice(
         batches=batches,
-        buf=buf,
-        handle=handle,
+        chunks=chunks,
         planned_next=staged[-1][0].computed_last_offset(),
         total_raw=total_raw,
         base0=base0,
         ts0=ts0,
+        count=n_total,
         read_from=start_offset,
     )
+
+
+class _MergedOut:
+    """Concatenated live-row view over per-chunk output buffers.
+
+    Exposes exactly the surface `tpu_finish` touches (count, the live
+    offset/timestamp/length columns, `to_columns`); chunk outputs stay
+    separate until the single native encode."""
+
+    def __init__(self, outbufs: List):
+        ns = [b.count for b in outbufs]
+        self.count = sum(ns)
+        self._outbufs = outbufs
+        self.offset_deltas = np.concatenate(
+            [b.offset_deltas[:n] for b, n in zip(outbufs, ns)]
+        )
+        self.timestamp_deltas = np.concatenate(
+            [b.timestamp_deltas[:n] for b, n in zip(outbufs, ns)]
+        )
+        self.lengths = np.concatenate(
+            [b.lengths[:n] for b, n in zip(outbufs, ns)]
+        )
+        self.key_lengths = np.concatenate(
+            [b.key_lengths[:n] for b, n in zip(outbufs, ns)]
+        )
+
+    def to_columns(self) -> dict:
+        parts = [b.to_columns() for b in self._outbufs]
+        val_off = np.zeros(self.count + 1, dtype=np.int64)
+        key_off = np.zeros(self.count + 1, dtype=np.int64)
+        pos = v = k = 0
+        for c in parts:
+            n = c["count"]
+            val_off[pos : pos + n + 1] = c["val_off"] + v
+            key_off[pos : pos + n + 1] = c["key_off"] + k
+            pos += n
+            v += int(c["val_off"][-1])
+            k += int(c["key_off"][-1])
+        return {
+            "count": self.count,
+            "val_flat": np.concatenate([c["val_flat"] for c in parts]),
+            "val_off": val_off,
+            "key_flat": np.concatenate([c["key_flat"] for c in parts]),
+            "key_off": key_off,
+            "key_present": np.concatenate([c["key_present"] for c in parts]),
+            "off_delta": self.offset_deltas.astype(np.int64),
+            "ts_delta": self.timestamp_deltas.astype(np.int64),
+        }
 
 
 def tpu_finish(
@@ -416,9 +606,10 @@ def tpu_finish(
     result = BatchProcessResult()
     result.next_offset = pending.planned_next
     try:
-        outbuf = tpu.finish_buffer(pending.buf, pending.handle)
+        outbufs = [tpu.finish_buffer(b, h) for b, h in pending.chunks]
     except TpuSpill:
         return _decline(metrics, "transform-error-spill")
+    outbuf = outbufs[0] if len(outbufs) == 1 else _MergedOut(outbufs)
     n_out = outbuf.count
     # survivors keep their stored offsets (deltas are already rebased to
     # base0), so a consumer resuming mid-slice filters correctly
@@ -484,7 +675,7 @@ def tpu_finish(
     # path re-counts bytes_in when this path bails out
     if metrics is not None:
         metrics.add_bytes_in(pending.total_raw)
-        metrics.add_fuel_used(pending.buf.count * max(len(tpu.stages), 1))
+        metrics.add_fuel_used(pending.count * max(len(tpu.stages), 1))
         metrics.add_records_out(n_out)
         metrics.add_fastpath()
     if tpu.agg_configs:

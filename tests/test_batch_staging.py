@@ -429,3 +429,147 @@ class TestAlignedDecode:
             assert np.array_equal(f1, f2) and np.array_equal(
                 s1[:n], s2[:n]
             ), trial
+
+
+@needs_native
+class TestChunkedDispatch:
+    """Stateless slices split into several concurrent device dispatches
+    (smart_chain._DISPATCH_CHUNK_ROWS); output must be bit-identical to
+    the single-dispatch and per-record paths."""
+
+    def _run_chunked(self, groups, bases, specs, chunk, max_bytes=10**9):
+        import fluvio_tpu.spu.smart_chain as sm
+
+        old = sm._DISPATCH_CHUNK_ROWS
+        sm._DISPATCH_CHUNK_ROWS = chunk
+        try:
+            chain = _chain("tpu", *specs)
+            return _tpu_process_batches(
+                chain, _shallow_batches(groups, bases), max_bytes
+            )
+        finally:
+            sm._DISPATCH_CHUNK_ROWS = old
+
+    def test_multi_chunk_equivalence(self):
+        groups = [_records(40, keyed=True), _records(40, start=40),
+                  _records(13, start=80, keyed=True)]
+        bases = [0, 40, 80]
+        specs = (("regex-filter", {"regex": "fluvio"}),
+                 ("json-map", {"field": "name"}))
+        fast = self._run_chunked(groups, bases, specs, chunk=16)
+        assert fast is not None
+        slow = process_batches(
+            _chain("python", *specs), _shallow_batches(groups, bases), 10**9
+        )
+        assert _flat_records(fast) == _flat_records(slow)
+        assert fast.next_offset == slow.next_offset
+
+    def test_chunk_boundary_sizes(self):
+        """Counts around the 1.5x-chunk threshold and exact multiples."""
+        specs = (("regex-filter", {"regex": "fluvio"}),)
+        for n in (15, 16, 24, 25, 32, 48):
+            groups, bases = [_records(n)], [0]
+            fast = self._run_chunked(groups, bases, specs, chunk=16)
+            slow = process_batches(
+                _chain("python", *specs), _shallow_batches(groups, bases), 10**9
+            )
+            assert _flat_records(fast) == _flat_records(slow), n
+
+    def test_chunked_max_bytes_truncation(self):
+        """max_bytes cutoff over a merged multi-chunk output matches the
+        single-dispatch fast path's record-prefix semantics exactly
+        (the per-record path trims at batch granularity instead)."""
+        groups, bases = [_records(60)], [0]
+        specs = (("regex-filter", {"regex": "fluvio"}),)
+        chunked = self._run_chunked(groups, bases, specs, chunk=16,
+                                    max_bytes=700)
+        single = self._run_chunked(groups, bases, specs, chunk=10**6,
+                                   max_bytes=700)
+        assert _flat_records(chunked) == _flat_records(single)
+        assert chunked.next_offset == single.next_offset
+        # and the cutoff actually trimmed the slice
+        assert chunked.next_offset < 60
+
+    def test_zero_record_slice(self):
+        """A slice whose batches carry zero records stages one empty
+        chunk and completes (regression: _MergedOut([]) crash)."""
+        from fluvio_tpu.spu.smart_chain import tpu_stage_dispatch, tpu_finish
+
+        chain = _chain("tpu", ("regex-filter", {"regex": "fluvio"}))
+        batches = _shallow_batches([[]], [0])
+        pending = tpu_stage_dispatch(chain, batches)
+        assert pending is not None and len(pending.chunks) == 1
+        result = tpu_finish(chain, pending, 10**9)
+        assert result is not None
+        assert not result.records.batches
+
+
+FILTER_SRC = b"""
+@smartmodule.filter(dsl=dsl.FilterProgram(
+    predicate=dsl.RegexMatch(arg=dsl.Value(), pattern="@param:field=regex")))
+def f(record):
+    import re
+    return re.search(params["regex"].encode(), record.value) is not None
+"""
+
+AGG_SRC = b"""
+@smartmodule.aggregate(dsl=dsl.AggregateProgram(
+    contribution=dsl.ParseInt(arg=dsl.Value()), combine="add"))
+def agg(acc, record):
+    return str(int(acc or b"0") + int(record.value)).encode()
+"""
+
+
+class TestStreamChainCache:
+    @staticmethod
+    def _ctx():
+        from fluvio_tpu.spu import SpuConfig
+        from fluvio_tpu.spu.context import GlobalContext
+
+        return GlobalContext(SpuConfig(id=1))
+
+    @staticmethod
+    def _inv(src, kind, params=None, lookback_last=0):
+        from fluvio_tpu.schema.smartmodule import (
+            SmartModuleInvocation, SmartModuleInvocationWasm,
+        )
+
+        return [SmartModuleInvocation(
+            wasm=SmartModuleInvocationWasm.adhoc(src),
+            kind=kind,
+            params=params or {},
+            lookback_last=lookback_last,
+        )]
+
+    def test_stateless_chain_shared(self):
+        from fluvio_tpu.schema.smartmodule import SmartModuleInvocationKind
+        from fluvio_tpu.spu.smart_chain import acquire_stream_chain
+
+        ctx = self._ctx()
+        k = SmartModuleInvocationKind.FILTER
+        inv = self._inv(FILTER_SRC, k, {"regex": "fluvio"})
+        c1 = acquire_stream_chain(inv, ctx, version=23)
+        c2 = acquire_stream_chain(inv, ctx, version=23)
+        assert c1 is c2
+        # different params -> different chain
+        inv2 = self._inv(FILTER_SRC, k, {"regex": "kafka"})
+        assert acquire_stream_chain(inv2, ctx, version=23) is not c1
+
+    def test_stateful_chain_not_shared(self):
+        from fluvio_tpu.schema.smartmodule import SmartModuleInvocationKind
+        from fluvio_tpu.spu.smart_chain import acquire_stream_chain
+
+        ctx = self._ctx()
+        inv = self._inv(AGG_SRC, SmartModuleInvocationKind.AGGREGATE)
+        assert acquire_stream_chain(inv, ctx) is not acquire_stream_chain(inv, ctx)
+
+    def test_lookback_chain_not_shared(self):
+        from fluvio_tpu.schema.smartmodule import SmartModuleInvocationKind
+        from fluvio_tpu.spu.smart_chain import acquire_stream_chain
+
+        ctx = self._ctx()
+        inv = self._inv(
+            FILTER_SRC, SmartModuleInvocationKind.FILTER,
+            {"regex": "fluvio"}, lookback_last=5,
+        )
+        assert acquire_stream_chain(inv, ctx) is not acquire_stream_chain(inv, ctx)
