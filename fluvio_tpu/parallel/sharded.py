@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fluvio_tpu.parallel.mesh import RECORD_AXIS, make_record_mesh
+from fluvio_tpu.smartengine.tpu import executor as kernels_executor
 from fluvio_tpu.smartengine.tpu import kernels
 from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer, apply_postops_host
 
@@ -55,6 +56,38 @@ class ShardedChainExecutor:
         self._jit_cache: Dict = {}
 
     # -- traced step ---------------------------------------------------------
+
+    def _local_step_ragged(
+        self, uploads: Dict, count, base_ts, carries, *, cfg: tuple
+    ):
+        """Rebuild this shard's padded arrays from its ragged upload, then
+        run the stage pipeline (same device-side re-pad as the single
+        device `_chain_fn_ragged`: the host link carries sum(lengths)
+        bytes per shard, not rows x width)."""
+        (width, kwidth, has_keys, has_offsets, ts_mode) = cfg
+        values, lengths = kernels_executor.ragged_repad_words(
+            uploads["flat_words"], uploads["lengths"], width
+        )
+        n_local = lengths.shape[0]
+        g0 = lax.axis_index(RECORD_AXIS) * n_local
+        arrays = {"values": values, "lengths": lengths}
+        if has_keys:
+            arrays["keys"] = uploads["keys"]
+            arrays["key_lengths"] = uploads["key_lengths"].astype(jnp.int32)
+        else:
+            arrays["keys"] = jnp.zeros((n_local, kwidth), dtype=jnp.uint8)
+            arrays["key_lengths"] = jnp.full((n_local,), -1, dtype=jnp.int32)
+        if has_offsets:
+            arrays["offset_deltas"] = uploads["offset_deltas"]
+        else:
+            arrays["offset_deltas"] = g0 + jnp.arange(n_local, dtype=jnp.int32)
+        if ts_mode == "zero":
+            arrays["timestamp_deltas"] = jnp.zeros((n_local,), dtype=jnp.int64)
+        else:
+            arrays["timestamp_deltas"] = uploads["timestamp_deltas"].astype(
+                jnp.int64
+            )
+        return self._local_step(arrays, count, base_ts, carries)
 
     def _local_step(self, arrays: Dict, count, base_ts, carries):
         ex = self.executor
@@ -118,15 +151,18 @@ class ShardedChainExecutor:
             carries,
         )
 
-    def _jitted(self, arrays: Dict):
-        key = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in arrays.items()))
+    def _jitted(self, uploads: Dict, cfg: tuple):
+        key = (
+            tuple(sorted((k, v.shape, str(v.dtype)) for k, v in uploads.items())),
+            cfg,
+        )
         fn = self._jit_cache.get(key)
         if fn is None:
             row = P(RECORD_AXIS)
             mat = P(RECORD_AXIS, None)
             rep = P()
             in_specs = (
-                {k: (mat if v.ndim == 2 else row) for k, v in arrays.items()},
+                {k: (mat if v.ndim == 2 else row) for k, v in uploads.items()},
                 rep,
                 rep,
                 jax.tree_util.tree_map(lambda _: rep, self._carries()),
@@ -136,9 +172,15 @@ class ShardedChainExecutor:
                 self._packed_specs(),
                 jax.tree_util.tree_map(lambda _: rep, self._carries()),
             )
+
+            def step(uploads, count, base_ts, carries):
+                return self._local_step_ragged(
+                    uploads, count, base_ts, carries, cfg=cfg
+                )
+
             fn = jax.jit(
                 _shard_map(
-                    self._local_step,
+                    step,
                     mesh=self.mesh,
                     in_specs=in_specs,
                     out_specs=out_specs,
@@ -175,33 +217,75 @@ class ShardedChainExecutor:
             for acc, win, has in self.executor.carries
         )
 
-    def _padded_arrays(self, buf: RecordBuffer) -> Dict[str, np.ndarray]:
-        rows = buf.rows
-        # shards must hold a multiple of 8 rows: each shard's survivor
-        # bitmask packs to whole bytes, and the concatenated per-shard
-        # masks must line up with global row numbering bit-for-bit
+    def _row_blocks(self, rows: int) -> tuple:
+        """(total padded rows, rows per shard): shards must hold a
+        multiple of 8 rows so each shard's survivor bitmask packs to
+        whole bytes and the concatenated per-shard masks line up with
+        global row numbering bit-for-bit."""
         step = self.n * 8
         need = max(step, ((rows + step - 1) // step) * step)
-        pad = need - rows
+        return need, need // self.n
+
+    def _stage_ragged(self, buf: RecordBuffer) -> tuple:
+        """Ragged H2D staging (the single-device link diet, per shard).
+
+        The aligned flat is cut at shard row boundaries; every shard's
+        segment pads to one bucketed segment length (equal shapes keep
+        one compiled program) and ships as i32 words. Derivable columns
+        never cross the link: arange offsets and zero timestamps are
+        synthesized on device, timestamps narrow to i32 when they fit,
+        lengths ride as u16 whenever the width allows. Returns
+        (uploads dict, static cfg, H2D byte count).
+        """
+        ex = self.executor
+        # shard over the LIVE rows (bucketed), not the buffer's pow2 row
+        # padding: trailing all-padding shards would otherwise still ship
+        # seg_len bytes each (equal per-shard shapes are required), which
+        # is exactly the H2D blowup this staging exists to avoid
+        need, shard_rows = self._row_blocks(min(buf.count, buf.rows))
+        flat, starts = buf.ragged_values()
+        lengths4 = (buf.lengths.astype(np.int64) + 3) & ~3
+        total = int(lengths4.sum())
+        # segment bounds at shard row boundaries (rows past buf.rows are
+        # zero-length padding and contribute no bytes)
+        cuts = [0]
+        for s in range(1, self.n):
+            r = s * shard_rows
+            cuts.append(int(starts[r]) if r < len(starts) else total)
+        cuts.append(total)
+        seg_sizes = np.diff(cuts)
+        seg_len = ex._bucket_bytes(max(int(seg_sizes.max()), 4))
+        segs = np.zeros((self.n, seg_len), dtype=np.uint8)
+        for s in range(self.n):
+            segs[s, : seg_sizes[s]] = flat[cuts[s] : cuts[s + 1]]
+        flat_words = segs.reshape(-1).view(np.int32)
 
         def pad_rows(a, fill=0):
+            pad = need - a.shape[0]
             if pad == 0:
                 return a
+            if pad < 0:  # buffer's pow2 row padding exceeds the live need
+                return a[:need]
             widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
             return np.pad(a, widths, constant_values=fill)
 
-        return {
-            "values": pad_rows(buf.dense_values()),
-            "lengths": pad_rows(buf.lengths),
-            "keys": pad_rows(buf.keys),
-            "key_lengths": pad_rows(buf.key_lengths, fill=-1),
-            "offset_deltas": pad_rows(buf.offset_deltas),
-            "timestamp_deltas": pad_rows(buf.timestamp_deltas),
-        }
+        lengths_np, has_keys, has_offsets, ts_mode, ts_np = (
+            kernels_executor.stage_link_columns(buf)
+        )
+        uploads = {"flat_words": flat_words, "lengths": pad_rows(lengths_np)}
+        if has_keys:
+            uploads["keys"] = pad_rows(buf.keys)
+            uploads["key_lengths"] = pad_rows(buf.key_lengths, fill=-1)
+        if has_offsets:
+            uploads["offset_deltas"] = pad_rows(buf.offset_deltas)
+        if ts_np is not None:
+            uploads["timestamp_deltas"] = pad_rows(ts_np)
+        cfg = (buf.width, buf.keys.shape[1], has_keys, has_offsets, ts_mode)
+        return uploads, cfg, sum(v.nbytes for v in uploads.values())
 
     def dispatch_buffer(self, buf: RecordBuffer):
-        arrays = self._padded_arrays(buf)
-        self.executor.h2d_bytes_total += sum(v.nbytes for v in arrays.values())
+        uploads, cfg, nbytes = self._stage_ragged(buf)
+        self.executor.h2d_bytes_total += nbytes
         sharded = {
             k: jax.device_put(
                 v,
@@ -209,9 +293,9 @@ class ShardedChainExecutor:
                     self.mesh, P(RECORD_AXIS, None) if v.ndim == 2 else P(RECORD_AXIS)
                 ),
             )
-            for k, v in arrays.items()
+            for k, v in uploads.items()
         }
-        fn = self._jitted(sharded)
+        fn = self._jitted(sharded, cfg)
         header, packed, new_carries = fn(
             sharded,
             jnp.int32(buf.count),

@@ -316,6 +316,63 @@ class _AggregateStage:
         return new_state, tuple(new_carries)
 
 
+def ragged_repad_words(flat, lengths, width: int):
+    """Device-side re-pad of a 4-aligned ragged upload (traced).
+
+    One gather rebuilds the padded value matrix; the host link only
+    carried sum(lengths) bytes. The flat is i32 words — 4x fewer gather
+    elements than per-byte, which is what the TPU's gather throughput is
+    sensitive to. Shared by the single-device ragged dispatch and the
+    per-shard rebuild in `parallel/sharded.py` (one implementation: a
+    re-pad fix cannot land in one path and miss the other). Returns
+    (values uint8[n, width], lengths int32[n])."""
+    lengths = lengths.astype(jnp.int32)
+    n = lengths.shape[0]
+    lengths4 = (lengths + 3) & ~3
+    word_starts = (jnp.cumsum(lengths4) - lengths4) >> 2
+    wwidth = width // 4
+    jw = jnp.arange(wwidth, dtype=jnp.int32)[None, :]
+    widx = word_starts[:, None] + jw
+    words = jnp.take(flat, jnp.clip(widx, 0, flat.shape[0] - 1), axis=0)
+    # unpack LE bytes from words: byte k of word w = (w >> 8k) & 0xFF
+    shifts = jnp.arange(4, dtype=jnp.int32)[None, None, :] * 8
+    unpacked = (words[:, :, None] >> shifts) & 0xFF
+    gathered = unpacked.reshape(n, width)
+    jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
+    mask = jidx < lengths[:, None]
+    return jnp.where(mask, gathered, 0).astype(jnp.uint8), lengths
+
+
+def stage_link_columns(buf):
+    """Host-side link policy: which columns cross the H2D link, at which
+    dtypes (shared by the single-device dispatch and the sharded
+    staging — the narrowing thresholds are policy and must not fork).
+
+    Returns (lengths_up, has_keys, has_offsets, ts_mode, ts_up):
+    derivable columns report as absent (arange offsets, zero
+    timestamps), timestamps narrow to i32 when they fit, lengths ride
+    u16 whenever the width allows. Arrays are unpadded — each caller
+    pads/buckets for its own layout."""
+    has_keys = buf.has_keys()
+    off = buf.offset_deltas[: buf.count]
+    has_offsets = not np.array_equal(
+        off, np.arange(buf.count, dtype=off.dtype)
+    )
+    live_ts = buf.timestamp_deltas[: buf.count]
+    if buf.count == 0 or not live_ts.any():
+        ts_mode, ts_up = "zero", None
+    elif np.abs(live_ts).max() < 2**31:
+        ts_mode, ts_up = "i32", buf.timestamp_deltas.astype(np.int32)
+    else:
+        ts_mode, ts_up = "i64", buf.timestamp_deltas
+    lengths_up = (
+        buf.lengths.astype(np.uint16)
+        if buf.width < (1 << 16)
+        else buf.lengths
+    )
+    return lengths_up, has_keys, has_offsets, ts_mode, ts_up
+
+
 class TpuChainExecutor:
     """Compiled chain + device-resident aggregate state."""
 
@@ -625,21 +682,8 @@ class TpuChainExecutor:
         timestamp deltas (``ts_mode='zero'``) are synthesized, and
         ``ts_mode='i32'`` timestamps upload narrow and widen on device.
         """
-        lengths = lengths.astype(jnp.int32)
+        values, lengths = ragged_repad_words(flat, lengths, width)
         n = lengths.shape[0]
-        lengths4 = (lengths + 3) & ~3
-        word_starts = (jnp.cumsum(lengths4) - lengths4) >> 2
-        wwidth = width // 4
-        jw = jnp.arange(wwidth, dtype=jnp.int32)[None, :]
-        widx = word_starts[:, None] + jw
-        words = jnp.take(flat, jnp.clip(widx, 0, flat.shape[0] - 1), axis=0)
-        # unpack LE bytes from words: byte k of word w = (w >> 8k) & 0xFF
-        shifts = jnp.arange(4, dtype=jnp.int32)[None, None, :] * 8
-        unpacked = (words[:, :, None] >> shifts) & 0xFF
-        gathered = unpacked.reshape(n, width)
-        jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
-        mask = jidx < lengths[:, None]
-        values = jnp.where(mask, gathered, 0).astype(jnp.uint8)
         if not has_keys:
             keys = jnp.zeros((n, kwidth), dtype=jnp.uint8)
             key_lengths = jnp.full((n,), -1, dtype=jnp.int32)
@@ -680,26 +724,13 @@ class TpuChainExecutor:
         bucket = self._bucket_bytes(max(len(flat), 4))
         if len(flat) < bucket:
             flat = np.pad(flat, (0, bucket - len(flat)))
-        # ship the aligned flat as i32 words (see _chain_fn_ragged)
-        flat = flat.view(np.int32)
-        has_keys = buf.has_keys()
+        # ship the aligned flat as i32 words (see _chain_fn_ragged);
         # derivable columns stay off the link (synthesized on device)
-        off = buf.offset_deltas[: buf.count]
-        has_offsets = not np.array_equal(off, np.arange(buf.count, dtype=off.dtype))
-        ts = buf.timestamp_deltas
-        live_ts = ts[: buf.count]
-        if buf.count == 0 or not live_ts.any():
-            ts_mode, ts_up = "zero", None
-        elif np.abs(live_ts).max() < 2**31:
-            ts_mode, ts_up = "i32", jnp.asarray(ts.astype(np.int32))
-        else:
-            ts_mode, ts_up = "i64", jnp.asarray(ts)
-        # lengths ride the link narrow (u16) whenever the width allows
-        lengths_up = (
-            buf.lengths.astype(np.uint16)
-            if buf.width < (1 << 16)
-            else buf.lengths
+        flat = flat.view(np.int32)
+        lengths_up, has_keys, has_offsets, ts_mode, ts_np = (
+            stage_link_columns(buf)
         )
+        ts_up = jnp.asarray(ts_np) if ts_np is not None else None
         header, packed, new_carries = self._jit_ragged(
             jnp.asarray(flat),
             jnp.asarray(lengths_up),
