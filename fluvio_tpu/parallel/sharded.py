@@ -38,8 +38,18 @@ class ShardedChainExecutor:
     """Row-sharded executor with the single-device executor's surface.
 
     Supports row-preserving chains (filters / span or byte maps /
-    aggregates). Fan-out (array_map) stays on the single-device
-    executor: per-shard capacity scatter needs its own design.
+    aggregates) AND fan-out (array_map) chains: each shard scatters its
+    explode outputs into its own capacity block, the per-shard exact
+    totals ride the stacked headers, and a shard whose total exceeds
+    its capacity triggers one bigger-capacity retry (mirroring the
+    single-device learned-capacity loop). Fan-out combined with an
+    aggregate stays single-device: the overflow retry would have to
+    roll back carries that other shards already advanced.
+
+    Aggregate carries chain at DISPATCH time through device futures
+    (`_pending_carries`), so `process_stream` pipelines sharded
+    stateful chains exactly like the single-device executor;
+    `discard_dispatch` restores the pre-dispatch futures.
     """
 
     def __init__(self, executor, n_devices: int, devices=None):
@@ -48,12 +58,19 @@ class ShardedChainExecutor:
             raise ValueError(
                 f"mesh_devices={n_devices} but only {len(devs)} jax devices"
             )
-        if executor._fanout:
-            raise ValueError("array_map chains are not sharded yet")
+        if executor._fanout and executor.agg_configs:
+            raise ValueError(
+                "array_map + aggregate chains are not sharded (capacity "
+                "retry cannot roll back cross-shard carries)"
+            )
         self.executor = executor
         self.n = n_devices
         self.mesh = make_record_mesh(n_devices, devices=devs)
         self._jit_cache: Dict = {}
+        # device-future carries of the most recent dispatch (stream
+        # pipelining); None = the host mirror is authoritative
+        self._pending_carries = None
+        self.fanout_retries = 0  # observability: capacity-retry count
 
     # -- traced step ---------------------------------------------------------
 
@@ -64,32 +81,32 @@ class ShardedChainExecutor:
         run the stage pipeline (same device-side re-pad as the single
         device `_chain_fn_ragged`: the host link carries sum(lengths)
         bytes per shard, not rows x width)."""
-        (width, kwidth, has_keys, has_offsets, ts_mode) = cfg
+        (width, kwidth, has_keys, has_offsets, ts_mode, fanout_cap) = cfg
         values, lengths = kernels_executor.ragged_repad_words(
             uploads["flat_words"], uploads["lengths"], width
         )
         n_local = lengths.shape[0]
         g0 = lax.axis_index(RECORD_AXIS) * n_local
-        arrays = {"values": values, "lengths": lengths}
-        if has_keys:
-            arrays["keys"] = uploads["keys"]
-            arrays["key_lengths"] = uploads["key_lengths"].astype(jnp.int32)
-        else:
-            arrays["keys"] = jnp.zeros((n_local, kwidth), dtype=jnp.uint8)
-            arrays["key_lengths"] = jnp.full((n_local,), -1, dtype=jnp.int32)
-        if has_offsets:
-            arrays["offset_deltas"] = uploads["offset_deltas"]
-        else:
-            arrays["offset_deltas"] = g0 + jnp.arange(n_local, dtype=jnp.int32)
-        if ts_mode == "zero":
-            arrays["timestamp_deltas"] = jnp.zeros((n_local,), dtype=jnp.int64)
-        else:
-            arrays["timestamp_deltas"] = uploads["timestamp_deltas"].astype(
-                jnp.int64
+        keys, key_lengths, offset_deltas, timestamp_deltas = (
+            kernels_executor.derived_meta_columns(
+                n_local, kwidth,
+                has_keys, uploads.get("keys"), uploads.get("key_lengths"),
+                has_offsets, uploads.get("offset_deltas"),
+                ts_mode, uploads.get("timestamp_deltas"),
+                idx_base=g0,
             )
-        return self._local_step(arrays, count, base_ts, carries)
+        )
+        arrays = {
+            "values": values,
+            "lengths": lengths,
+            "keys": keys,
+            "key_lengths": key_lengths,
+            "offset_deltas": offset_deltas,
+            "timestamp_deltas": timestamp_deltas,
+        }
+        return self._local_step(arrays, count, base_ts, carries, fanout_cap)
 
-    def _local_step(self, arrays: Dict, count, base_ts, carries):
+    def _local_step(self, arrays: Dict, count, base_ts, carries, fanout_cap=None):
         ex = self.executor
         ax = RECORD_AXIS
         n_local = arrays["values"].shape[0]
@@ -99,11 +116,15 @@ class ShardedChainExecutor:
         state["valid"] = gidx < count
         state["view_start"] = jnp.zeros((n_local,), dtype=jnp.int32)
         state["src_row"] = gidx
-        ctx = {"fanout_cap": None, "axis_name": ax, "g0": g0}
+        # fanout_cap is PER SHARD: each shard scatters into its own
+        # capacity block; src_row stays global so the host gather works
+        ctx = {"fanout_cap": fanout_cap, "axis_name": ax, "g0": g0}
         for stage in ex.stages:
             state, carries = stage.apply(state, carries, base_ts, ctx)
         valid = state["valid"]
         cnt = jnp.sum(valid.astype(jnp.int32))
+        fan_err = state.get("fan_err", jnp.asarray(False))
+        fan_total = state.get("fan_total", jnp.int32(0))
 
         def header(max_v, max_k):
             return jnp.stack(
@@ -111,19 +132,24 @@ class ShardedChainExecutor:
                     cnt.astype(jnp.int64),
                     max_v.astype(jnp.int64),
                     max_k.astype(jnp.int64),
-                    jnp.int64(0),
-                    jnp.int64(0),
+                    fan_err.astype(jnp.int64),
+                    fan_total.astype(jnp.int64),
                 ]
             )[None, :]
 
-        packed: Dict = {"mask": kernels.pack_mask(valid)}
+        packed: Dict = {}
+        if not ex._fanout:
+            packed["mask"] = kernels.pack_mask(valid)
         if ex._viewable:
-            _, (cstart, clen) = kernels.compact_rows(
-                valid, state["view_start"], state["lengths"]
-            )
-            packed["span_start"] = cstart
-            packed["span_len"] = clen
-            return header(jnp.max(clen), jnp.int32(0)), packed, carries
+            cols = [state["view_start"], state["lengths"]]
+            if ex._fanout:
+                cols.append(state["src_row"])
+            _, compacted = kernels.compact_rows(valid, *cols)
+            packed["span_start"] = compacted[0]
+            packed["span_len"] = compacted[1]
+            if ex._fanout:
+                packed["src_row"] = compacted[2]
+            return header(jnp.max(compacted[1]), jnp.int32(0)), packed, carries
         if ex._int_output:
             windowed = bool(ex.stages[-1].window_ms)
             cols = [state["agg_out_int"]]
@@ -134,17 +160,21 @@ class ShardedChainExecutor:
             if windowed:
                 packed["agg_win"] = compacted[1]
             return header(jnp.int32(0), jnp.int32(0)), packed, carries
-        _, compacted = kernels.compact_rows(
-            valid,
+        cols = [
             state["values"],
             state["lengths"],
             state["keys"],
             state["key_lengths"],
-        )
+        ]
+        if ex._fanout:
+            cols.append(state["src_row"])
+        _, compacted = kernels.compact_rows(valid, *cols)
         packed["values"] = compacted[0]
         packed["lengths"] = compacted[1]
         packed["keys"] = compacted[2]
         packed["key_lengths"] = compacted[3]
+        if ex._fanout:
+            packed["src_row"] = compacted[4]
         return (
             header(jnp.max(compacted[1]), jnp.max(compacted[3])),
             packed,
@@ -195,23 +225,34 @@ class ShardedChainExecutor:
         mat = P(RECORD_AXIS, None)
         ex = self.executor
         if ex._viewable:
-            return {"mask": row, "span_start": row, "span_len": row}
+            out = {"span_start": row, "span_len": row}
+            if ex._fanout:
+                out["src_row"] = row
+            else:
+                out["mask"] = row
+            return out
         if ex._int_output:
             out = {"mask": row, "agg_int": row}
             if bool(ex.stages[-1].window_ms):
                 out["agg_win"] = row
             return out
-        return {
-            "mask": row,
+        out = {
             "values": mat,
             "lengths": row,
             "keys": mat,
             "key_lengths": row,
         }
+        if ex._fanout:
+            out["src_row"] = row
+        else:
+            out["mask"] = row
+        return out
 
     # -- execution -----------------------------------------------------------
 
     def _carries(self):
+        if self._pending_carries is not None:
+            return self._pending_carries
         return tuple(
             (jnp.int64(acc), jnp.int64(win), jnp.asarray(has))
             for acc, win, has in self.executor.carries
@@ -283,9 +324,22 @@ class ShardedChainExecutor:
         cfg = (buf.width, buf.keys.shape[1], has_keys, has_offsets, ts_mode)
         return uploads, cfg, sum(v.nbytes for v in uploads.values())
 
-    def dispatch_buffer(self, buf: RecordBuffer):
+    def _shard_fanout_cap(self, buf: RecordBuffer, cap_total=None) -> int:
+        """Per-shard explode capacity: the learned global capacity split
+        across shards with 1.5x headroom for imbalance (a shard whose
+        exact total still exceeds it triggers the retry)."""
+        ex = self.executor
+        if cap_total is None:
+            cap_total = ex._fanout_cap(buf)
+        return ex._bucket_bytes(max(cap_total * 3 // (2 * self.n), 8), 8)
+
+    def dispatch_buffer(self, buf: RecordBuffer, cap_shard=None):
+        ex = self.executor
         uploads, cfg, nbytes = self._stage_ragged(buf)
-        self.executor.h2d_bytes_total += nbytes
+        if ex._fanout and cap_shard is None:
+            cap_shard = self._shard_fanout_cap(buf)
+        cfg = cfg + (cap_shard,)
+        ex.h2d_bytes_total += nbytes
         sharded = {
             k: jax.device_put(
                 v,
@@ -296,16 +350,23 @@ class ShardedChainExecutor:
             for k, v in uploads.items()
         }
         fn = self._jitted(sharded, cfg)
+        prev_carries = self._pending_carries
         header, packed, new_carries = fn(
             sharded,
             jnp.int32(buf.count),
             jnp.int64(buf.base_timestamp),
             self._carries(),
         )
-        return (new_carries, header, packed)
+        if ex.agg_configs:
+            # carries chain through device futures at dispatch time so
+            # streams pipeline; the host mirror commits at finish
+            self._pending_carries = new_carries
+        return (prev_carries, new_carries, header, packed, cap_shard)
 
     def discard_dispatch(self, handle) -> None:
-        pass  # carries commit in finish_buffer; nothing dispatched to undo
+        """Drop a speculative dispatch, restoring pre-dispatch carries."""
+        if self.executor.agg_configs:
+            self._pending_carries = handle[0]
 
     def _shard_slices(self, arr, counts, vw: int = 0):
         """Per-shard row slices bounded by that shard's survivor count
@@ -336,30 +397,74 @@ class ShardedChainExecutor:
         )
 
     def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
-        new_carries, header, packed = handle
+        from fluvio_tpu.smartengine.tpu.executor import TpuSpill
+
+        _prev, new_carries, header, packed, cap_shard = handle
         ex = self.executor
         hdrs = np.asarray(jax.device_get(header))  # (n_shards, 5)
         counts = hdrs[:, 0].astype(np.int64)
         total = int(counts.sum())
         n_rows = buf.rows
         width = buf.width
-        rows_out = min(ex._bucket_bytes(max(total, 1), 8), max(n_rows, 8))
+        if ex._fanout:
+            if hdrs[:, 3].any():
+                raise TpuSpill("array_map transform error: interpreter decides")
+            totals = hdrs[:, 4].astype(np.int64)
+            if int(totals.max()) > cap_shard:
+                # one bigger-capacity retry at the exact (bucketed)
+                # per-shard maximum; stateless by construction, so the
+                # abandoned first dispatch has no carries to roll back.
+                # Learn from the PER-SHARD peak (scaled to a global
+                # total), not the global sum: a persistently skewed
+                # stream would otherwise overflow-and-retry every batch
+                ex._learn_cap(buf, int(totals.max()) * self.n)
+                self.fanout_retries += 1
+                retry_cap = ex._bucket_bytes(int(totals.max()), 8)
+                handle = self.dispatch_buffer(buf, cap_shard=retry_cap)
+                _prev, new_carries, header, packed, cap_shard = handle
+                hdrs = np.asarray(jax.device_get(header))
+                if int(hdrs[:, 4].max()) > cap_shard:  # pragma: no cover
+                    raise TpuSpill(
+                        f"fanout overflow after retry: {int(hdrs[:, 4].max())}"
+                    )
+                counts = hdrs[:, 0].astype(np.int64)
+                total = int(counts.sum())
+        cap_rows = self.n * cap_shard if ex._fanout else n_rows
+        rows_out = min(ex._bucket_bytes(max(total, 1), 8), max(cap_rows, 8))
 
         # one async fetch for every column: all shard slices start their
         # D2H copies concurrently (same pattern as the single-device
-        # _fetch) instead of one blocking round-trip per column
+        # _fetch) instead of one blocking round-trip per column.
+        # Survivor recovery: row-preserving chains ship the 1-bit mask;
+        # fan-out chains ship the explicit per-shard src_row slices
+        # (global input row indices, so the host gather is unchanged).
         def _fetch_all(*column_groups):
-            cols = [packed["mask"]]
+            if ex._fanout:
+                src_slices = self._shard_slices(
+                    ex._narrow_static(packed["src_row"], max(n_rows, 1)),
+                    counts,
+                )
+                cols = list(src_slices)
+                n_lead = len(cols)
+            else:
+                cols = [packed["mask"]]
+                n_lead = 1
             for group in column_groups:
                 cols.extend(group)
             # the executor's single download point: byte accounting rides
             # along for sharded batches too
             host = ex._download(cols)
-            mask_h = np.asarray(host[0])
-            src_h = np.flatnonzero(
-                np.unpackbits(mask_h, bitorder="little")[:n_rows]
-            )
-            groups, pos = [], 1
+            if ex._fanout:
+                src_h = self._concat_counts(host[:n_lead], counts).astype(
+                    np.int64
+                )
+            else:
+                src_h = np.flatnonzero(
+                    np.unpackbits(np.asarray(host[0]), bitorder="little")[
+                        :n_rows
+                    ]
+                )
+            groups, pos = [], n_lead
             for group in column_groups:
                 groups.append(host[pos : pos + len(group)])
                 pos += len(group)
@@ -382,11 +487,26 @@ class ShardedChainExecutor:
             vw = min(ex._pad_slice(vw), width)
             out_values = np.zeros((rows_out, vw), dtype=np.uint8)
             if total:
-                cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
-                gathered = buf.dense_values()[
-                    src[:total, None], np.clip(cols, 0, width - 1)
-                ]
                 keep = np.arange(vw, dtype=np.int32)[None, :] < ln[:, None]
+                if buf.values is None:
+                    # flat-backed buffer (the broker path): slice views
+                    # straight out of the aligned flat — never build the
+                    # rows x width dense matrix the ragged staging avoided
+                    flat, starts = buf.ragged_values()
+                    if len(flat):
+                        base = starts.astype(np.int64)[src[:total]] + st
+                        cols = (
+                            base[:, None]
+                            + np.arange(vw, dtype=np.int64)[None, :]
+                        )
+                        gathered = flat[np.clip(cols, 0, len(flat) - 1)]
+                    else:  # all-empty values: every view is empty
+                        gathered = np.zeros((total, vw), dtype=np.uint8)
+                else:
+                    cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
+                    gathered = buf.values[
+                        src[:total, None], np.clip(cols, 0, width - 1)
+                    ]
                 out_values[:total] = apply_postops_host(
                     np.where(keep, gathered, 0), ex._view_postops
                 )
@@ -447,8 +567,16 @@ class ShardedChainExecutor:
         out_off = np.zeros((rows_out,), np.int32)
         out_ts = np.zeros((rows_out,), np.int64)
         src_c = np.clip(src[:total], 0, buf.offset_deltas.shape[0] - 1)
-        out_off[:total] = buf.offset_deltas[src_c]
-        out_ts[:total] = buf.timestamp_deltas[src_c]
+        if ex._fanout:
+            # fan-out outputs are "fresh": zero relative to their source
+            # record's batch, or the broker's batch-rebase columns
+            if buf.fresh_offset_deltas is not None:
+                out_off[:total] = buf.fresh_offset_deltas[src_c]
+            if buf.fresh_timestamp_deltas is not None:
+                out_ts[:total] = buf.fresh_timestamp_deltas[src_c]
+        else:
+            out_off[:total] = buf.offset_deltas[src_c]
+            out_ts[:total] = buf.timestamp_deltas[src_c]
 
         # commit carries: host mirror stays authoritative across calls
         if ex.agg_configs:

@@ -343,6 +343,38 @@ def ragged_repad_words(flat, lengths, width: int):
     return jnp.where(mask, gathered, 0).astype(jnp.uint8), lengths
 
 
+def derived_meta_columns(
+    n: int,
+    kwidth: int,
+    has_keys: bool,
+    keys,
+    key_lengths,
+    has_offsets: bool,
+    offset_deltas,
+    ts_mode: str,
+    timestamp_deltas,
+    idx_base=0,
+):
+    """Device-side synthesis of the columns `stage_link_columns` kept off
+    the link (traced; shared by the single-device ragged dispatch and the
+    per-shard rebuild — the sentinels and widenings must not fork).
+    ``idx_base`` is 0 single-device and the shard's global row origin
+    under shard_map. Returns (keys, key_lengths, offset_deltas,
+    timestamp_deltas)."""
+    if not has_keys:
+        keys = jnp.zeros((n, kwidth), dtype=jnp.uint8)
+        key_lengths = jnp.full((n,), -1, dtype=jnp.int32)
+    else:
+        key_lengths = key_lengths.astype(jnp.int32)
+    if not has_offsets:
+        offset_deltas = idx_base + jnp.arange(n, dtype=jnp.int32)
+    if ts_mode == "zero":
+        timestamp_deltas = jnp.zeros((n,), dtype=jnp.int64)
+    else:
+        timestamp_deltas = timestamp_deltas.astype(jnp.int64)
+    return keys, key_lengths, offset_deltas, timestamp_deltas
+
+
 def stage_link_columns(buf):
     """Host-side link policy: which columns cross the H2D link, at which
     dtypes (shared by the single-device dispatch and the sharded
@@ -684,15 +716,12 @@ class TpuChainExecutor:
         """
         values, lengths = ragged_repad_words(flat, lengths, width)
         n = lengths.shape[0]
-        if not has_keys:
-            keys = jnp.zeros((n, kwidth), dtype=jnp.uint8)
-            key_lengths = jnp.full((n,), -1, dtype=jnp.int32)
-        if not has_offsets:
-            offset_deltas = jnp.arange(n, dtype=jnp.int32)
-        if ts_mode == "zero":
-            timestamp_deltas = jnp.zeros((n,), dtype=jnp.int64)
-        else:
-            timestamp_deltas = timestamp_deltas.astype(jnp.int64)
+        keys, key_lengths, offset_deltas, timestamp_deltas = (
+            derived_meta_columns(
+                n, kwidth, has_keys, keys, key_lengths,
+                has_offsets, offset_deltas, ts_mode, timestamp_deltas,
+            )
+        )
         arrays = {
             "values": values,
             "lengths": lengths,
@@ -1247,11 +1276,9 @@ class TpuChainExecutor:
         The broker's consume loop shape: sustained throughput is bounded by
         max(compute, transfer), not their sum.
         """
-        if self.agg_configs and (self._fanout or self._sharded is not None):
-            # serialized: fan-out overflow retry must roll carries back
-            # (impossible once the next batch dispatched), and the
-            # sharded executor commits carries to the host mirror only at
-            # finish — a dispatch-ahead would read stale state
+        if self.agg_configs and self._fanout:
+            # serialized: fan-out overflow retry must roll carries back,
+            # impossible once the next batch dispatched
             for buf in bufs:
                 yield self.process_buffer(buf)
             return
@@ -1259,7 +1286,9 @@ class TpuChainExecutor:
         # two-phase pipeline through the delegating API (single-device OR
         # sharded mesh): finish_buffer handles overflow retry internally,
         # which is safe here — stateless chains have no carries to roll
-        # back, and aggregate chains without fan-out cannot overflow
+        # back, and aggregate chains without fan-out cannot overflow.
+        # Sharded aggregates pipeline too: carries chain through device
+        # futures at dispatch time (ShardedChainExecutor._pending_carries)
         pending = None
         for buf in bufs:
             handle = self.dispatch_buffer(buf)
@@ -1296,6 +1325,8 @@ class TpuChainExecutor:
 
     def sync_state_from(self, instances: List) -> None:
         self._device_carries = None  # host state becomes authoritative
+        if self._sharded is not None:
+            self._sharded._pending_carries = None
         slot = 0
         for inst in instances:
             if inst.kind != SmartModuleKind.AGGREGATE:
