@@ -67,6 +67,11 @@ class SmartEngine:
     # multi-device engine mode: shard chains over an n-device record
     # mesh via shard_map (0/1 = single device)
     mesh_devices: int = 0
+    # wall-clock budget per Python-hook call, ms (0 = unmetered; the
+    # fuel analog — DSL programs are bounded by construction, arbitrary
+    # hooks are not; see smartengine/metering.py). The SPU enables this
+    # by default so a hostile module cannot wedge the broker.
+    hook_budget_ms: int = 0
 
     def builder(self) -> "SmartModuleChainBuilder":
         return SmartModuleChainBuilder(engine=self)
@@ -100,10 +105,16 @@ class SmartModuleChainBuilder:
     def initialize(self, engine: Optional[SmartEngine] = None) -> "SmartModuleChainInstance":
         engine = engine or self.engine
         instances = []
+        from fluvio_tpu.smartengine.metering import run_metered
+
         for entry in self.entries:
             inst = PythonInstance(entry.module, entry.config)
             try:
-                inst.call_init()
+                # init is user code too: a looping init must become a
+                # typed chain-init error, not a wedged chain build
+                run_metered(
+                    inst.call_init, engine.hook_budget_ms, entry.module.name
+                )
             except Exception as e:  # noqa: BLE001 — user code boundary
                 raise SmartModuleChainInitError(
                     f"init failed for SmartModule {entry.module.name!r}: {e}"
@@ -175,6 +186,10 @@ class SmartModuleChainInstance:
         self.instances = instances
         self.tpu_chain = tpu_chain
         self.native_chain = native_chain
+        # set when a fuel trap abandoned a hook thread (metering.py):
+        # the chain fails fast with this error instead of re-entering
+        # user code whose previous invocation is still running
+        self._poisoned = None
 
     def __len__(self) -> int:
         return len(self.instances)
@@ -227,13 +242,51 @@ class SmartModuleChainInstance:
     def _process_instances(
         self, inp: SmartModuleInput, metrics: SmartModuleChainMetrics
     ) -> SmartModuleOutput:
-        """Interpreting per-instance pipeline (exact reference semantics)."""
+        """Interpreting per-instance pipeline (exact reference semantics).
+
+        Python hooks run under the engine's wall-clock fuel budget
+        (`hook_budget_ms`): exhaustion becomes a transform error — the
+        same surface a wasm fuel trap takes in the reference
+        (state.rs:40-55) — so the stream gets a typed error response and
+        the broker stays live instead of spinning forever."""
+        from fluvio_tpu.smartengine.metering import (
+            SmartModuleFuelError,
+            run_metered,
+            scale_budget,
+        )
+        from fluvio_tpu.smartmodule.types import (
+            SmartModuleTransformRuntimeError,
+        )
+
         base_offset = inp.base_offset
         base_timestamp = inp.base_timestamp
+        if self._poisoned is not None:
+            # an earlier fuel trap left this chain's hook thread alive
+            # and possibly mid-mutation: never re-enter it
+            out = SmartModuleOutput()
+            out.error = self._poisoned
+            return out
+        n_rec = len(inp.records) if inp.records is not None else inp.raw_count
+        budget = scale_budget(self.engine.hook_budget_ms, n_rec)
         next_input = inp
         output = SmartModuleOutput()
         for i, instance in enumerate(self.instances):
-            output = instance.process(next_input, metrics)
+            try:
+                output = run_metered(
+                    lambda: instance.process(next_input, metrics),
+                    budget,
+                    getattr(instance.module, "name", "smartmodule"),
+                )
+            except SmartModuleFuelError as e:
+                output = SmartModuleOutput()
+                output.error = SmartModuleTransformRuntimeError(
+                    hint=str(e),
+                    offset=base_offset,
+                    kind=instance.kind,
+                )
+                if e.abandoned:
+                    self._poisoned = output.error
+                break
             if output.error is not None:
                 # stop processing, return partial output (engine.rs:159-161)
                 break
@@ -260,6 +313,12 @@ class SmartModuleChainInstance:
         ``read_fn`` receives the module's Lookback config and returns the
         records to replay (parity: engine.rs:187-218).
         """
+        from fluvio_tpu.smartengine.metering import (
+            SmartModuleFuelError,
+            run_metered,
+            scale_budget,
+        )
+
         for instance in self.instances:
             if not instance.module.has_look_back():
                 continue
@@ -267,7 +326,25 @@ class SmartModuleChainInstance:
             records = await read_fn(lookback)
             if metrics is not None:
                 metrics.add_bytes_in(sum(len(r.value) for r in records))
-            instance.call_look_back(records)
+            # look_back replays user code over stored records on the
+            # broker: same fuel budget as process (error propagates as a
+            # chain error to the stream that attached the module)
+            try:
+                run_metered(
+                    lambda: instance.call_look_back(records),
+                    scale_budget(self.engine.hook_budget_ms, len(records)),
+                    getattr(instance.module, "name", "smartmodule"),
+                )
+            except SmartModuleFuelError as e:
+                if e.abandoned:
+                    from fluvio_tpu.smartmodule.types import (
+                        SmartModuleTransformRuntimeError,
+                    )
+
+                    self._poisoned = SmartModuleTransformRuntimeError(
+                        hint=str(e), kind=instance.kind
+                    )
+                raise
             # keep any device/native-side state in sync after host replay
             if self.tpu_chain is not None:
                 self.tpu_chain.sync_state_from(self.instances)

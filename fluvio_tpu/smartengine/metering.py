@@ -1,0 +1,130 @@
+"""Execution metering for arbitrary (non-DSL) Python SmartModule hooks.
+
+Capability parity: the reference executes untrusted modules under
+wasmtime with fuel metering and traps the instance when the budget is
+exhausted (fluvio-smartengine/src/engine/wasmtime/state.rs:14,40-55,
+engine.rs:31-35). DSL programs here are bounded by construction — they
+lower to fixed-size tensor programs — but a user-authored Python hook
+is arbitrary code; unmetered, one infinite loop would wedge the broker
+process forever.
+
+The TPU-first analog is a wall-clock budget per hook call enforced from
+outside the hook's thread: the hook runs on a dedicated watchdog
+thread, and when the budget expires a typed `SmartModuleFuelError` is
+injected at the hook's next bytecode boundary
+(PyThreadState_SetAsyncExc — the same mechanism CPython uses for
+KeyboardInterrupt delivery). Injection is retried until the hook
+actually unwinds, because user code with a bare ``except:`` can swallow
+the first one. A hook spinning inside a C extension cannot be
+interrupted this way; after a grace period the watchdog abandons the
+daemon thread and raises in the caller anyway, so the serving path
+always gets its typed error in bounded time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from typing import Callable
+
+#: how long to keep re-injecting before abandoning the hook thread
+_KILL_GRACE_SECONDS = 5.0
+
+#: hard ceiling on live abandoned hook threads process-wide; past it,
+#: metered execution is refused outright (fail-fast typed error) so a
+#: hostile module cannot accumulate spinners until the GIL starves
+_ABANDONED_LIMIT = 16
+
+_abandoned_lock = threading.Lock()
+_abandoned_threads: list = []
+
+
+def _live_abandoned() -> int:
+    with _abandoned_lock:
+        _abandoned_threads[:] = [t for t in _abandoned_threads if t.is_alive()]
+        return len(_abandoned_threads)
+
+
+def scale_budget(budget_ms: int, n_records: int) -> int:
+    """Input-proportional budget: reference fuel is per-instruction and
+    scales with work; a flat wall-clock cap would fail honest hooks on
+    large batches. One budget unit covers 10k records."""
+    if budget_ms <= 0:
+        return budget_ms
+    return budget_ms * max(1, -(-max(n_records, 1) // 10_000))
+
+
+class SmartModuleFuelError(Exception):
+    """A hook exceeded its execution budget (reference fuel trap,
+    wasmtime/state.rs:40-55 — there a wasm trap, here a typed error the
+    chain converts into a transform error response). ``abandoned`` marks
+    a hook that also ignored exception injection: its thread is still
+    running, and the owning chain must be poisoned so the hook is never
+    re-entered (state may be mid-mutation, and each re-run would leak
+    another spinner)."""
+
+    def __init__(
+        self,
+        name: str = "smartmodule",
+        budget_ms: int = 0,
+        abandoned: bool = False,
+        quarantined: bool = False,
+    ):
+        if quarantined:
+            msg = (
+                f"SmartModule {name!r} refused: too many abandoned hook "
+                f"threads ({_ABANDONED_LIMIT}) — hook metering quarantined"
+            )
+        else:
+            msg = f"SmartModule {name!r} exceeded its execution budget" + (
+                f" ({budget_ms} ms)" if budget_ms else ""
+            )
+        super().__init__(msg)
+        self.module = name
+        self.budget_ms = budget_ms
+        self.abandoned = abandoned
+        self.quarantined = quarantined
+
+
+def run_metered(fn: Callable, budget_ms: int, name: str = "smartmodule"):
+    """Run ``fn()`` with a wall-clock budget; raise SmartModuleFuelError
+    if it does not finish in time. ``budget_ms <= 0`` runs unmetered."""
+    if budget_ms <= 0:
+        return fn()
+    if _live_abandoned() >= _ABANDONED_LIMIT:
+        raise SmartModuleFuelError(name, budget_ms, quarantined=True)
+    box: dict = {}
+    done = threading.Event()
+
+    def runner() -> None:
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=runner, daemon=True, name=f"sm-meter-{name}")
+    t.start()
+    if not done.wait(budget_ms / 1000.0):
+        deadline = time.monotonic() + _KILL_GRACE_SECONDS
+        while not done.is_set() and time.monotonic() < deadline:
+            if t.ident is not None:
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(t.ident),
+                    ctypes.py_object(SmartModuleFuelError),
+                )
+            done.wait(0.05)
+        abandoned = not done.is_set()
+        if abandoned:
+            with _abandoned_lock:
+                _abandoned_threads.append(t)
+        raise SmartModuleFuelError(name, budget_ms, abandoned=abandoned)
+    err = box.get("error")
+    if err is not None:
+        if isinstance(err, SmartModuleFuelError):
+            # the injected class carries no context; re-raise with it
+            raise SmartModuleFuelError(name, budget_ms) from None
+        raise err
+    return box.get("result")

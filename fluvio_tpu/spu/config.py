@@ -17,6 +17,10 @@ class SmartEngineConfig:
     # multi-device engine mode: chains shard over an n-device record
     # mesh via shard_map (0/1 = single device)
     mesh_devices: int = 0
+    # fuel analog: wall-clock budget per Python-hook call (the broker
+    # meters arbitrary hook code by default so a hostile module cannot
+    # wedge it; 0 disables — see smartengine/metering.py)
+    hook_budget_ms: int = 5000
 
 
 @dataclass

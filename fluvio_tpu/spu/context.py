@@ -64,6 +64,7 @@ class GlobalContext:
             backend=config.smart_engine.backend,
             store_max_memory=config.smart_engine.store_max_memory,
             mesh_devices=config.smart_engine.mesh_devices,
+            hook_budget_ms=config.smart_engine.hook_budget_ms,
         )
         self.metrics = SpuMetrics()
         # stateless stream chains keyed by invocation fingerprint (LRU):

@@ -60,6 +60,7 @@ from fluvio_tpu.spu.smart_chain import (
     tpu_stage_dispatch,
 )
 from fluvio_tpu.smartengine.engine import EngineError, SmartModuleChainInitError
+from fluvio_tpu.smartengine.metering import SmartModuleFuelError
 from fluvio_tpu.smartmodule.types import SmartModuleInput
 from fluvio_tpu.transport.service import FluvioService
 from fluvio_tpu.transport.sink import ExclusiveSink, FluvioSink
@@ -156,7 +157,7 @@ async def handle_produce(ctx: GlobalContext, req: ProduceRequest) -> ProduceResp
     if req.smartmodules:
         try:
             chain = build_chain(req.smartmodules, ctx)
-        except (SmartModuleResolutionError, SmartModuleChainInitError, EngineError) as e:
+        except (SmartModuleResolutionError, SmartModuleChainInitError, EngineError, SmartModuleFuelError) as e:
             return _produce_error_response(req, _smartmodule_error_code(e), str(e))
 
     response = ProduceResponse()
@@ -185,7 +186,9 @@ async def handle_produce(ctx: GlobalContext, req: ProduceRequest) -> ProduceResp
                 continue
             records = pdata.records
             if chain is not None:
-                records, err = _apply_produce_chain(ctx, chain, records)
+                records, err = await _chain_off_loop(
+                    chain, _apply_produce_chain, ctx, chain, records
+                )
                 if err is not None:
                     presp.error_code = ErrorCode.SMARTMODULE_RUNTIME_ERROR
                     presp.error_message = str(err)
@@ -202,6 +205,24 @@ async def handle_produce(ctx: GlobalContext, req: ProduceRequest) -> ProduceResp
             if req.isolation == Isolation.READ_COMMITTED:
                 await _wait_for_hw(leader, leader.leo(), req.timeout_ms)
     return response
+
+
+
+async def _chain_off_loop(chain, fn, *args):
+    """Run a per-record chain pass off the event loop.
+
+    Arbitrary Python hooks execute inside these passes; on the loop
+    thread a slow or hostile module would stall EVERY connection for
+    its metering budget. A worker thread keeps the broker responsive,
+    and a per-chain lock serializes passes on shared (cached stateless)
+    chains so two streams never run one chain's instances concurrently.
+    """
+    lock = getattr(chain, "_exec_lock", None)
+    if lock is None:
+        lock = asyncio.Lock()
+        chain._exec_lock = lock
+    async with lock:
+        return await asyncio.to_thread(fn, *args)
 
 
 def _apply_produce_chain(ctx: GlobalContext, chain, records: RecordSet):
@@ -374,6 +395,13 @@ def _schedule_chain_warmup(chain) -> None:
         _warm()
 
 
+
+def _process_batches_from(chain, batches, max_bytes, metrics, start_offset):
+    return process_batches(
+        chain, batches, max_bytes, metrics, start_offset=start_offset
+    )
+
+
 class StreamFetchHandler:
     """One push stream: select loop over data / acks / end.
 
@@ -436,6 +464,7 @@ class StreamFetchHandler:
                 SmartModuleResolutionError,
                 SmartModuleChainInitError,
                 EngineError,
+                SmartModuleFuelError,
             ) as e:
                 info = leader.offsets()
                 await self._send_error(
@@ -525,8 +554,9 @@ class StreamFetchHandler:
                     # rare decline: rerun this slice on the per-record path
                     # (directly — re-entering process_batches would
                     # re-dispatch the failed slice and double-count)
-                    result = process_batches_per_record(
-                        chain, pending.batches, req.max_bytes, self.metrics
+                    result = await _chain_off_loop(
+                        chain, process_batches_per_record,
+                        chain, pending.batches, req.max_bytes, self.metrics,
                     )
                 sent_next = await self._push_processed(leader, result)
                 if self._ended:
@@ -548,9 +578,9 @@ class StreamFetchHandler:
                 continue
             if nxt_batches is not None:
                 # staging declined this slice: serial per-record path
-                result = process_batches(
-                    chain, nxt_batches, req.max_bytes, self.metrics,
-                    start_offset=read_from,
+                result = await _chain_off_loop(
+                    chain, _process_batches_from, chain, nxt_batches,
+                    req.max_bytes, self.metrics, read_from,
                 )
                 sent_next = await self._push_processed(leader, result)
                 if self._ended:
@@ -654,8 +684,9 @@ class StreamFetchHandler:
         # Shallow decode: the TPU fast path stages raw record slabs into
         # columnar buffers natively; the per-record path parses on demand.
         batches = rslice.decode_batches(parse_records=False)
-        result: BatchProcessResult = process_batches(
-            chain, batches, req.max_bytes, self.metrics, start_offset=offset
+        result: BatchProcessResult = await _chain_off_loop(
+            chain, _process_batches_from, chain, batches, req.max_bytes,
+            self.metrics, offset,
         )
         sent_next = await self._push_processed(leader, result)
         return max(sent_next, offset)

@@ -89,7 +89,10 @@ class LeaderReplicaState:
         """
         async with self._write_lock:
             if self.sm_chain is not None:
-                records = self._transform(records)
+                # dedup hooks are user code: run them off the event loop
+                # so a slow/hostile module cannot stall every connection
+                # (the write lock already serializes this chain)
+                records = await asyncio.to_thread(self._transform, records)
                 if not records.batches:
                     return self.storage.get_leo()
             base = self.storage.write_recordset(

@@ -348,3 +348,193 @@ transforms:
         chain = build_chain((lookup(step.uses), step.to_config()))
         out = chain.process(make_input(b"abc", b"xyz"))
         assert [r.value for r in out.successes] == [b"abc"]
+
+
+LOOPING_FILTER = b"""
+@smartmodule.filter
+def spin(record):
+    while True:
+        pass
+"""
+
+LOOPING_INIT = b"""
+@smartmodule.init
+def init(params):
+    while True:
+        pass
+
+@smartmodule.filter
+def ok(record):
+    return True
+"""
+
+LOOPING_LOOKBACK = b"""
+@smartmodule.look_back
+def lb(record):
+    while True:
+        pass
+
+@smartmodule.filter
+def ok(record):
+    return True
+"""
+
+
+class TestHookMetering:
+    """Fuel analog for arbitrary Python hooks (reference: wasmtime fuel,
+    engine/wasmtime/state.rs:14,40-55): a looping module must produce a
+    typed error in bounded time, never a wedged engine."""
+
+    def test_looping_hook_becomes_transform_error(self):
+        engine = SmartEngine(backend="python", hook_budget_ms=200)
+        chain = build_chain(
+            (LOOPING_FILTER, SmartModuleConfig()), engine=engine
+        )
+        out = chain.process(make_input(b"a", b"b"))
+        assert out.error is not None
+        assert "exceeded its execution budget" in str(out.error)
+        assert out.successes == []
+
+    def test_fuel_error_in_later_stage_reports_empty_output(self):
+        """The looping stage produced nothing before the trap, so the
+        chain reports the typed error with no successes (the failing
+        stage's partial output — engine.rs:159-161 — is empty here)."""
+        engine = SmartEngine(backend="python", hook_budget_ms=200)
+        chain = build_chain(
+            (lookup("regex-filter"), SmartModuleConfig(params={"regex": "keep"})),
+            (LOOPING_FILTER, SmartModuleConfig()),
+            engine=engine,
+        )
+        out = chain.process(make_input(b"keep-1", b"drop"))
+        assert out.error is not None  # second stage exhausted its budget
+        assert "execution budget" in str(out.error)
+        assert out.successes == []
+
+    def test_abandoned_hook_poisons_chain(self):
+        """A hook that swallows injection leaves a live thread; the
+        chain must fail fast on later calls instead of re-entering it."""
+        src = b"""
+@smartmodule.filter
+def stubborn(record):
+    while True:
+        try:
+            while True:
+                pass
+        except BaseException:
+            pass
+"""
+        engine = SmartEngine(backend="python", hook_budget_ms=100)
+        chain = build_chain((src, SmartModuleConfig()), engine=engine)
+        out = chain.process(make_input(b"a"))
+        assert out.error is not None
+        import time
+        t0 = time.time()
+        out2 = chain.process(make_input(b"b"))
+        assert out2.error is not None
+        assert time.time() - t0 < 1.0  # fail-fast: hook never re-entered
+
+    def test_unmetered_by_default_in_library(self):
+        assert SmartEngine().hook_budget_ms == 0
+
+    def test_looping_init_is_chain_init_error(self):
+        engine = SmartEngine(backend="python", hook_budget_ms=200)
+        with pytest.raises(SmartModuleChainInitError) as ei:
+            build_chain((LOOPING_INIT, SmartModuleConfig()), engine=engine)
+        assert "execution budget" in str(ei.value)
+
+    def test_looping_lookback_raises_fuel_error(self):
+        from fluvio_tpu.smartengine.metering import SmartModuleFuelError
+
+        engine = SmartEngine(backend="python", hook_budget_ms=200)
+        chain = build_chain(
+            (LOOPING_LOOKBACK, SmartModuleConfig(lookback=Lookback.last_n(1))),
+            engine=engine,
+        )
+
+        async def read_fn(lookback):
+            from fluvio_tpu.smartmodule.types import SmartModuleRecord
+
+            return [SmartModuleRecord(Record(value=b"x"))]
+
+        with pytest.raises(SmartModuleFuelError):
+            asyncio.run(chain.look_back(read_fn))
+
+    def test_hook_that_swallows_injection_still_errors(self):
+        """A bare except inside the hook cannot swallow the budget: the
+        watchdog re-injects until the hook unwinds (or abandons it) and
+        the caller gets the typed error either way."""
+        src = b"""
+@smartmodule.filter
+def stubborn(record):
+    while True:
+        try:
+            while True:
+                pass
+        except Exception:
+            pass
+"""
+        engine = SmartEngine(backend="python", hook_budget_ms=150)
+        chain = build_chain((src, SmartModuleConfig()), engine=engine)
+        out = chain.process(make_input(b"a"))
+        assert out.error is not None
+        assert "exceeded its execution budget" in str(out.error)
+
+    def test_broker_stays_live_after_looping_module(self, tmp_path):
+        """SPU serves a looping ad-hoc module: the stream gets an error
+        response, and a healthy consume on the same broker still works."""
+        import asyncio as aio
+
+        from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset
+        from fluvio_tpu.schema.smartmodule import (
+            SmartModuleInvocation,
+            SmartModuleInvocationKind,
+            SmartModuleInvocationWasm,
+        )
+        from fluvio_tpu.spu import SpuConfig, SpuServer
+        from fluvio_tpu.storage.config import ReplicaConfig
+
+        async def body():
+            cfg = SpuConfig(
+                id=7101,
+                public_addr="127.0.0.1:0",
+                log_base_dir=str(tmp_path),
+                replication=ReplicaConfig(base_dir=str(tmp_path)),
+            )
+            cfg.smart_engine.hook_budget_ms = 300
+            server = SpuServer(cfg)
+            await server.start()
+            server.ctx.create_replica("t", 0)
+            client = await Fluvio.connect(server.public_addr)
+            prod = await client.topic_producer("t", num_partitions=1)
+            futs = [await prod.send(b"", f"v{i}".encode()) for i in range(3)]
+            await prod.flush()
+            for f in futs:
+                await f.wait()
+
+            consumer = await client.partition_consumer("t", 0)
+            bad = ConsumerConfig(
+                disable_continuous=True,
+                smartmodules=[
+                    SmartModuleInvocation(
+                        wasm=SmartModuleInvocationWasm.adhoc(LOOPING_FILTER),
+                        kind=SmartModuleInvocationKind.FILTER,
+                    )
+                ],
+            )
+            with pytest.raises(Exception) as ei:
+                async for _ in consumer.stream(Offset.beginning(), bad):
+                    pass
+            assert "budget" in str(ei.value) or "SmartModule" in str(ei.value)
+
+            # broker must still serve a healthy stream afterwards
+            got = []
+            consumer2 = await client.partition_consumer("t", 0)
+            async for r in consumer2.stream(
+                Offset.beginning(), ConsumerConfig(disable_continuous=True)
+            ):
+                got.append(r.value)
+            assert got == [b"v0", b"v1", b"v2"]
+            await client.close()
+            await server.stop()
+
+        asyncio.run(body())
