@@ -2,16 +2,17 @@
 
 Capability parity: fluvio-hub-protocol/src/package_meta.rs (PackageMeta:
 name/version/group/description/files with sha256 sums) and
-fluvio-hub-util's tar build/verify + keymgmt. Signatures are
-HMAC-SHA256 with a locally-generated key (the reference signs with
-ed25519 key pairs; same trust model — possession of the key — without a
-crypto dependency).
+fluvio-hub-util's tar build/verify + keymgmt. Signatures are ed25519
+(fluvio-hub-util/src/keymgmt.rs): the signer's PUBLIC key travels in
+the signature envelope, so any downloader can verify the manifest was
+signed by that key and was not tampered with — and can additionally
+pin the key against a trusted set. (HMAC, the previous scheme, let any
+key holder forge and gave third parties nothing to verify.)
 """
 
 from __future__ import annotations
 
 import hashlib
-import hmac
 import io
 import json
 import os
@@ -19,7 +20,7 @@ import tarfile
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 MANIFEST_NAME = "package-meta.json"
 SIGNATURE_NAME = "package-meta.json.sig"
@@ -31,19 +32,93 @@ class HubError(Exception):
 
 
 def key_path() -> Path:
-    return Path(os.environ.get("FLUVIO_TPU_HUB_KEY", "~/.fluvio-tpu/hub.key")).expanduser()
+    return Path(
+        os.environ.get("FLUVIO_TPU_HUB_KEY", "~/.fluvio-tpu/hub-ed25519.key")
+    ).expanduser()
 
 
-def load_or_create_key() -> bytes:
-    """Signing key management (parity: hub-util keymgmt.rs)."""
+def _ed25519():
+    try:
+        from cryptography.hazmat.primitives.asymmetric import ed25519
+    except ImportError as e:  # pragma: no cover — cryptography is baked in
+        raise HubError(
+            "package signing needs the 'cryptography' package (ed25519)"
+        ) from e
+    return ed25519
+
+
+def load_or_create_key():
+    """Signing keypair management (parity: hub-util keymgmt.rs).
+
+    The key file holds the 32-byte ed25519 private seed (hex); the
+    public key derives from it. Returns an Ed25519PrivateKey."""
+    ed = _ed25519()
     path = key_path()
     if path.exists():
-        return bytes.fromhex(path.read_text().strip())
-    key = os.urandom(32)
+        seed = bytes.fromhex(path.read_text().strip())
+        return ed.Ed25519PrivateKey.from_private_bytes(seed)
+    key = ed.Ed25519PrivateKey.generate()
+    from cryptography.hazmat.primitives import serialization
+
+    seed = key.private_bytes(
+        serialization.Encoding.Raw,
+        serialization.PrivateFormat.Raw,
+        serialization.NoEncryption(),
+    )
     path.parent.mkdir(parents=True, exist_ok=True)
-    path.write_text(key.hex())
+    path.write_text(seed.hex())
     path.chmod(0o600)
     return key
+
+
+def public_key_hex(key=None) -> str:
+    """Hex of the raw 32-byte ed25519 public key (the publisher id)."""
+    from cryptography.hazmat.primitives import serialization
+
+    key = key if key is not None else load_or_create_key()
+    return key.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    ).hex()
+
+
+def _sign_manifest(manifest: bytes, key) -> bytes:
+    """Signature envelope: JSON {alg, pubkey, sig} so verification
+    needs nothing but the package itself."""
+    return json.dumps(
+        {
+            "alg": "ed25519",
+            "pubkey": public_key_hex(key),
+            "sig": key.sign(manifest).hex(),
+        },
+        sort_keys=True,
+    ).encode()
+
+
+def _verify_manifest(
+    manifest: bytes,
+    signature: bytes,
+    trusted_keys: Optional[Iterable[str]],
+    label: str,
+) -> None:
+    ed = _ed25519()
+    try:
+        envelope = json.loads(signature.decode())
+        alg = envelope["alg"]
+        pubkey_hex = envelope["pubkey"]
+        sig = bytes.fromhex(envelope["sig"])
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+        raise HubError(f"{label}: malformed signature envelope") from e
+    if alg != "ed25519":
+        raise HubError(f"{label}: unsupported signature algorithm {alg!r}")
+    try:
+        pub = ed.Ed25519PublicKey.from_public_bytes(bytes.fromhex(pubkey_hex))
+        pub.verify(sig, manifest)
+    except Exception as e:  # noqa: BLE001 — any failure is fail-closed
+        raise HubError(f"{label}: signature verification failed") from e
+    if trusted_keys is not None and pubkey_hex not in set(trusted_keys):
+        raise HubError(
+            f"{label}: signer {pubkey_hex[:16]}… is not in the trusted key set"
+        )
 
 
 @dataclass
@@ -75,12 +150,12 @@ def build_package(
     out_path: str | Path,
     meta: PackageMeta,
     artifacts: Dict[str, bytes],
-    key: Optional[bytes] = None,
+    key=None,
 ) -> PackageMeta:
     """Create a signed package tar (parity: hub-util package_sign/build).
 
-    Layout: package-meta.json + its HMAC signature + the artifact files,
-    each checksummed into the manifest before signing.
+    Layout: package-meta.json + its ed25519 signature envelope + the
+    artifact files, each checksummed into the manifest before signing.
     """
     meta.created_at = meta.created_at or int(time.time())
     meta.files = {
@@ -88,7 +163,7 @@ def build_package(
     }
     manifest = meta.to_json().encode()
     key = key if key is not None else load_or_create_key()
-    signature = hmac.new(key, manifest, hashlib.sha256).hexdigest().encode()
+    signature = _sign_manifest(manifest, key)
 
     out_path = Path(out_path)
     out_path.parent.mkdir(parents=True, exist_ok=True)
@@ -130,12 +205,16 @@ def read_package(path: str | Path) -> tuple[PackageMeta, Dict[str, bytes]]:
 
 def verify_package(
     path: str | Path,
-    key: Optional[bytes] = None,
+    trusted_keys: Optional[Iterable[str]] = None,
     contents: Optional[Dict[str, bytes]] = None,
 ) -> PackageMeta:
     """Check signature + checksums (parity: hub-util package_verify).
 
-    Pass pre-extracted ``contents`` to avoid re-reading the tarball.
+    The signature envelope carries the signer's public key, so any
+    download verifies without shared secrets; pass ``trusted_keys``
+    (hex public keys) to additionally pin WHO may have signed — e.g.
+    the publisher keys recorded in the registry's index. Pass
+    pre-extracted ``contents`` to avoid re-reading the tarball.
     """
     if contents is None:
         contents = _read_contents(path)
@@ -143,10 +222,7 @@ def verify_package(
     signature = contents.get(SIGNATURE_NAME)
     if manifest is None or signature is None:
         raise HubError(f"{path}: missing manifest or signature")
-    key = key if key is not None else load_or_create_key()
-    expected = hmac.new(key, manifest, hashlib.sha256).hexdigest().encode()
-    if not hmac.compare_digest(expected, signature):
-        raise HubError(f"{path}: signature verification failed")
+    _verify_manifest(manifest, signature, trusted_keys, str(path))
     meta = PackageMeta.from_json(manifest.decode())
     for name, digest in meta.files.items():
         data = contents.get(name)

@@ -20,6 +20,7 @@ from fluvio_tpu.hub.package import (
     _read_contents,
     _split_artifacts,
     build_package,
+    public_key_hex,
     verify_package,
 )
 
@@ -84,18 +85,40 @@ class HubRegistry:
         )
 
     def publish(self, meta: PackageMeta, artifacts: Dict[str, bytes]) -> str:
+        from fluvio_tpu.hub.package import load_or_create_key
+
+        signing_key = load_or_create_key()
         path = self.package_path(meta)
-        build_package(path, meta, artifacts)
+        build_package(path, meta, artifacts, key=signing_key)
         index = self._load_index()
-        key = f"{meta.group}/{meta.name}"
         entry = index["packages"].setdefault(
-            key, {"kind": meta.kind, "versions": []}
+            f"{meta.group}/{meta.name}", {"kind": meta.kind, "versions": []}
         )
         if meta.version not in entry["versions"]:
             entry["versions"].append(meta.version)
             entry["versions"].sort(key=_version_key)
+        # record the publisher's public key: downloads pin against this
+        # set, so a re-signed (attacker-keyed) tarball fails closed even
+        # though its envelope self-verifies
+        publishers = entry.setdefault("publishers", [])
+        pub = public_key_hex(signing_key)
+        if pub not in publishers:
+            publishers.append(pub)
         self._save_index(index)
         return meta.ref
+
+    def _trusted_for(self, group: str, name: str):
+        entry = self._load_index()["packages"].get(f"{group}/{name}") or {}
+        publishers = entry.get("publishers")
+        if not publishers:
+            # fail closed: an index entry with no recorded publisher keys
+            # cannot pin the signer, so a re-signed tarball would pass on
+            # envelope self-verification alone (re-publish to record keys)
+            raise HubError(
+                f"{group}/{name}: no publisher keys recorded in the index; "
+                "refusing unpinned verification"
+            )
+        return publishers
 
     def list_packages(self) -> List[dict]:
         index = self._load_index()
@@ -124,12 +147,17 @@ class HubRegistry:
         if not path.exists():
             raise HubError(f"package file missing: {path}")
         if verify:
-            verify_package(path)
+            verify_package(path, trusted_keys=self._trusted_for(group, name))
         return path
 
     def download(self, ref: str) -> tuple[PackageMeta, Dict[str, bytes]]:
         """Fetch + verify a package's artifacts in one read (hub download)."""
         path = self.resolve(ref, verify=False)
+        group, name, _ = parse_ref(ref)
         contents = _read_contents(path)
-        meta = verify_package(path, contents=contents)
+        meta = verify_package(
+            path,
+            trusted_keys=self._trusted_for(group, name),
+            contents=contents,
+        )
         return meta, _split_artifacts(contents)

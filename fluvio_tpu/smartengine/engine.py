@@ -17,6 +17,7 @@ Backend selection replaces the reference's single wasmtime runtime:
 
 from __future__ import annotations
 
+import asyncio
 import logging
 
 from dataclasses import dataclass, field
@@ -330,7 +331,10 @@ class SmartModuleChainInstance:
             # broker: same fuel budget as process (error propagates as a
             # chain error to the stream that attached the module)
             try:
-                run_metered(
+                # off the event loop: a looping look_back must stall only
+                # this attach, never every broker connection
+                await asyncio.to_thread(
+                    run_metered,
                     lambda: instance.call_look_back(records),
                     scale_budget(self.engine.hook_budget_ms, len(records)),
                     getattr(instance.module, "name", "smartmodule"),

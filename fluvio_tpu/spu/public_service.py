@@ -156,7 +156,7 @@ async def handle_produce(ctx: GlobalContext, req: ProduceRequest) -> ProduceResp
     chain = None
     if req.smartmodules:
         try:
-            chain = build_chain(req.smartmodules, ctx)
+            chain = await asyncio.to_thread(build_chain, req.smartmodules, ctx)
         except (SmartModuleResolutionError, SmartModuleChainInitError, EngineError, SmartModuleFuelError) as e:
             return _produce_error_response(req, _smartmodule_error_code(e), str(e))
 
@@ -456,8 +456,14 @@ class StreamFetchHandler:
         chain = None
         if req.smartmodules:
             try:
-                chain = acquire_stream_chain(
-                    req.smartmodules, self.ctx, version=self.version
+                # chain build runs @init hooks (user code, metered):
+                # keep it off the loop so a looping init stalls only
+                # this stream, not every connection
+                chain = await asyncio.to_thread(
+                    acquire_stream_chain,
+                    req.smartmodules,
+                    self.ctx,
+                    self.version,
                 )
                 await chain_look_back(chain, leader)
             except (

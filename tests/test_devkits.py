@@ -420,3 +420,101 @@ class TestPackageIndex:
             pkg.add_release(v, linux)
         # numeric prerelease identifiers compare as numbers (semver)
         assert pkg.latest_release(prerelease=True).version == "0.11.0-alpha.10"
+
+
+class TestEd25519Signing:
+    """Public-key signatures (parity: hub-util keymgmt.rs ed25519):
+    forged, re-signed, and tampered packages all fail closed."""
+
+    def test_signature_envelope_carries_public_key(self, hub_env):
+        import json
+
+        from fluvio_tpu.hub import HubRegistry
+        from fluvio_tpu.hub.package import (
+            SIGNATURE_NAME,
+            PackageMeta,
+            _read_contents,
+            public_key_hex,
+        )
+
+        registry = HubRegistry()
+        registry.publish(PackageMeta(name="p", version="1.0.0"), {"p.py": b"x"})
+        contents = _read_contents(registry.resolve("p"))
+        env = json.loads(contents[SIGNATURE_NAME])
+        assert env["alg"] == "ed25519"
+        assert env["pubkey"] == public_key_hex()
+
+    def test_wrong_key_fails_closed(self, hub_env, tmp_path):
+        """A package re-signed by a DIFFERENT valid keypair self-verifies
+        but must be rejected by the registry's publisher-key pin."""
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        import pytest as _pytest
+
+        from fluvio_tpu.hub import HubError, HubRegistry
+        from fluvio_tpu.hub.package import PackageMeta, build_package
+
+        registry = HubRegistry()
+        registry.publish(PackageMeta(name="w", version="1.0.0"), {"w.py": b"ok"})
+        # attacker rebuilds + re-signs the tarball with their own key
+        attacker = Ed25519PrivateKey.generate()
+        path = registry.resolve("w")
+        build_package(
+            path,
+            PackageMeta(name="w", version="1.0.0"),
+            {"w.py": b"malicious"},
+            key=attacker,
+        )
+        with _pytest.raises(HubError, match="trusted key set"):
+            registry.download("w")
+        with _pytest.raises(HubError, match="trusted key set"):
+            registry.resolve("w")
+
+    def test_corrupted_signature_fails_closed(self, hub_env):
+        import io
+        import tarfile
+
+        import pytest as _pytest
+
+        from fluvio_tpu.hub import HubError, HubRegistry
+        from fluvio_tpu.hub.package import (
+            SIGNATURE_NAME,
+            PackageMeta,
+            _read_contents,
+        )
+
+        registry = HubRegistry()
+        registry.publish(PackageMeta(name="c", version="1.0.0"), {"c.py": b"ok"})
+        path = registry.resolve("c")
+        members = _read_contents(path)
+        # flip one signature byte
+        import json
+
+        env = json.loads(members[SIGNATURE_NAME])
+        sig = bytearray.fromhex(env["sig"])
+        sig[0] ^= 0xFF
+        env["sig"] = bytes(sig).hex()
+        members[SIGNATURE_NAME] = json.dumps(env).encode()
+        with tarfile.open(path, "w:gz") as tar:
+            for name, data in members.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+        with _pytest.raises(HubError, match="verification failed"):
+            registry.download("c")
+
+    def test_third_party_verification_without_local_key(self, hub_env, tmp_path, monkeypatch):
+        """A downloader with NO local key material verifies a package
+        from its embedded public key (the HMAC scheme could not)."""
+        from fluvio_tpu.hub import HubRegistry
+        from fluvio_tpu.hub.package import PackageMeta, verify_package
+
+        registry = HubRegistry()
+        registry.publish(PackageMeta(name="t", version="1.0.0"), {"t.py": b"ok"})
+        path = registry.resolve("t")
+        # a different machine: different (nonexistent) key file
+        monkeypatch.setenv("FLUVIO_TPU_HUB_KEY", str(tmp_path / "other.key"))
+        meta = verify_package(path)
+        assert meta.name == "t"

@@ -416,22 +416,31 @@ class TestHookMetering:
         src = b"""
 @smartmodule.filter
 def stubborn(record):
-    while True:
+    import os
+    while os.environ.get("FLUVIO_TEST_SPIN_B") != "stop":
         try:
-            while True:
+            while os.environ.get("FLUVIO_TEST_SPIN_B") != "stop":
                 pass
         except BaseException:
             pass
+    return False
 """
         engine = SmartEngine(backend="python", hook_budget_ms=100)
         chain = build_chain((src, SmartModuleConfig()), engine=engine)
-        out = chain.process(make_input(b"a"))
-        assert out.error is not None
-        import time
-        t0 = time.time()
-        out2 = chain.process(make_input(b"b"))
-        assert out2.error is not None
-        assert time.time() - t0 < 1.0  # fail-fast: hook never re-entered
+        try:
+            out = chain.process(make_input(b"a"))
+            assert out.error is not None
+            import time
+            t0 = time.time()
+            out2 = chain.process(make_input(b"b"))
+            assert out2.error is not None
+            assert time.time() - t0 < 1.0  # fail-fast: hook never re-entered
+        finally:
+            import os as _os
+            _os.environ["FLUVIO_TEST_SPIN_B"] = "stop"
+            import time as _t
+            _t.sleep(0.1)
+            _os.environ.pop("FLUVIO_TEST_SPIN_B", None)
 
     def test_unmetered_by_default_in_library(self):
         assert SmartEngine().hook_budget_ms == 0
@@ -459,23 +468,32 @@ def stubborn(record):
         with pytest.raises(SmartModuleFuelError):
             asyncio.run(chain.look_back(read_fn))
 
-    def test_hook_that_swallows_injection_still_errors(self):
+    def test_hook_that_swallows_injection_still_errors(self, monkeypatch):
         """A bare except inside the hook cannot swallow the budget: the
         watchdog re-injects until the hook unwinds (or abandons it) and
-        the caller gets the typed error either way."""
+        the caller gets the typed error either way. (The env kill-switch
+        lets the abandoned thread exit AFTER the assertion so it does not
+        burn the GIL for the rest of the test session.)"""
+        import os as _os
+
         src = b"""
 @smartmodule.filter
 def stubborn(record):
-    while True:
+    import os
+    while os.environ.get("FLUVIO_TEST_SPIN_A") != "stop":
         try:
-            while True:
+            while os.environ.get("FLUVIO_TEST_SPIN_A") != "stop":
                 pass
         except Exception:
             pass
+    return False
 """
         engine = SmartEngine(backend="python", hook_budget_ms=150)
         chain = build_chain((src, SmartModuleConfig()), engine=engine)
-        out = chain.process(make_input(b"a"))
+        try:
+            out = chain.process(make_input(b"a"))
+        finally:
+            monkeypatch.setenv("FLUVIO_TEST_SPIN_A", "stop")
         assert out.error is not None
         assert "exceeded its execution budget" in str(out.error)
 
