@@ -30,6 +30,11 @@ class K8sApiError(Exception):
         self.message = message
 
 
+class _WatchUnsupported(Exception):
+    """The apiserver rejected ?watch=1 for a resource (fall back to
+    fingerprint polling)."""
+
+
 class K8sApi:
     """Namespaced-resource verbs over manifest dicts."""
 
@@ -56,6 +61,16 @@ class K8sApi:
         await asyncio.sleep(timeout)
         return False
 
+    async def watch_events(
+        self, resource: str, timeout: float
+    ) -> Optional[List[dict]]:
+        """Blocking watch for typed deltas: a list of K8s watch events
+        ({"type": ADDED|MODIFIED|DELETED, "object": manifest}), [] when
+        the timeout elapsed with no change, or None when this backend
+        cannot produce event streams (callers fall back to
+        ``watch_changed`` + full resync)."""
+        return None
+
 
 class FakeK8sApi(K8sApi):
     """In-memory apiserver-shaped store.
@@ -70,12 +85,15 @@ class FakeK8sApi(K8sApi):
         self._store: Dict[str, Dict[str, dict]] = {}
         self._version = 0
         self._events: Dict[str, asyncio.Event] = {}
+        self._event_log: Dict[str, List[dict]] = {}
 
     def _bucket(self, resource: str) -> Dict[str, dict]:
         return self._store.setdefault(resource, {})
 
-    def _notify(self, resource: str) -> None:
+    def _notify(self, resource: str, event: Optional[dict] = None) -> None:
         self._version += 1
+        if event is not None:
+            self._event_log.setdefault(resource, []).append(event)
         ev = self._events.get(resource)
         if ev is not None:
             ev.set()
@@ -100,8 +118,13 @@ class FakeK8sApi(K8sApi):
             obj["status"] = prev["status"]  # apply does not clear status
         self._version += 1
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._version)
+        is_new = prev is None
         self._bucket(resource)[name] = obj
-        self._notify(resource)
+        self._notify(
+            resource,
+            {"type": "ADDED" if is_new else "MODIFIED",
+             "object": json.loads(json.dumps(obj))},
+        )
         return json.loads(json.dumps(obj))
 
     async def patch_status(self, resource: str, name: str, status: dict) -> dict:
@@ -111,12 +134,17 @@ class FakeK8sApi(K8sApi):
         obj["status"] = json.loads(json.dumps(status))
         self._version += 1
         obj["metadata"]["resourceVersion"] = str(self._version)
-        self._notify(resource)
+        self._notify(
+            resource, {"type": "MODIFIED", "object": json.loads(json.dumps(obj))}
+        )
         return json.loads(json.dumps(obj))
 
     async def delete(self, resource: str, name: str) -> None:
-        self._bucket(resource).pop(name, None)
-        self._notify(resource)
+        prev = self._bucket(resource).pop(name, None)
+        self._notify(
+            resource,
+            {"type": "DELETED", "object": prev} if prev is not None else None,
+        )
 
     async def watch_changed(self, resource: str, timeout: float) -> bool:
         ev = self._events.setdefault(resource, asyncio.Event())
@@ -129,6 +157,15 @@ class FakeK8sApi(K8sApi):
             return True
         except asyncio.TimeoutError:
             return False
+
+    async def watch_events(
+        self, resource: str, timeout: float
+    ) -> Optional[List[dict]]:
+        log = self._event_log.setdefault(resource, [])
+        if not log:
+            await self.watch_changed(resource, timeout)
+        out, log[:] = list(log), []
+        return out
 
 
 def kube_context_from_env() -> dict:
@@ -161,15 +198,17 @@ class HttpK8sApi(K8sApi):
         self.server = server.rstrip("/")
         self.token = token
         self.ca_cert = ca_cert
+        # per-resource watch cursor (the last seen resourceVersion) and
+        # the set of resources whose server rejected ?watch=1
+        self._watch_rv: Dict[str, str] = {}
+        self._watch_unsupported: set = set()
 
     @classmethod
     def in_cluster(cls) -> "HttpK8sApi":
         ctx = kube_context_from_env()
         return cls(ctx["server"], ctx["token"], ctx["ca_cert"])
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None,
-                 content_type: str = "application/json",
-                 accept: str = "application/json"):
+    def _connect(self, timeout: float):
         import http.client
         from urllib.parse import urlparse
 
@@ -178,16 +217,22 @@ class HttpK8sApi(K8sApi):
             ctx = ssl.create_default_context()
             if self.ca_cert:
                 ctx.load_verify_locations(self.ca_cert)
-            conn = http.client.HTTPSConnection(
-                u.hostname, u.port or 443, context=ctx, timeout=30
+            return http.client.HTTPSConnection(
+                u.hostname, u.port or 443, context=ctx, timeout=timeout
             )
-        else:
-            conn = http.client.HTTPConnection(
-                u.hostname, u.port or 80, timeout=30
-            )
+        return http.client.HTTPConnection(u.hostname, u.port or 80, timeout=timeout)
+
+    def _headers(self, accept: str, content_type: str) -> dict:
         headers = {"Accept": accept, "Content-Type": content_type}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        return headers
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 content_type: str = "application/json",
+                 accept: str = "application/json"):
+        conn = self._connect(30)
+        headers = self._headers(accept, content_type)
         try:
             conn.request(
                 method,
@@ -243,13 +288,107 @@ class HttpK8sApi(K8sApi):
     async def delete(self, resource: str, name: str) -> None:
         await self._call("DELETE", f"{resource}/{name}")
 
+    # -- watch ---------------------------------------------------------------
+
+    def _watch_stream_once(self, resource: str, timeout: float):
+        """One blocking resourceVersion watch (list-then-watch protocol,
+        metadata/k8.rs:496 semantics): open ``?watch=1`` from the last
+        seen resourceVersion and return the events the server pushes
+        (empty list on a quiet timeout, WATCH_RESYNC when the cursor
+        expired — events were lost and the caller must re-list). Raises
+        _WatchUnsupported only for 4xx 'watch verb rejected' responses;
+        5xx are transient and surface as K8sApiError."""
+        from fluvio_tpu.metadata.client import WATCH_RESYNC
+
+        rv = self._watch_rv.get(resource)
+        if rv is None:
+            listing = self._request("GET", resource) or {}
+            rv = str((listing.get("metadata") or {}).get("resourceVersion", ""))
+            self._watch_rv[resource] = rv
+        conn = self._connect(max(timeout, 0.05) + 5)
+        params = (
+            f"watch=1&allowWatchBookmarks=true"
+            f"&timeoutSeconds={max(int(timeout), 1)}"
+        )
+        if rv:
+            params += f"&resourceVersion={rv}"
+        try:
+            conn.request(
+                "GET",
+                "/" + resource.lstrip("/") + "?" + params,
+                None,
+                self._headers("application/json", "application/json"),
+            )
+            resp = conn.getresponse()
+            if resp.status == 410:
+                # cursor expired: events in the gap are LOST — the
+                # caller must resync, not treat this as a quiet window
+                self._watch_rv.pop(resource, None)
+                return WATCH_RESYNC
+            if 400 <= resp.status < 500:
+                raise _WatchUnsupported(resp.status)
+            if resp.status >= 500:
+                raise K8sApiError(resp.status, "watch failed (transient)")
+            conn.sock.settimeout(max(timeout, 0.05))
+            events: List[dict] = []
+            while True:
+                try:
+                    line = resp.readline()
+                except (TimeoutError, OSError):
+                    break  # quiet window (or drained after first event)
+                if not line:
+                    break  # server closed (timeoutSeconds elapsed)
+                line = line.strip()
+                if not line:
+                    continue
+                evt = json.loads(line)
+                etype = evt.get("type")
+                obj = evt.get("object") or {}
+                new_rv = (obj.get("metadata") or {}).get("resourceVersion")
+                if new_rv:
+                    self._watch_rv[resource] = str(new_rv)
+                if etype == "BOOKMARK":
+                    continue
+                if etype == "ERROR":
+                    # e.g. in-stream 410: the gap's events are lost
+                    self._watch_rv.pop(resource, None)
+                    return WATCH_RESYNC if not events else events
+                events.append(evt)
+                # deliver promptly, but drain whatever the server has
+                # already buffered first — one reconnect per BATCH of
+                # events, not one per event
+                conn.sock.settimeout(0.05)
+            return events
+        finally:
+            conn.close()
+
+    async def watch_events(self, resource: str, timeout: float):
+        if resource in self._watch_unsupported:
+            return None
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._watch_stream_once(resource, timeout)
+            )
+        except _WatchUnsupported:
+            self._watch_unsupported.add(resource)
+            return None
+        except Exception:  # noqa: BLE001 — transient apiserver errors
+            # pace the retry: an unreachable apiserver must not turn the
+            # dispatcher's watch loop into a hot reconnect spin
+            await asyncio.sleep(min(max(timeout, 0.1), 1.0))
+            return []
+
     async def watch_changed(self, resource: str, timeout: float) -> bool:
-        """Poll a per-collection fingerprint and report a change only
-        when it moved. The fingerprint is the set of item (name,
+        """Watch-stream when the server supports it; otherwise poll a
+        per-collection fingerprint and report a change only when it
+        moved. The fingerprint is the set of item (name,
         resourceVersion) pairs — NOT the list's metadata.resourceVersion,
         which on a real apiserver is the cluster-global etcd revision and
         moves on every unrelated change (node leases, other workloads),
         which would stampede every dispatcher into constant resyncs."""
+        events = await self.watch_events(resource, timeout)
+        if events is not None:
+            return bool(events)
         if not hasattr(self, "_seen_fp"):
             self._seen_fp: dict = {}
         deadline = asyncio.get_running_loop().time() + timeout

@@ -21,6 +21,11 @@ from fluvio_tpu.stream_model.core import MetadataStoreObject, Spec
 S = TypeVar("S", bound=Spec)
 
 
+#: sentinel from watch_events: the event stream lost its place (e.g. a
+#: K8s 410 Gone) — deltas were dropped, a full resync is required
+WATCH_RESYNC = "watch-resync"
+
+
 class MetadataClient:
     """Backend interface. All methods are per-spec-type."""
 
@@ -42,6 +47,14 @@ class MetadataClient:
         """
         await asyncio.sleep(timeout)
         return False
+
+    async def watch_events(self, spec_type: type, timeout: float):
+        """Typed change feed: a list of ("apply", MetadataStoreObject) /
+        ("delete", key) deltas, [] on a quiet timeout, WATCH_RESYNC when
+        the backend lost its place in the stream (the caller must
+        re-list — deltas were dropped), or None when this backend has no
+        event stream (dispatcher uses watch_changed + full resync)."""
+        return None
 
 
 class InMemoryMetadataClient(MetadataClient):

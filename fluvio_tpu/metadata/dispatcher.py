@@ -15,7 +15,7 @@ import logging
 import os
 from typing import Optional
 
-from fluvio_tpu.metadata.client import MetadataClient
+from fluvio_tpu.metadata.client import WATCH_RESYNC, MetadataClient
 from fluvio_tpu.stream_model.store import StoreContext
 
 logger = logging.getLogger(__name__)
@@ -73,6 +73,15 @@ class MetadataDispatcher:
             return  # new local writes raced the read; next wake retries
         self.ctx.store.sync_all(objects)
 
+    def _apply_deltas(self, deltas) -> None:
+        """Incremental store updates from a backend watch stream — no
+        re-list (parity: metadata/k8.rs watch application)."""
+        for kind, payload in deltas:
+            if kind == "apply":
+                self.ctx.store.apply(payload)
+            elif kind == "delete":
+                self.ctx.store.delete(payload)
+
     async def _watch_loop(self) -> None:
         try:
             await self.resync()
@@ -82,13 +91,28 @@ class MetadataDispatcher:
         while not self._stopped:
             try:
                 timeout = max(next_full - asyncio.get_running_loop().time(), 0.01)
-                changed = await self.client.watch_changed(self.spec_type, timeout)
-                if changed or asyncio.get_running_loop().time() >= next_full:
+                deltas = await self.client.watch_events(self.spec_type, timeout)
+                if deltas is None:
+                    # no event stream: changed-hint + full resync
+                    changed = await self.client.watch_changed(
+                        self.spec_type, timeout
+                    )
+                    if changed:
+                        await self.resync()
+                elif deltas == WATCH_RESYNC:
+                    # the stream lost its place (cursor expired): deltas
+                    # were dropped, only a re-list restores consistency
                     await self.resync()
-                    if asyncio.get_running_loop().time() >= next_full:
-                        next_full = (
-                            asyncio.get_running_loop().time() + self.interval
-                        )
+                elif deltas:
+                    if self.ctx.pending_actions() or self._write_inflight:
+                        # local writes racing the stream: a full resync
+                        # (which defers for them) keeps ordering sane
+                        await self.resync()
+                    else:
+                        self._apply_deltas(deltas)
+                if asyncio.get_running_loop().time() >= next_full:
+                    await self.resync()
+                    next_full = asyncio.get_running_loop().time() + self.interval
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -71,3 +71,25 @@ class K8sMetadataClient(MetadataClient):
 
     async def watch_changed(self, spec_type: type, timeout: float) -> bool:
         return await self.api.watch_changed(self._path(spec_type), timeout)
+
+    async def watch_events(self, spec_type: type, timeout: float):
+        """K8s watch events -> typed store deltas (metadata/k8.rs:496:
+        the reference dispatcher applies watch stream updates without
+        re-listing; a None here sends the dispatcher down the
+        changed-hint + resync path)."""
+        from fluvio_tpu.metadata.client import WATCH_RESYNC
+
+        events = await self.api.watch_events(self._path(spec_type), timeout)
+        if events is None or events == WATCH_RESYNC:
+            return events
+        out = []
+        for evt in events:
+            obj = evt.get("object") or {}
+            name = (obj.get("metadata") or {}).get("name")
+            if not name:
+                continue
+            if evt.get("type") == "DELETED":
+                out.append(("delete", name))
+            else:
+                out.append(("apply", from_manifest(spec_type, obj)))
+        return out

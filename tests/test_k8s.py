@@ -11,6 +11,9 @@ the installer are exercised end-to-end without a cluster.
 from __future__ import annotations
 
 import asyncio
+import json
+
+import pytest
 
 from fluvio_tpu.client.admin import FluvioAdmin
 from fluvio_tpu.cluster.k8 import (
@@ -286,3 +289,375 @@ class TestIdConflicts:
                 await sc.stop()
 
         run(body())
+
+
+# -- HttpK8sApi against a recorded-response apiserver ------------------------
+
+
+class _RecordedApiServer:
+    """Minimal in-process apiserver: serves recorded JSON routes over
+    real HTTP (stdlib http.server), asserts auth headers, supports the
+    watch protocol (?watch=1 streams one event then closes). Gives the
+    HttpK8sApi transport — auth, verbs, status subresource, error
+    mapping, watch streaming — coverage without a cluster."""
+
+    def __init__(self, token: str = "secret-token"):
+        import http.server
+        import threading
+
+        self.token = token
+        self.requests: list = []
+        self.watch_events: list = []  # events the next watch call emits
+        self.store: dict = {}  # name -> manifest
+        self.rv = 100
+        srv = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reject_bad_auth(self) -> bool:
+                if self.headers.get("Authorization") != f"Bearer {srv.token}":
+                    self._json(401, {"message": "unauthorized"})
+                    return True
+                return False
+
+            def _json(self, status, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _record(self, body=None):
+                srv.requests.append(
+                    {
+                        "method": self.command,
+                        "path": self.path,
+                        "accept": self.headers.get("Accept", ""),
+                        "content_type": self.headers.get("Content-Type", ""),
+                        "body": body,
+                    }
+                )
+
+            def do_GET(self):
+                self._record()
+                if self._reject_bad_auth():
+                    return
+                if "watch=1" in self.path:
+                    # stream: emit queued events as JSON lines, then close
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    import time as _t
+
+                    deadline = _t.time() + 1.5
+                    while _t.time() < deadline and not srv.watch_events:
+                        _t.sleep(0.02)
+                    for evt in srv.watch_events:
+                        line = (json.dumps(evt) + "\n").encode()
+                        self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+                    srv.watch_events = []
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
+                name = self.path.rsplit("/", 1)[-1].split("?")[0]
+                if name in srv.store:
+                    self._json(200, srv.store[name])
+                elif self.path.split("?")[0].endswith("/topics"):
+                    self._json(
+                        200,
+                        {
+                            "metadata": {"resourceVersion": str(srv.rv)},
+                            "items": list(srv.store.values()),
+                        },
+                    )
+                else:
+                    self._json(404, {"message": "not found"})
+
+            def _read_body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else None
+
+            def do_POST(self):
+                body = self._read_body()
+                self._record(body)
+                if self._reject_bad_auth():
+                    return
+                srv.rv += 1
+                body.setdefault("metadata", {})["resourceVersion"] = str(srv.rv)
+                srv.store[body["metadata"]["name"]] = body
+                self._json(201, body)
+
+            def do_PUT(self):
+                body = self._read_body()
+                self._record(body)
+                if self._reject_bad_auth():
+                    return
+                srv.rv += 1
+                body.setdefault("metadata", {})["resourceVersion"] = str(srv.rv)
+                srv.store[body["metadata"]["name"]] = body
+                self._json(200, body)
+
+            def do_PATCH(self):
+                body = self._read_body()
+                self._record(body)
+                if self._reject_bad_auth():
+                    return
+                name = self.path.rsplit("/", 2)[-2]
+                obj = srv.store.get(name)
+                if obj is None:
+                    self._json(404, {"message": "not found"})
+                    return
+                obj["status"] = body.get("status", {})
+                srv.rv += 1
+                obj["metadata"]["resourceVersion"] = str(srv.rv)
+                self._json(200, obj)
+
+            def do_DELETE(self):
+                self._record()
+                if self._reject_bad_auth():
+                    return
+                name = self.path.rsplit("/", 1)[-1]
+                srv.store.pop(name, None)
+                self._json(200, {"status": "Success"})
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self._httpd.shutdown()
+
+
+class TestHttpK8sApi:
+    RES = "apis/fluvio.infinyon.com/v1/namespaces/default/topics"
+
+    def _api(self, srv):
+        from fluvio_tpu.k8s.api import HttpK8sApi
+
+        return HttpK8sApi(srv.url, token=srv.token)
+
+    def test_crud_status_auth_roundtrip(self):
+        srv = _RecordedApiServer()
+        try:
+            api = self._api(srv)
+
+            async def body():
+                created = await api.apply(
+                    self.RES,
+                    {"metadata": {"name": "t1"}, "spec": {"partitions": 2}},
+                )
+                assert created["metadata"]["resourceVersion"]
+                # second apply of an existing object goes PUT with the rv
+                await api.apply(
+                    self.RES,
+                    {"metadata": {"name": "t1"}, "spec": {"partitions": 3}},
+                )
+                await api.patch_status(self.RES, "t1", {"resolution": "Ok"})
+                got = await api.get(self.RES, "t1")
+                assert got["spec"]["partitions"] == 3
+                assert got["status"] == {"resolution": "Ok"}
+                items = await api.list(self.RES)
+                assert len(items) == 1
+                await api.delete(self.RES, "t1")
+                assert await api.get(self.RES, "t1") is None
+
+            run(body())
+            methods = [r["method"] for r in srv.requests]
+            assert "POST" in methods and "PUT" in methods
+            patch = next(r for r in srv.requests if r["method"] == "PATCH")
+            assert patch["content_type"] == "application/merge-patch+json"
+            assert patch["path"].endswith("/t1/status")
+            assert all(
+                r["method"] != "POST" or r["path"].endswith("/topics")
+                for r in srv.requests
+            )
+        finally:
+            srv.close()
+
+    def test_bad_token_maps_to_api_error(self):
+        from fluvio_tpu.k8s.api import HttpK8sApi, K8sApiError
+
+        srv = _RecordedApiServer()
+        try:
+            api = HttpK8sApi(srv.url, token="wrong")
+
+            async def body():
+                with pytest.raises(K8sApiError) as ei:
+                    await api.list(self.RES)
+                assert ei.value.status == 401
+
+            run(body())
+        finally:
+            srv.close()
+
+    def test_watch_stream_delivers_event(self):
+        srv = _RecordedApiServer()
+        try:
+            api = self._api(srv)
+            srv.watch_events = [
+                {
+                    "type": "MODIFIED",
+                    "object": {
+                        "metadata": {"name": "t1", "resourceVersion": "222"},
+                        "spec": {"partitions": 5},
+                    },
+                }
+            ]
+
+            async def body():
+                events = await api.watch_events(self.RES, timeout=3.0)
+                assert events and events[0]["object"]["spec"]["partitions"] == 5
+                # cursor advanced to the event's resourceVersion
+                assert api._watch_rv[self.RES] == "222"
+
+            run(body())
+            watch_req = [r for r in srv.requests if "watch=1" in r["path"]]
+            assert watch_req and "resourceVersion=" in watch_req[0]["path"]
+        finally:
+            srv.close()
+
+    def test_dispatcher_applies_watch_event_without_resync(self):
+        """The dispatcher must ingest a watch delta into its store with
+        NO re-list: after the initial resync, the only GETs the server
+        sees are watch requests."""
+        from fluvio_tpu.k8s.api import HttpK8sApi
+        from fluvio_tpu.metadata.dispatcher import MetadataDispatcher
+        from fluvio_tpu.metadata.k8 import K8sMetadataClient
+        from fluvio_tpu.metadata.topic import TopicSpec
+        from fluvio_tpu.stream_model.store import StoreContext
+
+        srv = _RecordedApiServer()
+        try:
+            api = self._api(srv)
+            client = K8sMetadataClient(api)
+            ctx = StoreContext(TopicSpec)
+
+            async def body():
+                dispatcher = MetadataDispatcher(
+                    client, ctx, reconcile_interval=30.0
+                )
+                dispatcher.start()
+                await asyncio.sleep(0.3)  # initial resync done
+                lists_before = len(
+                    [r for r in srv.requests
+                     if r["method"] == "GET" and "watch=1" not in r["path"]]
+                )
+                srv.watch_events = [
+                    {
+                        "type": "ADDED",
+                        "object": {
+                            "metadata": {"name": "tw", "resourceVersion": "300"},
+                            "spec": {"replicas": {"partitions": 4}},
+                        },
+                    }
+                ]
+                for _ in range(100):
+                    if ctx.store.value("tw") is not None:
+                        break
+                    await asyncio.sleep(0.05)
+                obj = ctx.store.value("tw")
+                assert obj is not None, "watch delta never reached the store"
+                lists_after = len(
+                    [r for r in srv.requests
+                     if r["method"] == "GET" and "watch=1" not in r["path"]]
+                )
+                assert lists_after == lists_before, "dispatcher re-listed"
+                await dispatcher.stop()
+
+            run(body())
+        finally:
+            srv.close()
+
+
+class TestWatchRecovery:
+    RES = TestHttpK8sApi.RES
+
+    def test_410_gone_forces_resync_signal(self):
+        """An expired watch cursor lost events: the api must return the
+        WATCH_RESYNC sentinel, not a quiet empty window."""
+        import http.server
+        import threading
+
+        from fluvio_tpu.k8s.api import HttpK8sApi
+        from fluvio_tpu.metadata.client import WATCH_RESYNC
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if "watch=1" in self.path:
+                    body = b'{"message":"too old resource version"}'
+                    self.send_response(410)
+                else:
+                    body = b'{"metadata":{"resourceVersion":"5"},"items":[]}'
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            api = HttpK8sApi(f"http://127.0.0.1:{httpd.server_address[1]}")
+
+            async def body():
+                got = await api.watch_events(self.RES, timeout=1.0)
+                assert got == WATCH_RESYNC
+                # cursor dropped: the next call re-lists for a fresh one
+                assert self.RES not in api._watch_rv
+
+            run(body())
+        finally:
+            httpd.shutdown()
+
+    def test_transient_5xx_does_not_disable_watch(self):
+        import http.server
+        import threading
+
+        from fluvio_tpu.k8s.api import HttpK8sApi
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if "watch=1" in self.path:
+                    body = b'{"message":"leader election"}'
+                    self.send_response(503)
+                else:
+                    body = b'{"metadata":{"resourceVersion":"5"},"items":[]}'
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            api = HttpK8sApi(f"http://127.0.0.1:{httpd.server_address[1]}")
+
+            async def body():
+                got = await api.watch_events(self.RES, timeout=0.2)
+                assert got == []  # transient, paced
+                assert self.RES not in api._watch_unsupported
+
+            run(body())
+        finally:
+            httpd.shutdown()
