@@ -1,0 +1,29 @@
+"""Shared example harness: run the sample against --addr, or boot an
+in-process single-SPU broker when --embedded is passed so the samples
+work with zero setup."""
+
+import tempfile
+
+
+async def maybe_embedded(main, args, topics=()):
+    if not args.embedded:
+        await main(args.addr)
+        return
+    from fluvio_tpu.spu import SpuConfig, SpuServer
+    from fluvio_tpu.storage.config import ReplicaConfig
+
+    tmp = tempfile.mkdtemp(prefix="fluvio-example-")
+    config = SpuConfig(
+        id=5001,
+        public_addr="127.0.0.1:0",
+        log_base_dir=tmp,
+        replication=ReplicaConfig(base_dir=tmp),
+    )
+    server = SpuServer(config)
+    await server.start()
+    for topic in topics:
+        server.ctx.create_replica(topic, 0)
+    try:
+        await main(server.public_addr)
+    finally:
+        await server.stop()

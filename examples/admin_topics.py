@@ -1,0 +1,32 @@
+"""Admin API: create, list, describe, and delete topics (parity: the
+reference's fluvio-admin examples). Needs an SC (start one with
+`python -m fluvio_tpu.cli cluster start --local`).
+
+    python examples/admin_topics.py --sc 127.0.0.1:9103
+"""
+
+import argparse
+import asyncio
+
+from fluvio_tpu.client.admin import FluvioAdmin
+from fluvio_tpu.metadata.topic import TopicSpec
+
+
+async def main(sc_addr: str) -> None:
+    admin = await FluvioAdmin.connect(sc_addr)
+    await admin.create_topic("demo-topic", TopicSpec.computed(2))
+    print("created demo-topic (2 partitions)")
+    for obj in await admin.list("topic"):
+        rs = obj.spec.replicas
+        partitions = len(rs.maps) if rs.is_assigned() else rs.partitions
+        print(f"  topic {obj.key}: partitions={partitions}")
+    await admin.delete("demo-topic", "topic")
+    print("deleted demo-topic")
+    await admin.close()
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sc", default="127.0.0.1:9103")
+    args = parser.parse_args()
+    asyncio.run(main(args.sc))

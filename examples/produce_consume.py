@@ -1,0 +1,49 @@
+"""Produce then consume a few records (parity: the reference's
+examples/00-produce + 01-consume samples).
+
+Run against a running cluster:
+
+    python -m fluvio_tpu.cli cluster start --local
+    python examples/produce_consume.py
+
+or fully self-contained with an embedded broker:
+
+    python examples/produce_consume.py --embedded
+"""
+
+import argparse
+import asyncio
+
+from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset
+
+from _embedded import maybe_embedded  # shared example harness
+
+
+async def main(addr: str) -> None:
+    client = await Fluvio.connect(addr)
+    producer = await client.topic_producer("hello-topic", num_partitions=1)
+    futures = [
+        await producer.send(f"key-{i}".encode(), f"value-{i}".encode())
+        for i in range(5)
+    ]
+    await producer.flush()
+    for f in futures:
+        meta = await f.wait()
+        print(f"produced at offset {meta.offset}")
+
+    consumer = await client.partition_consumer("hello-topic", 0)
+    async for record in consumer.stream(
+        Offset.beginning(), ConsumerConfig(disable_continuous=True)
+    ):
+        key = record.key.decode() if record.key else None
+        print(f"consumed offset={record.offset} key={key} value={record.value.decode()}")
+    await client.close()
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", default="127.0.0.1:9003")
+    parser.add_argument("--embedded", action="store_true",
+                        help="boot an in-process broker for this demo")
+    args = parser.parse_args()
+    asyncio.run(maybe_embedded(main, args, topics=["hello-topic"]))

@@ -1,0 +1,66 @@
+"""Consume through an ad-hoc SmartModule chain (filter + map), the
+engine's north-star path (parity: the reference's smartmodule consume
+examples).
+
+    python examples/smartmodule_consume.py --embedded
+"""
+
+import argparse
+import asyncio
+
+from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset
+from fluvio_tpu.schema.smartmodule import (
+    SmartModuleInvocation,
+    SmartModuleInvocationKind,
+    SmartModuleInvocationWasm,
+)
+
+from _embedded import maybe_embedded
+
+FILTER_SM = b"""
+@smartmodule.filter(dsl=dsl.FilterProgram(
+    predicate=dsl.Contains(arg=dsl.Value(), literal=b"keep")))
+def keep_only(record):
+    return b"keep" in record.value
+"""
+
+MAP_SM = b"""
+@smartmodule.map(dsl=dsl.MapProgram(value=dsl.Upper(arg=dsl.Value())))
+def upper(record):
+    return record.value.upper()
+"""
+
+
+async def main(addr: str) -> None:
+    client = await Fluvio.connect(addr)
+    producer = await client.topic_producer("events", num_partitions=1)
+    for i in range(6):
+        word = "keep" if i % 2 else "drop"
+        await producer.send(b"", f"{word}-event-{i}".encode())
+    await producer.flush()
+
+    config = ConsumerConfig(
+        disable_continuous=True,
+        smartmodules=[
+            SmartModuleInvocation(
+                wasm=SmartModuleInvocationWasm.adhoc(FILTER_SM),
+                kind=SmartModuleInvocationKind.FILTER,
+            ),
+            SmartModuleInvocation(
+                wasm=SmartModuleInvocationWasm.adhoc(MAP_SM),
+                kind=SmartModuleInvocationKind.MAP,
+            ),
+        ],
+    )
+    consumer = await client.partition_consumer("events", 0)
+    async for record in consumer.stream(Offset.beginning(), config):
+        print(f"offset={record.offset} value={record.value.decode()}")
+    await client.close()
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", default="127.0.0.1:9003")
+    parser.add_argument("--embedded", action="store_true")
+    args = parser.parse_args()
+    asyncio.run(maybe_embedded(main, args, topics=["events"]))

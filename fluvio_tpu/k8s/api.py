@@ -325,9 +325,12 @@ class HttpK8sApi(K8sApi):
                 # caller must resync, not treat this as a quiet window
                 self._watch_rv.pop(resource, None)
                 return WATCH_RESYNC
-            if 400 <= resp.status < 500:
+            if resp.status in (400, 404, 405, 501):
+                # the server does not speak the watch verb here
                 raise _WatchUnsupported(resp.status)
-            if resp.status >= 500:
+            if resp.status >= 400:
+                # 401/403/429/5xx: transient (token rotation, throttling,
+                # leader elections) — retry paced, never disable
                 raise K8sApiError(resp.status, "watch failed (transient)")
             conn.sock.settimeout(max(timeout, 0.05))
             events: List[dict] = []
@@ -350,9 +353,10 @@ class HttpK8sApi(K8sApi):
                 if etype == "BOOKMARK":
                     continue
                 if etype == "ERROR":
-                    # e.g. in-stream 410: the gap's events are lost
+                    # e.g. in-stream 410: the gap's events are lost, and
+                    # a resync supersedes anything buffered before it
                     self._watch_rv.pop(resource, None)
-                    return WATCH_RESYNC if not events else events
+                    return WATCH_RESYNC
                 events.append(evt)
                 # deliver promptly, but drain whatever the server has
                 # already buffered first — one reconnect per BATCH of
@@ -365,6 +369,11 @@ class HttpK8sApi(K8sApi):
     async def watch_events(self, resource: str, timeout: float):
         if resource in self._watch_unsupported:
             return None
+        # cap the blocking window: the executor thread cannot be
+        # cancelled, so a long quiet watch would pin a thread and stall
+        # process shutdown for the whole reconcile horizon; the
+        # dispatcher loops, so short windows just mean more cheap calls
+        timeout = min(timeout, 10.0)
         try:
             return await asyncio.get_running_loop().run_in_executor(
                 None, lambda: self._watch_stream_once(resource, timeout)

@@ -2,9 +2,11 @@
 
 Capability parity: the `fluvio-compression` crate (gzip/snappy/lz4/zstd,
 fluvio-compression/src/lib.rs). Codec ids live in the low 3 bits of the
-batch attributes word. gzip (zlib) and zstd are always available in this
-environment; lz4/snappy are gated — attempting to use a missing codec raises
-``UnsupportedCompression`` at call time, never at import time.
+batch attributes word. All four codecs are always available: gzip (zlib)
+and zstd natively, lz4 and snappy through the native wheels when
+installed and otherwise through the bundled pure-Python implementations
+(protocol/lz4_py.py frame codec, protocol/snappy_py.py raw codec) — a
+reference-produced lz4/snappy topic is consumable in any environment.
 """
 
 from __future__ import annotations
@@ -43,12 +45,12 @@ except ImportError:  # pragma: no cover
 try:
     import lz4.frame as _lz4  # type: ignore
 except ImportError:
-    _lz4 = None
+    from fluvio_tpu.protocol import lz4_py as _lz4  # pure-Python fallback
 
 try:
     import snappy as _snappy  # type: ignore
 except ImportError:
-    _snappy = None
+    from fluvio_tpu.protocol import snappy_py as _snappy  # pure-Python fallback
 
 
 def compress(codec: Compression, data: bytes) -> bytes:
@@ -61,12 +63,8 @@ def compress(codec: Compression, data: bytes) -> bytes:
             raise UnsupportedCompression("zstd not available")
         return _ZSTD_C.compress(data)
     if codec == Compression.LZ4:
-        if _lz4 is None:
-            raise UnsupportedCompression("lz4 not available in this environment")
         return _lz4.compress(data)
     if codec == Compression.SNAPPY:
-        if _snappy is None:
-            raise UnsupportedCompression("snappy not available in this environment")
         return _snappy.compress(data)
     raise UnsupportedCompression(f"unknown codec {codec}")
 
@@ -81,11 +79,7 @@ def decompress(codec: Compression, data: bytes) -> bytes:
             raise UnsupportedCompression("zstd not available")
         return _ZSTD_D.decompress(data)
     if codec == Compression.LZ4:
-        if _lz4 is None:
-            raise UnsupportedCompression("lz4 not available in this environment")
         return _lz4.decompress(data)
     if codec == Compression.SNAPPY:
-        if _snappy is None:
-            raise UnsupportedCompression("snappy not available in this environment")
         return _snappy.decompress(data)
     raise UnsupportedCompression(f"unknown codec {codec}")

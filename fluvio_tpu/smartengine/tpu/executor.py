@@ -1301,7 +1301,13 @@ class TpuChainExecutor:
     def process(
         self, inp: SmartModuleInput, metrics: Optional[SmartModuleChainMetrics] = None
     ) -> SmartModuleOutput:
-        buf = RecordBuffer.from_smartmodule_input(inp)
+        try:
+            buf = RecordBuffer.from_smartmodule_input(inp)
+        except ValueError as e:
+            # a record wider than MAX_WIDTH cannot stage into the padded
+            # device layout: spill to the interpreter (same surface as a
+            # device-detected transform error), never crash the chain
+            raise TpuSpill(str(e)) from None
         out = self.process_buffer(buf)
         if self.agg_configs:
             self._ensure_host_state()
