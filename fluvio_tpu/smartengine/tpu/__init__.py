@@ -34,11 +34,12 @@ _repo_cache = os.path.join(os.path.dirname(__file__), "..", "..", "..", ".xla_ca
 _cache_dir = os.environ.get(
     "FLUVIO_TPU_XLA_CACHE", os.path.abspath(_repo_cache)
 )
-if _cache_dir != "off":
+#: the resolved persistent-cache directory ("" when disabled) — the single
+#: source of truth; bench.py reads this for its cache-evidence section
+XLA_CACHE_DIR = "" if _cache_dir == "off" else os.path.expanduser(_cache_dir)
+if XLA_CACHE_DIR:
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir", os.path.expanduser(_cache_dir)
-        )
+        jax.config.update("jax_compilation_cache_dir", XLA_CACHE_DIR)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # noqa: BLE001 — older jax without these flags
