@@ -114,7 +114,10 @@ class SmartModuleChainBuilder:
                 # init is user code too: a looping init must become a
                 # typed chain-init error, not a wedged chain build
                 run_metered(
-                    inst.call_init, engine.hook_budget_ms, entry.module.name
+                    inst.call_init,
+                    engine.hook_budget_ms,
+                    entry.module.name,
+                    key=getattr(entry.module, "meter_key", ""),
                 )
             except Exception as e:  # noqa: BLE001 — user code boundary
                 raise SmartModuleChainInitError(
@@ -277,6 +280,7 @@ class SmartModuleChainInstance:
                     lambda: instance.process(next_input, metrics),
                     budget,
                     getattr(instance.module, "name", "smartmodule"),
+                    key=getattr(instance.module, "meter_key", ""),
                 )
             except SmartModuleFuelError as e:
                 output = SmartModuleOutput()
@@ -285,7 +289,12 @@ class SmartModuleChainInstance:
                     offset=base_offset,
                     kind=instance.kind,
                 )
-                if e.abandoned:
+                # abandoned: the hook thread is still running. Stateful
+                # (aggregate) instances poison on ANY trap: the injected
+                # exception lands at an arbitrary bytecode boundary, so
+                # the accumulator may be half-mutated even when the hook
+                # unwound cleanly.
+                if e.abandoned or instance.kind is SmartModuleKind.AGGREGATE:
                     self._poisoned = output.error
                 break
             if output.error is not None:
@@ -338,6 +347,7 @@ class SmartModuleChainInstance:
                     lambda: instance.call_look_back(records),
                     scale_budget(self.engine.hook_budget_ms, len(records)),
                     getattr(instance.module, "name", "smartmodule"),
+                    getattr(instance.module, "meter_key", ""),
                 )
             except SmartModuleFuelError as e:
                 if e.abandoned:

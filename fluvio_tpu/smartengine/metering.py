@@ -31,19 +31,56 @@ from typing import Callable
 #: how long to keep re-injecting before abandoning the hook thread
 _KILL_GRACE_SECONDS = 5.0
 
-#: hard ceiling on live abandoned hook threads process-wide; past it,
-#: metered execution is refused outright (fail-fast typed error) so a
-#: hostile module cannot accumulate spinners until the GIL starves
+#: per-module ceiling on live abandoned hook threads: past it, only THAT
+#: module's metered execution is refused. Matches the reference's
+#: per-instance trap isolation (wasmtime/state.rs:40-55): one hostile
+#: module must never take down well-behaved modules' hooks.
+_MODULE_ABANDONED_LIMIT = 4
+
+#: hard ceiling process-wide — a last-resort circuit breaker against many
+#: DISTINCT hostile modules (each under its own per-module limit)
+#: accumulating spinners until the GIL starves. Unlike the per-module
+#: limit this refuses ALL metered execution; its state is visible in the
+#: SPU monitoring socket so an operator can see why.
 _ABANDONED_LIMIT = 16
 
 _abandoned_lock = threading.Lock()
-_abandoned_threads: list = []
+#: module key -> list of live abandoned hook threads
+_abandoned_by_module: dict = {}
 
 
-def _live_abandoned() -> int:
+def _prune_dead_locked() -> None:
+    for key in list(_abandoned_by_module):
+        live = [t for t in _abandoned_by_module[key] if t.is_alive()]
+        if live:
+            _abandoned_by_module[key] = live
+        else:
+            del _abandoned_by_module[key]
+
+
+def _live_abandoned(key: str) -> tuple:
+    """(this module's live abandoned count, process-wide total)."""
     with _abandoned_lock:
-        _abandoned_threads[:] = [t for t in _abandoned_threads if t.is_alive()]
-        return len(_abandoned_threads)
+        _prune_dead_locked()
+        total = sum(len(v) for v in _abandoned_by_module.values())
+        return len(_abandoned_by_module.get(key, ())), total
+
+
+def quarantine_state() -> dict:
+    """Operator-visible quarantine snapshot (served by the SPU
+    monitoring socket and `fluvio-tpu metrics`)."""
+    with _abandoned_lock:
+        _prune_dead_locked()
+        per_module = {k: len(v) for k, v in _abandoned_by_module.items()}
+    total = sum(per_module.values())
+    return {
+        "abandoned_hook_threads": total,
+        "by_module": per_module,
+        "quarantined_modules": sorted(
+            k for k, n in per_module.items() if n >= _MODULE_ABANDONED_LIMIT
+        ),
+        "process_circuit_broken": total >= _ABANDONED_LIMIT,
+    }
 
 
 def scale_budget(budget_ms: int, n_records: int) -> int:
@@ -69,12 +106,19 @@ class SmartModuleFuelError(Exception):
         name: str = "smartmodule",
         budget_ms: int = 0,
         abandoned: bool = False,
-        quarantined: bool = False,
+        quarantined: str = "",
     ):
-        if quarantined:
+        if quarantined == "module":
             msg = (
-                f"SmartModule {name!r} refused: too many abandoned hook "
-                f"threads ({_ABANDONED_LIMIT}) — hook metering quarantined"
+                f"SmartModule {name!r} refused: this module abandoned "
+                f"{_MODULE_ABANDONED_LIMIT}+ hook threads — quarantined "
+                f"while they stay alive (other modules keep running)"
+            )
+        elif quarantined == "process":
+            msg = (
+                f"SmartModule {name!r} refused: {_ABANDONED_LIMIT}+ "
+                f"abandoned hook threads process-wide — metering circuit "
+                f"breaker open (see quarantine state in SPU monitoring)"
             )
         else:
             msg = f"SmartModule {name!r} exceeded its execution budget" + (
@@ -87,13 +131,26 @@ class SmartModuleFuelError(Exception):
         self.quarantined = quarantined
 
 
-def run_metered(fn: Callable, budget_ms: int, name: str = "smartmodule"):
+def run_metered(
+    fn: Callable,
+    budget_ms: int,
+    name: str = "smartmodule",
+    key: str = "",
+):
     """Run ``fn()`` with a wall-clock budget; raise SmartModuleFuelError
-    if it does not finish in time. ``budget_ms <= 0`` runs unmetered."""
+    if it does not finish in time. ``budget_ms <= 0`` runs unmetered.
+
+    ``key`` is the module's stable identity (source hash when available,
+    else its name) — abandonment is tracked per key so quarantine stays
+    scoped to the offending module."""
     if budget_ms <= 0:
         return fn()
-    if _live_abandoned() >= _ABANDONED_LIMIT:
-        raise SmartModuleFuelError(name, budget_ms, quarantined=True)
+    key = key or name
+    mine, total = _live_abandoned(key)
+    if mine >= _MODULE_ABANDONED_LIMIT:
+        raise SmartModuleFuelError(name, budget_ms, quarantined="module")
+    if total >= _ABANDONED_LIMIT:
+        raise SmartModuleFuelError(name, budget_ms, quarantined="process")
     box: dict = {}
     done = threading.Event()
 
@@ -119,7 +176,7 @@ def run_metered(fn: Callable, budget_ms: int, name: str = "smartmodule"):
         abandoned = not done.is_set()
         if abandoned:
             with _abandoned_lock:
-                _abandoned_threads.append(t)
+                _abandoned_by_module.setdefault(key, []).append(t)
         raise SmartModuleFuelError(name, budget_ms, abandoned=abandoned)
     err = box.get("error")
     if err is not None:

@@ -28,6 +28,7 @@ to JAX kernels, and tests assert DSL-vs-Python equivalence.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Dict, List, Optional
@@ -40,6 +41,11 @@ class SmartModuleDef:
     """A compiled SmartModule: hooks by kind + optional DSL programs."""
 
     name: str = "adhoc"
+    #: stable identity for metering quarantine: the source hash when the
+    #: module came from payload bytes, else the name. Names collide
+    #: (every adhoc invocation defaults to "adhoc"), hashes do not — a
+    #: quarantine keyed on this stays scoped to the hostile module.
+    meter_key: str = ""
     hooks: Dict[SmartModuleKind, Callable] = dc_field(default_factory=dict)
     dsl: Dict[SmartModuleKind, Any] = dc_field(default_factory=dict)
 
@@ -158,6 +164,7 @@ def load_source(source: str | bytes, name: str = "adhoc") -> SmartModuleDef:
     exec(code, namespace)
     module = current_module(reset=True)
     module.name = name
+    module.meter_key = hashlib.sha256(source.encode("utf-8")).hexdigest()[:16]
     module.transform_kind()  # validate: must export a transform
     return module
 

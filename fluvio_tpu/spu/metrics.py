@@ -31,10 +31,16 @@ class SpuMetrics:
     smartmodule: SmartModuleChainMetrics = field(default_factory=SmartModuleChainMetrics)
 
     def to_dict(self) -> dict:
+        from fluvio_tpu.smartengine.metering import quarantine_state
+
         return {
             "inbound": self.inbound.to_dict(),
             "outbound": self.outbound.to_dict(),
             "smartmodule": self.smartmodule.to_dict(),
+            # which modules are quarantined (abandoned hook threads) and
+            # whether the process-wide circuit breaker is open — the
+            # operator's view into why a module's streams error out
+            "hook_quarantine": quarantine_state(),
         }
 
     def to_json(self) -> str:

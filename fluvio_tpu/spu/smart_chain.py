@@ -246,8 +246,18 @@ def acquire_stream_chain(
     if cacheable:
         chain = ctx.stream_chains.get(key)
         if chain is not None:
-            ctx.stream_chains.move_to_end(key)
-            return chain
+            if getattr(chain, "_poisoned", None) is not None:
+                # a fuel trap poisoned this chain (abandoned hook thread
+                # or trapped stateful instance); never serve it to new
+                # streams — rebuild instead. A module that traps cleanly
+                # every time pays chain build + its budget per stream,
+                # matching the reference, where each stream instantiates
+                # the wasm and burns fuel to the trap; only ABANDONED
+                # threads escalate to the per-module quarantine.
+                del ctx.stream_chains[key]
+            else:
+                ctx.stream_chains.move_to_end(key)
+                return chain
     chain = build_chain(invocations, ctx, version)
     tpu = getattr(chain, "tpu_chain", None)
     if (

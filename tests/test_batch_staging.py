@@ -573,3 +573,22 @@ class TestStreamChainCache:
             {"regex": "fluvio"}, lookback_last=5,
         )
         assert acquire_stream_chain(inv, ctx) is not acquire_stream_chain(inv, ctx)
+
+    def test_poisoned_chain_evicted_from_cache(self):
+        """A cached chain that a fuel trap poisoned must never be served
+        to a new stream: the cache hit drops the entry and rebuilds
+        (ADVICE r4 medium)."""
+        from fluvio_tpu.schema.smartmodule import SmartModuleInvocationKind
+        from fluvio_tpu.spu.smart_chain import acquire_stream_chain
+
+        ctx = self._ctx()
+        inv = self._inv(
+            FILTER_SRC, SmartModuleInvocationKind.FILTER, {"regex": "fluvio"}
+        )
+        c1 = acquire_stream_chain(inv, ctx, version=23)
+        c1._poisoned = object()  # what an abandoned fuel trap sets
+        c2 = acquire_stream_chain(inv, ctx, version=23)
+        assert c2 is not c1
+        assert c2._poisoned is None
+        # the fresh chain replaced the poisoned entry in the cache
+        assert acquire_stream_chain(inv, ctx, version=23) is c2

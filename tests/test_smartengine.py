@@ -445,6 +445,125 @@ def stubborn(record):
     def test_unmetered_by_default_in_library(self):
         assert SmartEngine().hook_budget_ms == 0
 
+    def test_quarantine_is_per_module(self, monkeypatch):
+        """Module A abandoning its hook-thread limit quarantines ONLY A;
+        module B still executes metered (reference parity: per-instance
+        trap isolation, wasmtime/state.rs:40-55)."""
+        import threading
+
+        from fluvio_tpu.smartengine import metering as m
+
+        monkeypatch.setattr(m, "_KILL_GRACE_SECONDS", 0.2)
+        releases = []
+
+        def hang():
+            # Event.wait blocks inside C, so async-exc injection cannot
+            # land: the watchdog must abandon the thread every time
+            ev = threading.Event()
+            releases.append(ev)
+            ev.wait()
+
+        try:
+            for _ in range(m._MODULE_ABANDONED_LIMIT):
+                with pytest.raises(m.SmartModuleFuelError) as ei:
+                    m.run_metered(hang, 50, "mod-a", key="key-a")
+                assert ei.value.abandoned
+            # module A is now refused without entering user code
+            with pytest.raises(m.SmartModuleFuelError) as ei:
+                m.run_metered(hang, 50, "mod-a", key="key-a")
+            assert ei.value.quarantined == "module"
+            # module B is untouched
+            assert m.run_metered(lambda: 42, 500, "mod-b", key="key-b") == 42
+            state = m.quarantine_state()
+            assert "key-a" in state["quarantined_modules"]
+            assert state["process_circuit_broken"] is False
+            assert state["by_module"]["key-a"] == m._MODULE_ABANDONED_LIMIT
+        finally:
+            for ev in releases:
+                ev.set()
+
+    def test_process_circuit_breaker_last_resort(self, monkeypatch):
+        """Many DISTINCT modules abandoning threads trip the process-wide
+        breaker: all metered execution is refused with a typed error
+        naming the breaker (operator-visible via quarantine_state)."""
+        import threading
+
+        from fluvio_tpu.smartengine import metering as m
+
+        monkeypatch.setattr(m, "_KILL_GRACE_SECONDS", 0.2)
+        monkeypatch.setattr(m, "_ABANDONED_LIMIT", 2)
+        releases = []
+
+        def hang():
+            ev = threading.Event()
+            releases.append(ev)
+            ev.wait()
+
+        try:
+            for key in ("cb-1", "cb-2"):
+                with pytest.raises(m.SmartModuleFuelError):
+                    m.run_metered(hang, 50, key, key=key)
+            with pytest.raises(m.SmartModuleFuelError) as ei:
+                m.run_metered(lambda: 1, 500, "cb-innocent", key="cb-innocent")
+            assert ei.value.quarantined == "process"
+            assert m.quarantine_state()["process_circuit_broken"] is True
+        finally:
+            for ev in releases:
+                ev.set()
+
+    def test_quarantine_visible_in_spu_metrics(self):
+        from fluvio_tpu.spu.metrics import SpuMetrics
+
+        d = SpuMetrics().to_dict()
+        assert "hook_quarantine" in d
+        assert set(d["hook_quarantine"]) == {
+            "abandoned_hook_threads",
+            "by_module",
+            "quarantined_modules",
+            "process_circuit_broken",
+        }
+
+    def test_module_identity_is_source_hash(self):
+        """Adhoc modules all default to the same name; the meter key must
+        come from the payload so quarantine cannot cross modules."""
+        from fluvio_tpu.smartmodule.sdk import load_source
+
+        a = load_source("@smartmodule.filter\ndef f(r):\n    return True\n")
+        b = load_source("@smartmodule.filter\ndef f(r):\n    return False\n")
+        assert a.meter_key and b.meter_key
+        assert a.meter_key != b.meter_key
+        # same source -> same key (quarantine survives chain rebuilds)
+        a2 = load_source("@smartmodule.filter\ndef f(r):\n    return True\n")
+        assert a2.meter_key == a.meter_key
+
+    def test_aggregate_fuel_trap_poisons_chain(self):
+        """An injected fuel error can land mid-accumulator-update: any
+        trap on a stateful instance poisons the chain (ADVICE r4) so
+        half-mutated state is never served."""
+        src = b"""
+@smartmodule.aggregate
+def agg(acc, record):
+    # pure-bytecode loop: async-exc injection lands and unwinds the
+    # hook cleanly, so the trap is NOT abandoned (the previously
+    # unpoisoned case)
+    n = 0
+    while True:
+        n += 1
+    return acc
+"""
+        engine = SmartEngine(backend="python", hook_budget_ms=100)
+        chain = build_chain((src, SmartModuleConfig()), engine=engine)
+        out = chain.process(make_input(b"1"))
+        assert out.error is not None
+        # the trap unwound cleanly (not abandoned) but the chain must
+        # still fail fast: the accumulator may be inconsistent
+        import time as _t
+
+        t0 = _t.time()
+        out2 = chain.process(make_input(b"2"))
+        assert out2.error is not None
+        assert _t.time() - t0 < 1.0
+
     def test_looping_init_is_chain_init_error(self):
         engine = SmartEngine(backend="python", hook_budget_ms=200)
         with pytest.raises(SmartModuleChainInitError) as ei:
