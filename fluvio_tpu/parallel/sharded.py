@@ -42,9 +42,12 @@ class ShardedChainExecutor:
     explode outputs into its own capacity block, the per-shard exact
     totals ride the stacked headers, and a shard whose total exceeds
     its capacity triggers one bigger-capacity retry (mirroring the
-    single-device learned-capacity loop). Fan-out combined with an
-    aggregate stays single-device: the overflow retry would have to
-    roll back carries that other shards already advanced.
+    single-device learned-capacity loop). Fan-out composed with an
+    aggregate (explode -> count/sum, reference transforms/mod.rs:24-52
+    composes all kinds freely) shards too: the handle snapshots the
+    pre-dispatch carries, and an overflow retry rolls the cross-shard
+    carry chain back to that snapshot before re-dispatching, so the
+    abandoned first pass can never double-apply.
 
     Aggregate carries chain at DISPATCH time through device futures
     (`_pending_carries`), so `process_stream` pipelines sharded
@@ -57,11 +60,6 @@ class ShardedChainExecutor:
         if len(devs) < n_devices:
             raise ValueError(
                 f"mesh_devices={n_devices} but only {len(devs)} jax devices"
-            )
-        if executor._fanout and executor.agg_configs:
-            raise ValueError(
-                "array_map + aggregate chains are not sharded (capacity "
-                "retry cannot roll back cross-shard carries)"
             )
         self.executor = executor
         self.n = n_devices
@@ -155,10 +153,14 @@ class ShardedChainExecutor:
             cols = [state["agg_out_int"]]
             if windowed:
                 cols.append(state["agg_win_int"])
+            if ex._fanout:  # survivor recovery for explode -> aggregate
+                cols.append(state["src_row"])
             _, compacted = kernels.compact_rows(valid, *cols)
             packed["agg_int"] = compacted[0]
             if windowed:
                 packed["agg_win"] = compacted[1]
+            if ex._fanout:
+                packed["src_row"] = compacted[-1]
             return header(jnp.int32(0), jnp.int32(0)), packed, carries
         cols = [
             state["values"],
@@ -232,7 +234,8 @@ class ShardedChainExecutor:
                 out["mask"] = row
             return out
         if ex._int_output:
-            out = {"mask": row, "agg_int": row}
+            out = {"agg_int": row}
+            out["src_row" if ex._fanout else "mask"] = row
             if bool(ex.stages[-1].window_ms):
                 out["agg_win"] = row
             return out
@@ -408,15 +411,22 @@ class ShardedChainExecutor:
         width = buf.width
         if ex._fanout:
             if hdrs[:, 3].any():
+                # carries the abandoned dispatch advanced roll back to
+                # the handle's snapshot before the interpreter re-runs
+                self._pending_carries = _prev
                 raise TpuSpill("array_map transform error: interpreter decides")
             totals = hdrs[:, 4].astype(np.int64)
             if int(totals.max()) > cap_shard:
                 # one bigger-capacity retry at the exact (bucketed)
-                # per-shard maximum; stateless by construction, so the
-                # abandoned first dispatch has no carries to roll back.
-                # Learn from the PER-SHARD peak (scaled to a global
-                # total), not the global sum: a persistently skewed
-                # stream would otherwise overflow-and-retry every batch
+                # per-shard maximum. An aggregate downstream of the
+                # explode advanced the cross-shard carry chain on the
+                # abandoned dispatch: restore the handle's pre-dispatch
+                # snapshot first so the retry chains from clean state
+                # and can never double-apply. Learn from the PER-SHARD
+                # peak (scaled to a global total), not the global sum:
+                # a persistently skewed stream would otherwise
+                # overflow-and-retry every batch
+                self._pending_carries = _prev
                 ex._learn_cap(buf, int(totals.max()) * self.n)
                 self.fanout_retries += 1
                 retry_cap = ex._bucket_bytes(int(totals.max()), 8)
@@ -424,6 +434,7 @@ class ShardedChainExecutor:
                 _prev, new_carries, header, packed, cap_shard = handle
                 hdrs = np.asarray(jax.device_get(header))
                 if int(hdrs[:, 4].max()) > cap_shard:  # pragma: no cover
+                    self._pending_carries = _prev
                     raise TpuSpill(
                         f"fanout overflow after retry: {int(hdrs[:, 4].max())}"
                     )

@@ -185,6 +185,14 @@ class _ArrayMapStage:
         )
         new_state["fan_total"] = total
         new_state["fan_err"] = jnp.any(err_v)
+        ax = ctx.get("axis_name")
+        if ax is not None:
+            # the stage replaced each shard's n_local input rows with its
+            # own cap explode rows; downstream cross-shard ranking (the
+            # aggregate's global_last_true) must rank by the EXPLODE
+            # block origin or shard blocks overlap and a longer earlier
+            # shard outranks the true last row
+            ctx["g0"] = lax.axis_index(ax) * cap
         return new_state, carries
 
 
