@@ -555,19 +555,16 @@ def _xla_cache_entries() -> int:
 _CACHE_ENTRIES_AT_START = None  # captured in main() before the suite
 
 
-def _cache_stats(results: dict) -> dict:
+def _cache_stats() -> dict:
     """Persistent-cache evidence for the JSON line: new entries written
-    this run (== compiles that missed) plus each config's first-call
-    seconds. A warm run shows entries_written 0 and first calls <2s."""
+    this run (== compiles that missed). A warm run shows entries_written
+    0 and per-config first_call_s (in the configs section) < 2s."""
+    # per-config first-call seconds ride in configs.<name>.first_call_s;
+    # this section carries only the cache-level evidence
     stats = {
         "dir": _xla_cache_dir() or "off",
         "entries_before": _CACHE_ENTRIES_AT_START,
         "entries_after": _xla_cache_entries(),
-        "first_call_s": {
-            k: v["first_call_s"]
-            for k, v in results.items()
-            if isinstance(v, dict) and "first_call_s" in v
-        },
     }
     if stats["entries_before"] is not None:
         stats["entries_written"] = stats["entries_after"] - stats["entries_before"]
@@ -619,7 +616,7 @@ def _build_output(results: dict, extra_error: str = "") -> tuple:
         inner["degraded"] = True
     if extra_error:
         inner["error"] = extra_error
-    inner["xla_cache"] = _cache_stats(results)
+    inner["xla_cache"] = _cache_stats()
     if _BACKEND_MODE == "cpu_fallback":
         # the tunnel was dead: the headline MUST stay an honest zero (no
         # CPU number may masquerade as on-chip), but the round still
