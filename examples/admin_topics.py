@@ -1,8 +1,10 @@
 """Admin API: create, list, describe, and delete topics (parity: the
 reference's fluvio-admin examples). Needs an SC (start one with
-`python -m fluvio_tpu.cli cluster start --local`).
+`python -m fluvio_tpu.cli cluster start --local`), or pass
+``--embedded`` to boot one in-process:
 
     python examples/admin_topics.py --sc 127.0.0.1:9103
+    python examples/admin_topics.py --embedded
 """
 
 import argparse
@@ -10,6 +12,17 @@ import asyncio
 
 from fluvio_tpu.client.admin import FluvioAdmin
 from fluvio_tpu.metadata.topic import TopicSpec
+
+
+async def _embedded() -> None:
+    from fluvio_tpu.sc import ScConfig, ScServer
+
+    sc = ScServer(ScConfig(public_addr="127.0.0.1:0"))
+    await sc.start()
+    try:
+        await main(sc.public_addr)
+    finally:
+        await sc.stop()
 
 
 async def main(sc_addr: str) -> None:
@@ -28,5 +41,7 @@ async def main(sc_addr: str) -> None:
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--sc", default="127.0.0.1:9103")
+    parser.add_argument("--embedded", action="store_true",
+                        help="boot an in-process SC (zero setup)")
     args = parser.parse_args()
-    asyncio.run(main(args.sc))
+    asyncio.run(_embedded() if args.embedded else main(args.sc))

@@ -1,0 +1,6 @@
+"""C++ sources for the native runtime pieces, shipped inside the package
+so a wheel/sdist install can build them on demand (editable installs
+resolve the same path): the per-record baseline engine
+(`baseline_engine.cpp`, the wasmtime-proxy execution model) and the
+lz4-frame/snappy codecs (`codecs.cpp`). Compiled artifacts land in
+`_build/` next to the sources, keyed by source hash."""

@@ -1,0 +1,482 @@
+// Native lz4-frame and snappy codecs for the record-batch hot path.
+//
+// Capability parity: the reference's fluvio-compression crate links the
+// native lz4/snappy libraries (fluvio-compression/src/lib.rs); this file
+// implements both formats from their public specifications so a topic
+// configured with `compression: lz4|snappy` runs at native speed instead
+// of the bundled pure-Python fallbacks (~10-50 MB/s). Wire-compatible
+// with protocol/lz4_py.py and protocol/snappy_py.py (cross-validated in
+// tests/test_protocol.py).
+//
+// ABI: plain C structs over ctypes, same pattern as baseline_engine.cpp.
+// Every decode path bounds-checks before reading or writing; malformed
+// input returns len = -1 instead of corrupting memory.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+struct CodecBuf {
+  uint8_t* data;
+  int64_t len;  // < 0: error (data is null)
+};
+
+static CodecBuf fail() { return CodecBuf{nullptr, -1}; }
+
+void codec_free(uint8_t* p) { std::free(p); }
+
+// -- xxHash32 (one-shot, for the lz4 frame checksums) ------------------------
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static uint32_t xxh32(const uint8_t* p, size_t n, uint32_t seed) {
+  static const uint32_t P1 = 2654435761U, P2 = 2246822519U, P3 = 3266489917U,
+                        P4 = 668265263U, P5 = 374761393U;
+  const uint8_t* end = p + n;
+  uint32_t h;
+  if (n >= 16) {
+    uint32_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    const uint8_t* limit = end - 16;
+    do {
+      uint32_t k;
+      std::memcpy(&k, p, 4); v1 = rotl32(v1 + k * P2, 13) * P1; p += 4;
+      std::memcpy(&k, p, 4); v2 = rotl32(v2 + k * P2, 13) * P1; p += 4;
+      std::memcpy(&k, p, 4); v3 = rotl32(v3 + k * P2, 13) * P1; p += 4;
+      std::memcpy(&k, p, 4); v4 = rotl32(v4 + k * P2, 13) * P1; p += 4;
+    } while (p <= limit);
+    h = rotl32(v1, 1) + rotl32(v2, 7) + rotl32(v3, 12) + rotl32(v4, 18);
+  } else {
+    h = seed + P5;
+  }
+  h += (uint32_t)n;
+  while (p + 4 <= end) {
+    uint32_t k;
+    std::memcpy(&k, p, 4);
+    h = rotl32(h + k * P3, 17) * P4;
+    p += 4;
+  }
+  while (p < end) h = rotl32(h + (*p++) * P5, 11) * P1;
+  h ^= h >> 15; h *= P2; h ^= h >> 13; h *= P3; h ^= h >> 16;
+  return h;
+}
+
+// -- growable output ---------------------------------------------------------
+
+struct Out {
+  uint8_t* data = nullptr;
+  size_t len = 0, cap = 0;
+  bool grow(size_t need) {
+    if (len + need <= cap) return true;
+    size_t ncap = cap ? cap : 4096;
+    while (ncap < len + need) ncap *= 2;
+    uint8_t* nd = (uint8_t*)std::realloc(data, ncap);
+    if (!nd) return false;
+    data = nd; cap = ncap;
+    return true;
+  }
+  bool put(const uint8_t* p, size_t n) {
+    if (!grow(n)) return false;
+    std::memcpy(data + len, p, n);
+    len += n;
+    return true;
+  }
+  bool put_u8(uint8_t b) { return put(&b, 1); }
+  bool put_u32le(uint32_t v) {
+    uint8_t b[4] = {(uint8_t)v, (uint8_t)(v >> 8), (uint8_t)(v >> 16),
+                    (uint8_t)(v >> 24)};
+    return put(b, 4);
+  }
+};
+
+static CodecBuf done(Out& o) {
+  if (o.data == nullptr) {  // zero-length output: hand back a real pointer
+    o.data = (uint8_t*)std::malloc(1);
+    if (!o.data) return fail();
+  }
+  return CodecBuf{o.data, (int64_t)o.len};
+}
+
+// -- LZ4 block format --------------------------------------------------------
+
+// Greedy hash-table matcher per the block spec: token (lit len / match
+// len nibbles), extended lengths as 255-runs, 2-byte little-endian
+// offsets, minimum match 4. The final 5 bytes are always literals and
+// matches must not start within the last 12 (spec end conditions).
+static bool lz4_compress_block(const uint8_t* in, size_t n, Out& out) {
+  const size_t MINMATCH = 4, MFLIMIT = 12, LASTLITERALS = 5;
+  size_t pos = 0, anchor = 0;
+  uint32_t table[1 << 16];
+  std::memset(table, 0xFF, sizeof(table));
+
+  auto hash4 = [&](size_t p) -> uint32_t {
+    uint32_t v;
+    std::memcpy(&v, in + p, 4);
+    return (v * 2654435761U) >> 16;
+  };
+  auto emit_run = [&](size_t lit_len, size_t match_len_m4, size_t off) {
+    uint8_t token = (uint8_t)((lit_len >= 15 ? 15 : lit_len) << 4);
+    if (off) token |= (uint8_t)(match_len_m4 >= 15 ? 15 : match_len_m4);
+    if (!out.put_u8(token)) return false;
+    if (lit_len >= 15) {
+      size_t rest = lit_len - 15;
+      while (rest >= 255) { if (!out.put_u8(255)) return false; rest -= 255; }
+      if (!out.put_u8((uint8_t)rest)) return false;
+    }
+    if (!out.put(in + anchor, lit_len)) return false;
+    if (off) {
+      uint8_t ob[2] = {(uint8_t)off, (uint8_t)(off >> 8)};
+      if (!out.put(ob, 2)) return false;
+      if (match_len_m4 >= 15) {
+        size_t rest = match_len_m4 - 15;
+        while (rest >= 255) { if (!out.put_u8(255)) return false; rest -= 255; }
+        if (!out.put_u8((uint8_t)rest)) return false;
+      }
+    }
+    return true;
+  };
+
+  if (n >= MFLIMIT) {
+    size_t mflimit = n - MFLIMIT;
+    while (pos <= mflimit) {
+      uint32_t h = hash4(pos);
+      uint32_t cand = table[h];
+      table[h] = (uint32_t)pos;
+      uint32_t cur4, cnd4;
+      std::memcpy(&cur4, in + pos, 4);
+      if (cand != 0xFFFFFFFFu && pos - cand <= 65535) {
+        std::memcpy(&cnd4, in + cand, 4);
+        if (cur4 == cnd4) {
+          size_t mlen = MINMATCH;
+          size_t limit = n - LASTLITERALS;
+          while (pos + mlen < limit && in[cand + mlen] == in[pos + mlen]) mlen++;
+          if (!emit_run(pos - anchor, mlen - MINMATCH, pos - cand)) return false;
+          pos += mlen;
+          anchor = pos;
+          continue;
+        }
+      }
+      pos++;
+    }
+  }
+  // trailing literals
+  size_t lit = n - anchor;
+  return emit_run(lit, 0, 0);
+}
+
+static bool lz4_decompress_block(const uint8_t* in, size_t n, Out& out,
+                                 size_t max_out) {
+  size_t pos = 0;
+  size_t out_start = out.len;
+  while (pos < n) {
+    uint8_t token = in[pos++];
+    size_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (pos >= n) return false;
+        b = in[pos++];
+        lit += b;
+      } while (b == 255);
+    }
+    if (pos + lit > n) return false;
+    if (out.len - out_start + lit > max_out) return false;
+    if (!out.put(in + pos, lit)) return false;
+    pos += lit;
+    if (pos == n) break;  // last sequence has no match
+    if (pos + 2 > n) return false;
+    size_t off = in[pos] | ((size_t)in[pos + 1] << 8);
+    pos += 2;
+    if (off == 0 || off > out.len) return false;
+    size_t mlen = (token & 0xF);
+    if (mlen == 15) {
+      uint8_t b;
+      do {
+        if (pos >= n) return false;
+        b = in[pos++];
+        mlen += b;
+      } while (b == 255);
+    }
+    mlen += 4;
+    if (out.len - out_start + mlen > max_out) return false;
+    if (!out.grow(mlen)) return false;
+    // overlap-safe byte copy
+    size_t src = out.len - off;
+    for (size_t i = 0; i < mlen; i++) out.data[out.len + i] = out.data[src + i];
+    out.len += mlen;
+  }
+  return true;
+}
+
+// -- LZ4 frame format --------------------------------------------------------
+
+static const uint32_t LZ4_MAGIC = 0x184D2204u;
+static const uint32_t LZ4_SKIP_LO = 0x184D2A50u;
+static const size_t LZ4_BLOCK_MAX = 4u << 20;  // BD code 7, matches lz4_py
+
+CodecBuf lz4_frame_compress(const uint8_t* in, int64_t n64) {
+  size_t n = (size_t)n64;
+  Out out;
+  // descriptor: version 01, block-independent, no checksums/size/dict
+  uint8_t desc[2] = {(1 << 6) | (1 << 5), 7 << 4};
+  if (!out.put_u32le(LZ4_MAGIC) || !out.put(desc, 2) ||
+      !out.put_u8((uint8_t)(xxh32(desc, 2, 0) >> 8)))
+    { std::free(out.data); return fail(); }
+  for (size_t lo = 0; lo < n || lo == 0; lo += LZ4_BLOCK_MAX) {
+    size_t blen = n - lo < LZ4_BLOCK_MAX ? n - lo : LZ4_BLOCK_MAX;
+    if (blen == 0 && n != 0) break;
+    Out blk;
+    if (!lz4_compress_block(in + lo, blen, blk)) {
+      std::free(blk.data); std::free(out.data); return fail();
+    }
+    bool ok;
+    if (blk.len < blen || blen == 0) {
+      ok = out.put_u32le((uint32_t)blk.len) && out.put(blk.data, blk.len);
+    } else {  // incompressible: store raw with the high bit set
+      ok = out.put_u32le((uint32_t)blen | 0x80000000u) && out.put(in + lo, blen);
+    }
+    std::free(blk.data);
+    if (!ok) { std::free(out.data); return fail(); }
+    if (n == 0) break;
+  }
+  if (!out.put_u32le(0)) { std::free(out.data); return fail(); }
+  return done(out);
+}
+
+CodecBuf lz4_frame_decompress(const uint8_t* in, int64_t n64) {
+  size_t n = (size_t)n64, pos = 0;
+  Out out;
+  auto bail = [&]() { std::free(out.data); return fail(); };
+  bool saw_frame = false;
+  while (pos < n) {
+    if (pos + 4 > n) return bail();
+    uint32_t magic;
+    std::memcpy(&magic, in + pos, 4);
+    pos += 4;
+    if ((magic & 0xFFFFFFF0u) == LZ4_SKIP_LO) {
+      if (pos + 4 > n) return bail();
+      uint32_t skip;
+      std::memcpy(&skip, in + pos, 4);
+      pos += 4;
+      if (pos + skip > n) return bail();
+      pos += skip;
+      continue;
+    }
+    if (magic != LZ4_MAGIC) return bail();
+    saw_frame = true;
+    size_t desc_start = pos;
+    if (pos + 2 > n) return bail();
+    uint8_t flg = in[pos], bd = in[pos + 1];
+    pos += 2;
+    if ((flg >> 6) != 1) return bail();        // version must be 01
+    if (flg & 1) return bail();                // dictionaries unsupported
+    bool has_csize = flg & (1 << 3), has_cchk = flg & (1 << 2),
+         has_bchk = flg & (1 << 4);
+    uint8_t bd_code = (bd >> 4) & 0x7;
+    if (bd_code < 4) return bail();
+    size_t block_max = (size_t)1 << (8 + 2 * bd_code);  // 4->64KB .. 7->4MB
+    uint64_t content_size = 0;
+    if (has_csize) {
+      if (pos + 8 > n) return bail();
+      std::memcpy(&content_size, in + pos, 8);
+      pos += 8;
+    }
+    if (pos + 1 > n) return bail();
+    if (in[pos] != (uint8_t)(xxh32(in + desc_start, pos - desc_start, 0) >> 8))
+      return bail();
+    pos += 1;
+    size_t frame_out_start = out.len;
+    while (true) {
+      if (pos + 4 > n) return bail();
+      uint32_t bsize;
+      std::memcpy(&bsize, in + pos, 4);
+      pos += 4;
+      if (bsize == 0) break;  // end mark
+      bool raw = bsize & 0x80000000u;
+      size_t blen = bsize & 0x7FFFFFFFu;
+      if (blen > block_max || pos + blen > n) return bail();
+      if (has_bchk) {
+        if (pos + blen + 4 > n) return bail();
+      }
+      if (raw) {
+        if (!out.put(in + pos, blen)) return bail();
+      } else {
+        if (!lz4_decompress_block(in + pos, blen, out, block_max)) return bail();
+      }
+      if (has_bchk) {
+        uint32_t bc;
+        std::memcpy(&bc, in + pos + blen, 4);
+        if (bc != xxh32(in + pos, blen, 0)) return bail();
+        pos += 4;
+      }
+      pos += blen;
+    }
+    if (has_cchk) {
+      if (pos + 4 > n) return bail();
+      uint32_t cc;
+      std::memcpy(&cc, in + pos, 4);
+      pos += 4;
+      if (cc != xxh32(out.data + frame_out_start, out.len - frame_out_start, 0))
+        return bail();
+    }
+    if (has_csize && out.len - frame_out_start != content_size) return bail();
+  }
+  if (!saw_frame) return bail();
+  return done(out);
+}
+
+// -- snappy raw block format -------------------------------------------------
+
+CodecBuf snappy_compress(const uint8_t* in, int64_t n64) {
+  size_t n = (size_t)n64;
+  Out out;
+  auto bail = [&]() { std::free(out.data); return fail(); };
+  // preamble: uncompressed length varint
+  {
+    uint64_t v = n;
+    do {
+      uint8_t b = v & 0x7F;
+      v >>= 7;
+      if (v) b |= 0x80;
+      if (!out.put_u8(b)) return bail();
+    } while (v);
+  }
+  auto emit_literal = [&](size_t lo, size_t len) {
+    while (len) {
+      size_t chunk = len;  // tag can carry up to 2^32; emit in one go
+      if (chunk <= 60) {
+        if (!out.put_u8((uint8_t)((chunk - 1) << 2))) return false;
+      } else if (chunk < (1u << 8)) {
+        if (!out.put_u8(60 << 2) || !out.put_u8((uint8_t)(chunk - 1)))
+          return false;
+      } else if (chunk < (1u << 16)) {
+        uint8_t b[3] = {61 << 2, (uint8_t)(chunk - 1), (uint8_t)((chunk - 1) >> 8)};
+        if (!out.put(b, 3)) return false;
+      } else if (chunk < (1u << 24)) {
+        uint8_t b[4] = {62 << 2, (uint8_t)(chunk - 1), (uint8_t)((chunk - 1) >> 8),
+                        (uint8_t)((chunk - 1) >> 16)};
+        if (!out.put(b, 4)) return false;
+      } else {
+        uint8_t b[5] = {63 << 2, (uint8_t)(chunk - 1), (uint8_t)((chunk - 1) >> 8),
+                        (uint8_t)((chunk - 1) >> 16), (uint8_t)((chunk - 1) >> 24)};
+        if (!out.put(b, 5)) return false;
+      }
+      if (!out.put(in + lo, chunk)) return false;
+      lo += chunk;
+      len -= chunk;
+    }
+    return true;
+  };
+  auto emit_copy2 = [&](size_t off, size_t len) {
+    // tag 10: lengths 1-64, 2-byte LE offset (matches snappy_py's emitter)
+    while (len) {
+      size_t chunk = len > 64 ? 64 : len;
+      if (len - chunk == 1) chunk -= 1;  // never strand a 0-length tail
+      uint8_t b[3] = {(uint8_t)(((chunk - 1) << 2) | 2), (uint8_t)off,
+                      (uint8_t)(off >> 8)};
+      if (!out.put(b, 3)) return false;
+      len -= chunk;
+    }
+    return true;
+  };
+
+  if (n < 4) {
+    if (n && !emit_literal(0, n)) return bail();
+    return done(out);
+  }
+  uint32_t table[1 << 14];
+  std::memset(table, 0xFF, sizeof(table));
+  auto hash4 = [&](size_t p) -> uint32_t {
+    uint32_t v;
+    std::memcpy(&v, in + p, 4);
+    return (v * 2654435761U) >> 18;
+  };
+  size_t pos = 0, lit_start = 0;
+  while (pos + 4 <= n) {
+    uint32_t h = hash4(pos);
+    uint32_t cand = table[h];
+    table[h] = (uint32_t)pos;
+    uint32_t a, b;
+    std::memcpy(&a, in + pos, 4);
+    if (cand != 0xFFFFFFFFu && pos - cand < 65536) {
+      std::memcpy(&b, in + cand, 4);
+      if (a == b) {
+        size_t mlen = 4;
+        while (pos + mlen < n && in[cand + mlen] == in[pos + mlen]) mlen++;
+        if (pos > lit_start && !emit_literal(lit_start, pos - lit_start))
+          return bail();
+        if (!emit_copy2(pos - cand, mlen)) return bail();
+        pos += mlen;
+        lit_start = pos;
+        continue;
+      }
+    }
+    pos++;
+  }
+  if (n > lit_start && !emit_literal(lit_start, n - lit_start)) return bail();
+  return done(out);
+}
+
+CodecBuf snappy_decompress(const uint8_t* in, int64_t n64) {
+  size_t n = (size_t)n64, pos = 0;
+  Out out;
+  auto bail = [&]() { std::free(out.data); return fail(); };
+  uint64_t expected = 0;
+  int shift = 0;
+  while (true) {
+    if (pos >= n || shift > 63) return bail();
+    uint8_t b = in[pos++];
+    expected |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  while (pos < n) {
+    uint8_t tag = in[pos++];
+    uint8_t kind = tag & 3;
+    if (kind == 0) {  // literal
+      size_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        size_t nb = len - 60;
+        if (pos + nb > n) return bail();
+        len = 0;
+        for (size_t i = 0; i < nb; i++) len |= (size_t)in[pos + i] << (8 * i);
+        len += 1;
+        pos += nb;
+      }
+      if (pos + len > n) return bail();
+      if (!out.put(in + pos, len)) return bail();
+      pos += len;
+      continue;
+    }
+    size_t len, off;
+    if (kind == 1) {  // copy, 1-byte offset: len 4-11, 11-bit offset
+      len = ((tag >> 2) & 0x7) + 4;
+      if (pos + 1 > n) return bail();
+      off = ((size_t)(tag >> 5) << 8) | in[pos];
+      pos += 1;
+    } else if (kind == 2) {  // copy, 2-byte offset
+      len = (tag >> 2) + 1;
+      if (pos + 2 > n) return bail();
+      off = in[pos] | ((size_t)in[pos + 1] << 8);
+      pos += 2;
+    } else {  // copy, 4-byte offset
+      len = (tag >> 2) + 1;
+      if (pos + 4 > n) return bail();
+      off = 0;
+      for (int i = 0; i < 4; i++) off |= (size_t)in[pos + i] << (8 * i);
+      pos += 4;
+    }
+    if (off == 0 || off > out.len) return bail();
+    if (!out.grow(len)) return bail();
+    size_t src = out.len - off;
+    for (size_t i = 0; i < len; i++) out.data[out.len + i] = out.data[src + i];
+    out.len += len;
+  }
+  if (out.len != expected) return bail();
+  return done(out);
+}
+
+}  // extern "C"

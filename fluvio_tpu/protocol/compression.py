@@ -42,15 +42,63 @@ try:
 except ImportError:  # pragma: no cover
     _zstd = None
 
-try:
-    import lz4.frame as _lz4  # type: ignore
-except ImportError:
-    from fluvio_tpu.protocol import lz4_py as _lz4  # pure-Python fallback
+# lz4/snappy preference order: wheel -> bundled native library (built
+# on demand from native/codecs.cpp) -> pure-Python. The pure-Python
+# codecs are correctness fallbacks only: ~10-50 MB/s, a 20-100x cliff
+# on a compressed topic's hot path, so landing on one warns the
+# operator once per codec.
+import logging as _logging
 
-try:
-    import snappy as _snappy  # type: ignore
-except ImportError:
-    from fluvio_tpu.protocol import snappy_py as _snappy  # pure-Python fallback
+_logger = _logging.getLogger(__name__)
+_slow_codecs: set = set()
+
+
+def _warn_slow(codec: "Compression") -> None:
+    if codec not in _slow_codecs:
+        _slow_codecs.add(codec)
+        _logger.warning(
+            "%s is served by the pure-Python fallback codec (no wheel, "
+            "no native toolchain): expect ~10-50 MB/s on this path",
+            codec.name.lower(),
+        )
+
+
+def _pick_lz4():
+    try:
+        import lz4.frame as wheel  # type: ignore
+
+        return wheel, False
+    except ImportError:
+        pass
+    from fluvio_tpu.protocol import native_codecs
+
+    native = native_codecs.lz4_module()
+    if native is not None:
+        return native, False
+    from fluvio_tpu.protocol import lz4_py
+
+    return lz4_py, True
+
+
+def _pick_snappy():
+    try:
+        import snappy as wheel  # type: ignore
+
+        return wheel, False
+    except ImportError:
+        pass
+    from fluvio_tpu.protocol import native_codecs
+
+    native = native_codecs.snappy_module()
+    if native is not None:
+        return native, False
+    from fluvio_tpu.protocol import snappy_py
+
+    return snappy_py, True
+
+
+_lz4, _LZ4_SLOW = _pick_lz4()
+_snappy, _SNAPPY_SLOW = _pick_snappy()
 
 
 def compress(codec: Compression, data: bytes) -> bytes:
@@ -63,8 +111,12 @@ def compress(codec: Compression, data: bytes) -> bytes:
             raise UnsupportedCompression("zstd not available")
         return _ZSTD_C.compress(data)
     if codec == Compression.LZ4:
+        if _LZ4_SLOW:
+            _warn_slow(codec)
         return _lz4.compress(data)
     if codec == Compression.SNAPPY:
+        if _SNAPPY_SLOW:
+            _warn_slow(codec)
         return _snappy.compress(data)
     raise UnsupportedCompression(f"unknown codec {codec}")
 
@@ -79,7 +131,11 @@ def decompress(codec: Compression, data: bytes) -> bytes:
             raise UnsupportedCompression("zstd not available")
         return _ZSTD_D.decompress(data)
     if codec == Compression.LZ4:
+        if _LZ4_SLOW:
+            _warn_slow(codec)
         return _lz4.decompress(data)
     if codec == Compression.SNAPPY:
+        if _SNAPPY_SLOW:
+            _warn_slow(codec)
         return _snappy.decompress(data)
     raise UnsupportedCompression(f"unknown codec {codec}")
