@@ -43,10 +43,13 @@ except ImportError:  # pragma: no cover
     _zstd = None
 
 # lz4/snappy preference order: wheel -> bundled native library (built
-# on demand from native/codecs.cpp) -> pure-Python. The pure-Python
-# codecs are correctness fallbacks only: ~10-50 MB/s, a 20-100x cliff
-# on a compressed topic's hot path, so landing on one warns the
-# operator once per codec.
+# on demand from fluvio_tpu/native/codecs.cpp) -> pure-Python. The
+# pure-Python codecs are correctness fallbacks only: ~10-50 MB/s, a
+# 20-100x cliff on a compressed topic's hot path, so landing on one
+# warns the operator once per codec. Selection is LAZY (first lz4 or
+# snappy call): the native build shells out to g++ (~5 s cold), which
+# must not tax `import fluvio_tpu.protocol` in processes that never
+# touch those codecs.
 import logging as _logging
 
 _logger = _logging.getLogger(__name__)
@@ -63,42 +66,58 @@ def _warn_slow(codec: "Compression") -> None:
         )
 
 
-def _pick_lz4():
+def _pick_lz4() -> tuple:
+    """(module, impl) — impl in {"wheel", "native", "python"}."""
     try:
         import lz4.frame as wheel  # type: ignore
 
-        return wheel, False
+        return wheel, "wheel"
     except ImportError:
         pass
     from fluvio_tpu.protocol import native_codecs
 
     native = native_codecs.lz4_module()
     if native is not None:
-        return native, False
+        return native, "native"
     from fluvio_tpu.protocol import lz4_py
 
-    return lz4_py, True
+    return lz4_py, "python"
 
 
-def _pick_snappy():
+def _pick_snappy() -> tuple:
     try:
         import snappy as wheel  # type: ignore
 
-        return wheel, False
+        return wheel, "wheel"
     except ImportError:
         pass
     from fluvio_tpu.protocol import native_codecs
 
     native = native_codecs.snappy_module()
     if native is not None:
-        return native, False
+        return native, "native"
     from fluvio_tpu.protocol import snappy_py
 
-    return snappy_py, True
+    return snappy_py, "python"
 
 
-_lz4, _LZ4_SLOW = _pick_lz4()
-_snappy, _SNAPPY_SLOW = _pick_snappy()
+_lz4 = _snappy = None
+_LZ4_IMPL = _SNAPPY_IMPL = ""
+
+
+def lz4_codec() -> tuple:
+    """Resolved (module, impl) for lz4, picked on first use."""
+    global _lz4, _LZ4_IMPL
+    if _lz4 is None:
+        _lz4, _LZ4_IMPL = _pick_lz4()
+    return _lz4, _LZ4_IMPL
+
+
+def snappy_codec() -> tuple:
+    global _snappy, _SNAPPY_IMPL
+    if _snappy is None:
+        _snappy, _SNAPPY_IMPL = _pick_snappy()
+    return _snappy, _SNAPPY_IMPL
 
 
 def compress(codec: Compression, data: bytes) -> bytes:
@@ -111,13 +130,15 @@ def compress(codec: Compression, data: bytes) -> bytes:
             raise UnsupportedCompression("zstd not available")
         return _ZSTD_C.compress(data)
     if codec == Compression.LZ4:
-        if _LZ4_SLOW:
+        mod, impl = lz4_codec()
+        if impl == "python":
             _warn_slow(codec)
-        return _lz4.compress(data)
+        return mod.compress(data)
     if codec == Compression.SNAPPY:
-        if _SNAPPY_SLOW:
+        mod, impl = snappy_codec()
+        if impl == "python":
             _warn_slow(codec)
-        return _snappy.compress(data)
+        return mod.compress(data)
     raise UnsupportedCompression(f"unknown codec {codec}")
 
 
@@ -131,11 +152,13 @@ def decompress(codec: Compression, data: bytes) -> bytes:
             raise UnsupportedCompression("zstd not available")
         return _ZSTD_D.decompress(data)
     if codec == Compression.LZ4:
-        if _LZ4_SLOW:
+        mod, impl = lz4_codec()
+        if impl == "python":
             _warn_slow(codec)
-        return _lz4.decompress(data)
+        return mod.decompress(data)
     if codec == Compression.SNAPPY:
-        if _SNAPPY_SLOW:
+        mod, impl = snappy_codec()
+        if impl == "python":
             _warn_slow(codec)
-        return _snappy.decompress(data)
+        return mod.decompress(data)
     raise UnsupportedCompression(f"unknown codec {codec}")

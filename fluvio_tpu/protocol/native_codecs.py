@@ -1,4 +1,4 @@
-"""ctypes loader for native/codecs.cpp (lz4-frame + snappy).
+"""ctypes loader for fluvio_tpu/native/codecs.cpp (lz4-frame + snappy).
 
 Same compile-on-demand pattern as smartengine/native_backend.py: the
 shared library builds once per source hash with the baked-in g++ and
@@ -23,7 +23,7 @@ from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
-_SOURCE = Path(__file__).resolve().parents[2] / "native" / "codecs.cpp"
+_SOURCE = Path(__file__).resolve().parents[1] / "native" / "codecs.cpp"
 _BUILD_DIR = Path(
     os.environ.get("FLUVIO_TPU_NATIVE_BUILD", str(_SOURCE.parent / "_build"))
 )

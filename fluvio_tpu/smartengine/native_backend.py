@@ -3,7 +3,7 @@
 Capability parity: the reference's wasmtime engine executes *compiled*
 per-record transform code on the host CPU; this backend is that
 execution model for our artifact format — DSL programs lower to a
-compact postfix spec interpreted by ``native/baseline_engine.cpp``
+compact postfix spec interpreted by ``fluvio_tpu/native/baseline_engine.cpp``
 (compiled on demand with g++, cached by source hash). It is both the
 fast host path (``backend="native"``) and the honest wasmtime-proxy
 denominator for bench.py.
@@ -37,7 +37,7 @@ from fluvio_tpu.smartmodule.types import (
 
 logger = logging.getLogger(__name__)
 
-_SOURCE = Path(__file__).resolve().parents[2] / "native" / "baseline_engine.cpp"
+_SOURCE = Path(__file__).resolve().parents[1] / "native" / "baseline_engine.cpp"
 _BUILD_DIR = Path(
     os.environ.get("FLUVIO_TPU_NATIVE_BUILD", str(_SOURCE.parent / "_build"))
 )

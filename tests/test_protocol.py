@@ -277,7 +277,7 @@ class TestPurePythonCodecs:
 
 
 class TestNativeCodecs:
-    """native/codecs.cpp: wire-compatible with the bundled pure-Python
+    """fluvio_tpu/native/codecs.cpp: wire-compatible with the bundled pure-Python
     lz4/snappy codecs, and memory-safe on malformed input (VERDICT r4
     weak #6 — the fallbacks are correctness-only at ~10-50 MB/s; the
     native library is what a compressed topic's hot path should run)."""
@@ -351,9 +351,11 @@ class TestNativeCodecs:
         lz4/snappy through the native library, not the slow fallback."""
         from fluvio_tpu.protocol import compression as c
 
-        if c._LZ4_SLOW or c._SNAPPY_SLOW:
-            pytest.skip("no native toolchain: pure-Python fallback in use")
         data = b'{"name":"fluvio"}' * 1000
         for codec in (c.Compression.LZ4, c.Compression.SNAPPY):
             assert c.decompress(codec, c.compress(codec, data)) == data
+        _, lz4_impl = c.lz4_codec()
+        _, snappy_impl = c.snappy_codec()
+        if lz4_impl == "python" or snappy_impl == "python":
+            pytest.skip("no native toolchain: pure-Python fallback in use")
         assert not c._slow_codecs  # no slow-codec warning fired
