@@ -30,6 +30,23 @@ def add_hub_parser(sub) -> None:
     )
     dl.set_defaults(fn=hub_download)
 
+    rp = hsub.add_parser(
+        "repin",
+        help="record a package's current signer as a pinned publisher "
+        "(migration for indexes published before key pinning)",
+    )
+    rp.add_argument("ref", metavar="[group/]name[@version]")
+    rp.add_argument("--hub-dir")
+    rp.set_defaults(fn=hub_repin)
+
+
+async def hub_repin(args) -> int:
+    from fluvio_tpu.hub.registry import HubRegistry
+
+    signer = HubRegistry(args.hub_dir).repin(args.ref)
+    print(f"pinned publisher {signer[:16]}… for {args.ref}")
+    return 0
+
 
 async def hub_list(args) -> int:
     from fluvio_tpu.hub.registry import HubRegistry

@@ -233,6 +233,18 @@ def verify_package(
     return meta
 
 
+def package_signer(path: str | Path) -> str:
+    """The hex public key embedded in a package's signature envelope,
+    AFTER self-verification (the signature must be valid for that key
+    and the checksums intact). This is what `hub repin` records for
+    index entries published before publisher-key pinning existed — an
+    explicit trust-on-first-use decision by the operator."""
+    contents = _read_contents(path)
+    verify_package(path, trusted_keys=None, contents=contents)
+    envelope = json.loads(contents[SIGNATURE_NAME].decode())
+    return envelope["pubkey"]
+
+
 def publish_project(project, hub_dir: Optional[str] = None, kind: str = "smartmodule"):
     """Build + sign + store a project's artifact in the registry
     (parity: smdk/cdk publish)."""

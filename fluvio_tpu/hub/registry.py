@@ -113,12 +113,54 @@ class HubRegistry:
         if not publishers:
             # fail closed: an index entry with no recorded publisher keys
             # cannot pin the signer, so a re-signed tarball would pass on
-            # envelope self-verification alone (re-publish to record keys)
+            # envelope self-verification alone. Pre-pinning indexes
+            # migrate explicitly: `fluvio-tpu hub repin <ref>` records
+            # the current tarball's (self-verified) signer.
             raise HubError(
                 f"{group}/{name}: no publisher keys recorded in the index; "
-                "refusing unpinned verification"
+                "refusing unpinned verification (migrate with "
+                f"`fluvio-tpu hub repin {group}/{name}`)"
             )
         return publishers
+
+    def repin(self, ref: str) -> str:
+        """One-shot migration for index entries that predate publisher
+        pinning: self-verify the stored tarball's envelope + checksums
+        and record its signer as a pinned publisher. Trust-on-first-use
+        by explicit operator action — never done implicitly on
+        download, where it would defeat the pin. Returns the pinned
+        hex key.
+
+        Strictly scoped to the migration: a package that already has
+        recorded publishers is refused (repin must never widen an
+        existing trust set — a verification failure against a pinned
+        key means the TARBALL is wrong, not the pin), and the pin is
+        package-wide so version-qualified refs are rejected rather
+        than silently promoting one version's signer to all."""
+        from fluvio_tpu.hub.package import package_signer
+
+        group, name, version = parse_ref(ref)
+        if version is not None:
+            raise HubError(
+                "repin pins package-wide: pass the bare package ref "
+                f"({group}/{name}), not a version"
+            )
+        index = self._load_index()
+        entry = index["packages"].get(f"{group}/{name}")
+        if entry is None:
+            raise HubError(f"package {group}/{name} not in the hub")
+        if entry.get("publishers"):
+            raise HubError(
+                f"{group}/{name} already has pinned publishers; repin is "
+                "only for pre-pinning indexes. If downloads fail against "
+                "the existing pins, the tarball is not the publisher's — "
+                "do not re-pin around that."
+            )
+        path = self.resolve(ref, verify=False)
+        signer = package_signer(path)
+        entry["publishers"] = [signer]
+        self._save_index(index)
+        return signer
 
     def list_packages(self) -> List[dict]:
         index = self._load_index()

@@ -18,9 +18,12 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import ssl
 from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class K8sApiError(Exception):
@@ -202,6 +205,8 @@ class HttpK8sApi(K8sApi):
         # the set of resources whose server rejected ?watch=1
         self._watch_rv: Dict[str, str] = {}
         self._watch_unsupported: set = set()
+        # per-resource monotonic timestamp of the last auth-failure log
+        self._auth_warned: Dict[str, float] = {}
 
     @classmethod
     def in_cluster(cls) -> "HttpK8sApi":
@@ -305,6 +310,13 @@ class HttpK8sApi(K8sApi):
             listing = self._request("GET", resource) or {}
             rv = str((listing.get("metadata") or {}).get("resourceVersion", ""))
             self._watch_rv[resource] = rv
+            # anything that changed between the dispatcher's own resync
+            # list and THIS cursor-seeding list (especially a delete)
+            # would otherwise be delivered by neither — signal one
+            # resync now that the cursor is seeded, so the dispatcher
+            # reconciles the gap immediately instead of at the next
+            # periodic full resync
+            return WATCH_RESYNC
         conn = self._connect(max(timeout, 0.05) + 5)
         params = (
             f"watch=1&allowWatchBookmarks=true"
@@ -331,7 +343,7 @@ class HttpK8sApi(K8sApi):
             if resp.status >= 400:
                 # 401/403/429/5xx: transient (token rotation, throttling,
                 # leader elections) — retry paced, never disable
-                raise K8sApiError(resp.status, "watch failed (transient)")
+                raise K8sApiError(resp.status, f"watch failed ({resp.status})")
             conn.sock.settimeout(max(timeout, 0.05))
             events: List[dict] = []
             while True:
@@ -381,6 +393,23 @@ class HttpK8sApi(K8sApi):
         except _WatchUnsupported:
             self._watch_unsupported.add(resource)
             return None
+        except K8sApiError as e:
+            if e.status in (401, 403):
+                # a revoked/expired token turns the watch loop into a
+                # silent 1/s failure spin; surface it (rate-limited per
+                # resource) so the operator sees the auth problem
+                import time as _time
+
+                now = _time.monotonic()
+                last = self._auth_warned.get(resource, 0.0)
+                if now - last > 60.0:
+                    self._auth_warned[resource] = now
+                    logger.warning(
+                        "watch on %s failing with HTTP %s (auth): check "
+                        "the service-account token", resource, e.status,
+                    )
+            await asyncio.sleep(min(max(timeout, 0.1), 1.0))
+            return []
         except Exception:  # noqa: BLE001 — transient apiserver errors
             # pace the retry: an unreachable apiserver must not turn the
             # dispatcher's watch loop into a hot reconnect spin

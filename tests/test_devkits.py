@@ -518,3 +518,112 @@ class TestEd25519Signing:
         monkeypatch.setenv("FLUVIO_TPU_HUB_KEY", str(tmp_path / "other.key"))
         meta = verify_package(path)
         assert meta.name == "t"
+
+
+class TestRepinMigration:
+    """Indexes published before publisher-key pinning migrate with an
+    explicit `hub repin` (ADVICE r4: fail-closed must not brick old
+    packages without a path forward)."""
+
+    def test_unpinned_entry_fails_with_migration_hint(self, hub_env):
+        import json
+
+        from fluvio_tpu.hub import HubError, HubRegistry
+        from fluvio_tpu.hub.package import PackageMeta
+
+        registry = HubRegistry()
+        registry.publish(
+            PackageMeta(name="old", version="1.0.0"), {"old.py": b"ok"}
+        )
+        # simulate a pre-pinning index: drop the recorded publishers
+        index = json.loads(registry.index_path.read_text())
+        index["packages"]["local/old"].pop("publishers")
+        registry.index_path.write_text(json.dumps(index))
+
+        with pytest.raises(HubError) as ei:
+            registry.download("old")
+        assert "hub repin" in str(ei.value)
+
+    def test_repin_records_self_verified_signer(self, hub_env):
+        import json
+
+        from fluvio_tpu.hub import HubRegistry
+        from fluvio_tpu.hub.package import PackageMeta, load_or_create_key, public_key_hex
+
+        registry = HubRegistry()
+        registry.publish(
+            PackageMeta(name="old", version="1.0.0"), {"old.py": b"ok"}
+        )
+        index = json.loads(registry.index_path.read_text())
+        index["packages"]["local/old"].pop("publishers")
+        registry.index_path.write_text(json.dumps(index))
+
+        signer = registry.repin("old")
+        assert signer == public_key_hex(load_or_create_key())
+        # downloads verify again, pinned to the repinned key
+        meta, artifacts = registry.download("old")
+        assert artifacts["old.py"] == b"ok"
+
+    def test_repin_refuses_tampered_package(self, hub_env):
+        import json
+        import tarfile
+        import io
+
+        from fluvio_tpu.hub import HubError, HubRegistry
+        from fluvio_tpu.hub.package import PackageMeta
+
+        registry = HubRegistry()
+        registry.publish(
+            PackageMeta(name="old", version="1.0.0"), {"old.py": b"ok"}
+        )
+        path = registry.resolve("old", verify=False)
+        with tarfile.open(path, "r:gz") as tar:
+            members = {
+                m.name: tar.extractfile(m).read()
+                for m in tar.getmembers()
+                if m.isfile()
+            }
+        members["old.py"] = b"malicious"
+        with tarfile.open(path, "w:gz") as tar:
+            for name, data in members.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+        index = json.loads(registry.index_path.read_text())
+        index["packages"]["local/old"].pop("publishers")
+        registry.index_path.write_text(json.dumps(index))
+
+        # repin must self-verify before trusting: tampering fails closed
+        with pytest.raises(HubError):
+            registry.repin("old")
+
+    def test_repin_refuses_already_pinned_package(self, hub_env):
+        """repin must never widen an existing trust set: a verification
+        failure against pinned keys means the TARBALL is wrong."""
+        from fluvio_tpu.hub import HubError, HubRegistry
+        from fluvio_tpu.hub.package import PackageMeta
+
+        registry = HubRegistry()
+        registry.publish(
+            PackageMeta(name="pinned", version="1.0.0"), {"p.py": b"ok"}
+        )
+        with pytest.raises(HubError) as ei:
+            registry.repin("pinned")
+        assert "already has pinned publishers" in str(ei.value)
+
+    def test_repin_rejects_version_qualified_ref(self, hub_env):
+        import json
+
+        from fluvio_tpu.hub import HubError, HubRegistry
+        from fluvio_tpu.hub.package import PackageMeta
+
+        registry = HubRegistry()
+        registry.publish(
+            PackageMeta(name="old", version="1.0.0"), {"old.py": b"ok"}
+        )
+        index = json.loads(registry.index_path.read_text())
+        index["packages"]["local/old"].pop("publishers")
+        registry.index_path.write_text(json.dumps(index))
+        with pytest.raises(HubError) as ei:
+            registry.repin("old@1.0.0")
+        assert "package-wide" in str(ei.value)

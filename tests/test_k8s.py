@@ -517,6 +517,13 @@ class TestHttpK8sApi:
             ]
 
             async def body():
+                from fluvio_tpu.metadata.client import WATCH_RESYNC
+
+                # first call seeds the cursor and signals one resync so
+                # the dispatcher reconciles the list-to-list gap
+                assert await api.watch_events(self.RES, timeout=3.0) == (
+                    WATCH_RESYNC
+                )
                 events = await api.watch_events(self.RES, timeout=3.0)
                 assert events and events[0]["object"]["spec"]["partitions"] == 5
                 # cursor advanced to the event's resourceVersion
@@ -616,6 +623,12 @@ class TestWatchRecovery:
             api = HttpK8sApi(f"http://127.0.0.1:{httpd.server_address[1]}")
 
             async def body():
+                # first call: seeding resync (cursor kept)
+                assert await api.watch_events(self.RES, timeout=1.0) == (
+                    WATCH_RESYNC
+                )
+                assert self.RES in api._watch_rv
+                # second call reaches the watch and hits the 410
                 got = await api.watch_events(self.RES, timeout=1.0)
                 assert got == WATCH_RESYNC
                 # cursor dropped: the next call re-lists for a fresh one
@@ -654,8 +667,68 @@ class TestWatchRecovery:
             api = HttpK8sApi(f"http://127.0.0.1:{httpd.server_address[1]}")
 
             async def body():
+                from fluvio_tpu.metadata.client import WATCH_RESYNC
+
+                assert await api.watch_events(self.RES, timeout=0.2) == (
+                    WATCH_RESYNC  # seeding resync
+                )
                 got = await api.watch_events(self.RES, timeout=0.2)
                 assert got == []  # transient, paced
+                assert self.RES not in api._watch_unsupported
+
+            run(body())
+        finally:
+            httpd.shutdown()
+
+
+class TestAuthFailureVisibility:
+    RES = "apis/fluvio.infinyon.com/v1/namespaces/default/topics"
+
+    def test_401_watch_failure_logged_rate_limited(self, caplog):
+        """A revoked token must not degrade the watch loop into a silent
+        1/s failure spin: the auth status is logged (rate-limited per
+        resource) while the loop keeps its paced retry (ADVICE r4)."""
+        import http.server
+        import logging
+        import threading
+
+        from fluvio_tpu.k8s.api import HttpK8sApi
+        from fluvio_tpu.metadata.client import WATCH_RESYNC
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if "watch=1" in self.path:
+                    body = b'{"message":"Unauthorized"}'
+                    self.send_response(401)
+                else:
+                    body = b'{"metadata":{"resourceVersion":"5"},"items":[]}'
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            api = HttpK8sApi(f"http://127.0.0.1:{httpd.server_address[1]}")
+
+            async def body():
+                assert await api.watch_events(self.RES, timeout=0.2) == (
+                    WATCH_RESYNC  # seeding resync
+                )
+                with caplog.at_level(logging.WARNING, "fluvio_tpu.k8s.api"):
+                    assert await api.watch_events(self.RES, timeout=0.2) == []
+                    assert await api.watch_events(self.RES, timeout=0.2) == []
+                auth_logs = [
+                    r for r in caplog.records if "401" in r.getMessage()
+                ]
+                # surfaced once, not once per retry (rate limit)
+                assert len(auth_logs) == 1
                 assert self.RES not in api._watch_unsupported
 
             run(body())
