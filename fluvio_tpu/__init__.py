@@ -10,6 +10,6 @@ programs over an HBM-resident batched-record buffer.
 Reference capability map: see SURVEY.md at the repo root.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from fluvio_tpu.types import Offset, PartitionId, SpuId  # noqa: F401
