@@ -67,8 +67,8 @@ def _records(values):
     return out
 
 
-def _build(backend, specs):
-    b = SmartEngine(backend=backend).builder()
+def _build(backend, specs, mesh_devices=0):
+    b = SmartEngine(backend=backend, mesh_devices=mesh_devices).builder()
     for name, params in specs:
         b.add_smart_module(SmartModuleConfig(params=params or {}), lookup(name))
     return b.initialize()
@@ -113,3 +113,61 @@ class TestRandomChainFuzz:
             assert te == pe, (trial, specs)
             ran += 1
         assert ran >= 8, f"only {ran} compositions actually lowered"
+
+
+class TestShardedChainFuzz:
+    """The same randomized sweep under the shard_map engine mode: with
+    the array_map+aggregate refusal gone (r5), every lowerable
+    composition must also shard and stay bit-equal to the interpreter
+    across the 8-device mesh (fan-out scatter, cross-shard carries,
+    spill-on-error paths included)."""
+
+    def test_random_compositions_sharded(self):
+        import jax
+
+        n_dev = min(8, len(jax.devices()))
+        if n_dev < 2:
+            import pytest
+
+            pytest.skip("needs a multi-device mesh (conftest CPU mesh)")
+        rng = np.random.default_rng(131)
+        ran = 0
+        for trial in range(10):
+            depth = int(rng.integers(1, 3))
+            specs = [
+                _TRANSFORMS[int(rng.integers(0, len(_TRANSFORMS)))]
+                for _ in range(depth)
+            ]
+            tail = _TAILS[int(rng.integers(0, len(_TAILS)))]
+            if tail is not None:
+                specs = specs + [tail]
+
+            try:
+                sc = _build("tpu", specs, mesh_devices=n_dev)
+            except EngineError:
+                continue  # unlowerable composition
+            # every composition that lowers must also SHARD — a silent
+            # skip here would let a shard-refusal regression pass green
+            assert sc.tpu_chain._sharded is not None, (trial, specs)
+            pc = _build("python", specs)
+            values = _corpus(rng)
+            s_out = sc.process(
+                SmartModuleInput.from_records(_records(values), 7, 1000)
+            )
+            p_out = pc.process(
+                SmartModuleInput.from_records(_records(values), 7, 1000)
+            )
+            sv = [
+                (r.value, r.key, r.offset_delta, r.timestamp_delta)
+                for r in s_out.successes
+            ]
+            pv = [
+                (r.value, r.key, r.offset_delta, r.timestamp_delta)
+                for r in p_out.successes
+            ]
+            assert sv == pv, (trial, specs)
+            se = None if s_out.error is None else (s_out.error.offset, s_out.error.kind)
+            pe = None if p_out.error is None else (p_out.error.offset, p_out.error.kind)
+            assert se == pe, (trial, specs)
+            ran += 1
+        assert ran >= 5, f"only {ran} compositions actually sharded"
