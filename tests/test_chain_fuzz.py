@@ -74,45 +74,53 @@ def _build(backend, specs, mesh_devices=0):
     return b.initialize()
 
 
+def _fuzz_sweep(seed, trials, min_ran, mesh_devices=0, post_build=None):
+    """Shared sweep: random compositions, TPU (optionally sharded) vs
+    the per-record interpreter, full success + first-error parity."""
+    rng = np.random.default_rng(seed)
+    ran = 0
+    for trial in range(trials):
+        depth = int(rng.integers(1, 3))
+        specs = [
+            _TRANSFORMS[int(rng.integers(0, len(_TRANSFORMS)))]
+            for _ in range(depth)
+        ]
+        tail = _TAILS[int(rng.integers(0, len(_TAILS)))]
+        if tail is not None:
+            specs = specs + [tail]
+        try:
+            tc = _build("tpu", specs, mesh_devices=mesh_devices)
+        except EngineError:
+            continue  # unlowerable composition: auto mode would interpret
+        if post_build is not None:
+            post_build(tc, trial, specs)
+        pc = _build("python", specs)
+        values = _corpus(rng)
+        t_out = tc.process(
+            SmartModuleInput.from_records(_records(values), 7, 1000)
+        )
+        p_out = pc.process(
+            SmartModuleInput.from_records(_records(values), 7, 1000)
+        )
+        tv = [
+            (r.value, r.key, r.offset_delta, r.timestamp_delta)
+            for r in t_out.successes
+        ]
+        pv = [
+            (r.value, r.key, r.offset_delta, r.timestamp_delta)
+            for r in p_out.successes
+        ]
+        assert tv == pv, (trial, specs)
+        te = None if t_out.error is None else (t_out.error.offset, t_out.error.kind)
+        pe = None if p_out.error is None else (p_out.error.offset, p_out.error.kind)
+        assert te == pe, (trial, specs)
+        ran += 1
+    assert ran >= min_ran, f"only {ran} compositions actually lowered"
+
+
 class TestRandomChainFuzz:
     def test_random_compositions(self):
-        rng = np.random.default_rng(97)
-        ran = 0
-        for trial in range(16):
-            depth = int(rng.integers(1, 3))
-            specs = [
-                _TRANSFORMS[int(rng.integers(0, len(_TRANSFORMS)))]
-                for _ in range(depth)
-            ]
-            tail = _TAILS[int(rng.integers(0, len(_TAILS)))]
-            if tail is not None:
-                specs = specs + [tail]
-            try:
-                tc = _build("tpu", specs)
-            except EngineError:
-                continue  # unlowerable composition: auto mode would interpret
-            pc = _build("python", specs)
-            values = _corpus(rng)
-            t_out = tc.process(
-                SmartModuleInput.from_records(_records(values), 7, 1000)
-            )
-            p_out = pc.process(
-                SmartModuleInput.from_records(_records(values), 7, 1000)
-            )
-            tv = [
-                (r.value, r.key, r.offset_delta, r.timestamp_delta)
-                for r in t_out.successes
-            ]
-            pv = [
-                (r.value, r.key, r.offset_delta, r.timestamp_delta)
-                for r in p_out.successes
-            ]
-            assert tv == pv, (trial, specs)
-            te = None if t_out.error is None else (t_out.error.offset, t_out.error.kind)
-            pe = None if p_out.error is None else (p_out.error.offset, p_out.error.kind)
-            assert te == pe, (trial, specs)
-            ran += 1
-        assert ran >= 8, f"only {ran} compositions actually lowered"
+        _fuzz_sweep(seed=97, trials=16, min_ran=8)
 
 
 class TestShardedChainFuzz:
@@ -130,44 +138,13 @@ class TestShardedChainFuzz:
             import pytest
 
             pytest.skip("needs a multi-device mesh (conftest CPU mesh)")
-        rng = np.random.default_rng(131)
-        ran = 0
-        for trial in range(10):
-            depth = int(rng.integers(1, 3))
-            specs = [
-                _TRANSFORMS[int(rng.integers(0, len(_TRANSFORMS)))]
-                for _ in range(depth)
-            ]
-            tail = _TAILS[int(rng.integers(0, len(_TAILS)))]
-            if tail is not None:
-                specs = specs + [tail]
 
-            try:
-                sc = _build("tpu", specs, mesh_devices=n_dev)
-            except EngineError:
-                continue  # unlowerable composition
+        def must_shard(tc, trial, specs):
             # every composition that lowers must also SHARD — a silent
-            # skip here would let a shard-refusal regression pass green
-            assert sc.tpu_chain._sharded is not None, (trial, specs)
-            pc = _build("python", specs)
-            values = _corpus(rng)
-            s_out = sc.process(
-                SmartModuleInput.from_records(_records(values), 7, 1000)
-            )
-            p_out = pc.process(
-                SmartModuleInput.from_records(_records(values), 7, 1000)
-            )
-            sv = [
-                (r.value, r.key, r.offset_delta, r.timestamp_delta)
-                for r in s_out.successes
-            ]
-            pv = [
-                (r.value, r.key, r.offset_delta, r.timestamp_delta)
-                for r in p_out.successes
-            ]
-            assert sv == pv, (trial, specs)
-            se = None if s_out.error is None else (s_out.error.offset, s_out.error.kind)
-            pe = None if p_out.error is None else (p_out.error.offset, p_out.error.kind)
-            assert se == pe, (trial, specs)
-            ran += 1
-        assert ran >= 5, f"only {ran} compositions actually sharded"
+            # skip would let a shard-refusal regression pass green
+            assert tc.tpu_chain._sharded is not None, (trial, specs)
+
+        _fuzz_sweep(
+            seed=131, trials=10, min_ran=5,
+            mesh_devices=n_dev, post_build=must_shard,
+        )
