@@ -445,71 +445,86 @@ def stubborn(record):
     def test_unmetered_by_default_in_library(self):
         assert SmartEngine().hook_budget_ms == 0
 
-    def test_quarantine_is_per_module(self, monkeypatch):
+    @pytest.fixture
+    def hung_hook(self, monkeypatch):
+        """(hang, releases, metering) for abandonment tests: `hang`
+        blocks inside Event.wait (C code — async-exc injection cannot
+        land, so the watchdog must abandon the thread), the grace window
+        is shrunk, and teardown releases every hung thread even when the
+        test body fails: leaked spinners would otherwise count toward
+        the process-wide limit for the rest of the session."""
+        import threading
+
+        from fluvio_tpu.smartengine import metering as m
+
+        monkeypatch.setattr(m, "_KILL_GRACE_SECONDS", 0.2)
+        releases = []
+
+        def hang():
+            ev = threading.Event()
+            releases.append(ev)
+            ev.wait()
+
+        yield hang, releases, m
+        for ev in releases:
+            ev.set()
+
+    def test_quarantine_is_per_module(self, hung_hook):
         """Module A abandoning its hook-thread limit quarantines ONLY A;
         module B still executes metered (reference parity: per-instance
         trap isolation, wasmtime/state.rs:40-55)."""
-        import threading
-
-        from fluvio_tpu.smartengine import metering as m
-
-        monkeypatch.setattr(m, "_KILL_GRACE_SECONDS", 0.2)
-        releases = []
-
-        def hang():
-            # Event.wait blocks inside C, so async-exc injection cannot
-            # land: the watchdog must abandon the thread every time
-            ev = threading.Event()
-            releases.append(ev)
-            ev.wait()
-
-        try:
-            for _ in range(m._MODULE_ABANDONED_LIMIT):
-                with pytest.raises(m.SmartModuleFuelError) as ei:
-                    m.run_metered(hang, 50, "mod-a", key="key-a")
-                assert ei.value.abandoned
-            # module A is now refused without entering user code
+        hang, _, m = hung_hook
+        for _ in range(m._MODULE_ABANDONED_LIMIT):
             with pytest.raises(m.SmartModuleFuelError) as ei:
                 m.run_metered(hang, 50, "mod-a", key="key-a")
-            assert ei.value.quarantined == "module"
-            # module B is untouched
-            assert m.run_metered(lambda: 42, 500, "mod-b", key="key-b") == 42
-            state = m.quarantine_state()
-            assert "key-a" in state["quarantined_modules"]
-            assert state["process_circuit_broken"] is False
-            assert state["by_module"]["key-a"] == m._MODULE_ABANDONED_LIMIT
-        finally:
-            for ev in releases:
-                ev.set()
+            assert ei.value.abandoned
+        # module A is now refused without entering user code
+        with pytest.raises(m.SmartModuleFuelError) as ei:
+            m.run_metered(hang, 50, "mod-a", key="key-a")
+        assert ei.value.quarantined == "module"
+        # module B is untouched
+        assert m.run_metered(lambda: 42, 500, "mod-b", key="key-b") == 42
+        state = m.quarantine_state()
+        assert "key-a" in state["quarantined_modules"]
+        assert state["process_circuit_broken"] is False
+        assert state["by_module"]["key-a"] == m._MODULE_ABANDONED_LIMIT
 
-    def test_process_circuit_breaker_last_resort(self, monkeypatch):
+    def test_process_circuit_breaker_last_resort(self, hung_hook, monkeypatch):
         """Many DISTINCT modules abandoning threads trip the process-wide
         breaker: all metered execution is refused with a typed error
         naming the breaker (operator-visible via quarantine_state)."""
-        import threading
-
-        from fluvio_tpu.smartengine import metering as m
-
-        monkeypatch.setattr(m, "_KILL_GRACE_SECONDS", 0.2)
+        hang, _, m = hung_hook
         monkeypatch.setattr(m, "_ABANDONED_LIMIT", 2)
-        releases = []
+        for key in ("cb-1", "cb-2"):
+            with pytest.raises(m.SmartModuleFuelError):
+                m.run_metered(hang, 50, key, key=key)
+        with pytest.raises(m.SmartModuleFuelError) as ei:
+            m.run_metered(lambda: 1, 500, "cb-innocent", key="cb-innocent")
+        assert ei.value.quarantined == "process"
+        assert m.quarantine_state()["process_circuit_broken"] is True
 
-        def hang():
-            ev = threading.Event()
-            releases.append(ev)
-            ev.wait()
+    def test_quarantine_lifts_when_abandoned_threads_die(self, hung_hook):
+        """Quarantine is resource-scoped by design: it guards against
+        live spinner threads, so when a module's abandoned hooks finally
+        exit, the module may execute metered again (the error message
+        promises exactly 'while they stay alive')."""
+        import time
 
-        try:
-            for key in ("cb-1", "cb-2"):
-                with pytest.raises(m.SmartModuleFuelError):
-                    m.run_metered(hang, 50, key, key=key)
-            with pytest.raises(m.SmartModuleFuelError) as ei:
-                m.run_metered(lambda: 1, 500, "cb-innocent", key="cb-innocent")
-            assert ei.value.quarantined == "process"
-            assert m.quarantine_state()["process_circuit_broken"] is True
-        finally:
-            for ev in releases:
-                ev.set()
+        hang, releases, m = hung_hook
+        for _ in range(m._MODULE_ABANDONED_LIMIT):
+            with pytest.raises(m.SmartModuleFuelError):
+                m.run_metered(hang, 50, "mod-l", key="key-lift")
+        with pytest.raises(m.SmartModuleFuelError) as ei:
+            m.run_metered(lambda: 1, 500, "mod-l", key="key-lift")
+        assert ei.value.quarantined == "module"
+        for ev in releases:  # the spinners exit mid-test
+            ev.set()
+        for _ in range(100):  # wait for the released threads to die
+            if m.quarantine_state()["by_module"].get("key-lift", 0) == 0:
+                break
+            time.sleep(0.05)
+        assert m.run_metered(lambda: 7, 500, "mod-l", key="key-lift") == 7
+        assert "key-lift" not in m.quarantine_state()["quarantined_modules"]
 
     def test_quarantine_visible_in_spu_metrics(self):
         from fluvio_tpu.spu.metrics import SpuMetrics
