@@ -17,7 +17,6 @@ import os
 import subprocess
 import sys
 
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
@@ -53,19 +52,16 @@ def _run_example(name: str, *args: str) -> str:
     return proc.stdout
 
 
-@pytest.mark.timeout(240)
 def test_produce_consume_embedded():
     out = _run_example("produce_consume.py", "--embedded")
     assert "consumed" in out.lower() or "record" in out.lower(), out
 
 
-@pytest.mark.timeout(240)
 def test_smartmodule_consume_embedded():
     out = _run_example("smartmodule_consume.py", "--embedded")
     assert out.strip(), "example produced no output"
 
 
-@pytest.mark.timeout(240)
 def test_admin_topics_embedded():
     out = _run_example("admin_topics.py", "--embedded")
     assert out.strip(), "example produced no output"
