@@ -1,0 +1,101 @@
+"""Contract tests for bench.py's output JSON builder.
+
+BENCH_r{N}.json is the driver artifact the judge reads; these pin the
+shapes that round 5 introduced: an honest-zero headline wrapping a
+labeled cpu_fallback section when the chip is unreachable, backend
+labels on every healthy emit, aux sections (codecs) never becoming the
+headline, and degraded/headline_config markers.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_BENCH_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"
+)
+
+
+def _bench():
+    """Import bench.py as a module without running main()."""
+    if "bench" in sys.modules:
+        return sys.modules["bench"]
+    spec = importlib.util.spec_from_file_location("bench", _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+GOOD = {
+    "records_per_sec": 1000,
+    "baseline_records_per_sec": 500,
+    "vs_baseline": 2.0,
+    "first_call_s": 0.3,
+}
+
+
+def test_healthy_tpu_emit_carries_backend_and_cache():
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    out, rc = b._build_output({"2_filter_map": dict(GOOD)})
+    assert rc == 0
+    assert out["value"] == 1000 and out["vs_baseline"] == 2.0
+    assert out["backend"] == "tpu"
+    assert "xla_cache" in out
+    assert "degraded" not in out
+
+
+def test_cpu_fallback_wraps_honest_zero():
+    b = _bench()
+    b._BACKEND_MODE = "cpu_fallback"
+    out, rc = b._build_output({"2_filter_map": dict(GOOD)})
+    assert rc == 1
+    # the headline MUST stay zero: no CPU number may pose as on-chip
+    assert out["value"] == 0 and out["vs_baseline"] == 0
+    assert out["degraded"] is True and "unreachable" in out["error"]
+    inner = out["cpu_fallback"]
+    assert inner["value"] == 1000 and inner["backend"] == "cpu"
+    assert "NOT on-chip" in inner["note"]
+
+
+def test_cpu_fallback_with_no_results_still_emits():
+    """Rounds 3/4 lost their perf evidence to bare zeros; even a fully
+    failed fallback suite must yield a parseable JSON object."""
+    b = _bench()
+    b._BACKEND_MODE = "cpu_fallback"
+    out, rc = b._build_output({})
+    assert rc == 1 and out is not None
+    assert out["value"] == 0 and "cpu_fallback" in out
+
+
+def test_aux_sections_never_become_headline():
+    b = _bench()
+    b._BACKEND_MODE = "cpu"
+    results = {
+        "codecs": {"lz4": {"impl": "native"}},
+        "1_filter": dict(GOOD),
+    }
+    out, rc = b._build_output(results)
+    assert out["value"] == 1000
+    assert out["headline_config"] == "1_filter"  # substitute is labeled
+
+
+def test_watchdog_error_marks_degraded():
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    out, rc = b._build_output(
+        {"2_filter_map": dict(GOOD)}, extra_error="watchdog: stalled"
+    )
+    assert rc == 1 and out["degraded"] is True
+    assert out["error"] == "watchdog: stalled"
+    assert out["value"] == 1000  # best-so-far numbers still ride along
+
+
+def test_restricted_run_with_no_match_returns_none():
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    out, rc = b._build_output({})
+    assert out is None and rc == 2
