@@ -13,6 +13,8 @@ import importlib.util
 import os
 import sys
 
+import pytest
+
 _BENCH_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"
 )
@@ -35,6 +37,16 @@ GOOD = {
     "vs_baseline": 2.0,
     "first_call_s": 0.3,
 }
+
+
+@pytest.fixture(autouse=True)
+def _restore_backend_mode(monkeypatch):
+    """Each test sets bench._BACKEND_MODE explicitly; restore the
+    module default afterwards so the cached sys.modules entry cannot
+    leak state into later-importing tests."""
+    b = _bench()
+    monkeypatch.setattr(b, "_BACKEND_MODE", b._BACKEND_MODE)
+    yield
 
 
 def test_healthy_tpu_emit_carries_backend_and_cache():
