@@ -289,3 +289,57 @@ async def election(env: TestEnv) -> None:
         if admin is not None:
             await admin.close()
         await client.close()
+
+
+@fluvio_test(timeout_s=90)
+async def hostile_module(env: TestEnv) -> None:
+    """A SmartModule that never returns must not take the broker down:
+    its stream gets a typed fuel/quarantine error in bounded time, and
+    a plain consume on the same broker still serves (parity: the
+    reference's fuel-trap semantics under fluvio-test conditions;
+    wasmtime/state.rs:40-55)."""
+    from fluvio_tpu.schema.smartmodule import (
+        SmartModuleInvocation,
+        SmartModuleInvocationKind,
+        SmartModuleInvocationWasm,
+    )
+
+    looping = b"""
+@smartmodule.filter
+def f(record):
+    n = 0
+    while True:
+        n += 1
+    return True
+"""
+    driver = await TestDriver(env.sc_addr).connect()
+    try:
+        await driver.create_topic("hostile-test")
+        values = [f"hostile-{i}".encode() for i in range(50)]
+        await driver.produce_values("hostile-test", values)
+
+        consumer = await driver.client.partition_consumer("hostile-test", 0)
+        cfg = ConsumerConfig(
+            disable_continuous=True,
+            smartmodules=[
+                SmartModuleInvocation(
+                    wasm=SmartModuleInvocationWasm.adhoc(looping),
+                    kind=SmartModuleInvocationKind.FILTER,
+                )
+            ],
+        )
+        err = None
+        try:
+            async for _ in consumer.stream(Offset.beginning(), cfg):
+                pass
+        except Exception as e:  # noqa: BLE001 — the typed stream error
+            err = str(e)
+        assert err is not None, "looping module stream returned no error"
+        assert "budget" in err or "quarantin" in err, err
+
+        # the broker still serves plain consumes afterwards
+        got = await driver.consume_values("hostile-test", expect=len(values))
+        assert len(got) == len(values)
+        assert driver.verify_checksums(got)
+    finally:
+        await driver.close()
