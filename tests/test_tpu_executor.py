@@ -304,3 +304,90 @@ class TestWidthBuckets:
         got = run("tpu")
         assert got == run("python")
         assert len(got) == 50
+
+
+class TestDispatchPrefetch:
+    """Dispatch-time speculative D2H (the tunnel-RTT diet).
+
+    `dispatch_buffer` starts the header/mask copies and — once two
+    consecutive batches agree on a survivor bucket — the viewable
+    descriptor slices, speculatively. A stream whose survivor counts
+    shift buckets mid-flight must stay byte-correct through both the
+    hit and the miss path, and the miss must charge the wasted bytes
+    to the D2H counter.
+    """
+
+    def _bufs(self, counts):
+        from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+
+        out = []
+        for match_n in counts:
+            records = [
+                Record(value=(b"fluvio-%d" % i if i < match_n else b"drop-%d" % i))
+                for i in range(256)
+            ]
+            for i, r in enumerate(records):
+                r.offset_delta = i
+            out.append(RecordBuffer.from_records(records))
+        return out
+
+    def _chain(self, backend):
+        return build(
+            backend,
+            (lookup("regex-filter"), SmartModuleConfig(params={"regex": "fluvio"})),
+        )
+
+    def test_stream_correct_across_bucket_shift(self):
+        # the pipelined stream dispatches one batch ahead of the
+        # finishes that feed the bucket history, so arming lags one
+        # batch: the 40-run hits from its 4th dispatch, the 40->200
+        # shift misses twice (stale guess, then disagreeing history),
+        # and the 200-run re-arms and hits at its 4th batch
+        counts = [40, 40, 40, 40, 40, 200, 200, 200, 200]
+        tpu = self._chain("tpu").tpu_chain
+        piped = [
+            [r.value for r in out.to_records()]
+            for out in tpu.process_stream(iter(self._bufs(counts)))
+        ]
+        py = self._chain("python")
+        for vals, buf in zip(piped, self._bufs(counts)):
+            out = py.process(
+                SmartModuleInput.from_records(buf.to_records())
+            )
+            assert vals == [r.value for r in out.successes]
+
+    def test_spec_arms_hits_and_charges_misses(self):
+        tpu = self._chain("tpu").tpu_chain
+        b40, b200 = self._bufs([40, 200])
+
+        h = tpu.dispatch_buffer(b40)
+        assert "view" not in h[3]  # cold: no guess yet
+        tpu.finish_buffer(b40, h)
+        h = tpu.dispatch_buffer(b40)
+        assert "view" not in h[3]  # one observation: not armed yet
+        tpu.finish_buffer(b40, h)
+
+        h = tpu.dispatch_buffer(b40)
+        assert "view" in h[3]  # two agreeing buckets: armed
+        rows_guess = h[3]["view"][0]
+        hit_spec = h[3]["view"]
+        d2h_before = tpu.d2h_bytes_total
+        out = tpu.finish_buffer(b40, h)  # hit: same bucket
+        # the hit path must return the right BYTES (the prefetched
+        # descriptor slices drive the host-side value rebuild) ...
+        assert [r.value for r in out.to_records()] == [
+            b"fluvio-%d" % i for i in range(40)
+        ]
+        # ... and download the prefetched slices exactly once
+        hit_delta = tpu.d2h_bytes_total - d2h_before
+        assert hit_delta >= hit_spec[1].nbytes + hit_spec[2].nbytes
+        assert hit_delta < 2 * (hit_spec[1].nbytes + hit_spec[2].nbytes) + 4096
+
+        h = tpu.dispatch_buffer(b200)
+        assert "view" in h[3]
+        spec = h[3]["view"]
+        d2h_before = tpu.d2h_bytes_total
+        tpu.finish_buffer(b200, h)  # miss: bucket shifted
+        wasted = spec[1].nbytes + spec[2].nbytes
+        assert tpu.d2h_bytes_total - d2h_before >= wasted
+        assert tpu._spec_rows != rows_guess
