@@ -47,7 +47,7 @@ def _load():
             out = _BUILD_DIR / f"codecs-{digest}.so"
             if not out.exists():
                 _BUILD_DIR.mkdir(parents=True, exist_ok=True)
-                tmp = out.with_suffix(".so.tmp")
+                tmp = out.with_suffix(f".so.tmp.{os.getpid()}")
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
                      str(_SOURCE), "-o", str(tmp)],

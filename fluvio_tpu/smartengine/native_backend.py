@@ -99,7 +99,7 @@ def _compile_library() -> Path:
     if out.exists():
         return out
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
-    tmp = out.with_suffix(".so.tmp")
+    tmp = out.with_suffix(f".so.tmp.{os.getpid()}")
     cmd = [
         "g++",
         "-O2",

@@ -18,6 +18,7 @@ with a single XLA program per shape bucket.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -36,7 +37,7 @@ from fluvio_tpu.smartmodule.types import (
 )
 from fluvio_tpu.smartengine.config import SmartModuleConfig
 from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
-from fluvio_tpu.smartengine.tpu import kernels
+from fluvio_tpu.smartengine.tpu import glz, kernels
 from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer, apply_postops_host
 from fluvio_tpu.smartengine.tpu.lower import (
     Unlowerable,
@@ -435,7 +436,7 @@ class TpuChainExecutor:
             self._chain_fn_ragged,
             static_argnames=(
                 "width", "kwidth", "has_keys", "has_offsets", "ts_mode",
-                "fanout_cap",
+                "fanout_cap", "glz_bytes",
             ),
         )
         # do any stages write key columns? (drives D2H key download)
@@ -465,6 +466,15 @@ class TpuChainExecutor:
         # on CPU and on the real chip.
         self.h2d_bytes_total = 0
         self.d2h_bytes_total = 0
+        # glz link compression (smartengine/tpu/glz.py): record bytes
+        # cross the H2D link compressed and inflate ON DEVICE in the
+        # same jit as the chain. "auto" enables it off-CPU only — on
+        # the CPU backend there is no link to save, and tests opt in
+        # explicitly with FLUVIO_LINK_COMPRESS=on.
+        _lc = os.environ.get("FLUVIO_LINK_COMPRESS", "auto")
+        self._link_compress = _lc == "on" or (
+            _lc == "auto" and jax.default_backend() != "cpu"
+        )
         self._viewable = not agg_configs and all(
             isinstance(s, (_FilterStage, _ArrayMapStage))
             or (
@@ -707,6 +717,9 @@ class TpuChainExecutor:
         count,
         base_ts,
         carries,
+        glz_seqs=None,
+        glz_lits=None,
+        glz_depth=None,
         *,
         width: int,
         kwidth: int,
@@ -714,6 +727,7 @@ class TpuChainExecutor:
         has_offsets: bool,
         ts_mode: str,
         fanout_cap: Optional[int] = None,
+        glz_bytes: int = 0,
     ):
         """Reconstruct the padded matrix on device from the flat upload.
 
@@ -726,7 +740,19 @@ class TpuChainExecutor:
         lengths, arange offset deltas (``has_offsets=False``) and zero
         timestamp deltas (``ts_mode='zero'``) are synthesized, and
         ``ts_mode='i32'`` timestamps upload narrow and widen on device.
+
+        glz staging (``glz_bytes > 0``): the flat crossed the link
+        COMPRESSED — ``glz_seqs`` is (lit_lens u8, match_lens u8,
+        srcs i32) and ``glz_lits`` the literal stream; the gather-round
+        decode inflates to ``glz_bytes`` raw bytes on device, then
+        bitcasts to the same i32 words the raw path ships.
         """
+        if glz_bytes:
+            raw = glz.decompress_device(
+                glz_seqs[0], glz_seqs[1], glz_seqs[2], glz_lits,
+                glz_depth, glz_bytes,
+            )
+            flat = lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.int32)
         values, lengths = ragged_repad_words(flat, lengths, width)
         n = lengths.shape[0]
         keys, key_lengths, offset_deltas, timestamp_deltas = (
@@ -766,15 +792,15 @@ class TpuChainExecutor:
         bucket = self._bucket_bytes(max(len(flat), 4))
         if len(flat) < bucket:
             flat = np.pad(flat, (0, bucket - len(flat)))
-        # ship the aligned flat as i32 words (see _chain_fn_ragged);
-        # derivable columns stay off the link (synthesized on device)
-        flat = flat.view(np.int32)
+        flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes, flat_h2d = (
+            self._stage_flat(buf, flat, bucket)
+        )
         lengths_up, has_keys, has_offsets, ts_mode, ts_np = (
             stage_link_columns(buf)
         )
         ts_up = jnp.asarray(ts_np) if ts_np is not None else None
         header, packed, new_carries = self._jit_ragged(
-            jnp.asarray(flat),
+            flat_up,
             jnp.asarray(lengths_up),
             jnp.asarray(buf.keys) if has_keys else None,
             jnp.asarray(buf.key_lengths) if has_keys else None,
@@ -783,23 +809,71 @@ class TpuChainExecutor:
             jnp.int32(buf.count),
             jnp.int64(buf.base_timestamp),
             carries,
+            glz_seqs,
+            glz_lits,
+            glz_depth,
             width=buf.width,
             kwidth=buf.keys.shape[1],
             has_keys=has_keys,
             has_offsets=has_offsets,
             ts_mode=ts_mode,
             fanout_cap=fanout_cap,
+            glz_bytes=glz_bytes,
         )
         # keep aggregate state device-resident; host mirrors sync on demand
         self._device_carries = new_carries
         self.h2d_bytes_total += (
-            flat.nbytes
+            flat_h2d
             + lengths_up.nbytes
             + (buf.keys.nbytes + buf.key_lengths.nbytes if has_keys else 0)
             + (buf.offset_deltas.nbytes if has_offsets else 0)
             + (ts_up.nbytes if ts_up is not None else 0)
         )
         return header, packed
+
+    def _stage_flat(self, buf: RecordBuffer, flat: np.ndarray, bucket: int):
+        """Pick the flat's link form: glz-compressed or raw i32 words.
+
+        Returns (flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes,
+        h2d_bytes) — exactly one of flat_up / the glz arrays is
+        non-None. The compressed form is cached on the buffer (same
+        precedent as RecordBuffer.ragged_values caching the flat):
+        stream loops that re-dispatch one buffer pay the compressor
+        once. Token arrays bucket at pow2/8 like every other link
+        array so compile variants stay bounded.
+        """
+        if self._link_compress:
+            cached = getattr(buf, "_glz_cache", None)
+            if cached is not None and cached[0] == bucket:
+                comp = cached[1]
+            else:
+                comp = glz.compress(flat)
+                buf._glz_cache = (bucket, comp)
+            if comp is not None:
+                n_seq = len(comp.lit_lens)
+                seq_pad = self._bucket_bytes(max(n_seq, 8), floor=256)
+                lit_pad = self._bucket_bytes(max(comp.lits.size, 8), floor=256)
+                ll = np.zeros(seq_pad, np.uint8)
+                ll[:n_seq] = comp.lit_lens
+                ml = np.zeros(seq_pad, np.uint8)
+                ml[:n_seq] = comp.match_lens
+                srcs = np.zeros(seq_pad, np.int32)
+                srcs[:n_seq] = comp.srcs
+                lits = np.zeros(lit_pad, np.uint8)
+                lits[: comp.lits.size] = comp.lits
+                h2d = ll.nbytes + ml.nbytes + srcs.nbytes + lits.nbytes
+                return (
+                    None,
+                    (jnp.asarray(ll), jnp.asarray(ml), jnp.asarray(srcs)),
+                    jnp.asarray(lits),
+                    jnp.int32(comp.depth),
+                    bucket,
+                    h2d,
+                )
+        # ship the aligned flat as i32 words (see _chain_fn_ragged);
+        # derivable columns stay off the link (synthesized on device)
+        words = flat.view(np.int32)
+        return jnp.asarray(words), None, None, None, 0, words.nbytes
 
     def _ensure_host_state(self) -> None:
         if self._device_carries is None:

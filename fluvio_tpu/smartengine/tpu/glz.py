@@ -1,0 +1,258 @@
+"""glz link compression: host compressor bindings + device decompressor.
+
+The H2D link is a measured engine bottleneck when the tunnel degrades
+(BASELINE.md link calibration: 20-400 MB/s, wandering). glz keeps
+record bytes COMPRESSED across the link and inflates them on the
+device itself, inside the same jit program that re-pads and runs the
+chain — possible because the format (native/glz.cpp) is a list of
+LZ4-shaped sequences (literal run + match) whose matches never overlap
+their own output and whose match-chain depth is capped, turning
+decompression into a fixed number of vectorized gather rounds instead
+of a serial decode.
+
+Decode algorithm (all traced, static shapes):
+  1. per-sequence dst offsets = exclusive cumsum of lit_len+match_len;
+     literal-stream offsets = exclusive cumsum of lit_len
+  2. sequence id per output byte = scatter(1 at dst offsets) + cumsum
+  3. bytes inside the literal part: one gather from the literal stream
+  4. match bytes: `depth` rounds of out = out[src_idx] — round k
+     resolves every depth-k byte because its sources (depth < k)
+     resolved in earlier rounds
+
+Parity: the reference inflates wire compression on the CPU before its
+engine sees bytes (fluvio-compression/src/lib.rs); a CPU-side engine
+has nothing to gain from device-side inflation. Here it multiplies the
+effective link bandwidth by the corpus ratio (2-25x on JSON streams).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SOURCE = Path(__file__).resolve().parents[2] / "native" / "glz.cpp"
+_BUILD_DIR = Path(
+    os.environ.get("FLUVIO_TPU_NATIVE_BUILD", str(_SOURCE.parent / "_build"))
+)
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+MAX_DEPTH = 6       # gather rounds the device decode runs at most
+MIN_MATCH = 8       # sequences are 6 B; shorter matches don't pay
+MIN_INPUT = 4096    # below this the link time is noise — ship raw
+# worthwhile threshold: compressed bytes (seqs*6 + lits) must come in
+# under this fraction of raw before the executor switches the jit to
+# the compressed staging variant
+MAX_RATIO = 0.75
+
+
+class _GlzResult(ctypes.Structure):
+    _fields_ = [
+        ("n_seqs", ctypes.c_int64),
+        ("n_lits", ctypes.c_int64),
+        ("depth", ctypes.c_int32),
+        ("status", ctypes.c_int32),
+    ]
+
+
+def _load():
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            source = _SOURCE.read_bytes()
+            digest = hashlib.sha256(source).hexdigest()[:16]
+            out = _BUILD_DIR / f"glz-{digest}.so"
+            if not out.exists():
+                _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+                # per-process tmp name: concurrent builders must not
+                # write through the same inode the winner renames
+                tmp = out.with_suffix(f".so.tmp.{os.getpid()}")
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     str(_SOURCE), "-o", str(tmp)],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, out)
+            lib = ctypes.CDLL(str(out))
+        except (OSError, subprocess.CalledProcessError) as e:
+            logger.warning("glz link compression unavailable: %s", e)
+            _lib_failed = True
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.glz_compress.restype = _GlzResult
+        lib.glz_compress.argtypes = [
+            u8p, ctypes.c_int64,
+            u8p, u8p, i32p, ctypes.c_int64,
+            u8p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.glz_decompress.restype = ctypes.c_int32
+        lib.glz_decompress.argtypes = [
+            u8p, u8p, i32p, ctypes.c_int64,
+            u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class Compressed(NamedTuple):
+    lit_lens: np.ndarray    # uint8[n_seqs]
+    match_lens: np.ndarray  # uint8[n_seqs]
+    srcs: np.ndarray        # int32[n_seqs]
+    lits: np.ndarray        # uint8[n_lits]
+    depth: int              # gather rounds needed (<= MAX_DEPTH)
+    out_len: int            # decompressed size == len(raw)
+
+    @property
+    def nbytes(self) -> int:
+        return (self.lit_lens.nbytes + self.match_lens.nbytes
+                + self.srcs.nbytes + self.lits.nbytes)
+
+
+def compress(raw: np.ndarray, max_ratio: float = MAX_RATIO) -> Optional[Compressed]:
+    """Compress a uint8 array; None when raw is the better ship.
+
+    Returns None when the native library is unavailable, the input is
+    tiny, the compressor bailed (incompressible), or the achieved ratio
+    is worse than ``max_ratio`` — callers fall back to the raw staging
+    path in all those cases.
+    """
+    lib = _load()
+    n = int(raw.size)
+    if lib is None or n < MIN_INPUT:
+        return None
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    seq_cap = n // 4 + 64
+    lit_lens = np.empty(seq_cap, dtype=np.uint8)
+    match_lens = np.empty(seq_cap, dtype=np.uint8)
+    srcs = np.empty(seq_cap, dtype=np.int32)
+    lits = np.empty(n + 64, dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    res = lib.glz_compress(
+        raw.ctypes.data_as(u8p), n,
+        lit_lens.ctypes.data_as(u8p), match_lens.ctypes.data_as(u8p),
+        srcs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), seq_cap,
+        lits.ctypes.data_as(u8p), lits.size,
+        MAX_DEPTH, MIN_MATCH,
+    )
+    if res.status != 0:
+        return None
+    ns, nl = int(res.n_seqs), int(res.n_lits)
+    if ns * 6 + nl > n * max_ratio:
+        return None
+    return Compressed(
+        lit_lens=lit_lens[:ns].copy(), match_lens=match_lens[:ns].copy(),
+        srcs=srcs[:ns].copy(), lits=lits[:nl].copy(),
+        depth=max(int(res.depth), 1), out_len=n,
+    )
+
+
+def decompress_host(comp: Compressed) -> np.ndarray:
+    """Native reference decompressor (tests / debugging oracle)."""
+    lib = _load()
+    assert lib is not None
+    out = np.empty(comp.out_len, dtype=np.uint8)
+    ll = np.ascontiguousarray(comp.lit_lens, dtype=np.uint8)
+    ml = np.ascontiguousarray(comp.match_lens, dtype=np.uint8)
+    srcs = np.ascontiguousarray(comp.srcs, dtype=np.int32)
+    lits = np.ascontiguousarray(comp.lits, dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.glz_decompress(
+        ll.ctypes.data_as(u8p), ml.ctypes.data_as(u8p),
+        srcs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), ll.size,
+        lits.ctypes.data_as(u8p), lits.size,
+        out.ctypes.data_as(u8p), out.size,
+    )
+    if rc != 0:
+        raise ValueError(f"corrupt glz stream (rc={rc})")
+    return out
+
+
+def decompress_numpy(comp: Compressed) -> np.ndarray:
+    """Pure-numpy mirror of the DEVICE algorithm (same gather rounds).
+
+    Exists so tests can pin the traced program's semantics against an
+    executable spec without a jax dependency; must stay in lockstep
+    with ``decompress_device``.
+    """
+    out_len = comp.out_len
+    ll = comp.lit_lens.astype(np.int64)
+    ml = comp.match_lens.astype(np.int64)
+    total = ll + ml
+    dst_start = np.cumsum(total) - total
+    lit_start = np.cumsum(ll) - ll
+    marks = np.zeros(out_len, dtype=np.int64)
+    valid = (dst_start < out_len) & (total > 0)
+    np.add.at(marks, dst_start[valid], 1)
+    seq_id = np.cumsum(marks) - 1
+    within = np.arange(out_len, dtype=np.int64) - dst_start[seq_id]
+    in_lit = within < ll[seq_id]
+    nlit = max(comp.lits.size, 1)
+    lit_idx = np.clip(lit_start[seq_id] + within, 0, nlit - 1)
+    lits = comp.lits if comp.lits.size else np.zeros(1, np.uint8)
+    out = np.where(in_lit, lits[lit_idx], 0).astype(np.uint8)
+    midx = np.clip(
+        comp.srcs.astype(np.int64)[seq_id] + (within - ll[seq_id]),
+        0, out_len - 1,
+    )
+    for _ in range(comp.depth):
+        out = np.where(in_lit, out, out[midx])
+    return out
+
+
+def decompress_device(lit_lens, match_lens, srcs, lits, depth, out_len: int):
+    """Traced gather-round decode: uint8[out_len] from sequence arrays.
+
+    Sequence arrays may be zero-padded past the real count (link
+    bucketing) — pad sequences have lit_len == match_len == 0, land at
+    dst == out_len, and drop out of the scatter. ``depth`` is a traced
+    scalar so batches with different chain depths share one compiled
+    program (fori_loop dynamic bound).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    ll = lit_lens.astype(jnp.int32)
+    ml = match_lens.astype(jnp.int32)
+    total = ll + ml
+    dst_start = jnp.cumsum(total) - total
+    lit_start = jnp.cumsum(ll) - ll
+    # pad sequences (total == 0) may share dst_start with a real
+    # sequence; scatter them out of range so only real sequences mark
+    marks_at = jnp.where(total > 0, dst_start, out_len)
+    marks = jnp.zeros((out_len,), jnp.int32).at[marks_at].add(1, mode="drop")
+    seq_id = jnp.cumsum(marks) - 1
+    within = jnp.arange(out_len, dtype=jnp.int32) - jnp.take(dst_start, seq_id)
+    seq_ll = jnp.take(ll, seq_id)
+    in_lit = within < seq_ll
+    lit_idx = jnp.clip(
+        jnp.take(lit_start, seq_id) + within, 0, lits.shape[0] - 1
+    )
+    out = jnp.where(in_lit, jnp.take(lits, lit_idx), 0).astype(jnp.uint8)
+    midx = jnp.clip(
+        jnp.take(srcs, seq_id) + (within - seq_ll), 0, out_len - 1
+    )
+
+    def round_(_, o):
+        return jnp.where(in_lit, o, jnp.take(o, midx))
+
+    return lax.fori_loop(0, depth, round_, out)

@@ -1,0 +1,243 @@
+"""glz link compression: format round-trips + compressed staging parity.
+
+The format contract lives in native/glz.cpp; the device decode in
+smartengine/tpu/glz.py. Three implementations must agree byte-for-byte:
+the native sequential decoder (oracle), the numpy gather-round mirror
+(executable spec of the device algorithm), and the traced JAX decode
+the executor actually runs. The executor-level tests force
+FLUVIO_LINK_COMPRESS=on (the CPU backend defaults it off — no link to
+save) and pin the compressed staging path against the python engine.
+"""
+
+import numpy as np
+import pytest
+
+from fluvio_tpu.smartengine.tpu import glz
+
+pytestmark = pytest.mark.skipif(
+    not glz.available(), reason="native glz library unavailable"
+)
+
+
+def _json_corpus(n, seed=2024):
+    rng = np.random.default_rng(seed)
+    names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "redpanda", "flink"]
+    vals = [
+        f'{{"name":"{names[rng.integers(0, 6)]}-{i & 255}",'
+        f'"n":{rng.integers(0, 100000)}}}'.encode()
+        for i in range(n)
+    ]
+    return np.frombuffer(b"".join(vals), dtype=np.uint8).copy()
+
+
+CORPORA = {
+    "json": lambda: _json_corpus(4000),
+    "zeros": lambda: np.zeros(64 * 1024, np.uint8),
+    "run": lambda: np.frombuffer(b"ab" * 40000, np.uint8).copy(),
+    "period28": lambda: np.frombuffer(
+        b'{"name":"fluvio-1","n":123}\n' * 3000, np.uint8
+    ).copy(),
+    "mixed": lambda: np.concatenate(
+        [
+            _json_corpus(1000),
+            np.random.default_rng(3).integers(0, 256, 8192).astype(np.uint8),
+            _json_corpus(1000, seed=5),
+        ]
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CORPORA))
+def test_round_trip_all_decoders(name):
+    raw = CORPORA[name]()
+    comp = glz.compress(raw, max_ratio=1.0)
+    assert comp is not None, f"{name}: expected compressible"
+    assert comp.depth <= glz.MAX_DEPTH
+    # native sequential oracle (also validates the non-overlap
+    # invariant: rc=3 on any match reaching into its own output)
+    assert np.array_equal(glz.decompress_host(comp), raw)
+    # numpy mirror of the device gather rounds
+    assert np.array_equal(glz.decompress_numpy(comp), raw)
+
+
+def test_incompressible_ships_raw():
+    raw = np.random.default_rng(11).integers(0, 256, 128 * 1024).astype(np.uint8)
+    assert glz.compress(raw) is None
+
+
+def test_tiny_input_ships_raw():
+    assert glz.compress(np.zeros(64, np.uint8)) is None
+
+
+def test_ratio_threshold_respected():
+    raw = CORPORA["json"]()
+    comp = glz.compress(raw, max_ratio=1.0)
+    assert comp is not None
+    ratio = comp.nbytes / raw.size
+    # a threshold below the achieved ratio must refuse the stream
+    assert glz.compress(raw, max_ratio=ratio * 0.5) is None
+    # and one above it must accept
+    assert glz.compress(raw, max_ratio=min(ratio * 1.5, 1.0)) is not None
+
+
+def test_oracle_rejects_zero_total_sequences():
+    # interior (0,0) sequences are invalid glz: the device labeling
+    # cannot represent them, so the native oracle must fail closed
+    lit_lens = np.array([12, 0, 0], np.uint8)
+    match_lens = np.array([0, 0, 8], np.uint8)
+    srcs = np.array([-1, 99, 4], np.int32)
+    comp = glz.Compressed(
+        lit_lens=lit_lens, match_lens=match_lens, srcs=srcs,
+        lits=np.arange(12, dtype=np.uint8), depth=1, out_len=20,
+    )
+    with pytest.raises(ValueError):
+        glz.decompress_host(comp)
+
+
+def test_fuzz_structured_round_trips():
+    rng = np.random.default_rng(42)
+    pieces = [rng.integers(0, 256, rng.integers(4, 64)).astype(np.uint8)
+              for _ in range(32)]
+    for trial in range(20):
+        order = rng.integers(0, len(pieces), rng.integers(50, 400))
+        raw = np.concatenate([pieces[k] for k in order])
+        comp = glz.compress(raw, max_ratio=1.0)
+        if comp is None:
+            continue
+        assert np.array_equal(glz.decompress_host(comp), raw), trial
+        assert np.array_equal(glz.decompress_numpy(comp), raw), trial
+
+
+def test_device_decode_matches_numpy_mirror():
+    import jax
+    import jax.numpy as jnp
+
+    raw = CORPORA["json"]()
+    comp = glz.compress(raw, max_ratio=1.0)
+    assert comp is not None
+    # pad token arrays the way the executor's staging does
+    n_seq = len(comp.lit_lens)
+    seq_pad = n_seq + 37  # deliberately unaligned padding
+    ll = np.zeros(seq_pad, np.uint8)
+    ll[:n_seq] = comp.lit_lens
+    ml = np.zeros(seq_pad, np.uint8)
+    ml[:n_seq] = comp.match_lens
+    srcs = np.zeros(seq_pad, np.int32)
+    srcs[:n_seq] = comp.srcs
+    lits = np.zeros(comp.lits.size + 11, np.uint8)
+    lits[: comp.lits.size] = comp.lits
+
+    fn = jax.jit(
+        lambda a, b, c, d, depth: glz.decompress_device(
+            a, b, c, d, depth, comp.out_len
+        )
+    )
+    out = np.asarray(
+        fn(jnp.asarray(ll), jnp.asarray(ml), jnp.asarray(srcs),
+           jnp.asarray(lits), jnp.int32(comp.depth))
+    )
+    assert np.array_equal(out, raw)
+
+
+def _build(backend, specs):
+    from fluvio_tpu.models import lookup
+    from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+
+    b = SmartEngine(backend=backend).builder()
+    for name, params in specs:
+        b.add_smart_module(SmartModuleConfig(params=params or {}), lookup(name))
+    return b.initialize()
+
+
+def _run_chain(backend, specs, vals, ts=None):
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    chain = _build(backend, specs)
+    records = [Record(value=v) for v in vals]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+        if ts is not None:
+            r.timestamp_delta = int(ts[i])
+    out = chain.process(
+        SmartModuleInput.from_records(records, 0, 1_000_000)
+    )
+    assert out.error is None, out.error
+    return chain, [(r.value, r.key, r.offset_delta) for r in out.successes]
+
+
+@pytest.mark.parametrize(
+    "specs,with_ts",
+    [
+        ([("regex-filter", {"regex": "fluvio"}),
+          ("json-map", {"field": "name"})], False),
+        ([("aggregate-field", {"field": "n", "combine": "add"})], False),
+        # timestamps ride the i32 narrowing tier alongside the glz
+        # decode — the combination must stay covered
+        ([("windowed-sum", {"kind": "sum_int", "window_ms": "1000"})], True),
+        ([("array-map-json", None)], False),
+    ],
+    ids=["filter_map", "aggregate", "windowed_ts", "array_map"],
+)
+def test_executor_compressed_staging_parity(monkeypatch, specs, with_ts):
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    rng = np.random.default_rng(7)
+    names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "redpanda", "flink"]
+    if specs[0][0] == "array-map-json":
+        vals = [
+            f'["a{i & 31}","b{rng.integers(0, 1000)}",{i},"x"]'.encode()
+            for i in range(6000)
+        ]
+    elif specs[0][0] == "windowed-sum":
+        # repetitive enough that glz engages even on an int corpus
+        vals = [f"{i & 63:06d}".encode() for i in range(6000)]
+    else:
+        vals = [
+            f'{{"name":"{names[rng.integers(0, 6)]}-{i & 255}",'
+            f'"n":{rng.integers(0, 100000)}}}'.encode()
+            for i in range(6000)
+        ]
+    ts = ((np.arange(len(vals), dtype=np.int64) * 7919) % 60_000
+          if with_ts else None)
+    chain, got = _run_chain("tpu", specs, vals, ts)
+    assert chain.backend_in_use == "tpu"
+    ex = chain.tpu_chain
+    assert ex._link_compress, "compressed staging should be enabled"
+    _, ref = _run_chain("python", specs, vals, ts)
+    assert got == ref
+
+
+def test_executor_raw_fallback_on_incompressible(monkeypatch):
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    rng = np.random.default_rng(13)
+    # high-entropy payloads: glz bails, the executor ships raw words
+    vals = [bytes(rng.integers(33, 127, 40).astype(np.uint8)) + b"fluvio"
+            for i in range(4000)]
+    specs = [("regex-filter", {"regex": "fluvio"})]
+    chain, got = _run_chain("tpu", specs, vals)
+    _, ref = _run_chain("python", specs, vals)
+    assert got == ref
+
+
+def test_stream_reuse_hits_compression_cache(monkeypatch):
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+
+    vals = [f'{{"name":"fluvio-{i & 255}","n":{i}}}'.encode()
+            for i in range(6000)]
+    chain = _build("tpu", [("regex-filter", {"regex": "fluvio"})])
+    ex = chain.tpu_chain
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    records = [Record(value=v) for v in vals]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+    inp = SmartModuleInput.from_records(records)
+    buf = RecordBuffer.from_smartmodule_input(inp)
+    outs = list(ex.process_stream(iter([buf, buf, buf])))
+    assert len(outs) == 3
+    assert getattr(buf, "_glz_cache", None) is not None
+    h2d_per = ex.h2d_bytes_total / 3
+    flat, _ = buf.ragged_values()
+    assert h2d_per < flat.nbytes, "compressed batches should undercut raw"
