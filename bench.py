@@ -388,6 +388,16 @@ def run_config(name: str, cfg: dict, n: int, smoke: bool, deadline=None) -> dict
         "first_call_s": round(first_call, 2),
         "link_mb": [round(m, 2) for m in link_mb],
     }
+    # glz link compression attribution: which form the flat crossed in
+    # (link_mb above already reflects the compressed byte count)
+    glz_cache = getattr(buf, "_glz_cache", None)
+    if chain.tpu_chain._link_compress and glz_cache is not None:
+        comp = glz_cache[1]
+        flat_raw, _ = buf.ragged_values()
+        result["glz_ratio"] = (
+            round(comp.nbytes / max(len(flat_raw), 1), 3)
+            if comp is not None else None  # None = shipped raw (bailed)
+        )
     if _LINK.get("h2d_mb_s") and _LINK.get("d2h_mb_s"):
         # what this batch's transfers alone cost on the measured link:
         # pass_ms at (or under) this floor means the pipeline is
@@ -786,6 +796,16 @@ def _calibrate_link() -> None:
             f"link: rtt {_LINK['rtt_ms']}ms, "
             f"H2D {h2d:.0f} MB/s, D2H {d2h:.0f} MB/s"
         )
+        # weather-adaptive glz: compressed staging pays exactly when
+        # the link is slower than the compressor (~40-170 MB/s by
+        # corpus); on a fast link the raw path is already cheap and the
+        # device decode rounds are pure overhead. Respect an operator
+        # pin; otherwise decide from the measured H2D rate.
+        if "FLUVIO_LINK_COMPRESS" not in os.environ:
+            mode = "on" if h2d < 150 else "off"
+            os.environ["FLUVIO_LINK_COMPRESS"] = mode
+            _LINK["glz"] = mode
+            log(f"link compression: {mode} (H2D {h2d:.0f} MB/s)")
     except Exception as e:  # noqa: BLE001 — calibration must never kill a run
         log(f"link calibration failed: {type(e).__name__}: {e}")
 

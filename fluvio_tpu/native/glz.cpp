@@ -137,43 +137,88 @@ GlzResult glz_compress(const uint8_t* in, int64_t n,
         lit_anchor = upto;
     };
 
-    int64_t i = 0;
-    int64_t next_bail = 1 << 20;
-    while (i + 8 <= n && !overflow) {
-        uint64_t seq8 = load64(in + i);
+    // probe the three candidate generations at `pos`: the FIRST
+    // occurrence ever (a stable early-corpus dictionary; also the only
+    // slot far enough back to encode short-period runs, since matches
+    // may not overlap their own output), the most recent depth-0
+    // occurrence, and the most recent occurrence
+    auto probe = [&](int64_t pos, int64_t& best_len, int64_t& best_src,
+                     int& best_d) {
+        uint64_t seq8 = load64(in + pos);
         uint32_t h = hash64(seq8);
-        // three candidate generations: the FIRST occurrence ever (a
-        // stable early-corpus dictionary; also the only slot far
-        // enough back to encode short-period runs, since matches may
-        // not overlap their own output), the most recent depth-0
-        // occurrence, and the most recent occurrence
         int64_t cands[3] = {anchor[h], shallow[h], recent[h]};
-        if (anchor[h] < 0) anchor[h] = i;
-        int64_t best_len = 0, best_src = -1;
-        int best_d = 0;
+        best_len = 0; best_src = -1; best_d = 0;
         for (int ci = 0; ci < 3; ci++) {
             int64_t c = cands[ci];
             if (c < 0 || c == best_src) continue;
             if (load64(in + c) != seq8) continue;
             // non-overlap invariant: source must end at or before dst
-            int64_t cap = i - c;
-            if (cap > n - i) cap = n - i;
+            int64_t cap = pos - c;
+            if (cap > n - pos) cap = n - pos;
             if (cap < min_match) continue;
-            // depth-bounded extension: stop at the first source byte
+            // two-phase extension: word-wise equality first (the 8-byte
+            // prefix is already known equal), then one linear scan of
+            // the source's depth bytes, truncating at the first byte
             // that would push the match past max_depth
-            int d = 0;
-            int64_t len = 0;
-            while (len < cap && in[c + len] == in[i + len]
-                   && depth[c + len] < max_depth) {
-                if (depth[c + len] > d) d = depth[c + len];
-                len++;
+            int64_t len = 8;
+            while (len + 8 <= cap) {
+                uint64_t x = load64(in + c + len) ^ load64(in + pos + len);
+                if (x) { len += __builtin_ctzll(x) >> 3; goto scanned; }
+                len += 8;
+            }
+            while (len < cap && in[c + len] == in[pos + len]) len++;
+        scanned:
+            int d;
+            d = 0;
+            for (int64_t k = 0; k < len; k++) {
+                if (depth[c + k] >= max_depth) { len = k; break; }
+                if (depth[c + k] > d) d = depth[c + k];
             }
             if (len < min_match || len <= best_len) continue;
             best_len = len;
             best_src = c;
             best_d = d + 1;
         }
+        return h;
+    };
+
+    int64_t i = 0;
+    int64_t next_bail = 1 << 20;
+    // lazy carry: a deferred-to match probed at i+1 last iteration is
+    // reused as this iteration's match instead of re-probing (the only
+    // table insert since — the skipped position itself — can never win:
+    // its cap is 1 < min_match)
+    int64_t pend_len = 0, pend_src = -1;
+    int pend_d = 0;
+    bool pend_valid = false;
+    while (i + 8 <= n && !overflow) {
+        int64_t best_len, best_src;
+        int best_d;
+        uint32_t h;
+        if (pend_valid) {
+            h = hash64(load64(in + i));  // tables still learn this pos
+            best_len = pend_len; best_src = pend_src; best_d = pend_d;
+            pend_valid = false;
+        } else {
+            h = probe(i, best_len, best_src, best_d);
+        }
+        if (anchor[h] < 0) anchor[h] = i;
         recent[h] = i;
+        if (best_len && i + 9 <= n) {
+            // one-step-lazy (LZ4-HC flavor): when the match starting at
+            // the NEXT byte is strictly longer, keeping this byte
+            // literal buys a longer sequence overall
+            int64_t lazy_len, lazy_src;
+            int lazy_d;
+            probe(i + 1, lazy_len, lazy_src, lazy_d);
+            if (lazy_len > best_len + 1) {
+                shallow[h] = i;
+                pend_len = lazy_len; pend_src = lazy_src; pend_d = lazy_d;
+                pend_valid = true;
+                i += 1;
+                continue;
+            }
+        }
         if (best_len) {
             emit(i, best_len, best_src);
             std::memset(depth + i, best_d, (size_t)best_len);

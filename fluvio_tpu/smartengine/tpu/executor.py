@@ -18,6 +18,7 @@ with a single XLA program per shape bucket.
 
 from __future__ import annotations
 
+import logging
 import os
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
@@ -799,27 +800,53 @@ class TpuChainExecutor:
             stage_link_columns(buf)
         )
         ts_up = jnp.asarray(ts_np) if ts_np is not None else None
-        header, packed, new_carries = self._jit_ragged(
-            flat_up,
-            jnp.asarray(lengths_up),
-            jnp.asarray(buf.keys) if has_keys else None,
-            jnp.asarray(buf.key_lengths) if has_keys else None,
-            jnp.asarray(buf.offset_deltas) if has_offsets else None,
-            ts_up,
-            jnp.int32(buf.count),
-            jnp.int64(buf.base_timestamp),
-            carries,
-            glz_seqs,
-            glz_lits,
-            glz_depth,
-            width=buf.width,
-            kwidth=buf.keys.shape[1],
-            has_keys=has_keys,
-            has_offsets=has_offsets,
-            ts_mode=ts_mode,
-            fanout_cap=fanout_cap,
-            glz_bytes=glz_bytes,
-        )
+
+        def _call():
+            return self._jit_ragged(
+                flat_up,
+                jnp.asarray(lengths_up),
+                jnp.asarray(buf.keys) if has_keys else None,
+                jnp.asarray(buf.key_lengths) if has_keys else None,
+                jnp.asarray(buf.offset_deltas) if has_offsets else None,
+                ts_up,
+                jnp.int32(buf.count),
+                jnp.int64(buf.base_timestamp),
+                carries,
+                glz_seqs,
+                glz_lits,
+                glz_depth,
+                width=buf.width,
+                kwidth=buf.keys.shape[1],
+                has_keys=has_keys,
+                has_offsets=has_offsets,
+                ts_mode=ts_mode,
+                fanout_cap=fanout_cap,
+                glz_bytes=glz_bytes,
+            )
+
+        try:
+            header, packed, new_carries = _call()
+        except Exception as e:
+            if not glz_bytes:
+                raise
+            # self-healing: a backend that cannot compile/run the
+            # gather-round decode must not take the engine down —
+            # disable link compression for this executor and re-ship
+            # the batch raw (trace/compile errors surface at call time;
+            # async runtime failures heal in finish_buffer)
+            logging.getLogger(__name__).warning(
+                "glz device decode failed; link compression disabled: %s", e
+            )
+            self._link_compress = False
+            buf._glz_cache = None
+            # the compressed token arrays already crossed the link
+            # before the failure — keep them on the counter
+            self.h2d_bytes_total += flat_h2d
+            flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes, flat_h2d = (
+                self._stage_flat(buf, flat, bucket)
+            )
+            header, packed, new_carries = _call()
+        self._glz_last = bool(glz_bytes)
         # keep aggregate state device-resident; host mirrors sync on demand
         self._device_carries = new_carries
         self.h2d_bytes_total += (
@@ -1378,6 +1405,9 @@ class TpuChainExecutor:
         prev_carries = self._device_carries
         header, packed = self._dispatch(buf, fanout_cap=self._fanout_cap(buf))
         spec = self._start_result_copies(buf, header, packed)
+        # finish-side self-heal marker: whether THIS dispatch shipped a
+        # glz-compressed flat (async runtime failures surface at fetch)
+        spec["glz_used"] = getattr(self, "_glz_last", False)
         return (prev_carries, header, packed, spec)
 
     def _start_result_copies(self, buf: RecordBuffer, header, packed) -> Dict:
@@ -1467,6 +1497,24 @@ class TpuChainExecutor:
             self._charge_unfetched_spec(handle)
             self._device_carries = prev_carries
             raise
+        except Exception as e:
+            # async half of the glz self-heal (_dispatch catches trace/
+            # compile errors; device RUNTIME failures surface here when
+            # results are consumed): disable compression, roll carries
+            # back, re-run the batch raw. Unrelated failures re-raise
+            # from the raw retry.
+            if not (spec and spec.get("glz_used")) or not self._link_compress:
+                raise
+            logging.getLogger(__name__).warning(
+                "glz decode failed at fetch; link compression disabled: %s", e
+            )
+            self._link_compress = False
+            buf._glz_cache = None
+            self._device_carries = prev_carries
+            header, packed = self._dispatch(
+                buf, fanout_cap=self._fanout_cap(buf)
+            )
+            return self._fetch(buf, header, packed)
 
     def process_buffer(self, buf: RecordBuffer) -> RecordBuffer:
         """Array-in/array-out path (bench + broker stream path)."""

@@ -241,3 +241,50 @@ def test_stream_reuse_hits_compression_cache(monkeypatch):
     h2d_per = ex.h2d_bytes_total / 3
     flat, _ = buf.ragged_values()
     assert h2d_per < flat.nbytes, "compressed batches should undercut raw"
+
+
+def test_device_decode_failure_self_heals(monkeypatch):
+    # a backend that cannot run the gather-round decode must fall back
+    # to raw staging transparently (and stop compressing afterwards)
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+
+    def boom(*a, **k):
+        raise RuntimeError("no gather support on this backend")
+
+    monkeypatch.setattr(glz, "decompress_device", boom)
+    vals = [f'{{"name":"fluvio-{i & 255}","n":{i}}}'.encode()
+            for i in range(6000)]
+    chain, got = _run_chain("tpu", [("regex-filter", {"regex": "fluvio"})],
+                            vals)
+    assert not chain.tpu_chain._link_compress, "flag should latch off"
+    _, ref = _run_chain("python", [("regex-filter", {"regex": "fluvio"})],
+                        vals)
+    assert got == ref
+
+
+def test_fetch_time_decode_failure_self_heals(monkeypatch):
+    # async half of the self-heal: a runtime failure surfacing at fetch
+    # (not at trace/compile) must also latch compression off and retry
+    # the batch raw
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    from fluvio_tpu.smartengine.tpu.executor import TpuChainExecutor
+
+    real_fetch = TpuChainExecutor._fetch
+    state = {"bombed": False}
+
+    def fetch_bomb(self, buf, header, packed, spec=None):
+        if spec and spec.get("glz_used") and not state["bombed"]:
+            state["bombed"] = True
+            raise RuntimeError("simulated device runtime failure")
+        return real_fetch(self, buf, header, packed, spec)
+
+    monkeypatch.setattr(TpuChainExecutor, "_fetch", fetch_bomb)
+    vals = [f'{{"name":"fluvio-{i & 255}","n":{i}}}'.encode()
+            for i in range(6000)]
+    chain, got = _run_chain("tpu", [("regex-filter", {"regex": "fluvio"})],
+                            vals)
+    assert state["bombed"], "the fetch bomb should have fired"
+    assert not chain.tpu_chain._link_compress, "flag should latch off"
+    _, ref = _run_chain("python", [("regex-filter", {"regex": "fluvio"})],
+                        vals)
+    assert got == ref
