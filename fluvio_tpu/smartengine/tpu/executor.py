@@ -392,7 +392,8 @@ def stage_link_columns(buf):
 
     Returns (lengths_up, has_keys, has_offsets, ts_mode, ts_up):
     derivable columns report as absent (arange offsets, zero
-    timestamps), timestamps narrow to i32 when they fit, lengths ride
+    timestamps), timestamps ride the narrowest of u16/i32/i64 that
+    holds every delta, lengths ride
     the narrowest of u8/u16 the record width allows. Arrays are
     unpadded — each caller pads/buckets for its own layout."""
     has_keys = buf.has_keys()
@@ -403,6 +404,10 @@ def stage_link_columns(buf):
     live_ts = buf.timestamp_deltas[: buf.count]
     if buf.count == 0 or not live_ts.any():
         ts_mode, ts_up = "zero", None
+    elif live_ts.min() >= 0 and live_ts.max() < 2**16:
+        # the common stream shape: small non-negative deltas from the
+        # batch base — half the i32 tier's link bytes
+        ts_mode, ts_up = "u16", buf.timestamp_deltas.astype(np.uint16)
     elif np.abs(live_ts).max() < 2**31:
         ts_mode, ts_up = "i32", buf.timestamp_deltas.astype(np.int32)
     else:
@@ -740,7 +745,7 @@ class TpuChainExecutor:
         the link: row starts come from a device cumsum of the aligned
         lengths, arange offset deltas (``has_offsets=False``) and zero
         timestamp deltas (``ts_mode='zero'``) are synthesized, and
-        ``ts_mode='i32'`` timestamps upload narrow and widen on device.
+        narrowed timestamps (``ts_mode`` u16/i32) widen on device.
 
         glz staging (``glz_bytes > 0``): the flat crossed the link
         COMPRESSED — ``glz_seqs`` is (lit_lens u8, match_lens u8,

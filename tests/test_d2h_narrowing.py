@@ -171,3 +171,37 @@ class TestByteModeLengths:
             vals,
         )
         assert len(tv) == 1 and len(tv[0][0]) == 307
+
+
+def test_timestamp_link_tiers():
+    # stage_link_columns picks the narrowest timestamp upload the batch
+    # allows: zero (derivable) -> u16 -> i32 -> i64
+    import numpy as np
+
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+    from fluvio_tpu.smartengine.tpu.executor import stage_link_columns
+    from fluvio_tpu.protocol.record import Record
+
+    def buf_with_ts(deltas):
+        records = [Record(value=b"x") for _ in deltas]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+            r.timestamp_delta = int(deltas[i])
+        return RecordBuffer.from_records(records, 0, 1_000_000)
+
+    cases = [
+        ([0, 0, 0], "zero", None),
+        ([1, 500, 65535], "u16", np.uint16),
+        ([1, 500, 65536], "i32", np.int32),
+        ([-1, 5, 9], "i32", np.int32),  # negative deltas skip u16
+        ([1, 2**40, 3], "i64", np.int64),
+    ]
+    for deltas, want_mode, want_dtype in cases:
+        _, _, _, mode, ts_up = stage_link_columns(buf_with_ts(deltas))
+        assert mode == want_mode, (deltas, mode)
+        if want_dtype is None:
+            assert ts_up is None
+        else:
+            assert ts_up.dtype == want_dtype
+            n = len(deltas)
+            assert list(ts_up[:n].astype(np.int64)) == deltas
