@@ -44,6 +44,31 @@ sys.exit(0 if o.get('value',0)>0 and o.get('backend')=='tpu' else 1)
       fi
     fi
     if [ "$captured" = 1 ]; then
+      # A/B the glz link compression on the same weather window: a
+      # second run pinned to the OPPOSITE of whatever the primary's
+      # weather-adaptive mode chose isolates the device decode cost vs
+      # the link saving (BASELINE.md round-5 addendum names this the
+      # open variable). Drop any stale B arm first so a failed attempt
+      # can never pair an old window's file with this capture.
+      rm -f "$REPO/TPU_LIVE_BENCH_AB.json"
+      ab_pin=$(python -c "
+import json
+o=json.load(open('/tmp/sentinel_bench.json'))
+print('off' if o.get('link',{}).get('glz') == 'on' else 'on')
+" 2>>"$LOG")
+      if [ -n "$ab_pin" ] && (cd "$REPO" && timeout 3000 env \
+          BENCH_PROBE_BUDGET=240 FLUVIO_LINK_COMPRESS="$ab_pin" \
+          python bench.py >/tmp/sentinel_ab.json 2>>"$LOG"); then
+        if python -c "
+import json,sys
+o=json.load(open('/tmp/sentinel_ab.json'))
+sys.exit(0 if o.get('value',0)>0 and o.get('backend')=='tpu' else 1)
+" 2>>"$LOG"; then
+          cp /tmp/sentinel_ab.json "$REPO/.sentinel_ab.tmp"
+          mv "$REPO/.sentinel_ab.tmp" "$REPO/TPU_LIVE_BENCH_AB.json"
+          echo "[sentinel] captured glz=$ab_pin A/B arm $(date -u +%FT%TZ)" >>"$LOG"
+        fi
+      fi
       sleep 1800  # healthy capture done: back off to 30 min
     else
       echo "[sentinel] bench attempt failed $(date -u +%FT%TZ)" >>"$LOG"
