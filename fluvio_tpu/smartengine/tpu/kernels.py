@@ -605,7 +605,27 @@ _AGG_OPS = {
 def segmented_scan(
     x: jnp.ndarray, reset: jnp.ndarray, op_name: str
 ) -> jnp.ndarray:
-    """Inclusive segmented scan: resets start a new running value."""
+    """Inclusive segmented scan: resets start a new running value.
+
+    The add monoid rides primitive cumulative ops instead of a
+    tuple-carry ``associative_scan``: ``out[i] = cumsum[i] -
+    cumsum[last_reset(i) - 1]`` with the last reset position found by a
+    ``cummax`` over flagged indices. Bit-exact (int64 addition is
+    associative under any reassociation) and a far smaller XLA program —
+    the tuple scan unrolls ~log2(n) tuple-where steps, which dominated
+    the aggregate configs' 85-119 s on-chip compiles.
+    """
+    if op_name == "add":
+        n = x.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int32)
+        c = jnp.cumsum(x)
+        last_reset = lax.cummax(jnp.where(reset, idx, -1))
+        base = jnp.where(
+            last_reset >= 1,
+            jnp.take(c, jnp.clip(last_reset - 1, 0, n - 1)),
+            jnp.zeros((), c.dtype),
+        )
+        return c - base
     _, op = _AGG_OPS[op_name]
 
     def combine(a, b):
@@ -630,15 +650,18 @@ def last_true_value(
 def propagate_last_valid(
     values: jnp.ndarray, valid: jnp.ndarray
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Inclusive forward-fill of the last valid value; (filled, has_any)."""
+    """Inclusive forward-fill of the last valid value; (filled, has_any).
 
-    def combine(a, b):
-        ha, va = a
-        hb, vb = b
-        return ha | hb, jnp.where(hb, vb, va)
-
-    has, filled = lax.associative_scan(combine, (valid, values))
-    return filled, has
+    One ``cummax`` over flagged indices + one gather replaces the
+    tuple-carry ``associative_scan`` (same compile-size rationale as
+    ``segmented_scan``'s add path). Rows before any valid one gather
+    index 0 — exactly the value the tuple scan propagated there — and
+    ``has`` gates every consumer."""
+    n = values.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    li = lax.cummax(jnp.where(valid, idx, -1))
+    filled = jnp.take(values, jnp.clip(li, 0, n - 1))
+    return filled, li >= 0
 
 
 def assoc_scan_with_prefix(combine, elems, prefix, axis_name=None):

@@ -205,3 +205,4 @@ def test_timestamp_link_tiers():
             assert ts_up.dtype == want_dtype
             n = len(deltas)
             assert list(ts_up[:n].astype(np.int64)) == deltas
+

@@ -187,3 +187,42 @@ class TestLiteralSearch:
                 assert got[i] == (lit in data), (lit, data)
                 assert starts[i] == data.startswith(lit), (lit, data)
                 assert ends[i] == data.endswith(lit), (lit, data)
+
+
+def test_fast_scan_paths_match_tuple_scans():
+    # segmented_scan's add fast path (cumsum - base) and the cummax
+    # forward-fill must stay bit-equal to the tuple-carry
+    # associative_scan they replaced (the aggregate engine's semantics)
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+
+    from fluvio_tpu.smartengine.tpu import kernels
+
+    rng = np.random.default_rng(3)
+    for trial in range(15):
+        n = int(rng.integers(1, 300))
+        x = jnp.asarray(rng.integers(-10**12, 10**12, n))
+        reset = jnp.asarray(rng.random(n) < rng.random())
+
+        def combine(a, b):
+            fa, va = a
+            fb, vb = b
+            return fa | fb, jnp.where(fb, vb, va + vb)
+
+        _, want = lax.associative_scan(combine, (reset, x))
+        got = kernels.segmented_scan(x, reset, "add")
+        assert np.array_equal(np.asarray(want), np.asarray(got)), trial
+
+        vals = jnp.asarray(rng.integers(0, 10**9, n))
+        valid = jnp.asarray(rng.random(n) < rng.random())
+
+        def pcomb(a, b):
+            ha, va = a
+            hb, vb = b
+            return ha | hb, jnp.where(hb, vb, va)
+
+        whas, wfill = lax.associative_scan(pcomb, (valid, vals))
+        gfill, ghas = kernels.propagate_last_valid(vals, valid)
+        assert np.array_equal(np.asarray(wfill), np.asarray(gfill)), trial
+        assert np.array_equal(np.asarray(whas), np.asarray(ghas)), trial
