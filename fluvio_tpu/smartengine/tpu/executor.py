@@ -490,6 +490,13 @@ class TpuChainExecutor:
             )
             for s in stages
         )
+        # pure-filter chains: every survivor's value IS its input record,
+        # so the (start, length) descriptors are derivable host-side from
+        # the mask + the lengths the host already holds — only the
+        # bitmask crosses the D2H link (1 bit per input row)
+        self._identity_view = not agg_configs and all(
+            isinstance(s, _FilterStage) for s in stages
+        )
         # cumulative host-side postops for view-mode materialization;
         # valid because every postop is position-wise (commutes with the
         # later stages' slicing)
@@ -665,6 +672,14 @@ class TpuChainExecutor:
 
         packed: Dict = {}
         if self._viewable:
+            if self._identity_view:
+                # filter-only: the host derives every descriptor from
+                # the mask + its own lengths — packing (and returning)
+                # span columns would force XLA to keep compaction
+                # gathers the fetch never reads
+                packed["mask"] = kernels.pack_mask(valid)
+                mx = jnp.max(jnp.where(valid, state["lengths"], 0))
+                return _header(mx, jnp.int32(0)), packed, carries
             cols = [state["view_start"], state["lengths"]]
             if self._fanout:
                 cols.append(state["src_row"])
@@ -1094,6 +1109,17 @@ class TpuChainExecutor:
                 return self._delta_decode(raw, src_delta[1], count)
             return np.asarray(raw[:count]).astype(np.int64)
 
+        if self._viewable and self._identity_view:
+            # filter-only: the mask alone crosses the link; spans are
+            # (0, input_length) for every survivor by construction
+            rows = self._bucket_bytes(max(count, 1), 8)
+            host = self._download([packed["mask"]])
+            src = self._mask_to_src(host[0], buf)[:count]
+            st = np.zeros(count, dtype=np.int64)
+            ln = buf.lengths[src].astype(np.int32)
+            return self._materialize_view(
+                buf, count, rows, width, st, ln, src, max_v
+            )
         if self._viewable:
             n_desc = packed["span_start"].shape[0]
             rows = min(self._bucket_bytes(max(count, 1), 8), n_desc)
@@ -1122,53 +1148,82 @@ class TpuChainExecutor:
             if self._fanout:
                 src = _src_decode(host[2])
             else:
-                src = np.flatnonzero(
-                    np.unpackbits(host[2], bitorder="little")[: buf.rows]
-                )[:count]
+                src = self._mask_to_src(host[2], buf)[:count]
             st = st_h[:count].astype(np.int64)
             ln = ln_h[:count].astype(np.int32)
-            vw = min(self._pad_slice(max(max_v, 1)), width)
-            out_values = np.zeros((rows, vw), dtype=np.uint8)
-            if count:
-                keep = np.arange(vw, dtype=np.int32)[None, :] < ln[:, None]
-                if buf.values is None:
-                    # flat-backed buffer: slice views straight out of the
-                    # aligned flat (never builds the padded matrix)
-                    flat, starts = buf.ragged_values()
-                    if len(flat):
-                        base = starts.astype(np.int64)[src] + st
-                        cols = (
-                            base[:, None]
-                            + np.arange(vw, dtype=np.int64)[None, :]
-                        )
-                        gathered = flat[np.clip(cols, 0, len(flat) - 1)]
-                    else:  # all-empty values: every view is empty
-                        gathered = np.zeros((count, vw), dtype=np.uint8)
-                else:
-                    cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
-                    gathered = buf.values[
-                        src[:, None], np.clip(cols, 0, width - 1)
-                    ]
-                gathered = np.where(keep, gathered, 0)
-                out_values[:count] = apply_postops_host(
-                    gathered, self._view_postops
-                )
-            out_lengths = np.zeros((rows,), dtype=np.int32)
-            out_lengths[:count] = ln
-            if buf.has_keys():
-                out_keys = np.zeros((rows, buf.keys.shape[1]), dtype=np.uint8)
-                out_klens = np.full((rows,), -1, dtype=np.int32)
-                out_keys[:count] = buf.keys[src]
-                out_klens[:count] = buf.key_lengths[src]
-            else:
-                out_keys = np.zeros((rows, 1), dtype=np.uint8)
-                out_klens = np.full((rows,), -1, dtype=np.int32)
-            return self._assemble(buf, count, rows, out_values, out_lengths,
-                                  out_keys, out_klens, src)
+            return self._materialize_view(
+                buf, count, rows, width, st, ln, src, max_v
+            )
 
         if self._int_output:
             return self._fetch_ints(buf, count, packed, int_probe)
 
+        return self._fetch_bytes(
+            buf, count, packed, max_v, max_k, _src_col, _src_decode
+        )
+
+    @staticmethod
+    def _mask_to_src(mask_bytes: np.ndarray, buf: RecordBuffer) -> np.ndarray:
+        """Survivor indices from the packed 1-bit mask (little-endian
+        bit order, truncated to the buffer's live rows) — the ONE
+        decode for every mask-shipping fetch path."""
+        return np.flatnonzero(
+            np.unpackbits(mask_bytes, bitorder="little")[: buf.rows]
+        )
+
+    def _materialize_view(
+        self, buf: RecordBuffer, count: int, rows: int, width: int,
+        st: np.ndarray, ln: np.ndarray, src: np.ndarray, max_v: int,
+    ) -> RecordBuffer:
+        """Rebuild view-mode output bytes from the input slab the host
+        already holds (shared by the descriptor-download path and the
+        filter-only identity path, which derives st/ln host-side)."""
+        vw = min(self._pad_slice(max(max_v, 1)), width)
+        out_values = np.zeros((rows, vw), dtype=np.uint8)
+        if count:
+            keep = np.arange(vw, dtype=np.int32)[None, :] < ln[:, None]
+            if buf.values is None:
+                # flat-backed buffer: slice views straight out of the
+                # aligned flat (never builds the padded matrix)
+                flat, starts = buf.ragged_values()
+                if len(flat):
+                    base = starts.astype(np.int64)[src] + st
+                    cols = (
+                        base[:, None]
+                        + np.arange(vw, dtype=np.int64)[None, :]
+                    )
+                    gathered = flat[np.clip(cols, 0, len(flat) - 1)]
+                else:  # all-empty values: every view is empty
+                    gathered = np.zeros((count, vw), dtype=np.uint8)
+            else:
+                cols = st[:, None] + np.arange(vw, dtype=np.int64)[None, :]
+                gathered = buf.values[
+                    src[:, None], np.clip(cols, 0, width - 1)
+                ]
+            gathered = np.where(keep, gathered, 0)
+            out_values[:count] = apply_postops_host(
+                gathered, self._view_postops
+            )
+        out_lengths = np.zeros((rows,), dtype=np.int32)
+        out_lengths[:count] = ln
+        if buf.has_keys():
+            out_keys = np.zeros((rows, buf.keys.shape[1]), dtype=np.uint8)
+            out_klens = np.full((rows,), -1, dtype=np.int32)
+            out_keys[:count] = buf.keys[src]
+            out_klens[:count] = buf.key_lengths[src]
+        else:
+            out_keys = np.zeros((rows, 1), dtype=np.uint8)
+            out_klens = np.full((rows,), -1, dtype=np.int32)
+        return self._assemble(buf, count, rows, out_values, out_lengths,
+                              out_keys, out_klens, src)
+
+    def _fetch_bytes(
+        self, buf: RecordBuffer, count: int, packed, max_v, max_k,
+        _src_col, _src_decode,
+    ) -> RecordBuffer:
+        """Byte-mode materialization: compacted value/key columns cross
+        the link sliced to count x used-width (tail of `_fetch`; the
+        src-column helpers close over its probe state)."""
         n_rows = packed["values"].shape[0]
         rows = min(self._bucket_bytes(max(count, 1), 8), n_rows)
         vw = min(self._pad_slice(max(max_v, 1)), packed["values"].shape[1])
@@ -1215,9 +1270,7 @@ class TpuChainExecutor:
             src = _src_decode(host[pos])
             pos += 1
         elif want_mask:
-            src = np.flatnonzero(
-                np.unpackbits(host[pos], bitorder="little")[: buf.rows]
-            )
+            src = self._mask_to_src(host[pos], buf)
             pos += 1
         if want_keys:
             out_klens = host[pos]
@@ -1314,9 +1367,7 @@ class TpuChainExecutor:
             w_col, w_is_delta = _pick(packed["agg_win"], w_d, scal[2])
             slices.append(lax.slice(w_col, (0,), (rows,)))
         host = self._download(slices)
-        src = np.flatnonzero(
-            np.unpackbits(host[0], bitorder="little")[: buf.rows]
-        )
+        src = self._mask_to_src(host[0], buf)
         ints = (
             self._delta_decode(host[1], scal[1], count)
             if a_is_delta
@@ -1450,6 +1501,10 @@ class TpuChainExecutor:
             return spec
         if self._viewable:
             packed["mask"].copy_to_host_async()
+            if self._identity_view:
+                # filter-only: the mask IS the whole download — no
+                # descriptor speculation to arm
+                return spec
             guess = self._spec_rows
             n_desc = packed["span_start"].shape[0]
             if (

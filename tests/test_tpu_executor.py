@@ -323,7 +323,13 @@ class TestDispatchPrefetch:
         out = []
         for match_n in counts:
             records = [
-                Record(value=(b"fluvio-%d" % i if i < match_n else b"drop-%d" % i))
+                Record(
+                    value=(
+                        b'{"name":"fluvio-%d"}' % i
+                        if i < match_n
+                        else b'{"name":"drop-%d"}' % i
+                    )
+                )
                 for i in range(256)
             ]
             for i, r in enumerate(records):
@@ -332,9 +338,13 @@ class TestDispatchPrefetch:
         return out
 
     def _chain(self, backend):
+        # filter + span-map: descriptor speculation only exists for
+        # view chains with real descriptors (a filter-only chain rides
+        # the identity path, where the mask is the whole download)
         return build(
             backend,
             (lookup("regex-filter"), SmartModuleConfig(params={"regex": "fluvio"})),
+            (lookup("json-map"), SmartModuleConfig(params={"field": "name"})),
         )
 
     def test_stream_correct_across_bucket_shift(self):
@@ -376,7 +386,7 @@ class TestDispatchPrefetch:
         # the hit path must return the right BYTES (the prefetched
         # descriptor slices drive the host-side value rebuild) ...
         assert [r.value for r in out.to_records()] == [
-            b"fluvio-%d" % i for i in range(40)
+            b"FLUVIO-%d" % i for i in range(40)  # json-map uppercases
         ]
         # ... and download the prefetched slices exactly once
         hit_delta = tpu.d2h_bytes_total - d2h_before
