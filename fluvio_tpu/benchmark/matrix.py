@@ -12,7 +12,6 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Iterator, List
 
-import yaml
 
 
 @dataclass
@@ -67,6 +66,11 @@ class BenchmarkMatrix:
 
     @classmethod
     def from_yaml(cls, text: str) -> "BenchmarkMatrix":
+        # lazy: pyyaml is not a declared dependency — only --matrix
+        # users need it, and the installed `fbm` binary must not die at
+        # import time on a clean install
+        import yaml
+
         doc = yaml.safe_load(text) or {}
         known = set(cls.__dataclass_fields__)
         unknown = set(doc) - known
