@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -421,6 +422,28 @@ def stage_link_columns(buf):
     return lengths_up, has_keys, has_offsets, ts_mode, ts_up
 
 
+_GLZ_POOL = None
+_GLZ_POOL_LOCK = threading.Lock()
+
+
+def _compress_pool():
+    """Process-wide single-worker pool for the stream loop's
+    compress-ahead. Shared across executors so a broker that builds a
+    chain per consumer session holds ONE idle thread, not one per
+    discarded executor; lazily created so non-streaming processes never
+    spawn it."""
+    global _GLZ_POOL
+    if _GLZ_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with _GLZ_POOL_LOCK:
+            if _GLZ_POOL is None:
+                _GLZ_POOL = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="glz-compress"
+                )
+    return _GLZ_POOL
+
+
 class TpuChainExecutor:
     """Compiled chain + device-resident aggregate state."""
 
@@ -807,12 +830,7 @@ class TpuChainExecutor:
                 (jnp.int64(acc), jnp.int64(win), jnp.asarray(has))
                 for acc, win, has in self.carries
             )
-        flat, _starts = buf.ragged_values()
-        # bucket the flat size at pow2/8 granularity: bounded compile
-        # count (<=8 per size decade) without pow2's up-to-2x H2D blowup
-        bucket = self._bucket_bytes(max(len(flat), 4))
-        if len(flat) < bucket:
-            flat = np.pad(flat, (0, bucket - len(flat)))
+        flat, bucket = self._flat_and_bucket(buf)
         flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes, flat_h2d = (
             self._stage_flat(buf, flat, bucket)
         )
@@ -878,6 +896,38 @@ class TpuChainExecutor:
         )
         return header, packed
 
+    @staticmethod
+    def _flat_and_bucket(buf: RecordBuffer):
+        """The flat's link form: 4-aligned ragged bytes + the pow2/8
+        bucket it pads to — bounded compile count (<=8 per size decade)
+        without pow2's up-to-2x H2D blowup. Returned UNPADDED: the
+        warm-cache glz path never touches the bytes, so the pad copy is
+        paid only by the paths that ship them (`_padded`). One
+        implementation for the dispatch and the stream loop's
+        prefetch-compression worker (the cache key is the bucket; the
+        two must never disagree)."""
+        flat, _starts = buf.ragged_values()
+        bucket = TpuChainExecutor._bucket_bytes(max(len(flat), 4))
+        return flat, bucket
+
+    @staticmethod
+    def _padded(flat: np.ndarray, bucket: int) -> np.ndarray:
+        if len(flat) < bucket:
+            return np.pad(flat, (0, bucket - len(flat)))
+        return flat
+
+    def _precompress(self, buf: RecordBuffer) -> None:
+        """Worker-thread half of the stream loop's compress-ahead: fill
+        the buffer's glz cache so the NEXT dispatch finds it warm. The
+        compressor runs in C with the GIL released, so it overlaps the
+        consumer's processing of already-yielded batches instead of
+        serializing before the next dispatch."""
+        flat, bucket = self._flat_and_bucket(buf)
+        cached = getattr(buf, "_glz_cache", None)
+        if cached is not None and cached[0] == bucket:
+            return
+        buf._glz_cache = (bucket, glz.compress(self._padded(flat, bucket)))
+
     def _stage_flat(self, buf: RecordBuffer, flat: np.ndarray, bucket: int):
         """Pick the flat's link form: glz-compressed or raw i32 words.
 
@@ -894,7 +944,7 @@ class TpuChainExecutor:
             if cached is not None and cached[0] == bucket:
                 comp = cached[1]
             else:
-                comp = glz.compress(flat)
+                comp = glz.compress(self._padded(flat, bucket))
                 buf._glz_cache = (bucket, comp)
             if comp is not None:
                 n_seq = len(comp.lit_lens)
@@ -919,7 +969,7 @@ class TpuChainExecutor:
                 )
         # ship the aligned flat as i32 words (see _chain_fn_ragged);
         # derivable columns stay off the link (synthesized on device)
-        words = flat.view(np.int32)
+        words = self._padded(flat, bucket).view(np.int32)
         return jnp.asarray(words), None, None, None, 0, words.nbytes
 
     def _ensure_host_state(self) -> None:
@@ -1599,12 +1649,28 @@ class TpuChainExecutor:
         # back, and aggregate chains without fan-out cannot overflow.
         # Sharded aggregates pipeline too: carries chain through device
         # futures at dispatch time (ShardedChainExecutor._pending_carries)
+        # Compress-ahead: once batch k+1 arrives, a worker thread
+        # glz-compresses it (ctypes releases the GIL) while the consumer
+        # processes batch k-1's yielded results — the dispatch and yield
+        # ordering is exactly the pre-lookahead loop's, so a sparse
+        # source never delays a ready result behind a future arrival.
+        it = iter(bufs)
+        cur = next(it, None)
         pending = None
-        for buf in bufs:
-            handle = self.dispatch_buffer(buf)
+        fut = None
+        while cur is not None:
+            if fut is not None:
+                # settle before cur dispatches: the staging must never
+                # race the worker on the same buffer's cache
+                fut.result()
+                fut = None
+            handle = self.dispatch_buffer(cur)
             if pending is not None:
                 yield self.finish_buffer(pending[0], pending[1])
-            pending = (buf, handle)
+            pending = (cur, handle)
+            cur = next(it, None)
+            if cur is not None and self._link_compress and self._sharded is None:
+                fut = _compress_pool().submit(self._precompress, cur)
         if pending is not None:
             yield self.finish_buffer(pending[0], pending[1])
 

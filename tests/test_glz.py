@@ -288,3 +288,41 @@ def test_fetch_time_decode_failure_self_heals(monkeypatch):
     _, ref = _run_chain("python", [("regex-filter", {"regex": "fluvio"})],
                         vals)
     assert got == ref
+
+
+def test_stream_compress_ahead_no_double_work(monkeypatch):
+    # the stream loop's worker thread compresses batch k+1 while k is
+    # in flight; the staging must find the cache warm (one compress per
+    # distinct buffer, never a duplicate on the dispatch path)
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    calls = []
+    real_compress = glz.compress
+
+    def counting(raw, *a, **k):
+        calls.append(raw.size)
+        return real_compress(raw, *a, **k)
+
+    monkeypatch.setattr(glz, "compress", counting)
+
+    def mkbuf(seed):
+        vals = [f'{{"name":"fluvio-{(i * seed) & 255}","n":{i}}}'.encode()
+                for i in range(4000)]
+        records = [Record(value=v) for v in vals]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+        return RecordBuffer.from_smartmodule_input(
+            SmartModuleInput.from_records(records)
+        )
+
+    chain = _build("tpu", [("regex-filter", {"regex": "fluvio"})])
+    ex = chain.tpu_chain
+    bufs = [mkbuf(s) for s in (1, 3, 5, 7)]
+    outs = list(ex.process_stream(iter(bufs)))
+    assert len(outs) == 4 and all(o.count == 4000 for o in outs)
+    assert len(calls) == 4, f"expected one compress per buffer, saw {len(calls)}"
+    for b in bufs:
+        assert getattr(b, "_glz_cache", None) is not None
