@@ -1033,12 +1033,62 @@ def run_codec_bench() -> dict:
     return report
 
 
+def _acquire_bench_lock():
+    """One bench at a time per machine. The tunnel sentinel and the
+    driver both run this script against the same chip; concurrent runs
+    would halve each other's link bandwidth and corrupt both captures.
+    Waits up to 15 min for a holder (a sentinel mid-run), then proceeds
+    anyway — a stale lock must never forfeit the round's bench."""
+    import fcntl
+
+    try:
+        f = open(os.path.join(os.path.dirname(__file__), ".bench.lock"), "w")
+    except OSError as e:
+        log(f"bench lock unavailable: {e}")
+        return None
+    t0 = time.time()
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except BlockingIOError:
+            if time.time() - t0 > 900:
+                log("bench lock still held after 900s; proceeding unlocked")
+                return f
+            if int(time.time() - t0) % 60 < 5:
+                log("waiting for the bench lock (another bench is running)")
+            time.sleep(5)
+        except OSError as e:
+            # flock itself unsupported here (e.g. ENOLCK): not contention
+            log(f"bench lock not supported: {e}; proceeding unlocked")
+            return f
+
+
+_BENCH_LOCK = None  # module global: the fd must outlive main()
+
+
 def main() -> None:
-    global _BSTART, _BACKEND_MODE, _CACHE_ENTRIES_AT_START
+    global _T0, _BSTART, _BACKEND_MODE, _CACHE_ENTRIES_AT_START, _BENCH_LOCK
     if os.environ.get("BENCH_CPU") == "1":
-        # hermetic smoke runs (same trick as tests/conftest.py)
+        # hermetic smoke runs (same trick as tests/conftest.py) —
+        # never touches the chip, so never takes the chip lock
         _BACKEND_MODE = "cpu"
         _force_cpu()
+        _BSTART = time.time()
+        _run_after_lock()
+        return
+    # chip-targeting run: serialize against the sentinel (held for the
+    # whole process; exit frees); probe/budget clocks restart AFTER any
+    # lock wait so a waited-out run keeps its full measurement budget
+    _BENCH_LOCK = _acquire_bench_lock()
+    _T0 = time.time()
+    _run_after_lock()
+
+
+def _run_after_lock() -> None:
+    global _BSTART, _BACKEND_MODE, _CACHE_ENTRIES_AT_START
+    if _BACKEND_MODE == "cpu":
+        pass
     elif not _probe_device():
         # tunnel dead: a bare zero is zero information (rounds 3+4 lost
         # their perf evidence this way). Re-run the whole suite on the

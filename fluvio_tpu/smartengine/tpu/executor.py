@@ -1649,11 +1649,15 @@ class TpuChainExecutor:
         # back, and aggregate chains without fan-out cannot overflow.
         # Sharded aggregates pipeline too: carries chain through device
         # futures at dispatch time (ShardedChainExecutor._pending_carries)
-        # Compress-ahead: once batch k+1 arrives, a worker thread
-        # glz-compresses it (ctypes releases the GIL) while the consumer
-        # processes batch k-1's yielded results — the dispatch and yield
-        # ordering is exactly the pre-lookahead loop's, so a sparse
-        # source never delays a ready result behind a future arrival.
+        # Compress-ahead: a worker thread glz-compresses batch k+1
+        # (ctypes releases the GIL) while finish_buffer blocks on batch
+        # k-1's device work and the consumer processes its results —
+        # the one ordering with a real overlap window. The cost is a
+        # one-batch lookahead: batch k dispatches immediately (the
+        # device never idles behind an arrival), but k-1's results
+        # yield only after k+1 arrives — immaterial for eager sources
+        # (the bench, sharded pipelining, queue drains), one batch of
+        # result latency on a sparse tailing source.
         it = iter(bufs)
         cur = next(it, None)
         pending = None
@@ -1665,12 +1669,13 @@ class TpuChainExecutor:
                 fut.result()
                 fut = None
             handle = self.dispatch_buffer(cur)
+            nxt = next(it, None)
+            if nxt is not None and self._link_compress and self._sharded is None:
+                fut = _compress_pool().submit(self._precompress, nxt)
             if pending is not None:
                 yield self.finish_buffer(pending[0], pending[1])
             pending = (cur, handle)
-            cur = next(it, None)
-            if cur is not None and self._link_compress and self._sharded is None:
-                fut = _compress_pool().submit(self._precompress, cur)
+            cur = nxt
         if pending is not None:
             yield self.finish_buffer(pending[0], pending[1])
 

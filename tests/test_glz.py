@@ -299,11 +299,13 @@ def test_stream_compress_ahead_no_double_work(monkeypatch):
     from fluvio_tpu.protocol.record import Record
     from fluvio_tpu.smartmodule import SmartModuleInput
 
+    import threading
+
     calls = []
     real_compress = glz.compress
 
     def counting(raw, *a, **k):
-        calls.append(raw.size)
+        calls.append(threading.current_thread().name)
         return real_compress(raw, *a, **k)
 
     monkeypatch.setattr(glz, "compress", counting)
@@ -324,5 +326,8 @@ def test_stream_compress_ahead_no_double_work(monkeypatch):
     outs = list(ex.process_stream(iter(bufs)))
     assert len(outs) == 4 and all(o.count == 4000 for o in outs)
     assert len(calls) == 4, f"expected one compress per buffer, saw {len(calls)}"
+    # the first buffer compresses inline (nothing to overlap yet); the
+    # prefetched ones must run on the shared worker thread
+    assert sum("glz-compress" in n for n in calls) == 3, calls
     for b in bufs:
         assert getattr(b, "_glz_cache", None) is not None
