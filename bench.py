@@ -185,7 +185,7 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     log(f"  first call (compile): {first_call:.2f}s; {out.count} records out")
     # split: dispatch covers H2D + device compute; a full call adds the
     # descriptor D2H + host materialization. Attribution matters because
-    # the tunnel's D2H (~25 MB/s) is ~30x slower than its H2D.
+    # the tunnel's D2H (1.4-37 MB/s measured) is far slower than its H2D.
     t0 = time.time()
     header, packed = executor._dispatch(buf, fanout_cap=executor._fanout_cap(buf))
     jax.block_until_ready((header, packed))

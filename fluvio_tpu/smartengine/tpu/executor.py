@@ -478,8 +478,8 @@ class TpuChainExecutor:
         # of the record's own bytes, the device ships descriptors
         # (survivor bitmask + start/length per survivor) and the host
         # rebuilds output bytes from the slab it already holds — the D2H
-        # link (the measured bottleneck: ~25 MB/s vs ~800 MB/s H2D on
-        # this chip's tunnel) carries ~5x fewer bytes
+        # link (the scarce direction: BASELINE.md's calibrations range
+        # 1.4-37 MB/s D2H vs 20-700 MB/s H2D) carries ~5x fewer bytes
         self._fanout = any(isinstance(s, _ArrayMapStage) for s in stages)
         self._cap_ratio: float = 0.0  # learned fan-out elements per source row
         self._sharded = None  # multi-device delegate (enable_sharded)
@@ -651,8 +651,8 @@ class TpuChainExecutor:
     def _chain_fn(self, arrays: Dict, count, base_ts, carries, fanout_cap=None):
         """Fused chain body. Returns (header, packed dict, carries).
 
-        D2H is the scarce resource on the host link (~25 MB/s vs
-        ~800 MB/s H2D through the tunnel), so outputs ship as the
+        D2H is the scarce resource on the host link (BASELINE.md's
+        calibrations: 1.4-37 MB/s down vs 20-700 MB/s up), so outputs ship as the
         smallest sufficient representation — ``packed``'s keys are
         static per executor config:
 
