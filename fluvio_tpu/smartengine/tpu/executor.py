@@ -1516,6 +1516,31 @@ class TpuChainExecutor:
         spec["glz_used"] = getattr(self, "_glz_last", False)
         return (prev_carries, header, packed, spec)
 
+    def dispatch_buffers(self, bufs: List[RecordBuffer]) -> List[tuple]:
+        """Dispatch several buffers with ONE-AHEAD compress-ahead:
+        while buffer k stages and issues, the shared glz worker
+        compresses buffer k+1 (settle-before-dispatch, so staging never
+        races the worker on a cache). One-ahead bounds wasted work to a
+        single job if the self-heal disables compression mid-list, and
+        keeps the process-wide worker fair to other executors. Returns
+        [(buf, handle), ...] for `finish_buffer`. The SPU slice bridge
+        (spu/smart_chain.py) builds on this; the stream loop below
+        inlines the same pattern around its yields."""
+        out = []
+        fut = None
+        for i, buf in enumerate(bufs):
+            if fut is not None:
+                fut.result()
+                fut = None
+            if (
+                i + 1 < len(bufs)
+                and self._link_compress
+                and self._sharded is None
+            ):
+                fut = _compress_pool().submit(self._precompress, bufs[i + 1])
+            out.append((buf, self.dispatch_buffer(buf)))
+        return out
+
     def _start_result_copies(self, buf: RecordBuffer, header, packed) -> Dict:
         """Begin the D2H copies the fetch will block on, at dispatch time.
 

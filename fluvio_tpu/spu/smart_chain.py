@@ -498,7 +498,7 @@ def tpu_stage_dispatch(
     # abandoned mid-flight
     if n_total and int(merged["val_len"].max()) > MAX_WIDTH:
         return _decline(metrics, "record-too-wide")
-    chunks: List[tuple] = []
+    chunk_bufs: List = []
     for lo, hi in zip(bounds[:-1], bounds[1:]):
         part = _slice_columns(merged, lo, hi)
         try:
@@ -529,7 +529,10 @@ def tpu_stage_dispatch(
                 pos += n_b
             buf.fresh_offset_deltas = fo
             buf.fresh_timestamp_deltas = ft
-        chunks.append((buf, tpu.dispatch_buffer(buf)))
+        chunk_bufs.append(buf)
+    # one-ahead compress-ahead across chunks (executor-owned pattern:
+    # the worker glz-compresses chunk k+1 while chunk k dispatches)
+    chunks: List[tuple] = tpu.dispatch_buffers(chunk_bufs)
     return PendingSlice(
         batches=batches,
         chunks=chunks,
