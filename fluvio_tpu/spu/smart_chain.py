@@ -498,7 +498,15 @@ def tpu_stage_dispatch(
     # abandoned mid-flight
     if n_total and int(merged["val_len"].max()) > MAX_WIDTH:
         return _decline(metrics, "record-too-wide")
+    # with compression on, chunks batch up for the one-ahead
+    # compress-ahead (dispatch_buffers); with it off, each chunk
+    # dispatches as soon as it is built so the device computes chunk k
+    # while the host stages chunk k+1 — the pre-glz overlap
+    compress_ahead = (
+        getattr(tpu, "_link_compress", False) and tpu._sharded is None
+    )
     chunk_bufs: List = []
+    chunks: List[tuple] = []
     for lo, hi in zip(bounds[:-1], bounds[1:]):
         part = _slice_columns(merged, lo, hi)
         try:
@@ -529,10 +537,14 @@ def tpu_stage_dispatch(
                 pos += n_b
             buf.fresh_offset_deltas = fo
             buf.fresh_timestamp_deltas = ft
-        chunk_bufs.append(buf)
-    # one-ahead compress-ahead across chunks (executor-owned pattern:
-    # the worker glz-compresses chunk k+1 while chunk k dispatches)
-    chunks: List[tuple] = tpu.dispatch_buffers(chunk_bufs)
+        if compress_ahead:
+            chunk_bufs.append(buf)
+        else:
+            chunks.append((buf, tpu.dispatch_buffer(buf)))
+    if compress_ahead:
+        # executor-owned one-ahead pattern: the worker glz-compresses
+        # chunk k+1 while chunk k dispatches
+        chunks = tpu.dispatch_buffers(chunk_bufs)
     return PendingSlice(
         batches=batches,
         chunks=chunks,
