@@ -498,15 +498,13 @@ def tpu_stage_dispatch(
     # abandoned mid-flight
     if n_total and int(merged["val_len"].max()) > MAX_WIDTH:
         return _decline(metrics, "record-too-wide")
-    # with compression on, chunks batch up for the one-ahead
-    # compress-ahead (dispatch_buffers); with it off, each chunk
-    # dispatches as soon as it is built so the device computes chunk k
-    # while the host stages chunk k+1 — the pre-glz overlap
-    compress_ahead = (
-        getattr(tpu, "_link_compress", False) and tpu._sharded is None
-    )
+    # EVERY chunk builds (and passes its guards) before ANY dispatch:
+    # a mid-loop decline (staging-cap depends on each chunk's local
+    # padded width) must never abandon earlier chunks' in-flight device
+    # work. The build pass is view-based numpy slicing (flat-backed
+    # buffers are born in upload form), so the device idles ~ms per
+    # slice for it — the invariant is worth more than the overlap.
     chunk_bufs: List = []
-    chunks: List[tuple] = []
     for lo, hi in zip(bounds[:-1], bounds[1:]):
         part = _slice_columns(merged, lo, hi)
         try:
@@ -537,14 +535,11 @@ def tpu_stage_dispatch(
                 pos += n_b
             buf.fresh_offset_deltas = fo
             buf.fresh_timestamp_deltas = ft
-        if compress_ahead:
-            chunk_bufs.append(buf)
-        else:
-            chunks.append((buf, tpu.dispatch_buffer(buf)))
-    if compress_ahead:
-        # executor-owned one-ahead pattern: the worker glz-compresses
-        # chunk k+1 while chunk k dispatches
-        chunks = tpu.dispatch_buffers(chunk_bufs)
+        chunk_bufs.append(buf)
+    # executor-owned dispatch: with compression on, the worker
+    # glz-compresses chunk k+1 while chunk k dispatches (one-ahead);
+    # with it off this is a plain dispatch loop
+    chunks: List[tuple] = tpu.dispatch_buffers(chunk_bufs)
     return PendingSlice(
         batches=batches,
         chunks=chunks,

@@ -126,3 +126,22 @@ def test_link_calibration_rides_every_emit():
         assert out["link"] == {"rtt_ms": 65.0, "h2d_mb_s": 49.0, "d2h_mb_s": 37.0}
     finally:
         b._LINK.clear()
+
+
+def test_staging_ab_and_glz_fields_survive_the_emit():
+    # round-5 additions: the headline's staging A/B record and per-config
+    # glz ratio must ride through _build_output untouched (the judge
+    # reads them to attribute the chosen staging to the run's weather)
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    cfg = dict(GOOD)
+    cfg["staging_ab"] = {
+        "glz_ms": [100, 101], "raw_ms": [140, 139], "chosen": "glz",
+    }
+    cfg["glz_ratio"] = 0.476
+    out, rc = b._build_output({"2_filter_map": cfg})
+    assert rc == 0
+    got = out["configs"]["2_filter_map"]
+    assert got["staging_ab"]["chosen"] == "glz"
+    assert got["glz_ratio"] == 0.476
+
