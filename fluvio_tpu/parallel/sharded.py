@@ -25,13 +25,29 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fluvio_tpu.parallel.mesh import RECORD_AXIS, make_record_mesh
 from fluvio_tpu.smartengine.tpu import executor as kernels_executor
-from fluvio_tpu.smartengine.tpu import kernels
+from fluvio_tpu.smartengine.tpu import kernels, stripes
 from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer, apply_postops_host
 
 try:  # jax>=0.4.35 exposes shard_map at the top level
-    from jax import shard_map as _shard_map
+    from jax import shard_map as _shard_map_raw
 except ImportError:  # pragma: no cover — older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """Version-compatible shard_map: the replication-check knob was
+    renamed check_rep -> check_vma across jax releases; pallas kernels
+    inside the shard body require it off under either name."""
+    try:
+        return _shard_map_raw(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return _shard_map_raw(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
 
 
 class ShardedChainExecutor:
@@ -71,6 +87,72 @@ class ShardedChainExecutor:
         self.fanout_retries = 0  # observability: capacity-retry count
 
     # -- traced step ---------------------------------------------------------
+
+    def _local_step_striped(
+        self, uploads: Dict, count, base_ts, carries, *, cfg: tuple
+    ):
+        """Striped wide-record step: each shard derives its own stripe
+        plan from its local lengths — stripes never split across shard
+        boundaries because the ragged staging already cuts the flat at
+        shard ROW boundaries (whole records per shard). The segment axis
+        is the record axis, so the survivor mask, aggregate columns, and
+        cross-shard carry collectives are the narrow sharded path's,
+        unchanged."""
+        (_width, kwidth, has_keys, has_offsets, ts_mode, _cap, srows) = cfg
+        ex = self.executor
+        s, v = ex._stripe_s, ex._stripe_v
+        lengths = uploads["lengths"].astype(jnp.int32)
+        n_local = lengths.shape[0]
+        g0 = lax.axis_index(RECORD_AXIS) * n_local
+        live = (g0 + jnp.arange(n_local, dtype=jnp.int32)) < count
+        plan = stripes.plan_device(lengths, live, srows, s, v)
+        sv = stripes.striped_repad_words(uploads["flat_words"], lengths, plan, s)
+        keys, key_lengths, offset_deltas, timestamp_deltas = (
+            kernels_executor.derived_meta_columns(
+                n_local, kwidth,
+                has_keys, uploads.get("keys"), uploads.get("key_lengths"),
+                has_offsets, uploads.get("offset_deltas"),
+                ts_mode, uploads.get("timestamp_deltas"),
+                idx_base=g0,
+            )
+        )
+        arrays = {
+            "keys": keys,
+            "key_lengths": key_lengths,
+            "offset_deltas": offset_deltas,
+            "timestamp_deltas": timestamp_deltas,
+        }
+        seg_state = stripes.seg_state_of(plan, sv, lengths, arrays, s)
+        ctx = {"sv": sv, "plan": plan, "seg_state": seg_state, "n": n_local}
+        valid, seg_state, carries, _fan = ex._striped.run(
+            ctx, live, carries, base_ts,
+            {"fanout_cap": None, "axis_name": RECORD_AXIS, "g0": g0},
+        )
+        cnt = jnp.sum(valid.astype(jnp.int32))
+
+        def header(max_v):
+            return jnp.stack(
+                [
+                    cnt.astype(jnp.int64),
+                    max_v.astype(jnp.int64),
+                    jnp.int64(0),
+                    jnp.int64(0),
+                    jnp.int64(0),
+                ]
+            )[None, :]
+
+        packed: Dict = {"mask": kernels.pack_mask(valid)}
+        if ex._int_output:
+            windowed = bool(ex.stages[-1].window_ms)
+            cols = [seg_state["agg_out_int"]]
+            if windowed:
+                cols.append(seg_state["agg_win_int"])
+            _, compacted = kernels.compact_rows(valid, *cols)
+            packed["agg_int"] = compacted[0]
+            if windowed:
+                packed["agg_win"] = compacted[1]
+            return header(jnp.int32(0)), packed, carries
+        return header(jnp.max(jnp.where(valid, lengths, 0))), packed, carries
 
     def _local_step_ragged(
         self, uploads: Dict, count, base_ts, carries, *, cfg: tuple
@@ -184,6 +266,7 @@ class ShardedChainExecutor:
         )
 
     def _jitted(self, uploads: Dict, cfg: tuple):
+        striped = len(cfg) == 7  # (..., fanout_cap, srows)
         key = (
             tuple(sorted((k, v.shape, str(v.dtype)) for k, v in uploads.items())),
             cfg,
@@ -201,14 +284,16 @@ class ShardedChainExecutor:
             )
             out_specs = (
                 row,  # per-shard (1, 5) headers stack to (n, 5)
-                self._packed_specs(),
+                self._packed_specs(striped),
                 jax.tree_util.tree_map(lambda _: rep, self._carries()),
             )
 
+            local_step = (
+                self._local_step_striped if striped else self._local_step_ragged
+            )
+
             def step(uploads, count, base_ts, carries):
-                return self._local_step_ragged(
-                    uploads, count, base_ts, carries, cfg=cfg
-                )
+                return local_step(uploads, count, base_ts, carries, cfg=cfg)
 
             fn = jax.jit(
                 _shard_map(
@@ -216,16 +301,24 @@ class ShardedChainExecutor:
                     mesh=self.mesh,
                     in_specs=in_specs,
                     out_specs=out_specs,
-                    check_vma=False,
                 )
             )
             self._jit_cache[key] = fn
         return fn
 
-    def _packed_specs(self):
+    def _packed_specs(self, striped: bool = False):
         row = P(RECORD_AXIS)
         mat = P(RECORD_AXIS, None)
         ex = self.executor
+        if striped:
+            # striped chains ship the segment mask (and, for aggregate
+            # tails, the compacted int columns) — never descriptors
+            out = {"mask": row}
+            if ex._int_output:
+                out["agg_int"] = row
+                if bool(ex.stages[-1].window_ms):
+                    out["agg_win"] = row
+            return out
         if ex._viewable:
             out = {"span_start": row, "span_len": row}
             if ex._fanout:
@@ -336,12 +429,44 @@ class ShardedChainExecutor:
             cap_total = ex._fanout_cap(buf)
         return ex._bucket_bytes(max(cap_total * 3 // (2 * self.n), 8), 8)
 
+    def _stripe_rows_shard(self, buf: RecordBuffer) -> int:
+        """Static per-shard stripe-row count: every shard compiles to the
+        worst shard's (bucketed) stripe total so shapes stay uniform
+        under shard_map."""
+        ex = self.executor
+        _need, shard_rows = self._row_blocks(min(buf.count, buf.rows))
+        worst = 8
+        for s in range(self.n):
+            lo = s * shard_rows
+            hi = min((s + 1) * shard_rows, buf.count)
+            if hi > lo:
+                worst = max(
+                    worst,
+                    int(
+                        stripes.stripe_counts(
+                            buf.lengths[lo:hi], ex._stripe_s, ex._stripe_v
+                        ).sum()
+                    ),
+                )
+        return ex._bucket_bytes(worst, floor=8)
+
     def dispatch_buffer(self, buf: RecordBuffer, cap_shard=None):
+        from fluvio_tpu.smartengine.tpu.executor import TpuSpill
+
         ex = self.executor
         uploads, cfg, nbytes = self._stage_ragged(buf)
         if ex._fanout and cap_shard is None:
             cap_shard = self._shard_fanout_cap(buf)
         cfg = cfg + (cap_shard,)
+        if ex._needs_stripes(buf):
+            if ex._striped_chain() is None or ex._fanout:
+                # wide batch outside the sharded stripeable subset
+                # (fan-out explodes stay single-device or interpret)
+                raise TpuSpill(
+                    f"record width {buf.width} exceeds the narrow layout "
+                    "and the chain cannot stripe under shard_map"
+                )
+            cfg = cfg + (self._stripe_rows_shard(buf),)
         ex.h2d_bytes_total += nbytes
         sharded = {
             k: jax.device_put(
@@ -482,18 +607,26 @@ class ShardedChainExecutor:
             return src_h, groups
 
         if ex._viewable:
-            # span descriptors are width-bounded: ship them at the same
-            # narrow dtype the single-device fetch uses (uint8/uint16)
-            src, (st_parts, ln_parts) = _fetch_all(
-                self._shard_slices(
-                    ex._narrow_static(packed["span_start"], width), counts
-                ),
-                self._shard_slices(
-                    ex._narrow_static(packed["span_len"], width + 1), counts
-                ),
-            )
-            st = self._concat_counts(st_parts, counts).astype(np.int64)
-            ln = self._concat_counts(ln_parts, counts).astype(np.int32)
+            if ex._needs_stripes(buf):
+                # striped survivors are whole records: the segment mask
+                # is the entire download; spans derive host-side
+                src, _ = _fetch_all()
+                st = np.zeros(total, dtype=np.int64)
+                ln = buf.lengths[src[:total]].astype(np.int32)
+            else:
+                # span descriptors are width-bounded: ship them at the
+                # same narrow dtype the single-device fetch uses
+                src, (st_parts, ln_parts) = _fetch_all(
+                    self._shard_slices(
+                        ex._narrow_static(packed["span_start"], width), counts
+                    ),
+                    self._shard_slices(
+                        ex._narrow_static(packed["span_len"], width + 1),
+                        counts,
+                    ),
+                )
+                st = self._concat_counts(st_parts, counts).astype(np.int64)
+                ln = self._concat_counts(ln_parts, counts).astype(np.int32)
             vw = int(max(int(hdrs[:, 1].max()), 1))
             vw = min(ex._pad_slice(vw), width)
             out_values = np.zeros((rows_out, vw), dtype=np.uint8)

@@ -23,7 +23,11 @@ from fluvio_tpu.types import NO_TIMESTAMP
 
 MIN_ROWS = 8
 MIN_WIDTH = 32
+# widest record the NARROW (one row per record) device layout stages;
+# wider records stage as striped segments (smartengine/tpu/stripes.py)
+# up to the hard staging ceiling below
 MAX_WIDTH = 1 << 16
+MAX_RECORD_WIDTH = 1 << 20
 
 
 def apply_postops_host(values: np.ndarray, postops) -> np.ndarray:
@@ -169,8 +173,10 @@ class RecordBuffer:
         max_k = max((len(r.key) for r in records if r.key is not None), default=0)
         width = bucket_width(max_v)
         kwidth = _next_pow2(max_k, MIN_WIDTH) if max_k else MIN_WIDTH
-        if width > MAX_WIDTH:
-            raise ValueError(f"record value of {max_v} bytes exceeds {MAX_WIDTH}")
+        if width > MAX_RECORD_WIDTH:
+            raise ValueError(
+                f"record value of {max_v} bytes exceeds {MAX_RECORD_WIDTH}"
+            )
 
         values = np.zeros((rows, width), dtype=np.uint8)
         lengths = np.zeros(rows, dtype=np.int32)
@@ -290,8 +296,10 @@ class RecordBuffer:
         lengths_live = (val_off[1:] - val_off[:-1]).astype(np.int32)
         max_v = int(lengths_live.max()) if n else 0
         width = bucket_width(max_v)
-        if width > MAX_WIDTH:
-            raise ValueError(f"record value of {max_v} bytes exceeds {MAX_WIDTH}")
+        if width > MAX_RECORD_WIDTH:
+            raise ValueError(
+                f"record value of {max_v} bytes exceeds {MAX_RECORD_WIDTH}"
+            )
         lengths = np.zeros(rows, dtype=np.int32)
         lengths[:n] = lengths_live
         values = np.zeros((rows, width), dtype=np.uint8)
@@ -334,8 +342,10 @@ class RecordBuffer:
         val_len = cols["val_len"]
         max_v = int(val_len.max()) if n else 0
         width = bucket_width(max_v)
-        if width > MAX_WIDTH:
-            raise ValueError(f"record value of {max_v} bytes exceeds {MAX_WIDTH}")
+        if width > MAX_RECORD_WIDTH:
+            raise ValueError(
+                f"record value of {max_v} bytes exceeds {MAX_RECORD_WIDTH}"
+            )
         lengths = np.zeros(rows, dtype=np.int32)
         lengths[:n] = val_len.astype(np.int32)
         starts = np.zeros(rows, dtype=np.int32)

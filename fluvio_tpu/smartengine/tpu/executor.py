@@ -39,8 +39,13 @@ from fluvio_tpu.smartmodule.types import (
 )
 from fluvio_tpu.smartengine.config import SmartModuleConfig
 from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
-from fluvio_tpu.smartengine.tpu import glz, kernels
-from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer, apply_postops_host
+from fluvio_tpu.smartengine.tpu import glz, kernels, stripes
+from fluvio_tpu.smartengine.tpu.buffer import (
+    MAX_RECORD_WIDTH,
+    MAX_WIDTH,
+    RecordBuffer,
+    apply_postops_host,
+)
 from fluvio_tpu.smartengine.tpu.lower import (
     Unlowerable,
     apply_postops,
@@ -422,6 +427,16 @@ def stage_link_columns(buf):
     return lengths_up, has_keys, has_offsets, ts_mode, ts_up
 
 
+def effective_link_compress() -> bool:
+    """Resolve ``FLUVIO_LINK_COMPRESS`` (on/off/auto) to the mode
+    executors actually run with: "auto" enables it off-CPU only — on
+    the CPU backend there is no link to save. The ONE home for this
+    policy (the bench records it next to every capture; the sentinel's
+    A/B arm pins its opposite)."""
+    mode = os.environ.get("FLUVIO_LINK_COMPRESS", "auto")
+    return mode == "on" or (mode == "auto" and jax.default_backend() != "cpu")
+
+
 _GLZ_POOL = None
 _GLZ_POOL_LOCK = threading.Lock()
 
@@ -468,6 +483,33 @@ class TpuChainExecutor:
                 "fanout_cap", "glz_bytes",
             ),
         )
+        # striped wide-record layout (stripes.py): records wider than the
+        # narrow layout stage as fixed-width stripe rows sharing a
+        # segment id; the striped lowering is built lazily on the first
+        # wide batch (resolved DSL programs ride along from try_build)
+        self._programs: List = []
+        self._striped = None
+        self._striped_tried = False
+        self._stripe_s, self._stripe_v = stripes.stripe_params()
+        self._stripe_threshold = int(
+            os.environ.get("FLUVIO_STRIPE_THRESHOLD", MAX_WIDTH)
+        )
+        self._jit_striped = jax.jit(
+            self._chain_fn_striped,
+            static_argnames=(
+                "srows", "kwidth", "has_keys", "has_offsets", "ts_mode",
+                "fanout_cap", "glz_bytes",
+            ),
+        )
+        # glz self-heal bookkeeping: a heal invalidates the device carry
+        # lineage of every aggregate dispatch already in flight; the
+        # epoch marks them stale and the dispatch sequence tells a stale
+        # finish whether the healed carry tip is still current (safe to
+        # re-dispatch from) or already consumed by later dispatches
+        self._heal_epoch = 0
+        self._heal_carries = None
+        self._heal_dispatch_seq = -1
+        self._dispatch_seq = 0
         # do any stages write key columns? (drives D2H key download)
         self._writes_keys = any(
             (isinstance(s, _MapStage) and s.key_fn is not None)
@@ -497,13 +539,9 @@ class TpuChainExecutor:
         self.d2h_bytes_total = 0
         # glz link compression (smartengine/tpu/glz.py): record bytes
         # cross the H2D link compressed and inflate ON DEVICE in the
-        # same jit as the chain. "auto" enables it off-CPU only — on
-        # the CPU backend there is no link to save, and tests opt in
-        # explicitly with FLUVIO_LINK_COMPRESS=on.
-        _lc = os.environ.get("FLUVIO_LINK_COMPRESS", "auto")
-        self._link_compress = _lc == "on" or (
-            _lc == "auto" and jax.default_backend() != "cpu"
-        )
+        # same jit as the chain; tests opt in explicitly with
+        # FLUVIO_LINK_COMPRESS=on
+        self._link_compress = effective_link_compress()
         self._viewable = not agg_configs and all(
             isinstance(s, (_FilterStage, _ArrayMapStage))
             or (
@@ -561,6 +599,7 @@ class TpuChainExecutor:
     ) -> Optional["TpuChainExecutor"]:
         stages: List = []
         agg_configs: List[Tuple[str, Optional[int], bytes]] = []
+        programs: List = []
         if not entries:
             return None
         try:
@@ -570,6 +609,7 @@ class TpuChainExecutor:
                 if prog is None:
                     return None
                 prog = dsl.resolve_params(prog, config.params)
+                programs.append(prog)
                 if isinstance(prog, dsl.FilterProgram):
                     if infer_type(prog.predicate) != "bool":
                         raise Unlowerable("filter predicate must be bool")
@@ -640,7 +680,9 @@ class TpuChainExecutor:
                     return None
         except (Unlowerable, KeyError):
             return None
-        return cls(stages, agg_configs)
+        ex = cls(stages, agg_configs)
+        ex._programs = programs
+        return ex
 
     def attach(self, instances: List) -> None:
         """Python-side instances mirror aggregate state for backend parity."""
@@ -815,6 +857,171 @@ class TpuChainExecutor:
         }
         return self._chain_fn(arrays, count, base_ts, carries, fanout_cap)
 
+    # -- striped wide-record path -------------------------------------------
+
+    def _needs_stripes(self, buf: RecordBuffer) -> bool:
+        """Layout decision only: does this batch's width exceed the
+        narrow (one row per record) layout? Whether the CHAIN can run
+        striped is `_striped_chain`'s call."""
+        return buf.width > self._stripe_threshold
+
+    def _striped_chain(self):
+        """Lazily-built striped lowering of the chain (None when any
+        stage is outside the stripeable subset — wide batches then keep
+        the interpreter spill)."""
+        if not self._striped_tried:
+            self._striped_tried = True
+            sc = None
+            if self._programs and (self._viewable or self._int_output):
+                sc = stripes.try_build_striped(
+                    self._programs, self.stages, self._stripe_s, self._stripe_v
+                )
+            if (
+                sc is not None
+                and not self._int_output
+                and tuple(sc.postops) != tuple(self._view_postops)
+            ):  # pragma: no cover — both derive from the same programs
+                sc = None
+            self._striped = sc
+        return self._striped
+
+    def max_stageable_width(self) -> int:
+        """Widest record value this chain stages on device (the broker's
+        record-too-wide decline keys off this instead of a constant).
+        Must be conservative: a slice this guard admits may never raise
+        TpuSpill at dispatch time (in-flight chunks would be abandoned),
+        so the sharded fan-out exclusion counts against it."""
+        if self._sharded is not None and self._fanout:
+            return self._stripe_threshold
+        if self._striped_chain() is not None:
+            return MAX_RECORD_WIDTH
+        return self._stripe_threshold
+
+    def _stripe_rows(self, buf: RecordBuffer) -> int:
+        """Static stripe-row count for a batch (bucketed pow2/8 so
+        compile variants stay bounded, like every other shape axis)."""
+        exact = stripes.plan_rows(
+            buf.lengths, buf.count, self._stripe_s, self._stripe_v
+        )
+        return self._bucket_bytes(max(exact, 8), floor=8)
+
+    def _chain_fn_striped(
+        self,
+        flat,
+        lengths,
+        keys,
+        key_lengths,
+        offset_deltas,
+        timestamp_deltas,
+        count,
+        base_ts,
+        carries,
+        glz_seqs=None,
+        glz_lits=None,
+        glz_depth=None,
+        *,
+        srows: int,
+        kwidth: int,
+        has_keys: bool,
+        has_offsets: bool,
+        ts_mode: str,
+        fanout_cap: Optional[int] = None,
+        glz_bytes: int = 0,
+    ):
+        """Striped chain body: same ragged flat upload as the narrow
+        path (glz decode included), re-padded into ``srows`` stripe rows
+        of ``_stripe_s`` bytes with the segment sidecar derived on
+        device from the lengths. Filters reduce per segment, aggregates
+        run on the segment axis (the narrow scan stages, reused), and
+        outputs ship as the segment survivor bitmask / aggregate ints /
+        fan-out descriptors — the narrow fetch paths consume all three.
+        """
+        if glz_bytes:
+            raw = glz.decompress_device(
+                glz_seqs[0], glz_seqs[1], glz_seqs[2], glz_lits,
+                glz_depth, glz_bytes,
+            )
+            flat = lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.int32)
+        lengths = lengths.astype(jnp.int32)
+        n = lengths.shape[0]
+        s, v = self._stripe_s, self._stripe_v
+        live = jnp.arange(n, dtype=jnp.int32) < count
+        plan = stripes.plan_device(lengths, live, srows, s, v)
+        sv = stripes.striped_repad_words(flat, lengths, plan, s)
+        keys, key_lengths, offset_deltas, timestamp_deltas = (
+            derived_meta_columns(
+                n, kwidth, has_keys, keys, key_lengths,
+                has_offsets, offset_deltas, ts_mode, timestamp_deltas,
+            )
+        )
+        arrays = {
+            "keys": keys,
+            "key_lengths": key_lengths,
+            "offset_deltas": offset_deltas,
+            "timestamp_deltas": timestamp_deltas,
+        }
+        seg_state = stripes.seg_state_of(plan, sv, lengths, arrays, s)
+        ctx = {"sv": sv, "plan": plan, "seg_state": seg_state, "n": n}
+        valid, seg_state, carries, fan = self._striped.run(
+            ctx, live, carries, base_ts, {"fanout_cap": fanout_cap}
+        )
+        packed: Dict = {}
+        if fan is not None:
+            flag, st_g, len_g = fan
+            contributing = jnp.take(valid, plan["seg"]) & plan["row_live"]
+            zeros_b = jnp.zeros((srows,), bool)
+            zeros_i = jnp.zeros((srows,), jnp.int32)
+            total, local_row, rel_start, elen = kernels.fanout_scatter(
+                flag, st_g, len_g, zeros_b, zeros_i, zeros_i,
+                contributing, fanout_cap,
+            )
+            src_seg = jnp.take(
+                plan["seg"], jnp.clip(local_row, 0, srows - 1)
+            )
+            out_count = jnp.minimum(total, jnp.int32(fanout_cap))
+            packed["span_start"] = rel_start
+            packed["span_len"] = elen
+            packed["src_row"] = src_seg
+            header = jnp.stack(
+                [
+                    out_count.astype(jnp.int64),
+                    jnp.max(elen).astype(jnp.int64),
+                    jnp.int64(0),
+                    jnp.int64(0),  # split mode cannot error
+                    total.astype(jnp.int64),
+                ]
+            )
+            return header, packed, carries
+        out_count = jnp.sum(valid.astype(jnp.int32))
+
+        def _header(max_v):
+            return jnp.stack(
+                [
+                    out_count.astype(jnp.int64),
+                    max_v.astype(jnp.int64),
+                    jnp.int64(0),
+                    jnp.int64(0),
+                    jnp.int64(0),
+                ]
+            )
+
+        if self._int_output:
+            windowed = bool(self.stages[-1].window_ms)
+            cols = [seg_state["agg_out_int"]]
+            if windowed:
+                cols.append(seg_state["agg_win_int"])
+            _, compacted = kernels.compact_rows(valid, *cols)
+            packed["agg_int"] = compacted[0]
+            if windowed:
+                packed["agg_win"] = compacted[1]
+            packed["mask"] = kernels.pack_mask(valid)
+            return _header(jnp.int32(0)), packed, carries
+        # viewable (filters + postop maps): survivors are whole records,
+        # so the 1-bit segment mask is the entire D2H payload
+        packed["mask"] = kernels.pack_mask(valid)
+        mx = jnp.max(jnp.where(valid, lengths, 0))
+        return _header(mx), packed, carries
+
     def _dispatch(self, buf: RecordBuffer, fanout_cap: Optional[int] = None):
         """Async-dispatch one batch.
 
@@ -830,6 +1037,14 @@ class TpuChainExecutor:
                 (jnp.int64(acc), jnp.int64(win), jnp.asarray(has))
                 for acc, win, has in self.carries
             )
+        striped = self._needs_stripes(buf)
+        if striped and self._striped_chain() is None:
+            # the one structural fallback left: a wide batch whose chain
+            # is outside the stripeable subset spills to the interpreter
+            raise TpuSpill(
+                f"record width {buf.width} exceeds the narrow layout and "
+                "the chain is not stripeable"
+            )
         flat, bucket = self._flat_and_bucket(buf)
         flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes, flat_h2d = (
             self._stage_flat(buf, flat, bucket)
@@ -840,7 +1055,7 @@ class TpuChainExecutor:
         ts_up = jnp.asarray(ts_np) if ts_np is not None else None
 
         def _call():
-            return self._jit_ragged(
+            args = (
                 flat_up,
                 jnp.asarray(lengths_up),
                 jnp.asarray(buf.keys) if has_keys else None,
@@ -853,7 +1068,8 @@ class TpuChainExecutor:
                 glz_seqs,
                 glz_lits,
                 glz_depth,
-                width=buf.width,
+            )
+            kwargs = dict(
                 kwidth=buf.keys.shape[1],
                 has_keys=has_keys,
                 has_offsets=has_offsets,
@@ -861,6 +1077,11 @@ class TpuChainExecutor:
                 fanout_cap=fanout_cap,
                 glz_bytes=glz_bytes,
             )
+            if striped:
+                return self._jit_striped(
+                    *args, srows=self._stripe_rows(buf), **kwargs
+                )
+            return self._jit_ragged(*args, width=buf.width, **kwargs)
 
         try:
             header, packed, new_carries = _call()
@@ -887,6 +1108,7 @@ class TpuChainExecutor:
         self._glz_last = bool(glz_bytes)
         # keep aggregate state device-resident; host mirrors sync on demand
         self._device_carries = new_carries
+        self._dispatch_seq += 1
         self.h2d_bytes_total += (
             flat_h2d
             + lengths_up.nbytes
@@ -1159,9 +1381,14 @@ class TpuChainExecutor:
                 return self._delta_decode(raw, src_delta[1], count)
             return np.asarray(raw[:count]).astype(np.int64)
 
-        if self._viewable and self._identity_view:
-            # filter-only: the mask alone crosses the link; spans are
-            # (0, input_length) for every survivor by construction
+        if self._viewable and (
+            self._identity_view
+            or (self._needs_stripes(buf) and not self._fanout)
+        ):
+            # filter-only (and striped filter/postop chains, whose
+            # survivors are whole records): the mask alone crosses the
+            # link; spans are (0, input_length) for every survivor by
+            # construction and postops apply host-side
             rows = self._bucket_bytes(max(count, 1), 8)
             host = self._download([packed["mask"]])
             src = self._mask_to_src(host[0], buf)[:count]
@@ -1511,9 +1738,11 @@ class TpuChainExecutor:
         prev_carries = self._device_carries
         header, packed = self._dispatch(buf, fanout_cap=self._fanout_cap(buf))
         spec = self._start_result_copies(buf, header, packed)
-        # finish-side self-heal marker: whether THIS dispatch shipped a
-        # glz-compressed flat (async runtime failures surface at fetch)
+        # finish-side self-heal markers: whether THIS dispatch shipped a
+        # glz-compressed flat (async runtime failures surface at fetch),
+        # and the heal epoch its carry lineage belongs to
         spec["glz_used"] = getattr(self, "_glz_last", False)
+        spec["epoch"] = self._heal_epoch
         return (prev_carries, header, packed, spec)
 
     def dispatch_buffers(self, bufs: List[RecordBuffer]) -> List[tuple]:
@@ -1576,9 +1805,9 @@ class TpuChainExecutor:
             return spec
         if self._viewable:
             packed["mask"].copy_to_host_async()
-            if self._identity_view:
-                # filter-only: the mask IS the whole download — no
-                # descriptor speculation to arm
+            if self._identity_view or "span_start" not in packed:
+                # filter-only and striped chains: the mask IS the whole
+                # download — no descriptor speculation to arm
                 return spec
             guess = self._spec_rows
             n_desc = packed["span_start"].shape[0]
@@ -1601,6 +1830,16 @@ class TpuChainExecutor:
             self._sharded.discard_dispatch(handle)
             return
         self._charge_unfetched_spec(handle)
+        spec = handle[3] if len(handle) > 3 else None
+        if (
+            self.agg_configs
+            and spec is not None
+            and spec.get("epoch", self._heal_epoch) != self._heal_epoch
+        ):
+            # a glz heal already superseded this handle's carry lineage;
+            # restoring its pre-dispatch futures would resurrect the
+            # corrupt chain the heal rolled away from
+            return
         self._device_carries = handle[0]
 
     def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
@@ -1616,6 +1855,12 @@ class TpuChainExecutor:
         if self._sharded is not None:
             return self._sharded.finish_buffer(buf, handle)
         prev_carries, header, packed, spec = handle
+        if (
+            self.agg_configs
+            and spec is not None
+            and spec.get("epoch", self._heal_epoch) != self._heal_epoch
+        ):
+            return self._finish_stale_epoch(buf, handle)
         try:
             return self._fetch(buf, header, packed, spec)
         except _FanoutOverflow as o:
@@ -1637,8 +1882,12 @@ class TpuChainExecutor:
             # compile errors; device RUNTIME failures surface here when
             # results are consumed): disable compression, roll carries
             # back, re-run the batch raw. Unrelated failures re-raise
-            # from the raw retry.
-            if not (spec and spec.get("glz_used")) or not self._link_compress:
+            # from the raw retry. Gated on THIS batch's own glz_used —
+            # not the executor-wide latch: under the pipelined loop,
+            # batch k's heal latches compression off while batch k+1
+            # (already dispatched compressed) is still in flight, and
+            # k+1 must heal too instead of re-raising.
+            if not (spec and spec.get("glz_used")):
                 raise
             logging.getLogger(__name__).warning(
                 "glz decode failed at fetch; link compression disabled: %s", e
@@ -1646,10 +1895,48 @@ class TpuChainExecutor:
             self._link_compress = False
             buf._glz_cache = None
             self._device_carries = prev_carries
+            if self.agg_configs:
+                # every aggregate dispatch in flight chained its device
+                # carries off the failed decode: mark their lineage stale
+                # so their finish re-dispatches (or spills) instead of
+                # silently fetching diverged results
+                self._heal_epoch += 1
             header, packed = self._dispatch(
                 buf, fanout_cap=self._fanout_cap(buf)
             )
+            if self.agg_configs:
+                self._heal_carries = self._device_carries
+                self._heal_dispatch_seq = self._dispatch_seq
             return self._fetch(buf, header, packed)
+
+    def _finish_stale_epoch(self, buf: RecordBuffer, handle) -> RecordBuffer:
+        """Finish an aggregate dispatch whose carry lineage a glz heal
+        invalidated while it was in flight.
+
+        When nothing else has consumed the carry chain since the heal
+        (the common pipelined case: stale handles finish in dispatch
+        order), re-dispatch this batch from the healed tip — the repaired
+        chain stays on device end to end. When later dispatches already
+        advanced the chain past the gap, those results are poisoned too:
+        restore the healed tip, invalidate them, and spill this batch to
+        the interpreter (which re-syncs authoritative state afterwards).
+        """
+        self._charge_unfetched_spec(handle)
+        if self._dispatch_seq == self._heal_dispatch_seq:
+            header, packed = self._dispatch(
+                buf, fanout_cap=self._fanout_cap(buf)
+            )
+            self._heal_carries = self._device_carries
+            self._heal_dispatch_seq = self._dispatch_seq
+            return self._fetch(buf, header, packed)
+        self._heal_epoch += 1
+        self._heal_dispatch_seq = -1
+        if self._heal_carries is not None:
+            self._device_carries = self._heal_carries
+            self._heal_carries = None
+        raise TpuSpill(
+            "glz heal invalidated in-flight aggregate carry lineage"
+        )
 
     def process_buffer(self, buf: RecordBuffer) -> RecordBuffer:
         """Array-in/array-out path (bench + broker stream path)."""
@@ -1710,9 +1997,12 @@ class TpuChainExecutor:
         try:
             buf = RecordBuffer.from_smartmodule_input(inp)
         except ValueError as e:
-            # a record wider than MAX_WIDTH cannot stage into the padded
-            # device layout: spill to the interpreter (same surface as a
-            # device-detected transform error), never crash the chain
+            # a record beyond even the striped layout's hard ceiling
+            # (MAX_RECORD_WIDTH) cannot stage: spill to the interpreter
+            # (same surface as a device-detected transform error), never
+            # crash the chain. Records merely wider than the narrow
+            # layout stage striped — or spill from _dispatch when the
+            # chain is outside the stripeable subset.
             raise TpuSpill(str(e)) from None
         out = self.process_buffer(buf)
         if self.agg_configs:

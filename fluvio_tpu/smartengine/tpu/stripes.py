@@ -1,0 +1,527 @@
+"""Striped wide-record device layout.
+
+Records wider than the narrow layout's ``MAX_WIDTH`` used to spill every
+batch to the interpreter (the record-too-wide ``TpuSpill``). Streaming
+accelerators instead decompose variable-width records into fixed-width
+tiles with segment bookkeeping (cf. Diba's segment streams and Sextans'
+streaming tiling); this module is that decomposition for the
+HBM-resident ``RecordBuffer``:
+
+- a record of ``len`` bytes becomes K consecutive device rows ("stripes")
+  of ``STRIPE_WIDTH`` bytes sharing a segment id, with a per-row
+  ``(segment_id, stripe_idx, stripe_len)`` sidecar DERIVED ON DEVICE from
+  the record lengths — the flat H2D copy stays the single contiguous
+  ragged transfer the narrow path ships (glz staging compresses it the
+  same way);
+- consecutive stripes overlap by ``STRIPE_OVERLAP`` bytes, so any byte
+  window up to the overlap length is wholly contained in some stripe:
+  filter literals evaluate per stripe and reduce per segment
+  (``jax.ops.segment_max`` over stripe verdicts) with no boundary miss;
+- map transforms are restricted to the position-wise postop family
+  (upper/lower), which commute with striping — outputs ship as the
+  segment survivor bitmask and the host re-materializes from the slab it
+  already holds (the narrow view-mode diet, unchanged);
+- aggregate contributions evaluate on a segment-level state (full
+  lengths, stripe-0 byte prefix) and the existing segmented-scan
+  aggregate stages run unchanged over the segment axis, so carries
+  accumulate per segment;
+- array_map ``split`` explodes compute separator positions per stripe
+  (each owned by exactly one stripe) and resolve cross-stripe element
+  extents with a suffix-min over the segment's stripe rows.
+
+Exactness bounds (build-time checked where possible, documented where
+data-dependent):
+
+- filter/regex literals must be no longer than ``STRIPE_OVERLAP``
+  (start-anchored: the stripe width); non-literal regexes (DFA scans),
+  ``JsonGet``-sourced predicates and transforms, ``word_count``, and
+  ``json_array`` explodes are NOT stripeable — chains containing them
+  keep the interpreter spill for wide batches;
+- ``ParseInt`` contributions parse the record's leading int from the
+  first stripe: a record whose int prefix (whitespace + sign + digits)
+  extends past ``STRIPE_WIDTH`` bytes parses only the in-stripe prefix.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fluvio_tpu.ops.regex_dfa import literal_of
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartengine.tpu import kernels
+from fluvio_tpu.smartengine.tpu.lower import Unlowerable, apply_postops, lower_expr
+
+STRIPE_WIDTH = 8192    # bytes per device row (pow2; must be 4-aligned)
+STRIPE_OVERLAP = 128   # shared bytes between consecutive stripes
+
+
+def stripe_params() -> Tuple[int, int]:
+    """(stripe width, overlap) with env overrides for tests/benches.
+
+    The step (width - overlap) must stay 4-aligned so stripe starts land
+    on i32 word boundaries and the ragged word gather stays word-exact.
+    """
+    s = int(os.environ.get("FLUVIO_STRIPE_WIDTH", STRIPE_WIDTH))
+    v = int(os.environ.get("FLUVIO_STRIPE_OVERLAP", STRIPE_OVERLAP))
+    if s % 4 or v % 4 or v >= s:
+        raise ValueError(f"bad stripe params width={s} overlap={v}")
+    return s, v
+
+
+def stripe_counts(lengths: np.ndarray, s: int, v: int) -> np.ndarray:
+    """Host mirror of the device stripe-count formula (must agree)."""
+    step = s - v
+    lengths = np.asarray(lengths, dtype=np.int64)
+    return np.maximum(1, (np.maximum(lengths - v, 0) + step - 1) // step)
+
+
+def plan_rows(lengths: np.ndarray, count: int, s: int, v: int) -> int:
+    """Exact live stripe-row total for a batch (host side; the executor
+    buckets it into the static compile shape)."""
+    if count == 0:
+        return 1
+    return int(stripe_counts(lengths[:count], s, v).sum())
+
+
+def plan_device(lengths, live, r: int, s: int, v: int) -> dict:
+    """Derive the stripe sidecar on device from the record lengths.
+
+    ``lengths`` is int32[n] (record rows, zero past the live count),
+    ``live`` bool[n], ``r`` the static stripe-row count. Returns per
+    stripe-row arrays: ``seg`` (record row), ``stripe_idx``,
+    ``abs_start`` (byte offset of the stripe within its record),
+    ``stripe_len``, ``row_live``, ``is_last``, plus the per-record
+    ``first_row`` index and stripe count ``k``.
+    """
+    step = s - v
+    n = lengths.shape[0]
+    k = jnp.where(
+        live,
+        jnp.maximum(1, (jnp.maximum(lengths - v, 0) + step - 1) // step),
+        0,
+    ).astype(jnp.int32)
+    cum = jnp.cumsum(k)
+    r_live = cum[-1]
+    rr = jnp.arange(r, dtype=jnp.int32)
+    seg = jnp.searchsorted(cum, rr, side="right").astype(jnp.int32)
+    seg_c = jnp.clip(seg, 0, n - 1)
+    first_row = cum - k
+    stripe_idx = rr - jnp.take(first_row, seg_c)
+    row_live = rr < r_live
+    seg_len = jnp.take(lengths, seg_c)
+    abs_start = stripe_idx * step
+    stripe_len = jnp.where(row_live, jnp.clip(seg_len - abs_start, 0, s), 0)
+    is_last = row_live & (stripe_idx == jnp.take(k, seg_c) - 1)
+    return {
+        "seg": seg_c,
+        "stripe_idx": stripe_idx,
+        "abs_start": abs_start,
+        "stripe_len": stripe_len,
+        "row_live": row_live,
+        "is_last": is_last,
+        "first_row": first_row,
+        "k": k,
+        "step": step,
+        "s": s,
+        "v": v,
+    }
+
+
+def striped_repad_words(flat, lengths, plan, s: int):
+    """Build the striped byte matrix [r, s] from the 4-aligned ragged
+    flat upload (same i32-word gather diet as the narrow
+    ``ragged_repad_words``; stripe starts are word-aligned because the
+    stripe step is 4-aligned). Overlap bytes are gathered twice from the
+    same flat — HBM cost only, never link bytes."""
+    lengths = lengths.astype(jnp.int32)
+    lengths4 = (lengths + 3) & ~3
+    word_starts = (jnp.cumsum(lengths4) - lengths4) >> 2
+    ws = jnp.take(word_starts, plan["seg"]) + (plan["abs_start"] >> 2)
+    wwidth = s // 4
+    jw = jnp.arange(wwidth, dtype=jnp.int32)[None, :]
+    widx = ws[:, None] + jw
+    words = jnp.take(flat, jnp.clip(widx, 0, flat.shape[0] - 1), axis=0)
+    shifts = jnp.arange(4, dtype=jnp.int32)[None, None, :] * 8
+    unpacked = (words[:, :, None] >> shifts) & 0xFF
+    gathered = unpacked.reshape(words.shape[0], s)
+    jidx = jnp.arange(s, dtype=jnp.int32)[None, :]
+    mask = jidx < plan["stripe_len"][:, None]
+    return jnp.where(mask, gathered, 0).astype(jnp.uint8)
+
+
+def seg_any(verdict, plan, n: int):
+    """Per-segment OR of per-stripe verdicts (the segment reduce the
+    striped filter engine is built on)."""
+    x = (verdict & plan["row_live"]).astype(jnp.int32)
+    return (
+        jax.ops.segment_max(
+            x, plan["seg"], num_segments=n, indices_are_sorted=True
+        )
+        > 0
+    )
+
+
+def seg_state_of(plan, striped_values, lengths, arrays: dict, s: int) -> dict:
+    """Segment-level state view: full record lengths + the stripe-0 byte
+    prefix, alongside the un-striped meta columns. Narrow lowerings over
+    this state are exact for length/key/const expressions and
+    prefix-exact (within the first stripe) for byte parses."""
+    n = lengths.shape[0]
+    r = striped_values.shape[0]
+    s0 = jnp.clip(plan["first_row"], 0, r - 1)
+    seg_values = jnp.take(striped_values, s0, axis=0)
+    live = plan["k"] > 0
+    seg_values = jnp.where(live[:, None], seg_values, 0)
+    return {
+        "values": seg_values,
+        "lengths": lengths.astype(jnp.int32),
+        "keys": arrays["keys"],
+        "key_lengths": arrays["key_lengths"],
+        "offset_deltas": arrays["offset_deltas"],
+        "timestamp_deltas": arrays["timestamp_deltas"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Build-time lowering
+# ---------------------------------------------------------------------------
+
+_SEG_EXACT_NODES = (
+    dsl.Cmp, dsl.Len, dsl.ParseInt, dsl.Value, dsl.Key, dsl.Const,
+    dsl.Upper, dsl.Lower, dsl.And, dsl.Or, dsl.Not, dsl.Contains,
+    dsl.StartsWith, dsl.EndsWith,
+)
+
+
+def _check_seg_exact(expr) -> None:
+    """Whitelist for expressions evaluated on the segment-level state:
+    length/key/const reads are exact; ``ParseInt`` over record bytes is
+    prefix-exact within the first stripe (module docstring). Anything
+    touching full record bytes structurally (JsonGet, Concat, regex)
+    is rejected."""
+    if not isinstance(expr, _SEG_EXACT_NODES):
+        raise Unlowerable(f"{type(expr).__name__} not stripeable")
+    for f in ("arg", "left", "right"):
+        sub = getattr(expr, f, None)
+        if isinstance(sub, dsl.Expr):
+            _check_seg_exact(sub)
+    for sub in getattr(expr, "args", []) or []:
+        _check_seg_exact(sub)
+    if isinstance(expr, (dsl.Contains, dsl.StartsWith, dsl.EndsWith)):
+        # byte searches are only seg-exact over key/const sources; a
+        # Value-sourced search must go through the striped kernels
+        if _value_postops(expr.arg) is not None:
+            raise Unlowerable("value search must lower striped")
+
+
+def _value_postops(arg) -> Optional[Tuple[str, ...]]:
+    """``arg`` as a postop chain over the record value: ``Upper(Lower(
+    Value()))`` -> ("lower", "upper"). None when the byte source is not
+    the record value (key/const — exact on the segment state); raises
+    for sources that are neither (JsonGet etc.)."""
+    if isinstance(arg, dsl.Value):
+        return ()
+    if isinstance(arg, (dsl.Upper, dsl.Lower)):
+        inner = _value_postops(arg.arg)
+        if inner is None:
+            return None
+        return inner + ("upper" if isinstance(arg, dsl.Upper) else "lower",)
+    if isinstance(arg, (dsl.Key, dsl.Const)):
+        return None
+    raise Unlowerable(f"{type(arg).__name__} not stripeable as a byte source")
+
+
+def _lower_striped_literal(kind: str, lit: bytes, postops, s: int, v: int):
+    """One literal predicate over striped record bytes.
+
+    ``kind``: contains | startswith | endswith | equals. Containment
+    windows up to the overlap length are whole in some stripe, so the
+    per-stripe verdict OR is exact; anchored forms additionally pin the
+    stripe (first/last) and, for equals, the full segment length.
+    """
+    limit = s if kind in ("startswith", "equals") else v
+    if len(lit) > limit:
+        raise Unlowerable(
+            f"literal of {len(lit)} bytes exceeds the stripe "
+            f"{'width' if limit == s else 'overlap'} ({limit})"
+        )
+
+    def fn(ctx):
+        sv = apply_postops(ctx["sv"], postops)
+        slen = ctx["plan"]["stripe_len"]
+        plan, n = ctx["plan"], ctx["n"]
+        if kind == "contains":
+            row = kernels.literal_search(sv, slen, lit)
+            return seg_any(row, plan, n)
+        if kind == "startswith":
+            row = kernels.literal_startswith(sv, slen, lit)
+            return seg_any(row & (plan["stripe_idx"] == 0), plan, n)
+        if kind == "endswith":
+            row = kernels.literal_endswith(sv, slen, lit)
+            return seg_any(row & plan["is_last"], plan, n)
+        # equals: start-anchored match plus exact segment length
+        row = kernels.literal_startswith(sv, slen, lit)
+        hit = seg_any(row & (plan["stripe_idx"] == 0), plan, n)
+        return hit & (ctx["seg_state"]["lengths"] == len(lit))
+
+    return fn
+
+
+def lower_striped_predicate(expr, s: int, v: int) -> Callable:
+    """Lower a filter predicate to fn(ctx) -> bool[n] (segment level).
+
+    ``ctx`` carries ``sv`` (striped values, with any upstream postops
+    already applied), ``plan``, ``seg_state``, ``n``.
+    """
+    if isinstance(expr, dsl.And):
+        fns = [lower_striped_predicate(a, s, v) for a in expr.args]
+        return lambda c: _fold(fns, c, lambda x, y: x & y)
+    if isinstance(expr, dsl.Or):
+        fns = [lower_striped_predicate(a, s, v) for a in expr.args]
+        return lambda c: _fold(fns, c, lambda x, y: x | y)
+    if isinstance(expr, dsl.Not):
+        inner = lower_striped_predicate(expr.arg, s, v)
+        return lambda c: ~inner(c)
+    if isinstance(expr, dsl.Cmp):
+        _check_seg_exact(expr)
+        fn = lower_expr(expr)
+        return lambda c: fn(c["seg_state"])
+    if isinstance(expr, (dsl.Contains, dsl.StartsWith, dsl.EndsWith)):
+        postops = _value_postops(expr.arg)
+        if postops is None:  # key/const source: exact on the segment state
+            _check_seg_exact(expr)
+            fn = lower_expr(expr)
+            return lambda c: fn(c["seg_state"])
+        kind = {
+            dsl.Contains: "contains",
+            dsl.StartsWith: "startswith",
+            dsl.EndsWith: "endswith",
+        }[type(expr)]
+        return _lower_striped_literal(kind, expr.literal, postops, s, v)
+    if isinstance(expr, dsl.RegexMatch):
+        postops = _value_postops(expr.arg)
+        if postops is None:
+            raise Unlowerable("striped regex must read the record value")
+        info = literal_of(expr.pattern)
+        if info is None:
+            raise Unlowerable("non-literal regex needs the DFA scan")
+        lit, a_start, a_end = info
+        if a_start and a_end:
+            kind = "equals"
+        elif a_start:
+            kind = "startswith"
+        elif a_end:
+            kind = "endswith"
+        else:
+            kind = "contains"
+        return _lower_striped_literal(kind, lit, postops, s, v)
+    raise Unlowerable(f"{type(expr).__name__} not stripeable as a predicate")
+
+
+def _fold(fns, ctx, op):
+    out = fns[0](ctx)
+    for f in fns[1:]:
+        out = op(out, f(ctx))
+    return out
+
+
+def _map_postops(prog) -> Tuple[str, ...]:
+    """A map program stripeable iff it rewrites neither keys nor spans:
+    a pure postop chain over the record value."""
+    if prog.key is not None:
+        raise Unlowerable("striped map cannot rewrite keys")
+    post = _value_postops(prog.value)
+    if post is None:
+        raise Unlowerable("striped map must transform the record value")
+    return post
+
+
+def _check_contribution(prog) -> None:
+    if prog.contribution is not None:
+        _check_seg_exact(prog.contribution)
+    elif prog.kind == "word_count":
+        # per-stripe word counts double-count tokens spanning overlap
+        raise Unlowerable("word_count is not stripeable")
+
+
+# ---------------------------------------------------------------------------
+# Striped fan-out (array_map split mode, single-byte separator)
+# ---------------------------------------------------------------------------
+
+# packs (segment, byte position) into one int64 for the segment-fenced
+# suffix-min; plain int so importing this module never initializes a
+# jax backend (same rule as kernels._AGG_OPS neutrals)
+_ENC_BASE = 1 << 22  # > MAX_RECORD_WIDTH
+
+
+def striped_split_bounds(sv, plan, sep: int, n: int):
+    """Element emission grid for ``value.split(sep)`` over striped rows.
+
+    Each byte position is OWNED by exactly one stripe (the overlap tail
+    belongs to the next stripe), so separator positions dedup by
+    construction, and the row-major flag order is record order per
+    segment. Element extents that cross stripe rows resolve with a
+    suffix-min of each row's first separator position over the segment's
+    rows. Returns (flag[r,s], abs_start[r,s], elen[r,s]).
+    """
+    r, s = sv.shape
+    step = plan["step"]
+    jidx = jnp.arange(s, dtype=jnp.int32)[None, :]
+    owned_len = jnp.where(
+        plan["is_last"], plan["stripe_len"], jnp.minimum(step, plan["stripe_len"])
+    )
+    owned = jidx < owned_len[:, None]
+    m = (sv == sep) & owned
+
+    # record-order predecessor of column 0: the previous stripe's last
+    # owned byte (non-last rows own exactly `step` bytes), or record start
+    prev_last = jnp.concatenate([jnp.zeros((1,), bool), m[:-1, step - 1]])
+    col0_boundary = (plan["stripe_idx"] == 0) | prev_last
+    prev_boundary = jnp.concatenate([col0_boundary[:, None], m[:, :-1]], axis=1)
+    starts = owned & ~m & prev_boundary
+    abs_pos = plan["abs_start"][:, None] + jidx
+
+    # next separator at >= j: within-row next where one exists, else the
+    # suffix-min of later rows' first separator — segment-fenced by
+    # packing the segment id into the high bits of the encoded position
+    row_next = kernels._next_index_ge(m, s)  # [r, s]; == s when none
+    has_sep = jnp.any(m, axis=1)
+    first_abs = jnp.where(
+        has_sep,
+        plan["abs_start"] + row_next[:, 0],
+        jnp.int32(_ENC_BASE - 1),  # "no separator in this row" sentinel
+    )
+    enc = plan["seg"].astype(jnp.int64) * _ENC_BASE + first_abs.astype(jnp.int64)
+    enc = jnp.where(plan["row_live"], enc, jnp.int64(2**62))
+    suffix = jax.lax.cummin(enc[::-1])[::-1]
+    after = jnp.concatenate([suffix[1:], jnp.full((1,), 2**62, jnp.int64)])
+    cross_next = jnp.where(
+        (after // _ENC_BASE == plan["seg"].astype(jnp.int64))
+        & (after % _ENC_BASE < _ENC_BASE - 1),
+        (after % _ENC_BASE).astype(jnp.int32),
+        jnp.int32(-1),
+    )
+    # full record length per stripe row (the last stripe carries it; the
+    # segment reduce broadcasts it to the earlier stripes)
+    seg_last_len = jax.ops.segment_max(
+        jnp.where(plan["is_last"], plan["abs_start"] + plan["stripe_len"], 0),
+        plan["seg"],
+        num_segments=n,
+        indices_are_sorted=True,
+    )
+    row_rec_len = jnp.take(seg_last_len, plan["seg"])
+    fallback = jnp.where(cross_next >= 0, cross_next, row_rec_len)
+    next_abs = jnp.where(
+        row_next < s,
+        plan["abs_start"][:, None] + row_next,
+        fallback[:, None],
+    )
+    elen = jnp.where(starts, next_abs - abs_pos, 0)
+    return starts, jnp.where(starts, abs_pos, 0), elen
+
+
+# ---------------------------------------------------------------------------
+# Chain build + run
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StripedChain:
+    """Stripe-capable lowering of a whole SmartModule chain.
+
+    ``ops`` entries: ("filter", fn) | ("postops", tuple) |
+    ("agg", aggregate_stage) | ("fanout", sep_byte). Postops accumulate
+    into ``postops`` — the executor's host-side view materialization
+    applies them (they must equal the narrow build's ``_view_postops``).
+    """
+
+    ops: List = field(default_factory=list)
+    postops: Tuple[str, ...] = ()
+    fanout: bool = False
+    has_agg: bool = False
+
+    def run(self, ctx, valid, carries, base_ts, agg_ctx):
+        """Execute the striped chain; returns (valid[n], seg_state,
+        carries, fan) — ``fan`` is the (flag, start, elen) emission grid
+        for fan-out chains, else None."""
+        fan = None
+        for kind, arg in self.ops:
+            if kind == "filter":
+                valid = valid & arg(ctx)
+            elif kind == "postops":
+                ctx["sv"] = apply_postops(ctx["sv"], arg)
+                ctx["seg_state"]["values"] = apply_postops(
+                    ctx["seg_state"]["values"], arg
+                )
+            elif kind == "agg":
+                st = dict(ctx["seg_state"])
+                st["valid"] = valid
+                st, carries = arg.apply(st, carries, base_ts, agg_ctx)
+                ctx["seg_state"] = st
+            else:  # fanout (terminal)
+                fan = striped_split_bounds(
+                    ctx["sv"], ctx["plan"], arg, ctx["n"]
+                )
+        return valid, ctx["seg_state"], carries, fan
+
+
+def try_build_striped(programs, stages, s: int, v: int) -> Optional[StripedChain]:
+    """Striped lowering of the chain's resolved programs; None when any
+    stage is outside the stripeable subset (wide batches then keep the
+    interpreter spill). ``stages`` are the executor's narrow stages — the
+    aggregate stages are REUSED so segment-level aggregation shares the
+    narrow path's carry slots and scan kernels exactly."""
+    from fluvio_tpu.smartengine.tpu import executor as _ex
+
+    chain = StripedChain()
+    try:
+        for i, prog in enumerate(programs):
+            terminal = chain.fanout or (
+                chain.has_agg and not isinstance(prog, dsl.AggregateProgram)
+            )
+            if terminal:
+                # aggregates only as a chain suffix; fan-out only last
+                raise Unlowerable("stage after a striped terminal stage")
+            if isinstance(prog, dsl.FilterProgram):
+                chain.ops.append(
+                    ("filter", lower_striped_predicate(prog.predicate, s, v))
+                )
+            elif isinstance(prog, dsl.MapProgram):
+                post = _map_postops(prog)
+                if post:
+                    chain.ops.append(("postops", post))
+                chain.postops += post
+            elif isinstance(prog, dsl.FilterMapProgram):
+                chain.ops.append(
+                    ("filter", lower_striped_predicate(prog.predicate, s, v))
+                )
+                post = _map_postops(prog)
+                if post:
+                    chain.ops.append(("postops", post))
+                chain.postops += post
+            elif isinstance(prog, dsl.AggregateProgram):
+                _check_contribution(prog)
+                stage = stages[i]
+                assert isinstance(stage, _ex._AggregateStage)
+                chain.ops.append(("agg", stage))
+                chain.has_agg = True
+            elif isinstance(prog, dsl.ArrayMapProgram):
+                if prog.mode != "split" or len(prog.sep) != 1:
+                    raise Unlowerable(
+                        "striped array_map supports single-byte split only"
+                    )
+                if chain.has_agg:
+                    raise Unlowerable("striped fan-out after aggregate")
+                chain.ops.append(("fanout", prog.sep[0]))
+                chain.fanout = True
+            else:
+                raise Unlowerable(f"{type(prog).__name__} not stripeable")
+    except Unlowerable:
+        return None
+    return chain

@@ -411,7 +411,7 @@ def tpu_stage_dispatch(
     """
     from fluvio_tpu.protocol.compression import Compression, decompress
     from fluvio_tpu.smartengine import native_backend
-    from fluvio_tpu.smartengine.tpu.buffer import MAX_WIDTH, RecordBuffer
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
 
     tpu = getattr(chain, "tpu_chain", None)
     if tpu is None or not batches:
@@ -495,8 +495,10 @@ def tpu_stage_dispatch(
         bounds = [0, n_total]  # n_total == 0 still stages one empty chunk
     # whole-slice width guard BEFORE any dispatch: a too-wide record
     # declines the slice without leaving earlier chunks' device work
-    # abandoned mid-flight
-    if n_total and int(merged["val_len"].max()) > MAX_WIDTH:
+    # abandoned mid-flight. The bound is the CHAIN's: stripe-capable
+    # chains stage wide records as striped segments (tpu/stripes.py) up
+    # to the hard ceiling, others decline at the narrow layout width.
+    if n_total and int(merged["val_len"].max()) > tpu.max_stageable_width():
         return _decline(metrics, "record-too-wide")
     # EVERY chunk builds (and passes its guards) before ANY dispatch:
     # a mid-loop decline (staging-cap depends on each chunk's local
@@ -511,7 +513,7 @@ def tpu_stage_dispatch(
             buf = RecordBuffer.from_flat(
                 part, base_offset=base0, base_timestamp=ts0
             )
-        except ValueError:  # value wider than MAX_WIDTH: per-record path
+        except ValueError:  # value beyond the hard ceiling: per-record path
             return _decline(metrics, "record-too-wide")
         # dense-amplification guard: one huge value would pad every
         # row of the DEVICE-side re-padded matrix (rows x width in

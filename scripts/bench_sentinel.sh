@@ -45,16 +45,19 @@ sys.exit(0 if o.get('value',0)>0 and o.get('backend')=='tpu' else 1)
     fi
     if [ "$captured" = 1 ]; then
       # A/B the glz link compression on the same weather window: a
-      # second run pinned to the OPPOSITE of whatever the primary's
-      # weather-adaptive mode chose isolates the device decode cost vs
-      # the link saving (BASELINE.md round-5 addendum names this the
-      # open variable). Drop any stale B arm first so a failed attempt
-      # can never pair an old window's file with this capture.
+      # second run pinned to the OPPOSITE of the primary's RESOLVED
+      # effective mode isolates the device decode cost vs the link
+      # saving (BASELINE.md round-5 addendum names this the open
+      # variable). bench.py emits link.glz unconditionally (operator
+      # pins included); a capture without it aborts the A/B rather
+      # than guessing — an empty pin must never duplicate the
+      # primary's own arm. Drop any stale B arm first so a failed
+      # attempt can never pair an old window's file with this capture.
       rm -f "$REPO/TPU_LIVE_BENCH_AB.json"
       ab_pin=$(python -c "
 import json
 o=json.load(open('/tmp/sentinel_bench.json'))
-print('off' if o.get('link',{}).get('glz') == 'on' else 'on')
+print({'on': 'off', 'off': 'on'}.get(o.get('link', {}).get('glz'), ''))
 " 2>>"$LOG")
       if [ -n "$ab_pin" ] && (cd "$REPO" && timeout 3000 env \
           BENCH_PROBE_BUDGET=240 FLUVIO_LINK_COMPRESS="$ab_pin" \

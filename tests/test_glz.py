@@ -290,6 +290,104 @@ def test_fetch_time_decode_failure_self_heals(monkeypatch):
     assert got == ref
 
 
+def _int_bufs(n_bufs, n=6000):
+    """Repetitive int corpora (glz engages) as RecordBuffers."""
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    bufs, val_lists = [], []
+    for b in range(n_bufs):
+        vals = [f"{(i * (b + 1)) & 63:06d}".encode() for i in range(n)]
+        records = [Record(value=v) for v in vals]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+        bufs.append(
+            RecordBuffer.from_smartmodule_input(
+                SmartModuleInput.from_records(records)
+            )
+        )
+        val_lists.append(vals)
+    return bufs, val_lists
+
+
+def _arm_first_fetch_bomb(monkeypatch):
+    """Bomb the FIRST compressed fetch (the async-failure surface);
+    all later fetches run for real."""
+    from fluvio_tpu.smartengine.tpu.executor import TpuChainExecutor
+
+    real_fetch = TpuChainExecutor._fetch
+    state = {"bombed": False}
+
+    def fetch_bomb(self, buf, header, packed, spec=None):
+        if spec and spec.get("glz_used") and not state["bombed"]:
+            state["bombed"] = True
+            raise RuntimeError("simulated device decode failure")
+        return real_fetch(self, buf, header, packed, spec)
+
+    monkeypatch.setattr(TpuChainExecutor, "_fetch", fetch_bomb)
+    return state
+
+
+def test_pipelined_heal_redispatches_inflight_aggregate(monkeypatch):
+    # ADVICE round 5: batch k's decode failure heals at fetch, but batch
+    # k+1 was ALREADY dispatched compressed AND chained its aggregate
+    # carries off the corrupt decode. The heal must (a) let k+1 heal off
+    # its own spec (not the executor-wide latch) and (b) re-dispatch it
+    # from the healed carries so device carry lineage cannot diverge.
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    state = _arm_first_fetch_bomb(monkeypatch)
+    chain = _build("tpu", [("aggregate-sum", None)])
+    ex = chain.tpu_chain
+    bufs, val_lists = _int_bufs(2)
+    outs = list(ex.process_stream(iter(bufs)))
+    assert state["bombed"], "the decode bomb should have fired"
+    assert not ex._link_compress, "compression should latch off"
+    assert len(outs) == 2
+
+    py = _build("python", [("aggregate-sum", None)])
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    for out, vals in zip(outs, val_lists):
+        records = [Record(value=v) for v in vals]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+        ref = py.process(SmartModuleInput.from_records(records))
+        assert [r.value for r in out.to_records()] == [
+            r.value for r in ref.successes
+        ]
+    # the device carry chain must equal the interpreter's accumulator
+    ex._ensure_host_state()
+    assert ex.carries[0][0] == int(py.instances[0].accumulator)
+
+
+def test_pipelined_heal_spills_when_chain_moved_on(monkeypatch):
+    # three batches: the heal happens at finish(k) while k+1 is in
+    # flight, then k+2 DISPATCHES (consuming the carry chain) before
+    # k+1 finishes. k+1's lineage cannot be repaired in place — the
+    # executor must restore the healed tip and raise TpuSpill rather
+    # than silently fetch diverged aggregates.
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    from fluvio_tpu.smartengine.tpu.executor import TpuSpill
+
+    state = _arm_first_fetch_bomb(monkeypatch)
+    chain = _build("tpu", [("aggregate-sum", None)])
+    ex = chain.tpu_chain
+    bufs, val_lists = _int_bufs(3)
+    outs = []
+    with pytest.raises(TpuSpill):
+        for out in ex.process_stream(iter(bufs)):
+            outs.append(out)
+    assert state["bombed"]
+    assert len(outs) == 1, "batch k healed and yielded before the spill"
+    # carries restored to the healed after-k tip: the interpreter rerun
+    # of k+1 starts from exactly the right accumulator
+    ex._ensure_host_state()
+    expected = sum(int(v) for v in val_lists[0])
+    assert ex.carries[0][0] == expected
+
+
 def test_stream_compress_ahead_no_double_work(monkeypatch):
     # the stream loop's worker thread compresses batch k+1 while k is
     # in flight; the staging must find the cache warm (one compress per

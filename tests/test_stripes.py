@@ -1,0 +1,456 @@
+"""Striped wide-record layout (smartengine/tpu/stripes.py).
+
+Records wider than the narrow layout stage as K consecutive device rows
+sharing a segment id; these tests pin bit-equality against the
+interpreting backend for every stripeable stage family — filter
+(boundary-straddling literals included), postop maps, split explodes
+with elements spanning stripes, and aggregate chains — on the
+single-device AND sharded engine modes, plus the graceful interpreter
+spill for chains outside the stripeable subset. Stripe geometry shrinks
+via env overrides so small corpora exercise multi-stripe segments.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from fluvio_tpu.models import lookup
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+from fluvio_tpu.smartengine.tpu import stripes
+from fluvio_tpu.smartengine.tpu.buffer import MAX_WIDTH, RecordBuffer
+from fluvio_tpu.smartmodule import SmartModuleInput, dsl
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef
+from fluvio_tpu.smartmodule.types import SmartModuleKind
+
+# 64-byte stripes, 16-byte overlap -> 48-byte step: a ~200-byte record
+# spans 4-5 stripes, and literals placed near multiples of 48 straddle
+# stripe boundaries (the overlap-containment property under test)
+STRIPE_ENV = {
+    "FLUVIO_STRIPE_THRESHOLD": "64",
+    "FLUVIO_STRIPE_WIDTH": "64",
+    "FLUVIO_STRIPE_OVERLAP": "16",
+}
+
+
+@pytest.fixture
+def small_stripes(monkeypatch):
+    for k, v in STRIPE_ENV.items():
+        monkeypatch.setenv(k, v)
+
+
+def upper_map_module() -> SmartModuleDef:
+    m = SmartModuleDef(name="upper-map")
+    m.dsl[SmartModuleKind.MAP] = dsl.MapProgram(value=dsl.Upper(arg=dsl.Value()))
+    m.hooks[SmartModuleKind.MAP] = lambda record: dsl.ascii_upper(record.value)
+    return m
+
+
+def filter_module(pattern: str) -> SmartModuleDef:
+    m = SmartModuleDef(name="stripe-filter")
+    m.dsl[SmartModuleKind.FILTER] = dsl.FilterProgram(
+        predicate=dsl.RegexMatch(arg=dsl.Value(), pattern=pattern)
+    )
+    return m
+
+
+def predicate_module(predicate: dsl.Expr) -> SmartModuleDef:
+    m = SmartModuleDef(name="stripe-predicate")
+    m.dsl[SmartModuleKind.FILTER] = dsl.FilterProgram(predicate=predicate)
+    return m
+
+
+def split_module(sep: bytes = b",") -> SmartModuleDef:
+    m = SmartModuleDef(name="stripe-split")
+    m.dsl[SmartModuleKind.ARRAY_MAP] = dsl.ArrayMapProgram(mode="split", sep=sep)
+    m.hooks[SmartModuleKind.ARRAY_MAP] = lambda record: [
+        x for x in record.value.split(sep) if x
+    ]
+    return m
+
+
+def _build(backend: str, mods, mesh=None):
+    eng = (
+        SmartEngine(backend=backend, mesh_devices=mesh)
+        if mesh
+        else SmartEngine(backend=backend)
+    )
+    b = eng.builder()
+    for mod, params in mods:
+        b.add_smart_module(SmartModuleConfig(params=params or {}), mod)
+    return b.initialize()
+
+
+def _run(chain, vals, ts=None):
+    records = [Record(value=v) for v in vals]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+        if ts is not None:
+            r.timestamp_delta = int(ts[i])
+    out = chain.process(SmartModuleInput.from_records(records, 0, 1_000_000))
+    assert out.error is None, out.error
+    return [(r.value, r.key, r.offset_delta) for r in out.successes]
+
+
+def _assert_equivalent(mods_factory, vals, ts=None, mesh=None, striped=True):
+    chain = _build("tpu", mods_factory(), mesh=mesh)
+    assert chain.backend_in_use == "tpu"
+    ex = chain.tpu_chain
+    assert (ex._striped_chain() is not None) == striped
+    got = _run(chain, vals, ts)
+    ref = _run(_build("python", mods_factory()), vals, ts)
+    assert got == ref
+    return ex
+
+
+def _wide_corpus(n=300, seed=7):
+    rng = np.random.default_rng(seed)
+    names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "redpanda"]
+    return [
+        (
+            f"{'pre' * int(rng.integers(0, 60))} "
+            f"{names[int(rng.integers(0, 5))]} tail{i}"
+        ).encode()
+        for i in range(n)
+    ]
+
+
+class TestStripePlan:
+    def test_device_plan_matches_host_counts(self):
+        rng = np.random.default_rng(11)
+        s, v = 64, 16
+        lengths = np.concatenate(
+            [rng.integers(0, 400, size=60), [0, 1, v, v + 1, s, s + 1, 2 * s]]
+        ).astype(np.int32)
+        count = len(lengths) - 3  # tail rows are padding
+        k_host = stripes.stripe_counts(lengths[:count], s, v)
+        r = int(k_host.sum())
+        import jax.numpy as jnp
+
+        live = jnp.arange(len(lengths)) < count
+        plan = jax.jit(
+            lambda l: stripes.plan_device(l, live, r + 5, s, v)
+        )(jnp.asarray(lengths))
+        k_dev = np.asarray(plan["k"])
+        assert np.array_equal(k_dev[:count], k_host)
+        assert k_dev[count:].sum() == 0
+        # every live stripe row reconstructs its segment's coverage
+        seg = np.asarray(plan["seg"])
+        idx = np.asarray(plan["stripe_idx"])
+        slen = np.asarray(plan["stripe_len"])
+        astart = np.asarray(plan["abs_start"])
+        row_live = np.asarray(plan["row_live"])
+        step = s - v
+        for i in range(count):
+            rows = np.flatnonzero((seg == i) & row_live)
+            assert len(rows) == k_host[i]
+            assert np.array_equal(idx[rows], np.arange(k_host[i]))
+            assert np.array_equal(astart[rows], np.arange(k_host[i]) * step)
+            # stripes cover the record exactly: last stripe ends at len
+            if len(rows):
+                last = rows[-1]
+                assert astart[last] + slen[last] == lengths[i]
+
+    def test_bad_stripe_params_rejected(self, monkeypatch):
+        monkeypatch.setenv("FLUVIO_STRIPE_WIDTH", "64")
+        monkeypatch.setenv("FLUVIO_STRIPE_OVERLAP", "64")
+        with pytest.raises(ValueError):
+            stripes.stripe_params()
+
+    def test_defaults_word_aligned(self):
+        s, v = stripes.stripe_params()
+        assert s % 4 == 0 and v % 4 == 0 and v < s
+
+
+class TestStripedEquivalence:
+    def test_filter_literal_straddles_boundaries(self, small_stripes):
+        # place the literal at every offset around the 48-byte stripe
+        # step so matches cross stripe boundaries in both directions
+        vals = [
+            (b"x" * pad) + b"fluvio" + b"y" * 40 for pad in range(0, 120)
+        ] + [b"x" * pad + b"nope" + b"y" * 40 for pad in range(0, 60)]
+        ex = _assert_equivalent(
+            lambda: [(filter_module("fluvio"), None)], vals
+        )
+        assert ex._needs_stripes is not None
+
+    def test_filter_boolean_composition(self, small_stripes):
+        pred = dsl.And(
+            args=[
+                dsl.Or(
+                    args=[
+                        dsl.Contains(arg=dsl.Value(), literal=b"fluvio"),
+                        dsl.Contains(arg=dsl.Value(), literal=b"pulsar"),
+                    ]
+                ),
+                dsl.Not(arg=dsl.Contains(arg=dsl.Value(), literal=b"veto")),
+                dsl.Cmp(cmp="gt", left=dsl.Len(arg=dsl.Value()),
+                        right=dsl.ParseInt(arg=dsl.Const(data=b"60"))),
+            ]
+        )
+        rng = np.random.default_rng(3)
+        words = [b"fluvio", b"pulsar", b"veto", b"other"]
+        vals = [
+            b" ".join(
+                words[int(rng.integers(0, 4))]
+                for _ in range(int(rng.integers(1, 40)))
+            )
+            for _ in range(250)
+        ]
+        _assert_equivalent(lambda: [(predicate_module(pred), None)], vals)
+
+    def test_filter_anchored_literals(self, small_stripes):
+        vals = (
+            [b"fluvio" + b"x" * n for n in (0, 10, 50, 100, 150)]
+            + [b"x" * n + b"fluvio" for n in (0, 10, 47, 48, 100, 141)]
+            + [b"fluvio", b"x" * 200, b"fluvioz" * 25]
+        )
+        for pattern in ("^fluvio", "fluvio$", "^fluvio$"):
+            _assert_equivalent(
+                lambda: [(filter_module(pattern), None)], vals
+            )
+
+    def test_filter_plus_upper_map(self, small_stripes):
+        vals = _wide_corpus()
+        _assert_equivalent(
+            lambda: [
+                (filter_module("fluvio"), None),
+                (upper_map_module(), None),
+            ],
+            vals,
+        )
+
+    def test_upper_map_then_filter_sees_folded_bytes(self, small_stripes):
+        # the filter's striped kernels must search the POST-fold bytes
+        vals = [b"a" * n + b"FLUVIO" + b"b" * 30 for n in range(0, 100, 7)]
+        _assert_equivalent(
+            lambda: [
+                (upper_map_module(), None),
+                (filter_module("FLUVIO"), None),
+            ],
+            vals,
+        )
+        # lowercase pattern must now never match after the fold
+        _assert_equivalent(
+            lambda: [
+                (upper_map_module(), None),
+                (filter_module("fluvio"), None),
+            ],
+            vals,
+        )
+
+    def test_aggregate_sum_max_count(self, small_stripes):
+        rng = np.random.default_rng(5)
+        vals = [
+            (f"{int(rng.integers(-500, 1000))} {'x' * int(rng.integers(0, 200))}").encode()
+            for _ in range(300)
+        ]
+        for name in ("aggregate-sum", "aggregate-max", "aggregate-count"):
+            _assert_equivalent(lambda: [(lookup(name), None)], vals)
+
+    def test_filter_then_aggregate_carries_across_calls(self, small_stripes):
+        rng = np.random.default_rng(9)
+        vals = [
+            (f"{int(rng.integers(0, 100))} {'fluvio ' * int(rng.integers(0, 30))}").encode()
+            for _ in range(200)
+        ]
+        mods = lambda: [
+            (filter_module("fluvio"), None),
+            (lookup("aggregate-sum"), None),
+        ]
+        tpu, py = _build("tpu", mods()), _build("python", mods())
+        assert tpu.tpu_chain._striped_chain() is not None
+        for lo in (0, 100):  # stateful: two process() calls chain carries
+            got = _run(tpu, vals[lo : lo + 100])
+            ref = _run(py, vals[lo : lo + 100])
+            assert got == ref
+
+    def test_windowed_sum(self, small_stripes):
+        rng = np.random.default_rng(13)
+        vals = [
+            (f"{int(rng.integers(0, 100))}{' ' * int(rng.integers(0, 150))}").encode()
+            for _ in range(300)
+        ]
+        ts = (np.arange(300, dtype=np.int64) * 7919) % 60_000
+        _assert_equivalent(
+            lambda: [
+                (lookup("windowed-sum"), {"kind": "sum_int", "window_ms": "1000"})
+            ],
+            vals,
+            ts=ts,
+        )
+
+    def test_split_explode_elements_span_stripes(self, small_stripes):
+        rng = np.random.default_rng(17)
+        vals = []
+        for i in range(250):
+            parts = [
+                b"e%d" % j + b"y" * int(rng.integers(0, 70))
+                for j in range(int(rng.integers(1, 8)))
+            ]
+            v = b",".join(parts)
+            if i % 3 == 0:
+                v = b"," + v + b",,"  # leading/trailing/empty segments
+            vals.append(v)
+        vals += [b"", b",", b",,,", b"single" * 40]
+        _assert_equivalent(lambda: [(split_module(), None)], vals)
+
+    def test_filter_then_split_explode(self, small_stripes):
+        rng = np.random.default_rng(19)
+        vals = [
+            (b"fluvio," if i % 2 else b"kafka,")
+            + b",".join(
+                b"p%d" % j + b"z" * int(rng.integers(0, 60))
+                for j in range(int(rng.integers(1, 6)))
+            )
+            for i in range(200)
+        ]
+        _assert_equivalent(
+            lambda: [
+                (filter_module("fluvio"), None),
+                (split_module(), None),
+            ],
+            vals,
+        )
+
+    def test_json_chain_spills_gracefully(self, small_stripes):
+        # JsonGet is outside the stripeable subset: wide batches take the
+        # interpreter spill and outputs still match exactly
+        vals = [
+            (f'{{"name":"fluvio-{i}","pad":"{"x" * 120}"}}').encode()
+            for i in range(60)
+        ]
+        _assert_equivalent(
+            lambda: [
+                (lookup("regex-filter"), {"regex": "fluvio"}),
+                (lookup("json-map"), {"field": "name"}),
+            ],
+            vals,
+            striped=False,
+        )
+
+    def test_literal_longer_than_overlap_spills(self, small_stripes):
+        lit = b"q" * 20  # > 16-byte overlap: containment not guaranteed
+        vals = [b"x" * n + lit + b"y" * 30 for n in range(0, 90, 5)]
+        _assert_equivalent(
+            lambda: [
+                (predicate_module(dsl.Contains(arg=dsl.Value(), literal=lit)), None)
+            ],
+            vals,
+            striped=False,
+        )
+
+    def test_word_count_spills(self, small_stripes):
+        vals = [b"a b c " * 30 for _ in range(40)]
+        _assert_equivalent(
+            lambda: [(lookup("word-count"), None)], vals, striped=False
+        )
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs 4 virtual devices"
+)
+class TestStripedSharded:
+    def test_sharded_filter_map(self, small_stripes):
+        vals = _wide_corpus(n=400)
+        ex = _assert_equivalent(
+            lambda: [
+                (filter_module("fluvio"), None),
+                (upper_map_module(), None),
+            ],
+            vals,
+            mesh=4,
+        )
+        assert ex._sharded is not None
+
+    def test_sharded_aggregate(self, small_stripes):
+        rng = np.random.default_rng(23)
+        vals = [
+            (f"{int(rng.integers(0, 1000))} {'x' * int(rng.integers(0, 180))}").encode()
+            for _ in range(400)
+        ]
+        _assert_equivalent(lambda: [(lookup("aggregate-sum"), None)], vals, mesh=4)
+
+    def test_sharded_windowed(self, small_stripes):
+        rng = np.random.default_rng(29)
+        vals = [
+            (f"{int(rng.integers(0, 100))}{' ' * int(rng.integers(0, 120))}").encode()
+            for _ in range(400)
+        ]
+        ts = (np.arange(400, dtype=np.int64) * 7919) % 60_000
+        _assert_equivalent(
+            lambda: [
+                (lookup("windowed-sum"), {"kind": "sum_int", "window_ms": "1000"})
+            ],
+            vals,
+            ts=ts,
+            mesh=4,
+        )
+
+    def test_sharded_fanout_wide_spills(self, small_stripes):
+        # striped fan-out stays single-device; the sharded engine spills
+        # wide explode batches to the interpreter instead of diverging
+        vals = [b"a" * 100 + b",b,c" for _ in range(64)]
+        _assert_equivalent(
+            lambda: [(split_module(), None)], vals, mesh=4, striped=True
+        )
+
+
+class TestWideDefaults:
+    def test_70k_records_fused_under_default_geometry(self):
+        # the real thing: >64 KiB records through the DEFAULT stripe
+        # params run fused (process_buffer raises TpuSpill if any spill
+        # were left) and match the interpreter byte-for-byte
+        body = b"x" * (70 * 1024)
+        vals = [
+            b'{"name":"%s-%d","body":"%s"}'
+            % (b"fluvio" if i % 2 else b"kafka", i, body)
+            for i in range(12)
+        ]
+        mods = lambda: [(filter_module("fluvio"), None)]
+        chain = _build("tpu", mods())
+        ex = chain.tpu_chain
+        assert ex._striped_chain() is not None
+        records = [Record(value=v) for v in vals]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+        buf = RecordBuffer.from_smartmodule_input(
+            SmartModuleInput.from_records(records)
+        )
+        assert buf.width > MAX_WIDTH  # narrow layout cannot hold these
+        assert ex._needs_stripes(buf)
+        out = ex.process_buffer(buf)  # TpuSpill here would fail the test
+        got = [(r.value, r.offset_delta) for r in out.to_records()]
+        ref = [(v, o) for (v, _k, o) in _run(_build("python", mods()), vals)]
+        assert got == ref
+
+    def test_max_stageable_width_reflects_chain(self):
+        from fluvio_tpu.smartengine.tpu.buffer import MAX_RECORD_WIDTH
+
+        striped = _build(
+            "tpu", [(filter_module("fluvio"), None)]
+        ).tpu_chain
+        assert striped.max_stageable_width() == MAX_RECORD_WIDTH
+        unstripeable = _build(
+            "tpu",
+            [
+                (lookup("regex-filter"), {"regex": "fluvio"}),
+                (lookup("json-map"), {"field": "name"}),
+            ],
+        ).tpu_chain
+        assert unstripeable.max_stageable_width() == MAX_WIDTH
+
+    @pytest.mark.skipif(
+        len(jax.devices()) < 4, reason="needs 4 virtual devices"
+    )
+    def test_sharded_fanout_width_guard_is_conservative(self):
+        # sharded fan-out cannot stripe: the broker's pre-dispatch guard
+        # must see the NARROW bound, or a wide slice would pass the
+        # guard and then TpuSpill mid-dispatch, abandoning in-flight
+        # chunks (the invariant smart_chain.py stages around)
+        ex = _build("tpu", [(split_module(), None)], mesh=4).tpu_chain
+        assert ex._sharded is not None and ex._fanout
+        assert ex.max_stageable_width() == MAX_WIDTH
+        assert ex._striped_chain() is not None  # single-device could
