@@ -181,6 +181,8 @@ CONFIGS = {
 def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     import jax
 
+    from fluvio_tpu.telemetry import TELEMETRY
+
     executor = chain.tpu_chain
     t0 = time.time()
     out = executor.process_buffer(buf)
@@ -194,9 +196,19 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     jax.block_until_ready((header, packed))
     dispatch = time.time() - t0
     h0, d0 = executor.h2d_bytes_total, executor.d2h_bytes_total
+    # phase attribution rides the SERIAL pass: phases are sequential
+    # there, so their sum must track the measured wall time (the
+    # pipelined passes below overlap device with host by design)
+    pt0 = TELEMETRY.phase_totals()
     t0 = time.time()
     out = executor.process_buffer(buf)
     single = time.time() - t0
+    pt1 = TELEMETRY.phase_totals()
+    phase_ms = {
+        k: round((pt1[k][1] - pt0[k][1]) * 1000, 2)
+        for k in pt1
+        if pt1[k][1] > pt0[k][1]
+    }
     link_mb = (
         (executor.h2d_bytes_total - h0) / 1e6,
         (executor.d2h_bytes_total - d0) / 1e6,
@@ -211,6 +223,7 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     # bandwidth wanders, so report every pass and take the median across
     # passes rather than trusting one number
     times = []
+    hist0 = TELEMETRY.batch_hist_copy()
     for p in range(passes):
         if times and deadline and time.time() > deadline:
             # a degraded tunnel stretches each pass unboundedly; once one
@@ -222,7 +235,31 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
             pass
         times.append((time.time() - t0) / runs)
         log(f"  pass {p}: pipelined {times[-1]*1000:.0f}ms/batch")
-    return out, times, first_call, link_mb
+    phases = _phase_breakdown(
+        single, phase_ms, TELEMETRY.batch_hist_copy().diff(hist0)
+    )
+    return out, times, first_call, link_mb, phases
+
+
+def _phase_breakdown(single_s: float, phase_ms: dict, e2e_hist) -> dict:
+    """Compact per-phase record for BENCH_DETAIL.json: serial-pass wall
+    + per-phase ms (their sum must track the wall within ~10%), p50/p99
+    end-to-end batch latency across the pipelined passes, and the top-3
+    phase shares of attributed time."""
+    total = sum(phase_ms.values())
+    top = sorted(phase_ms.items(), key=lambda kv: -kv[1])[:3]
+    out = {
+        "wall_ms": round(single_s * 1000, 2),
+        "phase_sum_ms": round(total, 2),
+        "phase_ms": phase_ms,
+        "top": [
+            [name, round(ms / total, 2) if total else 0.0] for name, ms in top
+        ],
+    }
+    if e2e_hist.count:
+        out["e2e_p50_ms"] = round(e2e_hist.percentile(50) * 1000, 2)
+        out["e2e_p99_ms"] = round(e2e_hist.percentile(99) * 1000, 2)
+    return out
 
 
 def bench_host_baseline(specs, values, ts, base_n: int, backend: str) -> float:
@@ -359,7 +396,9 @@ def _run_config(
     verify_outputs(cfg["specs"], values, ts, min(n, 512))
     chain = build_chain("tpu", cfg["specs"])
     assert chain.backend_in_use == "tpu", name
-    out, times, first_call, link_mb = bench_tpu(chain, buf, runs, passes, deadline)
+    out, times, first_call, link_mb, phases = bench_tpu(
+        chain, buf, runs, passes, deadline
+    )
     staging_ab = None
     if ab_eligible:
         # staging A/B: nobody re-runs this after the round, so the
@@ -381,7 +420,7 @@ def _run_config(
             os.environ["FLUVIO_LINK_COMPRESS"] = "off"
             try:
                 chain_b = build_chain("tpu", cfg["specs"])
-                out_b, times_b, first_b, link_b = bench_tpu(
+                out_b, times_b, first_b, link_b, phases_b = bench_tpu(
                     chain_b, buf, runs, passes, deadline
                 )
             except Exception as e:  # noqa: BLE001 — optional re-measure
@@ -395,8 +434,8 @@ def _run_config(
                 }
                 if statistics.median(times_b) < statistics.median(times):
                     staging_ab["chosen"] = "raw"
-                    out, times, first_call, link_mb = (
-                        out_b, times_b, first_b, link_b,
+                    out, times, first_call, link_mb, phases = (
+                        out_b, times_b, first_b, link_b, phases_b,
                     )
                     chain = chain_b
                 else:
@@ -447,6 +486,9 @@ def _run_config(
         # persistent XLA cache makes this <2s; cold compiles are 20-40s
         "first_call_s": round(first_call, 2),
         "link_mb": [round(m, 2) for m in link_mb],
+        # per-phase breakdown (telemetry subsystem): serial-pass wall +
+        # phase attribution + pipelined p50/p99 end-to-end
+        "phases": phases,
     }
     if staging_ab:
         result["staging_ab"] = staging_ab
@@ -791,6 +833,19 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         compact["xla_cache"] = {
             "entries_written": out["xla_cache"]["entries_written"]
         }
+    # ONE compact phases key: the headline config's breakdown (p50/p99
+    # end-to-end + top-3 phase shares); full per-config phase tables
+    # live in BENCH_DETAIL.json
+    headline_cfg = (out.get("configs") or {}).get(
+        out.get("headline_config", "2_filter_map")
+    )
+    if isinstance(headline_cfg, dict) and isinstance(
+        headline_cfg.get("phases"), dict
+    ):
+        ph = headline_cfg["phases"]
+        compact["phases"] = {
+            k: ph[k] for k in ("e2e_p50_ms", "e2e_p99_ms", "top") if k in ph
+        }
     if "configs" in out:
         compact["configs"] = _compact_configs(out["configs"])
     if "cpu_fallback" in out:
@@ -804,7 +859,7 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # "link" drops LAST: link.glz is the field the sentinel's A/B pin
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
-    for drop in ("configs", "cpu_fallback", "error", "xla_cache", "link"):
+    for drop in ("configs", "cpu_fallback", "phases", "error", "xla_cache", "link"):
         if len(json.dumps(compact)) <= limit:
             break
         compact.pop(drop, None)

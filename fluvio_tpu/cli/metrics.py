@@ -1,7 +1,14 @@
 """`metrics` subcommand — read an SPU's monitoring socket.
 
 Capability parity: fluvio-cli/src/monitoring.rs (the CLI-side reader of
-the SPU metrics unix socket).
+the SPU metrics unix socket), extended with the telemetry surface:
+
+- default: render the snapshot as a table — broker counters, fast-path
+  vs fallback slices WITH the per-reason decline breakdown, heal/spill/
+  stripe-fallback counters, and the per-phase latency table,
+- ``--format json``: the raw JSON dump (the legacy output),
+- ``--format prom``: Prometheus text-format exposition (same snapshot),
+- ``--spans``: dump the recent per-batch span ring as JSON.
 """
 
 from __future__ import annotations
@@ -15,12 +22,148 @@ def add_metrics_parser(sub) -> None:
         "--path",
         help="monitoring unix-socket path (default: FLUVIO_METRIC_SPU)",
     )
+    p.add_argument(
+        "--format",
+        choices=("table", "json", "prom"),
+        default="table",
+        help="output format (default: table)",
+    )
+    p.add_argument(
+        "--spans",
+        action="store_true",
+        help="dump the recent per-batch phase spans as JSON and exit",
+    )
     p.set_defaults(fn=metrics)
 
 
-async def metrics(args) -> int:
-    from fluvio_tpu.spu.monitoring import read_metrics
+def _fmt_count(n) -> str:
+    return f"{n:,}" if isinstance(n, int) else str(n)
 
+
+def _rows_to_table(rows, header=None) -> str:
+    """Minimal fixed-width table (no external deps)."""
+    all_rows = ([header] if header else []) + rows
+    widths = [
+        max(len(str(r[i])) for r in all_rows) for i in range(len(all_rows[0]))
+    ]
+    out = []
+    for j, r in enumerate(all_rows):
+        out.append(
+            "  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+        )
+        if header and j == 0:
+            out.append("  ".join("-" * w for w in widths))
+    return "\n".join(out)
+
+
+def render_metrics_table(data: dict) -> str:
+    """Snapshot dict (the monitoring JSON) -> operator-facing table.
+
+    Pure function so the endpoint-parity test can compare it against a
+    Prometheus scrape of the same instant without a terminal."""
+    sections = []
+
+    rows = []
+    for direction in ("inbound", "outbound"):
+        d = data.get(direction) or {}
+        rows.append(
+            (direction, _fmt_count(d.get("records", 0)),
+             _fmt_count(d.get("bytes", 0)))
+        )
+    sections.append(
+        "broker\n" + _rows_to_table(rows, header=("dir", "records", "bytes"))
+    )
+
+    sm = data.get("smartmodule") or {}
+    rows = [
+        (k, _fmt_count(sm.get(k, 0)))
+        for k in (
+            "bytes_in", "records_out", "invocation_count", "fuel_used",
+            "fastpath_slices", "fallback_slices",
+        )
+    ]
+    sections.append(
+        "smartmodule\n" + _rows_to_table(rows, header=("counter", "value"))
+    )
+    reasons = sm.get("fallback_reasons") or {}
+    if reasons:
+        rows = [(r, _fmt_count(n)) for r, n in sorted(reasons.items())]
+        sections.append(
+            "fallback reasons\n"
+            + _rows_to_table(rows, header=("reason", "slices"))
+        )
+
+    tel = data.get("telemetry") or {}
+    counters = tel.get("counters") or {}
+    rows = [
+        ("glz_heals", _fmt_count(counters.get("heals", 0))),
+        ("stripe_fallbacks", _fmt_count(counters.get("stripe_fallbacks", 0))),
+    ]
+    for reason, n in sorted((counters.get("spills") or {}).items()):
+        rows.append((f"spill[{reason}]", _fmt_count(n)))
+    for reason, n in sorted((counters.get("declines") or {}).items()):
+        rows.append((f"decline[{reason}]", _fmt_count(n)))
+    sections.append(
+        "pipeline events\n" + _rows_to_table(rows, header=("event", "count"))
+    )
+
+    batches = tel.get("batches") or {}
+    rows = []
+    for path, b in sorted(batches.items()):
+        if not b.get("count"):
+            continue
+        rows.append(
+            (path, _fmt_count(b.get("count", 0)),
+             _fmt_count(b.get("records", 0)),
+             b.get("p50_ms", 0), b.get("p99_ms", 0))
+        )
+    if rows:
+        sections.append(
+            "batch latency\n"
+            + _rows_to_table(
+                rows, header=("path", "batches", "records", "p50_ms", "p99_ms")
+            )
+        )
+
+    phases = tel.get("phases") or {}
+    rows = [
+        (name, _fmt_count(h.get("count", 0)), h.get("p50_ms", 0),
+         h.get("p99_ms", 0), h.get("sum_s", 0))
+        for name, h in sorted(
+            phases.items(), key=lambda kv: -kv[1].get("sum_s", 0)
+        )
+    ]
+    if rows:
+        sections.append(
+            "phases (by total time)\n"
+            + _rows_to_table(
+                rows, header=("phase", "count", "p50_ms", "p99_ms", "sum_s")
+            )
+        )
+
+    quarantine = data.get("hook_quarantine")
+    if quarantine:
+        sections.append("hook quarantine\n" + json.dumps(quarantine, indent=1))
+
+    return "\n\n".join(sections)
+
+
+async def metrics(args) -> int:
+    from fluvio_tpu.spu.monitoring import (
+        read_metrics,
+        read_prometheus,
+        read_spans,
+    )
+
+    if args.spans:
+        print(json.dumps(await read_spans(args.path), indent=1))
+        return 0
+    if args.format == "prom":
+        print(await read_prometheus(args.path), end="")
+        return 0
     data = await read_metrics(args.path)
-    print(json.dumps(data, indent=2))
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+    else:
+        print(render_metrics_table(data))
     return 0

@@ -15,6 +15,7 @@ row-local. Selected by ``SmartEngine(mesh_devices=N)`` /
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import jax
@@ -24,6 +25,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fluvio_tpu.parallel.mesh import RECORD_AXIS, make_record_mesh
+from fluvio_tpu.telemetry import TELEMETRY
 from fluvio_tpu.smartengine.tpu import executor as kernels_executor
 from fluvio_tpu.smartengine.tpu import kernels, stripes
 from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer, apply_postops_host
@@ -450,11 +452,20 @@ class ShardedChainExecutor:
                 )
         return ex._bucket_bytes(worst, floor=8)
 
-    def dispatch_buffer(self, buf: RecordBuffer, cap_shard=None):
+    def dispatch_buffer(self, buf: RecordBuffer, cap_shard=None, reuse_span=None):
         from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
         ex = self.executor
+        # a fan-out retry passes the batch's ORIGINAL span back in so the
+        # retry's stage/h2d/dispatch/device time accumulates onto it
+        # instead of a second span that would be discarded
+        span = reuse_span if reuse_span is not None else TELEMETRY.begin_batch()
+        t_ph = time.perf_counter() if span is not None else 0.0
         uploads, cfg, nbytes = self._stage_ragged(buf)
+        if span is not None:
+            now = time.perf_counter()
+            span.add("stage", now - t_ph)
+            t_ph = now
         if ex._fanout and cap_shard is None:
             cap_shard = self._shard_fanout_cap(buf)
         cfg = cfg + (cap_shard,)
@@ -462,9 +473,11 @@ class ShardedChainExecutor:
             if ex._striped_chain() is None or ex._fanout:
                 # wide batch outside the sharded stripeable subset
                 # (fan-out explodes stay single-device or interpret)
+                TELEMETRY.add_stripe_fallback()
                 raise TpuSpill(
                     f"record width {buf.width} exceeds the narrow layout "
-                    "and the chain cannot stripe under shard_map"
+                    "and the chain cannot stripe under shard_map",
+                    reason="record-too-wide-unstripeable",
                 )
             cfg = cfg + (self._stripe_rows_shard(buf),)
         ex.h2d_bytes_total += nbytes
@@ -477,6 +490,10 @@ class ShardedChainExecutor:
             )
             for k, v in uploads.items()
         }
+        if span is not None:
+            now = time.perf_counter()
+            span.add("h2d", now - t_ph)
+            t_ph = now
         fn = self._jitted(sharded, cfg)
         prev_carries = self._pending_carries
         header, packed, new_carries = fn(
@@ -485,11 +502,14 @@ class ShardedChainExecutor:
             jnp.int64(buf.base_timestamp),
             self._carries(),
         )
+        if span is not None:
+            span.add("dispatch", time.perf_counter() - t_ph)
+            span.mark_dispatched()
         if ex.agg_configs:
             # carries chain through device futures at dispatch time so
             # streams pipeline; the host mirror commits at finish
             self._pending_carries = new_carries
-        return (prev_carries, new_carries, header, packed, cap_shard)
+        return (prev_carries, new_carries, header, packed, cap_shard, span)
 
     def discard_dispatch(self, handle) -> None:
         """Drop a speculative dispatch, restoring pre-dispatch carries."""
@@ -527,9 +547,13 @@ class ShardedChainExecutor:
     def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
         from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
-        _prev, new_carries, header, packed, cap_shard = handle
+        _prev, new_carries, header, packed, cap_shard, span = handle
+        t_f0 = time.perf_counter() if span is not None else 0.0
+        d2h0 = span.phase("d2h") if span is not None else 0.0
         ex = self.executor
         hdrs = np.asarray(jax.device_get(header))  # (n_shards, 5)
+        if span is not None:
+            span.mark_device_ready()
         counts = hdrs[:, 0].astype(np.int64)
         total = int(counts.sum())
         n_rows = buf.rows
@@ -555,13 +579,18 @@ class ShardedChainExecutor:
                 ex._learn_cap(buf, int(totals.max()) * self.n)
                 self.fanout_retries += 1
                 retry_cap = ex._bucket_bytes(int(totals.max()), 8)
-                handle = self.dispatch_buffer(buf, cap_shard=retry_cap)
-                _prev, new_carries, header, packed, cap_shard = handle
+                handle = self.dispatch_buffer(
+                    buf, cap_shard=retry_cap, reuse_span=span
+                )
+                _prev, new_carries, header, packed, cap_shard, _ = handle
                 hdrs = np.asarray(jax.device_get(header))
+                if span is not None:
+                    span.mark_device_ready()
                 if int(hdrs[:, 4].max()) > cap_shard:  # pragma: no cover
                     self._pending_carries = _prev
                     raise TpuSpill(
-                        f"fanout overflow after retry: {int(hdrs[:, 4].max())}"
+                        f"fanout overflow after retry: {int(hdrs[:, 4].max())}",
+                        reason="fanout-overflow",
                     )
                 counts = hdrs[:, 0].astype(np.int64)
                 total = int(counts.sum())
@@ -589,7 +618,7 @@ class ShardedChainExecutor:
                 cols.extend(group)
             # the executor's single download point: byte accounting rides
             # along for sharded batches too
-            host = ex._download(cols)
+            host = ex._download(cols, span)
             if ex._fanout:
                 src_h = self._concat_counts(host[:n_lead], counts).astype(
                     np.int64
@@ -728,6 +757,17 @@ class ShardedChainExecutor:
             ex.carries = [(int(a), int(w), bool(h)) for a, w, h in hostc]
             ex._device_carries = None
             ex._sync_instances()
+
+        if span is not None:
+            t_end = time.perf_counter()
+            wait = 0.0
+            if span.ready_t is not None and span.ready_t > t_f0:
+                wait = span.ready_t - t_f0
+            span.add(
+                "fetch", (t_end - t_f0) - wait - (span.phase("d2h") - d2h0)
+            )
+            # input-record semantic, matching the single-device path
+            TELEMETRY.end_batch(span, records=buf.count)
 
         return RecordBuffer(
             values=out_values,

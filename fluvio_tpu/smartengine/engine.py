@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, List, Optional
@@ -222,13 +223,16 @@ class SmartModuleChainInstance:
 
             try:
                 output = self.tpu_chain.process(inp, metrics)
-            except TpuSpill:
+            except TpuSpill as e:
                 # device detected a transform error (or exhausted fan-out
                 # capacity): the interpreting python instances re-run the
                 # batch for exact first-error semantics (device carries
                 # were restored, and are re-mirrored from the instances
                 # after the rerun)
-                return self._process_instances(inp, metrics)
+                from fluvio_tpu.telemetry import TELEMETRY
+
+                TELEMETRY.add_spill(getattr(e, "reason", "transform-error"))
+                return self._process_instances(inp, metrics, spilled=True)
             metrics.add_records_out(len(output.successes))
             return output
 
@@ -244,7 +248,10 @@ class SmartModuleChainInstance:
         return self._process_instances(inp, metrics)
 
     def _process_instances(
-        self, inp: SmartModuleInput, metrics: SmartModuleChainMetrics
+        self,
+        inp: SmartModuleInput,
+        metrics: SmartModuleChainMetrics,
+        spilled: bool = False,
     ) -> SmartModuleOutput:
         """Interpreting per-instance pipeline (exact reference semantics).
 
@@ -252,7 +259,16 @@ class SmartModuleChainInstance:
         (`hook_budget_ms`): exhaustion becomes a transform error — the
         same surface a wasm fuel trap takes in the reference
         (state.rs:40-55) — so the stream gets a typed error response and
-        the broker stays live instead of spinning forever."""
+        the broker stays live instead of spinning forever.
+
+        Telemetry: the whole pass records as ONE interpreter-path batch
+        span (one clock pair — no per-record work); a fused-path spill
+        rerun (``spilled=True``) additionally books its wall time under
+        the ``spill`` phase so fused-vs-interpreter time is comparable
+        per batch."""
+        from fluvio_tpu.telemetry import TELEMETRY
+
+        span = TELEMETRY.begin_batch(path="interpreter")
         from fluvio_tpu.smartengine.metering import (
             SmartModuleFuelError,
             run_metered,
@@ -264,13 +280,16 @@ class SmartModuleChainInstance:
 
         base_offset = inp.base_offset
         base_timestamp = inp.base_timestamp
+        n_rec = len(inp.records) if inp.records is not None else inp.raw_count
         if self._poisoned is not None:
             # an earlier fuel trap left this chain's hook thread alive
-            # and possibly mid-mutation: never re-enter it
+            # and possibly mid-mutation: never re-enter it. The rejected
+            # batch still records: an error storm on a poisoned chain
+            # must stay visible in interpreter batch counts
             out = SmartModuleOutput()
             out.error = self._poisoned
+            TELEMETRY.end_batch(span, records=n_rec)
             return out
-        n_rec = len(inp.records) if inp.records is not None else inp.raw_count
         budget = scale_budget(self.engine.hook_budget_ms, n_rec)
         next_input = inp
         output = SmartModuleOutput()
@@ -311,6 +330,10 @@ class SmartModuleChainInstance:
             self.tpu_chain.sync_state_from(self.instances)
         if output.error is None:
             metrics.add_records_out(len(output.successes))
+        if span is not None:
+            if spilled:
+                span.add("spill", time.perf_counter() - span.t0)
+            TELEMETRY.end_batch(span, records=n_rec)
         return output
 
     async def look_back(

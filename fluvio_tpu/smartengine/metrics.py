@@ -54,15 +54,19 @@ class SmartModuleChainMetrics:
             )
 
     def to_dict(self) -> dict:
-        return {
-            "bytes_in": self.bytes_in,
-            "records_out": self.records_out,
-            "invocation_count": self.invocation_count,
-            "fuel_used": self.fuel_used,
-            "fastpath_slices": self.fastpath_slices,
-            "fallback_slices": self.fallback_slices,
-            "fallback_reasons": dict(self.fallback_reasons),
-        }
+        # snapshot under the lock: a scrape concurrent with add_* must
+        # never see torn multi-field state (e.g. bytes_in advanced but
+        # invocation_count not yet)
+        with self._lock:
+            return {
+                "bytes_in": self.bytes_in,
+                "records_out": self.records_out,
+                "invocation_count": self.invocation_count,
+                "fuel_used": self.fuel_used,
+                "fastpath_slices": self.fastpath_slices,
+                "fallback_slices": self.fallback_slices,
+                "fallback_reasons": dict(self.fallback_reasons),
+            }
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())

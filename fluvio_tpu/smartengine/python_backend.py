@@ -24,6 +24,7 @@ pins the byte-level semantics the TPU backend must reproduce.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, List, Optional, Tuple
 
 from fluvio_tpu.protocol.record import Record
@@ -39,6 +40,7 @@ from fluvio_tpu.smartmodule.types import (
 )
 from fluvio_tpu.smartengine.config import SmartModuleConfig
 from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
+from fluvio_tpu.telemetry import TELEMETRY
 
 
 def _normalize_map_result(result, record: Record) -> Tuple[Optional[bytes], bytes]:
@@ -97,10 +99,16 @@ class PythonInstance:
             SmartModuleRecord(r, inp.base_offset, inp.base_timestamp) for r in records
         ]
         hook = self.module.hook(self.kind)
+        # one clock pair per instance per batch: interpreter cost stays
+        # comparable against the fused path's phase spans. NOT gated on
+        # TELEMETRY.enabled — event counters stay on when span/histogram
+        # capture is off (the documented contract)
+        t0 = time.perf_counter()
         if hook is not None:
             out = self._run_hook(hook, sm_records, inp)
         else:
             out = self._run_dsl(sm_records, inp)
+        TELEMETRY.add_interp_instance(time.perf_counter() - t0, len(sm_records))
         if metrics is not None:
             metrics.add_fuel_used(len(sm_records))
         return out

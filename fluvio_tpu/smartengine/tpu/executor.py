@@ -21,6 +21,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -28,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from fluvio_tpu.telemetry import TELEMETRY
 
 from fluvio_tpu.protocol.record import Record
 from fluvio_tpu.smartmodule import dsl
@@ -73,7 +76,12 @@ class TpuSpill(Exception):
     """Raised when a batch must be re-run on the interpreting backend for
     exact semantics (device-detected transform error, or fan-out capacity
     exhaustion after retry). Aggregate device carries are restored before
-    raising so the rerun cannot double-count."""
+    raising so the rerun cannot double-count. ``reason`` is a short
+    stable key for the telemetry spill counter."""
+
+    def __init__(self, message: str, reason: str = "transform-error"):
+        super().__init__(message)
+        self.reason = reason
 
 
 class _FanoutOverflow(Exception):
@@ -1022,13 +1030,20 @@ class TpuChainExecutor:
         mx = jnp.max(jnp.where(valid, lengths, 0))
         return _header(mx), packed, carries
 
-    def _dispatch(self, buf: RecordBuffer, fanout_cap: Optional[int] = None):
+    def _dispatch(
+        self,
+        buf: RecordBuffer,
+        fanout_cap: Optional[int] = None,
+        span=None,
+    ):
         """Async-dispatch one batch.
 
         Values go up ragged (flat bytes + starts) and are re-padded on
         device; key columns are synthesized on device when the batch has
         no keys. Remaining columns go as separate arrays — the host link
-        runs per-array transfer streams concurrently.
+        runs per-array transfer streams concurrently. ``span`` (a
+        telemetry BatchSpan, or None) collects the host-side phase
+        clock pairs: stage / glz_compress / h2d / dispatch.
         """
         if self._device_carries is not None:
             carries = self._device_carries
@@ -1041,14 +1056,26 @@ class TpuChainExecutor:
         if striped and self._striped_chain() is None:
             # the one structural fallback left: a wide batch whose chain
             # is outside the stripeable subset spills to the interpreter
+            TELEMETRY.add_stripe_fallback()
             raise TpuSpill(
                 f"record width {buf.width} exceeds the narrow layout and "
-                "the chain is not stripeable"
+                "the chain is not stripeable",
+                reason="record-too-wide-unstripeable",
             )
+        t_ph = time.perf_counter() if span is not None else 0.0
         flat, bucket = self._flat_and_bucket(buf)
+        if span is not None:
+            now = time.perf_counter()
+            span.add("stage", now - t_ph)
+            t_ph = now
         flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes, flat_h2d = (
             self._stage_flat(buf, flat, bucket)
         )
+        if span is not None:
+            now = time.perf_counter()
+            # the compressed form's staging IS the compressor (plus token
+            # padding); the raw form's is the pad + device enqueue
+            span.add("glz_compress" if glz_bytes else "h2d", now - t_ph)
         lengths_up, has_keys, has_offsets, ts_mode, ts_np = (
             stage_link_columns(buf)
         )
@@ -1083,6 +1110,7 @@ class TpuChainExecutor:
                 )
             return self._jit_ragged(*args, width=buf.width, **kwargs)
 
+        t_ph = time.perf_counter() if span is not None else 0.0
         try:
             header, packed, new_carries = _call()
         except Exception as e:
@@ -1096,6 +1124,7 @@ class TpuChainExecutor:
             logging.getLogger(__name__).warning(
                 "glz device decode failed; link compression disabled: %s", e
             )
+            TELEMETRY.add_heal()
             self._link_compress = False
             buf._glz_cache = None
             # the compressed token arrays already crossed the link
@@ -1105,6 +1134,8 @@ class TpuChainExecutor:
                 self._stage_flat(buf, flat, bucket)
             )
             header, packed, new_carries = _call()
+        if span is not None:
+            span.add("dispatch", time.perf_counter() - t_ph)
         self._glz_last = bool(glz_bytes)
         # keep aggregate state device-resident; host mirrors sync on demand
         self._device_carries = new_carries
@@ -1304,15 +1335,18 @@ class TpuChainExecutor:
             n += mask.nbytes
         self.d2h_bytes_total += n
 
-    def _download(self, slices):
+    def _download(self, slices, span=None):
         """Start every D2H copy, block once, account the bytes — the ONE
         point where result arrays leave the device (the sharded fetch
         routes through it too, so the counters cannot silently miss a
         path). Accumulates: a batch whose fetch runs twice (fan-out
         capacity retry) reports its total traffic."""
+        t_ph = time.perf_counter() if span is not None else 0.0
         for s in slices:
             s.copy_to_host_async()
         host = jax.device_get(slices)
+        if span is not None:
+            span.add("d2h", time.perf_counter() - t_ph)
         self.d2h_bytes_total += 64 + sum(np.asarray(a).nbytes for a in host)
         return host
 
@@ -1333,6 +1367,7 @@ class TpuChainExecutor:
         (None on the fan-out retry path, which re-dispatched).
         """
         spec = spec or {}
+        span = spec.get("span")
         # fan-out source rows are non-decreasing after compaction, so they
         # ship as uint8 deltas + a scalar base whenever the max delta fits
         # (the probe scalars ride the header sync the fetch pays anyway) —
@@ -1361,6 +1396,10 @@ class TpuChainExecutor:
             int_probe = (a_d, w_d, [int(x) for x in got[1:]])
         else:
             hdr = jax.device_get(header)
+        if span is not None:
+            # the header sync is the first blocking wait on this batch's
+            # results: everything up to here since dispatch-end is device
+            span.mark_device_ready()
         count, max_v, max_k = int(hdr[0]), int(hdr[1]), int(hdr[2])
         if int(hdr[3]):
             raise TpuSpill("array_map transform error: interpreter decides")
@@ -1390,7 +1429,7 @@ class TpuChainExecutor:
             # link; spans are (0, input_length) for every survivor by
             # construction and postops apply host-side
             rows = self._bucket_bytes(max(count, 1), 8)
-            host = self._download([packed["mask"]])
+            host = self._download([packed["mask"]], span)
             src = self._mask_to_src(host[0], buf)[:count]
             st = np.zeros(count, dtype=np.int64)
             ln = buf.lengths[src].astype(np.int32)
@@ -1420,7 +1459,7 @@ class TpuChainExecutor:
                     slices.append(lax.slice(_src_col(), (0,), (rows,)))
                 else:
                     slices.append(packed["mask"])
-            host = self._download(slices)
+            host = self._download(slices, span)
             st_h, ln_h = host[0], host[1]
             if self._fanout:
                 src = _src_decode(host[2])
@@ -1433,10 +1472,10 @@ class TpuChainExecutor:
             )
 
         if self._int_output:
-            return self._fetch_ints(buf, count, packed, int_probe)
+            return self._fetch_ints(buf, count, packed, int_probe, span)
 
         return self._fetch_bytes(
-            buf, count, packed, max_v, max_k, _src_col, _src_decode
+            buf, count, packed, max_v, max_k, _src_col, _src_decode, span
         )
 
     @staticmethod
@@ -1496,7 +1535,7 @@ class TpuChainExecutor:
 
     def _fetch_bytes(
         self, buf: RecordBuffer, count: int, packed, max_v, max_k,
-        _src_col, _src_decode,
+        _src_col, _src_decode, span=None,
     ) -> RecordBuffer:
         """Byte-mode materialization: compacted value/key columns cross
         the link sliced to count x used-width (tail of `_fetch`; the
@@ -1538,7 +1577,7 @@ class TpuChainExecutor:
         if want_dev_offsets:
             slices.append(lax.slice(packed["offset_deltas"], (0,), (rows,)))
             slices.append(lax.slice(packed["timestamp_deltas"], (0,), (rows,)))
-        host = self._download(slices)
+        host = self._download(slices, span)
         out_values, out_lengths = host[:2]
         out_lengths = out_lengths.astype(np.int32)
         pos = 2
@@ -1615,7 +1654,9 @@ class TpuChainExecutor:
             out_klens = np.full((rows,), -1, dtype=np.int32)
         return out_values, out_lengths, out_keys, out_klens
 
-    def _fetch_ints(self, buf: RecordBuffer, count: int, packed, probe) -> RecordBuffer:
+    def _fetch_ints(
+        self, buf: RecordBuffer, count: int, packed, probe, span=None
+    ) -> RecordBuffer:
         """Int-output D2H: survivor mask + accumulator column(s); the host
         renders decimals (and window keys) itself.
 
@@ -1643,7 +1684,7 @@ class TpuChainExecutor:
         if windowed:
             w_col, w_is_delta = _pick(packed["agg_win"], w_d, scal[2])
             slices.append(lax.slice(w_col, (0,), (rows,)))
-        host = self._download(slices)
+        host = self._download(slices, span)
         src = self._mask_to_src(host[0], buf)
         ints = (
             self._delta_decode(host[1], scal[1], count)
@@ -1735,9 +1776,19 @@ class TpuChainExecutor:
         """
         if self._sharded is not None:
             return self._sharded.dispatch_buffer(buf)
+        span = TELEMETRY.begin_batch()
         prev_carries = self._device_carries
-        header, packed = self._dispatch(buf, fanout_cap=self._fanout_cap(buf))
+        header, packed = self._dispatch(
+            buf, fanout_cap=self._fanout_cap(buf), span=span
+        )
+        t_ph = time.perf_counter() if span is not None else 0.0
         spec = self._start_result_copies(buf, header, packed)
+        if span is not None:
+            # the probe math + async D2H registration: charged to d2h —
+            # it is the download's initiation half
+            span.add("d2h", time.perf_counter() - t_ph)
+            span.mark_dispatched()
+            spec["span"] = span
         # finish-side self-heal markers: whether THIS dispatch shipped a
         # glz-compressed flat (async runtime failures surface at fetch),
         # and the heal epoch its carry lineage belongs to
@@ -1861,18 +1912,28 @@ class TpuChainExecutor:
             and spec.get("epoch", self._heal_epoch) != self._heal_epoch
         ):
             return self._finish_stale_epoch(buf, handle)
+        span = spec.get("span") if spec else None
+        t_f0 = time.perf_counter() if span is not None else 0.0
+        d2h0 = span.phase("d2h") if span is not None else 0.0
         try:
-            return self._fetch(buf, header, packed, spec)
+            out = self._fetch(buf, header, packed, spec)
         except _FanoutOverflow as o:
             self._learn_cap(buf, o.total)
             self._device_carries = prev_carries
             cap = self._bucket_bytes(o.total, 1024)
-            header, packed = self._dispatch(buf, fanout_cap=cap)
+            header, packed = self._dispatch(buf, fanout_cap=cap, span=span)
+            if span is not None:
+                span.mark_dispatched()
             try:
-                return self._fetch(buf, header, packed)
+                out = self._fetch(
+                    buf, header, packed, {"span": span} if span else None
+                )
             except _FanoutOverflow as e:  # pragma: no cover — total is exact
                 self._device_carries = prev_carries
-                raise TpuSpill(f"fanout overflow after retry: {e.total}")
+                raise TpuSpill(
+                    f"fanout overflow after retry: {e.total}",
+                    reason="fanout-overflow",
+                )
         except TpuSpill:
             self._charge_unfetched_spec(handle)
             self._device_carries = prev_carries
@@ -1892,6 +1953,7 @@ class TpuChainExecutor:
             logging.getLogger(__name__).warning(
                 "glz decode failed at fetch; link compression disabled: %s", e
             )
+            TELEMETRY.add_heal()
             self._link_compress = False
             buf._glz_cache = None
             self._device_carries = prev_carries
@@ -1902,12 +1964,32 @@ class TpuChainExecutor:
                 # silently fetching diverged results
                 self._heal_epoch += 1
             header, packed = self._dispatch(
-                buf, fanout_cap=self._fanout_cap(buf)
+                buf, fanout_cap=self._fanout_cap(buf), span=span
             )
+            if span is not None:
+                span.mark_dispatched()
             if self.agg_configs:
                 self._heal_carries = self._device_carries
                 self._heal_dispatch_seq = self._dispatch_seq
-            return self._fetch(buf, header, packed)
+            out = self._fetch(
+                buf, header, packed, {"span": span} if span else None
+            )
+        if span is not None:
+            # fetch = host materialization time inside this finish call:
+            # total minus the device wait (up to ready_t) minus the
+            # blocking d2h copies recorded since this call began
+            t_end = time.perf_counter()
+            wait = 0.0
+            if span.ready_t is not None and span.ready_t > t_f0:
+                wait = span.ready_t - t_f0
+            span.add(
+                "fetch", (t_end - t_f0) - wait - (span.phase("d2h") - d2h0)
+            )
+            # records = INPUT records staged through this batch (same
+            # semantic as the interpreter path, so per-path record
+            # counters compare identical workloads)
+            TELEMETRY.end_batch(span, records=buf.count)
+        return out
 
     def _finish_stale_epoch(self, buf: RecordBuffer, handle) -> RecordBuffer:
         """Finish an aggregate dispatch whose carry lineage a glz heal
@@ -1935,7 +2017,8 @@ class TpuChainExecutor:
             self._device_carries = self._heal_carries
             self._heal_carries = None
         raise TpuSpill(
-            "glz heal invalidated in-flight aggregate carry lineage"
+            "glz heal invalidated in-flight aggregate carry lineage",
+            reason="heal-lineage",
         )
 
     def process_buffer(self, buf: RecordBuffer) -> RecordBuffer:
@@ -2003,7 +2086,7 @@ class TpuChainExecutor:
             # crash the chain. Records merely wider than the narrow
             # layout stage striped — or spill from _dispatch when the
             # chain is outside the stripeable subset.
-            raise TpuSpill(str(e)) from None
+            raise TpuSpill(str(e), reason="record-too-wide") from None
         out = self.process_buffer(buf)
         if self.agg_configs:
             self._ensure_host_state()

@@ -21,7 +21,10 @@ class RecordCounter:
             self.bytes += nbytes
 
     def to_dict(self) -> dict:
-        return {"records": self.records, "bytes": self.bytes}
+        # under the lock: records/bytes advance together in add(); a
+        # concurrent scrape must not observe one without the other
+        with self._lock:
+            return {"records": self.records, "bytes": self.bytes}
 
 
 @dataclass
@@ -30,10 +33,13 @@ class SpuMetrics:
     outbound: RecordCounter = field(default_factory=RecordCounter)
     smartmodule: SmartModuleChainMetrics = field(default_factory=SmartModuleChainMetrics)
 
-    def to_dict(self) -> dict:
+    def to_dict(self, include_telemetry: bool = True) -> dict:
         from fluvio_tpu.smartengine.metering import quarantine_state
 
-        return {
+        # each sub-snapshot copies under its own lock (see RecordCounter /
+        # SmartModuleChainMetrics.to_dict), so a scrape racing add_* sees
+        # internally-consistent sections
+        out = {
             "inbound": self.inbound.to_dict(),
             "outbound": self.outbound.to_dict(),
             "smartmodule": self.smartmodule.to_dict(),
@@ -42,6 +48,16 @@ class SpuMetrics:
             # operator's view into why a module's streams error out
             "hook_quarantine": quarantine_state(),
         }
+        if include_telemetry:
+            from fluvio_tpu.telemetry import TELEMETRY
+
+            # pipeline telemetry: per-phase latency histograms, batch
+            # latency by path, heal/spill/stripe/decline counters.
+            # The Prometheus renderer reads the registry itself —
+            # include_telemetry=False skips building percentiles a prom
+            # scrape would throw away.
+            out["telemetry"] = TELEMETRY.snapshot()
+        return out
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())

@@ -3,7 +3,18 @@
 Capability parity: fluvio-spu/src/monitoring.rs:12-67 — the broker's
 metrics struct is serialized as JSON to any client that connects to a
 unix socket whose path comes from ``FLUVIO_METRIC_SPU`` (default
-``SPU_MONITORING_UNIX_SOCKET``). One JSON document per connection.
+``SPU_MONITORING_UNIX_SOCKET``).
+
+Protocol: the client MAY send one mode line before reading:
+
+- ``json``  (or nothing — the legacy reader) → the metrics JSON dump,
+  now including the pipeline-telemetry snapshot,
+- ``prom``  → Prometheus text-format exposition of the same snapshot,
+- ``spans`` → the recent per-batch span ring as a JSON array.
+
+A client that sends nothing still gets JSON after a short grace wait,
+so pre-existing scrapers keep working unchanged. One document per
+connection, then close — same as the reference.
 """
 
 from __future__ import annotations
@@ -18,6 +29,10 @@ logger = logging.getLogger(__name__)
 
 SPU_MONITORING_UNIX_SOCKET = "/tmp/fluvio-spu.sock"
 
+# grace wait for the optional mode line; legacy clients that connect and
+# only read pay this once before the JSON dump starts
+_MODE_LINE_TIMEOUT_S = 0.2
+
 
 def monitoring_path(override: Optional[str] = None) -> str:
     if override:
@@ -26,7 +41,8 @@ def monitoring_path(override: Optional[str] = None) -> str:
 
 
 class MonitoringServer:
-    """Serves the SPU metrics JSON dump on a unix socket."""
+    """Serves the SPU metrics (JSON / Prometheus text / span dump) on a
+    unix socket."""
 
     def __init__(self, ctx, path: Optional[str] = None):
         self.ctx = ctx
@@ -39,12 +55,37 @@ class MonitoringServer:
         self._server = await asyncio.start_unix_server(self._handle, path=self.path)
         logger.info("monitoring started on %s", self.path)
 
+    def _payload(self, mode: str) -> bytes:
+        from fluvio_tpu.telemetry import TELEMETRY, render_prometheus
+
+        if mode == "prom":
+            # the renderer reads the telemetry registry directly; only
+            # the broker counter sections come from the metrics dict
+            return render_prometheus(
+                spu_metrics=self.ctx.metrics.to_dict(include_telemetry=False)
+            ).encode()
+        if mode == "spans":
+            return (json.dumps(TELEMETRY.spans_json(), indent=1) + "\n").encode()
+        return json.dumps(self.ctx.metrics.to_dict(), indent=2).encode()
+
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         try:
-            payload = json.dumps(self.ctx.metrics.to_dict(), indent=2).encode()
-            writer.write(payload)
+            mode = "json"
+            try:
+                line = await asyncio.wait_for(
+                    reader.readline(), _MODE_LINE_TIMEOUT_S
+                )
+                requested = line.decode("ascii", "replace").strip().lower()
+                if requested in ("prom", "spans", "json"):
+                    mode = requested
+            except (asyncio.TimeoutError, ValueError):
+                # legacy client (no mode line) or a line exceeding the
+                # stream reader's limit (readline raises ValueError):
+                # fall through to the JSON dump either way
+                pass
+            writer.write(self._payload(mode))
             await writer.drain()
         finally:
             writer.close()
@@ -58,14 +99,29 @@ class MonitoringServer:
             os.remove(self.path)
 
 
+async def _read_mode(path: Optional[str], mode: str) -> bytes:
+    reader, writer = await asyncio.open_unix_connection(monitoring_path(path))
+    try:
+        writer.write(mode.encode("ascii") + b"\n")
+        await writer.drain()
+        return await reader.read()
+    finally:
+        writer.close()
+
+
 async def read_metrics(path: Optional[str] = None) -> dict:
     """Client side: connect and decode one metrics dump.
 
     Parity: fluvio-cli/src/monitoring.rs (the CLI's metrics reader).
     """
-    reader, writer = await asyncio.open_unix_connection(monitoring_path(path))
-    try:
-        payload = await reader.read()
-    finally:
-        writer.close()
-    return json.loads(payload)
+    return json.loads(await _read_mode(path, "json"))
+
+
+async def read_prometheus(path: Optional[str] = None) -> str:
+    """Scrape the Prometheus text-format exposition."""
+    return (await _read_mode(path, "prom")).decode()
+
+
+async def read_spans(path: Optional[str] = None) -> list:
+    """Fetch the recent per-batch span ring as a list of dicts."""
+    return json.loads(await _read_mode(path, "spans"))

@@ -102,6 +102,7 @@ from fluvio_tpu.smartmodule.types import (
 )
 from fluvio_tpu.spu.context import GlobalContext
 from fluvio_tpu.spu.replica import LeaderReplicaState
+from fluvio_tpu.telemetry import TELEMETRY
 from fluvio_tpu.types import NO_TIMESTAMP
 
 
@@ -383,6 +384,7 @@ class PendingSlice:
 def _decline(metrics, reason: str):
     if metrics is not None:
         metrics.add_fallback(reason)
+    TELEMETRY.add_decline(reason)
     return None
 
 
@@ -416,6 +418,8 @@ def tpu_stage_dispatch(
     tpu = getattr(chain, "tpu_chain", None)
     if tpu is None or not batches:
         return None
+    t_stage0 = time.perf_counter() if TELEMETRY.enabled else 0.0
+    glz_decode_s = 0.0
     staged: List[tuple] = []
     total_raw = 0
     for batch in batches:
@@ -423,7 +427,12 @@ def tpu_stage_dispatch(
         if raw is None:
             return _decline(metrics, "no-raw-records")
         if batch.header.compression() != Compression.NONE:
-            raw = decompress(batch.header.compression(), raw)
+            if TELEMETRY.enabled:
+                t_dc = time.perf_counter()
+                raw = decompress(batch.header.compression(), raw)
+                glz_decode_s += time.perf_counter() - t_dc
+            else:
+                raw = decompress(batch.header.compression(), raw)
         cols = native_backend.decode_record_columns_aligned(raw)
         if cols is None:
             return _decline(metrics, "no-native-decoder")
@@ -538,6 +547,14 @@ def tpu_stage_dispatch(
             buf.fresh_offset_deltas = fo
             buf.fresh_timestamp_deltas = ft
         chunk_bufs.append(buf)
+    if TELEMETRY.enabled:
+        # slice-level staging cost (native decode, column merge, chunk
+        # builds), net of stored-batch decompression; the per-chunk
+        # device work below books into its own spans
+        TELEMETRY.add_phase("glz_decode", glz_decode_s)
+        TELEMETRY.add_phase(
+            "stage", time.perf_counter() - t_stage0 - glz_decode_s
+        )
     # executor-owned dispatch: with compression on, the worker
     # glz-compresses chunk k+1 while chunk k dispatches (one-ahead);
     # with it off this is a plain dispatch loop
@@ -633,7 +650,12 @@ def tpu_finish(
             outbufs.append(tpu.finish_buffer(b, h))
     except TpuSpill:
         # later chunks' dispatch-time D2H copies still crossed the link;
-        # discard them so the executor's byte accounting stays honest
+        # discard them so the executor's byte accounting stays honest.
+        # NOT counted as a telemetry spill here: the per-record rerun
+        # re-enters chain.process, whose own TpuSpill handler counts one
+        # spill per batch — counting the slice here too would inflate
+        # spills_total for the single logical event (the slice-level
+        # decline counter below already records it once)
         for _, h in pending.chunks[len(outbufs) + 1 :]:
             tpu.discard_dispatch(h)
         return _decline(metrics, "transform-error-spill")

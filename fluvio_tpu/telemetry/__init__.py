@@ -1,0 +1,36 @@
+"""Pipeline telemetry: per-batch phase spans, latency histograms, and a
+scrapeable metrics surface.
+
+Stream processors live or die by phase-level visibility (Diba,
+arXiv:2304.01659 builds reconfiguration decisions on per-stage latency
+telemetry); this package gives the engine exactly that without touching
+per-record work:
+
+- `spans`     — per-batch pipeline spans with FIXED phase labels,
+                captured in a bounded ring buffer,
+- `histogram` — log-bucketed (HDR-style) latency histograms: fixed
+                bucket array, mergeable, percentile interpolation,
+- `registry`  — the process-wide `TELEMETRY` singleton the hot paths
+                record into and the export surfaces snapshot from,
+- `prometheus`— text-format exposition of a snapshot.
+
+Always-on contract: one monotonic clock pair per phase per batch, no
+per-record work; ``FLUVIO_TELEMETRY=0`` disables span/histogram capture
+entirely (event counters stay on — they are as cheap as the existing
+`SmartModuleChainMetrics` adds).
+"""
+
+from fluvio_tpu.telemetry.histogram import LatencyHistogram
+from fluvio_tpu.telemetry.spans import PHASES, BatchSpan, SpanRing
+from fluvio_tpu.telemetry.registry import TELEMETRY, PipelineTelemetry
+from fluvio_tpu.telemetry.prometheus import render_prometheus
+
+__all__ = [
+    "LatencyHistogram",
+    "PHASES",
+    "BatchSpan",
+    "SpanRing",
+    "TELEMETRY",
+    "PipelineTelemetry",
+    "render_prometheus",
+]

@@ -1,0 +1,189 @@
+"""Prometheus text-format exposition of a telemetry snapshot.
+
+Renders `PipelineTelemetry` (histograms, counters) and optionally the
+SPU's `SpuMetrics` dict into exposition format 0.0.4 text — the format
+every Prometheus-compatible scraper (and `promtool check metrics`)
+accepts. The telemetry series copy out under ONE registry lock hold, so
+all telemetry samples in a scrape are from the same instant (broker
+counter sections snapshot under their own locks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fluvio_tpu.telemetry.registry import TELEMETRY, PipelineTelemetry
+
+_PREFIX = "fluvio_tpu"
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Writer:
+    def __init__(self) -> None:
+        self.lines = []
+
+    def header(self, name: str, help_text: str, kind: str) -> None:
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {kind}")
+
+    def sample(self, name: str, labels: dict, value: float) -> None:
+        if labels:
+            inner = ",".join(
+                f'{k}="{_escape_label(str(v))}"' for k, v in labels.items()
+            )
+            self.lines.append(f"{name}{{{inner}}} {_fmt(value)}")
+        else:
+            self.lines.append(f"{name} {_fmt(value)}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def _histogram(w: _Writer, name: str, help_text: str, series) -> None:
+    """``series``: [(labels_dict, LatencyHistogram)] — one TYPE header,
+    one bucket ladder per label set."""
+    w.header(name, help_text, "histogram")
+    for labels, hist in series:
+        for bound, cum in hist.cumulative_buckets():
+            le = "+Inf" if bound is None else _fmt(bound)
+            w.sample(f"{name}_bucket", dict(labels, le=le), cum)
+        w.sample(f"{name}_sum", labels, hist.sum)
+        w.sample(f"{name}_count", labels, hist.count)
+
+
+def render_prometheus(
+    telemetry: Optional[PipelineTelemetry] = None,
+    spu_metrics: Optional[dict] = None,
+) -> str:
+    """Exposition text for the telemetry registry (and, when given, the
+    SPU broker counters dict from ``SpuMetrics.to_dict()``)."""
+    t = telemetry if telemetry is not None else TELEMETRY
+    w = _Writer()
+
+    with t._lock:
+        batch_series = [
+            ({"path": path}, h.copy()) for path, h in t.batch_latency.items()
+        ]
+        phase_series = [
+            ({"phase": p}, h.copy()) for p, h in t.phase_hist.items()
+        ]
+        records = dict(t.batch_records)
+        heals, stripe = t.heals, t.stripe_fallbacks
+        spills, declines = dict(t.spills), dict(t.declines)
+        interp = (t.interp_calls, t.interp_seconds, t.interp_records)
+
+    _histogram(
+        w,
+        f"{_PREFIX}_batch_latency_seconds",
+        "End-to-end per-batch pipeline latency by execution path.",
+        batch_series,
+    )
+    _histogram(
+        w,
+        f"{_PREFIX}_phase_seconds",
+        "Per-batch time spent in each pipeline phase.",
+        phase_series,
+    )
+
+    w.header(
+        f"{_PREFIX}_batch_records_total",
+        "Records processed, by execution path.",
+        "counter",
+    )
+    for path, n in sorted(records.items()):
+        w.sample(f"{_PREFIX}_batch_records_total", {"path": path}, n)
+
+    w.header(
+        f"{_PREFIX}_glz_heals_total",
+        "Link-compression self-heal events (glz disabled + batch re-shipped raw).",
+        "counter",
+    )
+    w.sample(f"{_PREFIX}_glz_heals_total", {}, heals)
+
+    w.header(
+        f"{_PREFIX}_stripe_fallbacks_total",
+        "Wide batches spilled because the chain is outside the stripeable subset.",
+        "counter",
+    )
+    w.sample(f"{_PREFIX}_stripe_fallbacks_total", {}, stripe)
+
+    w.header(
+        f"{_PREFIX}_spills_total",
+        "Fused-path batches re-run on the interpreter, by reason.",
+        "counter",
+    )
+    for reason, n in sorted(spills.items()):
+        w.sample(f"{_PREFIX}_spills_total", {"reason": reason}, n)
+
+    w.header(
+        f"{_PREFIX}_declines_total",
+        "Fast-path staging declines, by reason.",
+        "counter",
+    )
+    for reason, n in sorted(declines.items()):
+        w.sample(f"{_PREFIX}_declines_total", {"reason": reason}, n)
+
+    for name, help_text, value in (
+        ("interp_instance_calls_total",
+         "Interpreter module-instance invocations.", interp[0]),
+        ("interp_instance_seconds_total",
+         "Wall seconds spent inside interpreter module instances.", interp[1]),
+        ("interp_instance_records_total",
+         "Records fed through interpreter module instances.", interp[2]),
+    ):
+        w.header(f"{_PREFIX}_{name}", help_text, "counter")
+        w.sample(f"{_PREFIX}_{name}", {}, value)
+
+    if spu_metrics is not None:
+        _render_spu(w, spu_metrics)
+    return w.text()
+
+
+def _render_spu(w: _Writer, m: dict) -> None:
+    for direction in ("inbound", "outbound"):
+        d = m.get(direction) or {}
+        w.header(
+            f"{_PREFIX}_spu_{direction}_records_total",
+            f"Broker {direction} records.",
+            "counter",
+        )
+        w.sample(f"{_PREFIX}_spu_{direction}_records_total", {}, d.get("records", 0))
+        w.header(
+            f"{_PREFIX}_spu_{direction}_bytes_total",
+            f"Broker {direction} bytes.",
+            "counter",
+        )
+        w.sample(f"{_PREFIX}_spu_{direction}_bytes_total", {}, d.get("bytes", 0))
+    sm = m.get("smartmodule") or {}
+    scalar_fields = (
+        ("bytes_in", "Bytes fed into SmartModule chains."),
+        ("records_out", "Records produced by SmartModule chains."),
+        ("invocation_count", "Chain invocations."),
+        ("fuel_used", "Metered fuel units consumed."),
+        ("fastpath_slices", "Read slices that ran the coalesced TPU fast path."),
+        ("fallback_slices", "Read slices that fell back to the per-record loop."),
+    )
+    for field, help_text in scalar_fields:
+        name = f"{_PREFIX}_smartmodule_{field}_total"
+        w.header(name, help_text, "counter")
+        w.sample(name, {}, sm.get(field, 0))
+    w.header(
+        f"{_PREFIX}_smartmodule_fallback_reasons_total",
+        "Fast-path fallback slices by decline reason.",
+        "counter",
+    )
+    for reason, n in sorted((sm.get("fallback_reasons") or {}).items()):
+        w.sample(
+            f"{_PREFIX}_smartmodule_fallback_reasons_total",
+            {"reason": reason},
+            n,
+        )

@@ -1,0 +1,187 @@
+"""Process-wide telemetry registry.
+
+One `PipelineTelemetry` per process (module-global ``TELEMETRY``),
+recording:
+
+- batch end-to-end latency histograms, split by path (``fused`` /
+  ``interpreter``) so the two execution modes are directly comparable,
+- per-phase latency histograms + running time totals (the bench's
+  per-phase breakdown reads the totals; histograms answer "is the
+  d2h tail bimodal"),
+- event counters: glz heals, interpreter spills keyed by reason,
+  stripe fallbacks, fast-path declines keyed by reason,
+- a bounded ring of recent `BatchSpan`s for debugging dumps.
+
+Hot-path contract: `begin_batch` returns None when capture is disabled
+(``FLUVIO_TELEMETRY=0``) and every instrumentation site guards on that;
+`end_batch` takes one lock for the histogram adds (per BATCH, never per
+record). Counters stay on even when capture is off — they cost the same
+as the existing `SmartModuleChainMetrics` adds.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from fluvio_tpu.telemetry.histogram import LatencyHistogram
+from fluvio_tpu.telemetry.spans import PHASES, BatchSpan, SpanRing
+
+SPAN_RING_CAPACITY = 256
+
+
+class PipelineTelemetry:
+    def __init__(self, ring_capacity: int = SPAN_RING_CAPACITY) -> None:
+        self.enabled = os.environ.get("FLUVIO_TELEMETRY", "1") != "0"
+        self._lock = threading.Lock()
+        self.batch_latency: Dict[str, LatencyHistogram] = {
+            "fused": LatencyHistogram(),
+            "interpreter": LatencyHistogram(),
+        }
+        self.phase_hist: Dict[str, LatencyHistogram] = {
+            p: LatencyHistogram() for p in PHASES
+        }
+        self.spans = SpanRing(ring_capacity)
+        # event counters (always-on)
+        self.heals = 0
+        self.stripe_fallbacks = 0
+        self.spills: Dict[str, int] = {}
+        self.declines: Dict[str, int] = {}
+        self.batch_records: Dict[str, int] = {"fused": 0, "interpreter": 0}
+        # per-module-instance interpreter accounting (one clock pair per
+        # instance per batch): lets fused-vs-interpreter cost comparisons
+        # see where interpreter time concentrates without per-record work
+        self.interp_calls = 0
+        self.interp_seconds = 0.0
+        self.interp_records = 0
+
+    # -- span lifecycle ------------------------------------------------------
+
+    def begin_batch(self, path: str = "fused") -> Optional[BatchSpan]:
+        if not self.enabled:
+            return None
+        return BatchSpan(path)
+
+    def end_batch(self, span: Optional[BatchSpan], records: int = 0) -> None:
+        if span is None:
+            return
+        span.t_end = time.perf_counter()
+        span.records = records
+        e2e = span.t_end - span.t0
+        with self._lock:
+            hist = self.batch_latency.get(span.path)
+            if hist is None:  # pragma: no cover — fixed path vocabulary
+                hist = self.batch_latency.setdefault(
+                    span.path, LatencyHistogram()
+                )
+            hist.record(e2e)
+            self.batch_records[span.path] = (
+                self.batch_records.get(span.path, 0) + records
+            )
+            for name, s in zip(PHASES, span.phase_s):
+                if s > 0.0:
+                    self.phase_hist[name].record(s)
+        self.spans.push(span)
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        """Record phase time measured outside a span (slice-level host
+        staging in the broker bridge, where one read slice fans into
+        several per-chunk spans)."""
+        if not self.enabled or seconds <= 0.0:
+            return
+        with self._lock:
+            self.phase_hist[name].record(seconds)
+
+    # -- counters ------------------------------------------------------------
+
+    def add_heal(self) -> None:
+        with self._lock:
+            self.heals += 1
+
+    def add_stripe_fallback(self) -> None:
+        with self._lock:
+            self.stripe_fallbacks += 1
+
+    def add_spill(self, reason: str) -> None:
+        with self._lock:
+            self.spills[reason] = self.spills.get(reason, 0) + 1
+
+    def add_decline(self, reason: str) -> None:
+        with self._lock:
+            self.declines[reason] = self.declines.get(reason, 0) + 1
+
+    def add_interp_instance(self, seconds: float, records: int) -> None:
+        with self._lock:
+            self.interp_calls += 1
+            self.interp_seconds += seconds
+            self.interp_records += records
+
+    # -- reads ---------------------------------------------------------------
+
+    def phase_totals(self) -> Dict[str, tuple]:
+        """{phase: (count, total_seconds)} — the bench's per-phase
+        breakdown diffs two of these around a timed pass."""
+        with self._lock:
+            return {
+                p: (h.count, h.sum) for p, h in self.phase_hist.items()
+            }
+
+    def batch_hist_copy(self, path: str = "fused") -> LatencyHistogram:
+        with self._lock:
+            return self.batch_latency[path].copy()
+
+    def snapshot(self) -> dict:
+        """The ONE snapshot shape every export surface renders from
+        (monitoring JSON, Prometheus text, CLI table) — they must not
+        drift apart, so they all start here."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "batches": {
+                    path: dict(h.to_dict(), records=self.batch_records.get(path, 0))
+                    for path, h in self.batch_latency.items()
+                },
+                "phases": {
+                    p: h.to_dict()
+                    for p, h in self.phase_hist.items()
+                    if h.count
+                },
+                "counters": {
+                    "heals": self.heals,
+                    "stripe_fallbacks": self.stripe_fallbacks,
+                    "spills": dict(self.spills),
+                    "declines": dict(self.declines),
+                    "interp_instance": {
+                        "calls": self.interp_calls,
+                        "seconds": round(self.interp_seconds, 6),
+                        "records": self.interp_records,
+                    },
+                },
+                "spans_retained": len(self.spans),
+                "spans_total": self.spans.total,
+            }
+
+    def spans_json(self, limit: Optional[int] = None) -> List[dict]:
+        return [s.to_dict() for s in self.spans.recent(limit)]
+
+    def reset(self) -> None:
+        """Test/bench isolation helper — never called on the hot path."""
+        with self._lock:
+            for h in self.batch_latency.values():
+                h.__init__()
+            for h in self.phase_hist.values():
+                h.__init__()
+            self.heals = 0
+            self.stripe_fallbacks = 0
+            self.spills = {}
+            self.declines = {}
+            self.batch_records = {"fused": 0, "interpreter": 0}
+            self.interp_calls = 0
+            self.interp_seconds = 0.0
+            self.interp_records = 0
+        self.spans = SpanRing(self.spans.capacity)
+
+
+TELEMETRY = PipelineTelemetry()

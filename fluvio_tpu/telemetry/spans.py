@@ -1,0 +1,143 @@
+"""Per-batch pipeline spans with fixed phase labels, in a bounded ring.
+
+A `BatchSpan` is one batch's walk through the pipeline. Phases are a
+FIXED vocabulary (indexes into one flat float list — no per-phase dict
+allocation on the hot path):
+
+- ``stage``        host staging: ragged flat build, column merge/slice
+- ``glz_compress`` host glz compression of the H2D flat
+- ``h2d``          host-side link staging/enqueue (device array builds;
+                   the physical transfer overlaps ``device``)
+- ``dispatch``     jit call: trace lookup + async dispatch enqueue
+- ``device``       dispatch-complete -> first result sync satisfied
+                   (TRUE device-compute span: measured from the
+                   dispatch->block_until_ready delta, so the pipelined
+                   stream loop attributes overlap correctly — batch k's
+                   device time keeps counting while the host dispatches
+                   batch k+1)
+- ``fetch``        host-side result materialization after download
+- ``d2h``          blocking device->host copy time
+- ``glz_decode``   host decompression of stored-batch compression on
+                   the staging side (device-side glz inflate is inside
+                   the jit and therefore part of ``device``)
+- ``spill``        interpreter re-run after a fused-path spill/decline
+
+Overhead contract: begin/end is two monotonic clock reads; each phase
+adds one clock pair. No per-record work anywhere.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+PHASES = (
+    "stage",
+    "glz_compress",
+    "h2d",
+    "dispatch",
+    "device",
+    "fetch",
+    "d2h",
+    "glz_decode",
+    "spill",
+)
+_PHASE_INDEX = {name: i for i, name in enumerate(PHASES)}
+
+
+class BatchSpan:
+    """One batch's phase timings. Not thread-safe; owned by the thread
+    driving the batch (ring insertion at `end` is what synchronizes)."""
+
+    __slots__ = (
+        "t0", "t_end", "phase_s", "records", "path", "dispatch_end", "ready_t",
+    )
+
+    def __init__(self, path: str = "fused") -> None:
+        self.t0 = time.perf_counter()
+        self.t_end: Optional[float] = None
+        self.phase_s: List[float] = [0.0] * len(PHASES)
+        self.records = 0
+        self.path = path
+        # set by mark_dispatched; the device phase measures from here
+        self.dispatch_end: Optional[float] = None
+        # when the first blocking result sync returned (finish-side
+        # "fetch" accounting subtracts the wait up to this point)
+        self.ready_t: Optional[float] = None
+
+    def add(self, phase: str, seconds: float) -> None:
+        if seconds > 0.0:
+            self.phase_s[_PHASE_INDEX[phase]] += seconds
+
+    def mark_dispatched(self) -> None:
+        self.dispatch_end = time.perf_counter()
+
+    def mark_device_ready(self) -> None:
+        """First blocking sync on this batch's results returned: the
+        device span is dispatch-end -> now (monotone clock pair)."""
+        now = time.perf_counter()
+        if self.dispatch_end is not None:
+            self.add("device", now - self.dispatch_end)
+            self.dispatch_end = None  # a re-dispatch restarts the pair
+        self.ready_t = now
+
+    def phase(self, name: str) -> float:
+        return self.phase_s[_PHASE_INDEX[name]]
+
+    def to_dict(self) -> Dict:
+        d = {
+            "path": self.path,
+            "records": self.records,
+            "e2e_ms": round(
+                ((self.t_end if self.t_end is not None else time.perf_counter())
+                 - self.t0) * 1000, 3,
+            ),
+            "t0": round(self.t0, 6),
+        }
+        if self.t_end is not None:
+            d["t_end"] = round(self.t_end, 6)
+        d["phases_ms"] = {
+            name: round(s * 1000, 3)
+            for name, s in zip(PHASES, self.phase_s)
+            if s > 0.0
+        }
+        return d
+
+
+class SpanRing:
+    """Bounded ring of completed spans: O(1) push, keeps the most
+    recent ``capacity`` spans in completion order."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._slots: List[Optional[BatchSpan]] = [None] * capacity
+        self._next = 0  # total pushes (monotone)
+        self._lock = threading.Lock()
+
+    def push(self, span: BatchSpan) -> None:
+        with self._lock:
+            self._slots[self._next % self.capacity] = span
+            self._next += 1
+
+    def __len__(self) -> int:
+        return min(self._next, self.capacity)
+
+    @property
+    def total(self) -> int:
+        """Spans ever pushed (wrapped ones included)."""
+        return self._next
+
+    def recent(self, limit: Optional[int] = None) -> List[BatchSpan]:
+        """Most-recent-last list of retained spans."""
+        with self._lock:
+            n = min(self._next, self.capacity)
+            start = self._next - n
+            spans = [
+                self._slots[i % self.capacity] for i in range(start, self._next)
+            ]
+        if limit is not None and limit < len(spans):
+            spans = spans[-limit:]
+        return spans

@@ -141,6 +141,17 @@ def _full_config(rps: int, x: float) -> dict:
         "link_floor_ms": 777,
         "link_saturation": 0.45,
         "glz_ratio": 0.476,
+        "phases": {
+            "wall_ms": 1693.4,
+            "phase_sum_ms": 1650.2,
+            "phase_ms": {
+                "stage": 201.5, "glz_compress": 144.2, "dispatch": 55.1,
+                "device": 901.2, "fetch": 240.8, "d2h": 107.4,
+            },
+            "top": [["device", 0.55], ["fetch", 0.15], ["stage", 0.12]],
+            "e2e_p50_ms": 1554.0,
+            "e2e_p99_ms": 1698.0,
+        },
     }
 
 
@@ -210,6 +221,11 @@ def test_compact_line_fits_driver_window():
     assert "codecs" not in parsed["configs"]  # aux detail stays in the file
     assert parsed["link"]["glz"] == "on"
     assert parsed["detail"] == "BENCH_DETAIL.json"
+    # telemetry satellite: ONE compact phases key (the headline's p50/p99
+    # + top-3 phase shares); the per-config phase tables stay in the file
+    assert parsed["phases"]["e2e_p50_ms"] == 1554.0
+    assert parsed["phases"]["top"][0][0] == "device"
+    assert "phase_ms" not in parsed["phases"]  # full table is detail-only
 
 
 def test_compact_line_trims_pathological_blowup_keeps_link():

@@ -1,0 +1,296 @@
+"""Telemetry subsystem: histogram math, span ring, span attribution in
+the pipelined stream loop, and counter wiring through the engine.
+
+Covers the ISSUE-2 satellite matrix: bucket boundaries / merge /
+percentile interpolation, ring-buffer wraparound, and the fused-path
+span capture where batch k's fetch overlaps batch k+1's dispatch.
+"""
+
+import numpy as np
+import pytest
+
+from fluvio_tpu.models import lookup
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+from fluvio_tpu.smartmodule import SmartModuleInput
+from fluvio_tpu.telemetry import (
+    TELEMETRY,
+    BatchSpan,
+    LatencyHistogram,
+    PipelineTelemetry,
+    SpanRing,
+)
+from fluvio_tpu.telemetry.histogram import BUCKET_BOUNDS, N_BUCKETS
+from fluvio_tpu.telemetry.spans import PHASES
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Every test starts from a clean process-global registry (other
+    suites run chains too; their batches must not leak into counts)."""
+    TELEMETRY.reset()
+    prior = TELEMETRY.enabled
+    TELEMETRY.enabled = True
+    yield
+    TELEMETRY.enabled = prior
+    TELEMETRY.reset()
+
+
+def build_chain(backend, specs):
+    b = SmartEngine(backend=backend).builder()
+    for name, params in specs:
+        b.add_smart_module(SmartModuleConfig(params=params or {}), lookup(name))
+    return b.initialize()
+
+
+def make_buf(values):
+    records = [Record(value=v) for v in values]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+    return RecordBuffer.from_records(records)
+
+
+class TestHistogram:
+    def test_bucket_boundaries_are_fixed_geometric(self):
+        assert len(BUCKET_BOUNDS) == N_BUCKETS - 1
+        ratios = [
+            BUCKET_BOUNDS[i + 1] / BUCKET_BOUNDS[i]
+            for i in range(len(BUCKET_BOUNDS) - 1)
+        ]
+        assert all(abs(r - 2**0.5) < 1e-9 for r in ratios)
+        # ladder spans microseconds to minutes
+        assert BUCKET_BOUNDS[0] == pytest.approx(1e-6)
+        assert BUCKET_BOUNDS[-1] > 180
+
+    def test_record_lands_in_expected_bucket(self):
+        h = LatencyHistogram()
+        h.record(0.0)  # below the first bound -> bucket 0
+        assert h.counts[0] == 1
+        h2 = LatencyHistogram()
+        # exactly ON a boundary goes to the NEXT bucket (bounds are
+        # upper-inclusive-exclusive via bisect_right)
+        h2.record(BUCKET_BOUNDS[3])
+        assert h2.counts[4] == 1
+        h3 = LatencyHistogram()
+        h3.record(BUCKET_BOUNDS[-1] * 10)  # overflow -> +Inf bucket
+        assert h3.counts[-1] == 1
+
+    def test_negative_observation_clamps_to_zero(self):
+        h = LatencyHistogram()
+        h.record(-1.0)
+        assert h.counts[0] == 1 and h.min == 0.0
+
+    def test_merge_adds_counts_and_stats(self):
+        a, b = LatencyHistogram(), LatencyHistogram()
+        for v in (0.001, 0.002, 0.004):
+            a.record(v)
+        for v in (0.5, 1.0):
+            b.record(v)
+        a.merge(b)
+        assert a.count == 5
+        assert a.sum == pytest.approx(1.507)
+        assert a.min == pytest.approx(0.001)
+        assert a.max == pytest.approx(1.0)
+        assert sum(a.counts) == 5
+
+    def test_diff_recovers_delta_observations(self):
+        h = LatencyHistogram()
+        h.record(0.01)
+        snap = h.copy()
+        h.record(0.02)
+        h.record(0.03)
+        d = h.diff(snap)
+        assert d.count == 2
+        assert d.sum == pytest.approx(0.05)
+        assert sum(d.counts) == 2
+
+    def test_percentile_interpolation(self):
+        h = LatencyHistogram()
+        # 100 observations in one bucket: p0..p100 interpolate linearly
+        # across that bucket's [lo, hi)
+        for _ in range(100):
+            h.record(0.010)
+        i = next(j for j, c in enumerate(h.counts) if c)
+        lo = BUCKET_BOUNDS[i - 1]
+        hi = BUCKET_BOUNDS[i]
+        assert lo <= h.percentile(50) <= hi
+        assert h.percentile(1) < h.percentile(99)
+        # p100 reaches the bucket's upper bound exactly
+        assert h.percentile(100) == pytest.approx(hi)
+
+    def test_percentile_across_buckets(self):
+        h = LatencyHistogram()
+        for _ in range(90):
+            h.record(0.001)
+        for _ in range(10):
+            h.record(1.0)
+        assert h.percentile(50) < 0.01
+        assert h.percentile(99) > 0.5
+        assert h.percentile(0) == 0.0 or h.percentile(0) <= 0.001 * 2
+
+    def test_empty_histogram(self):
+        h = LatencyHistogram()
+        assert h.percentile(50) == 0.0
+        assert h.mean() == 0.0
+        d = h.to_dict()
+        assert d["count"] == 0
+
+    def test_cumulative_buckets_monotone_with_inf(self):
+        h = LatencyHistogram()
+        for v in (0.001, 0.1, 10.0, 10_000.0):
+            h.record(v)
+        buckets = h.cumulative_buckets()
+        cums = [c for _, c in buckets]
+        assert cums == sorted(cums)
+        assert buckets[-1][0] is None  # +Inf always present
+        assert buckets[-1][1] == h.count
+
+
+class TestSpanRing:
+    def test_wraparound_keeps_most_recent_in_order(self):
+        ring = SpanRing(4)
+        spans = []
+        for i in range(10):
+            s = BatchSpan()
+            s.records = i
+            ring.push(s)
+            spans.append(s)
+        assert len(ring) == 4
+        assert ring.total == 10
+        assert [s.records for s in ring.recent()] == [6, 7, 8, 9]
+        assert [s.records for s in ring.recent(limit=2)] == [8, 9]
+
+    def test_under_capacity(self):
+        ring = SpanRing(8)
+        for i in range(3):
+            s = BatchSpan()
+            s.records = i
+            ring.push(s)
+        assert len(ring) == 3
+        assert [s.records for s in ring.recent()] == [0, 1, 2]
+
+    def test_zero_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            SpanRing(0)
+
+
+class TestSpanAttribution:
+    """Fused-path spans through the real executor on the CPU backend."""
+
+    def test_process_buffer_records_full_span(self):
+        chain = build_chain(
+            "tpu",
+            [("regex-filter", {"regex": "fluvio"}), ("json-map", {"field": "name"})],
+        )
+        assert chain.backend_in_use == "tpu"
+        buf = make_buf(
+            [b'{"name":"fluvio-%d"}' % i for i in range(64)]
+            + [b'{"name":"kafka"}'] * 64
+        )
+        out = chain.tpu_chain.process_buffer(buf)
+        assert out.count == 64
+        spans = TELEMETRY.spans.recent()
+        assert len(spans) == 1
+        span = spans[0]
+        assert span.path == "fused"
+        # records carries INPUT records (same semantic as the
+        # interpreter path, so per-path counters compare workloads)
+        assert span.records == 128
+        assert span.t_end is not None and span.t_end > span.t0
+        d = span.to_dict()
+        # the serial pass walks every hot phase
+        for phase in ("stage", "dispatch", "device"):
+            assert d["phases_ms"].get(phase, 0) > 0, phase
+        assert set(d["phases_ms"]) <= set(PHASES)
+        # attributed time cannot exceed wall (phases are disjoint clock
+        # pairs within one serial batch)
+        assert sum(d["phases_ms"].values()) <= d["e2e_ms"] * 1.05
+        snap = TELEMETRY.snapshot()
+        assert snap["batches"]["fused"]["count"] == 1
+        assert snap["batches"]["fused"]["records"] == 128
+        assert snap["phases"]["device"]["count"] == 1
+
+    def test_pipelined_stream_overlap_attribution(self):
+        """Batch k's fetch overlaps batch k+1's dispatch in
+        process_stream; every batch must still get exactly one span and
+        the overlap must show up in the span timestamps."""
+        chain = build_chain("tpu", [("regex-filter", {"regex": "fluvio"})])
+        bufs = [
+            make_buf(
+                [b'{"name":"fluvio-%d"}' % i for i in range(32)]
+                + [b'{"name":"other"}'] * 32
+            )
+            for _ in range(5)
+        ]
+        outs = list(chain.tpu_chain.process_stream(iter(bufs)))
+        assert len(outs) == 5 and all(o.count == 32 for o in outs)
+        spans = TELEMETRY.spans.recent()
+        assert len(spans) == 5
+        # spans complete in batch order...
+        ends = [s.t_end for s in spans]
+        assert ends == sorted(ends)
+        # ...and the pipeline overlaps: batch k+1's span OPENS (dispatch
+        # side) before batch k's span CLOSES (fetch side) — the loop
+        # dispatches ahead by construction
+        overlaps = [
+            spans[k + 1].t0 < spans[k].t_end for k in range(len(spans) - 1)
+        ]
+        assert all(overlaps)
+        # device time was attributed from each batch's own dispatch->sync
+        # clock pair, not from the finish call's start
+        for s in spans:
+            assert s.phase("device") >= 0.0
+            assert s.phase("dispatch") > 0.0
+
+    def test_disabled_capture_records_nothing(self):
+        TELEMETRY.enabled = False
+        chain = build_chain("tpu", [("regex-filter", {"regex": "x"})])
+        buf = make_buf([b"x1", b"y2"])
+        out = chain.tpu_chain.process_buffer(buf)
+        assert out.count == 1
+        assert len(TELEMETRY.spans.recent()) == 0
+        assert TELEMETRY.snapshot()["batches"]["fused"]["count"] == 0
+
+    def test_interpreter_path_records_batch(self):
+        chain = build_chain("python", [("regex-filter", {"regex": "fluvio"})])
+        records = [Record(value=b"fluvio"), Record(value=b"kafka")]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+        out = chain.process(SmartModuleInput.from_records(records))
+        assert out.error is None
+        snap = TELEMETRY.snapshot()
+        assert snap["batches"]["interpreter"]["count"] == 1
+        assert snap["batches"]["interpreter"]["records"] == 2
+        # per-instance interpreter accounting rode along
+        interp = snap["counters"]["interp_instance"]
+        assert interp["calls"] == 1 and interp["records"] == 2
+
+
+class TestCounters:
+    def test_decline_and_spill_counters(self):
+        t = PipelineTelemetry()
+        t.add_decline("no-raw-records")
+        t.add_decline("no-raw-records")
+        t.add_spill("transform-error")
+        t.add_heal()
+        t.add_stripe_fallback()
+        c = t.snapshot()["counters"]
+        assert c["declines"] == {"no-raw-records": 2}
+        assert c["spills"] == {"transform-error": 1}
+        assert c["heals"] == 1 and c["stripe_fallbacks"] == 1
+
+    def test_spill_rerun_records_spill_phase(self):
+        """A fused-path spill re-runs on the interpreter and books the
+        rerun's wall time under the ``spill`` phase."""
+        chain = build_chain("tpu", [("array-map-json", None)])
+        assert chain.backend_in_use == "tpu"
+        records = [Record(value=b"[1,2]"), Record(value=b"not-an-array")]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+        out = chain.process(SmartModuleInput.from_records(records))
+        assert out.error is not None  # exact error came from the rerun
+        snap = TELEMETRY.snapshot()
+        assert sum(snap["counters"]["spills"].values()) == 1
+        assert snap["phases"].get("spill", {}).get("count", 0) == 1
+        assert snap["batches"]["interpreter"]["count"] == 1

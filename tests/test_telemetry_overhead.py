@@ -1,0 +1,122 @@
+"""Always-on telemetry overhead gate (ISSUE-2 CI satellite).
+
+Runs the fused headline chain (regex-filter + json-map, bench config
+``2_filter_map``) on the hermetic CPU backend with telemetry ON vs OFF
+and asserts the throughput delta stays under the gate — so always-on
+instrumentation can't silently regress the hot path.
+
+Methodology: alternating measurement passes (on/off interleaved so
+machine drift hits both arms equally), best-of-N per arm (min is the
+noise-robust estimator for a fixed workload), and one re-measure retry
+before failing. The gate is 2% (ISSUE acceptance) with a small absolute
+floor so a sub-millisecond workload can't fail on scheduler jitter.
+"""
+
+import os
+import time
+
+import numpy as np
+
+from fluvio_tpu.models import lookup
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+from fluvio_tpu.telemetry import TELEMETRY
+
+# records/sec delta gate; FLUVIO_TELEMETRY_GATE overrides for tuning
+GATE = float(os.environ.get("FLUVIO_TELEMETRY_GATE", "0.02"))
+N_RECORDS = 4096
+BATCHES_PER_PASS = 6
+PASSES_PER_ARM = 4
+
+
+def _headline_chain():
+    b = SmartEngine(backend="tpu").builder()
+    for name, params in (
+        ("regex-filter", {"regex": "fluvio"}),
+        ("json-map", {"field": "name"}),
+    ):
+        b.add_smart_module(SmartModuleConfig(params=params), lookup(name))
+    chain = b.initialize()
+    assert chain.backend_in_use == "tpu"
+    return chain
+
+
+def _corpus_buf():
+    rng = np.random.default_rng(2024)
+    names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "redpanda", "flink"]
+    picks = rng.integers(0, len(names), size=N_RECORDS)
+    records = [
+        Record(value=f'{{"name":"{names[picks[i]]}-{i & 1023}","n":{i}}}'.encode())
+        for i in range(N_RECORDS)
+    ]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+    return RecordBuffer.from_records(records)
+
+
+def _one_pass(executor, buf) -> float:
+    t0 = time.perf_counter()
+    for out in executor.process_stream(iter([buf] * BATCHES_PER_PASS)):
+        pass
+    return (time.perf_counter() - t0) / BATCHES_PER_PASS
+
+
+def _measure(executor, buf):
+    """Interleaved best-of per arm: [off, on] x PASSES_PER_ARM."""
+    prior = TELEMETRY.enabled
+    times = {False: [], True: []}
+    try:
+        for _ in range(PASSES_PER_ARM):
+            for enabled in (False, True):
+                TELEMETRY.enabled = enabled
+                times[enabled].append(_one_pass(executor, buf))
+    finally:
+        TELEMETRY.enabled = prior
+    return min(times[False]), min(times[True])
+
+
+def test_telemetry_overhead_under_gate():
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    buf = _corpus_buf()
+    # warm: pay the XLA compile + shape-bucket traces outside the window
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+
+    for attempt in range(3):
+        off_s, on_s = _measure(executor, buf)
+        # absolute floor: a couple of clock pairs per batch is the real
+        # instrumentation cost; a 2% gate on a noisy sub-ms pass isn't
+        overhead = max(on_s - off_s, 0.0)
+        if overhead <= off_s * GATE or overhead < 200e-6:
+            break
+    else:
+        raise AssertionError(
+            f"telemetry overhead {overhead*1e6:.0f}us/batch on a "
+            f"{off_s*1e3:.2f}ms batch exceeds the {GATE:.0%} gate "
+            f"after 3 measurement rounds"
+        )
+    rps_off = N_RECORDS / off_s
+    rps_on = N_RECORDS / on_s
+    # records/sec framing of the same gate (ISSUE acceptance criterion)
+    assert rps_on >= rps_off * (1 - GATE) or overhead < 200e-6
+
+
+def test_telemetry_disabled_skips_span_capture_entirely():
+    """The off switch must mean OFF: no spans, no histogram writes."""
+    chain = _headline_chain()
+    buf = _corpus_buf()
+    TELEMETRY.reset()
+    prior = TELEMETRY.enabled
+    TELEMETRY.enabled = False
+    try:
+        for out in chain.tpu_chain.process_stream(iter([buf] * 2)):
+            pass
+        snap = TELEMETRY.snapshot()
+        assert snap["spans_total"] == 0
+        assert snap["batches"]["fused"]["count"] == 0
+        assert not snap["phases"]
+    finally:
+        TELEMETRY.enabled = prior
+        TELEMETRY.reset()
