@@ -98,14 +98,31 @@ def render_metrics_table(data: dict) -> str:
     rows = [
         ("glz_heals", _fmt_count(counters.get("heals", 0))),
         ("stripe_fallbacks", _fmt_count(counters.get("stripe_fallbacks", 0))),
+        ("quarantined", _fmt_count(counters.get("quarantined", 0))),
     ]
     for reason, n in sorted((counters.get("spills") or {}).items()):
         rows.append((f"spill[{reason}]", _fmt_count(n)))
     for reason, n in sorted((counters.get("declines") or {}).items()):
         rows.append((f"decline[{reason}]", _fmt_count(n)))
+    for point, n in sorted((counters.get("retries") or {}).items()):
+        rows.append((f"retry[{point}]", _fmt_count(n)))
+    breaker = counters.get("breaker") or {}
+    rows.append(
+        ("breaker_short_circuits",
+         _fmt_count(breaker.get("short_circuits", 0)))
+    )
+    for state, n in sorted((breaker.get("transitions") or {}).items()):
+        rows.append((f"breaker_to[{state}]", _fmt_count(n)))
     sections.append(
         "pipeline events\n" + _rows_to_table(rows, header=("event", "count"))
     )
+
+    states = breaker.get("states") or {}
+    if states:
+        rows = [(name, state) for name, state in sorted(states.items())]
+        sections.append(
+            "breaker state\n" + _rows_to_table(rows, header=("chain", "state"))
+        )
 
     batches = tel.get("batches") or {}
     rows = []

@@ -25,6 +25,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fluvio_tpu.parallel.mesh import RECORD_AXIS, make_record_mesh
+from fluvio_tpu.resilience import faults
 from fluvio_tpu.telemetry import TELEMETRY
 from fluvio_tpu.smartengine.tpu import executor as kernels_executor
 from fluvio_tpu.smartengine.tpu import kernels, stripes
@@ -461,6 +462,7 @@ class ShardedChainExecutor:
         # instead of a second span that would be discarded
         span = reuse_span if reuse_span is not None else TELEMETRY.begin_batch()
         t_ph = time.perf_counter() if span is not None else 0.0
+        faults.maybe_fire("stage")
         uploads, cfg, nbytes = self._stage_ragged(buf)
         if span is not None:
             now = time.perf_counter()
@@ -480,7 +482,7 @@ class ShardedChainExecutor:
                     reason="record-too-wide-unstripeable",
                 )
             cfg = cfg + (self._stripe_rows_shard(buf),)
-        ex.h2d_bytes_total += nbytes
+        faults.maybe_fire("h2d")
         sharded = {
             k: jax.device_put(
                 v,
@@ -495,6 +497,7 @@ class ShardedChainExecutor:
             span.add("h2d", now - t_ph)
             t_ph = now
         fn = self._jitted(sharded, cfg)
+        faults.maybe_fire("dispatch")
         prev_carries = self._pending_carries
         header, packed, new_carries = fn(
             sharded,
@@ -505,6 +508,9 @@ class ShardedChainExecutor:
         if span is not None:
             span.add("dispatch", time.perf_counter() - t_ph)
             span.mark_dispatched()
+        # byte accounting only after the dispatch commits: a retried
+        # attempt that failed mid-staging must not double-count the link
+        ex.h2d_bytes_total += nbytes
         if ex.agg_configs:
             # carries chain through device futures at dispatch time so
             # streams pipeline; the host mirror commits at finish
@@ -551,6 +557,8 @@ class ShardedChainExecutor:
         t_f0 = time.perf_counter() if span is not None else 0.0
         d2h0 = span.phase("d2h") if span is not None else 0.0
         ex = self.executor
+        # device-side failures surface at the first blocking sync
+        faults.maybe_fire("device")
         hdrs = np.asarray(jax.device_get(header))  # (n_shards, 5)
         if span is not None:
             span.mark_device_ready()

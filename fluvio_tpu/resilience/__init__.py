@@ -1,0 +1,38 @@
+"""Resilience layer: fault injection, recovery policy, dead-lettering.
+
+Three pieces, consumed by the executor/engine/broker seams:
+
+- :mod:`fluvio_tpu.resilience.faults` — the process-global fault-point
+  registry (``FLUVIO_FAULTS`` / :func:`faults.inject`) whose
+  :func:`faults.maybe_fire` calls are threaded through every failure
+  seam the recovery layer guards,
+- :mod:`fluvio_tpu.resilience.policy` — transient/deterministic fault
+  classification, bounded retry with exponential backoff + jitter, and
+  the per-chain circuit breaker (fused -> interpreter demotion with
+  half-open probe re-promotion),
+- :mod:`fluvio_tpu.resilience.deadletter` — the bounded on-disk
+  quarantine for batches that fail both execution paths.
+"""
+
+from fluvio_tpu.resilience.faults import (  # noqa: F401
+    FAULT_POINTS,
+    FAULTS,
+    FaultRegistry,
+    InjectedFault,
+    maybe_fire,
+)
+from fluvio_tpu.resilience.policy import (  # noqa: F401
+    CLOSED,
+    DETERMINISTIC,
+    HALF_OPEN,
+    OPEN,
+    TRANSIENT,
+    CircuitBreaker,
+    RetryPolicy,
+    classify,
+)
+from fluvio_tpu.resilience.deadletter import (  # noqa: F401
+    deadletter_dir,
+    load_entry,
+    quarantine_batch,
+)

@@ -1,0 +1,185 @@
+"""Poison-batch quarantine: the bounded on-disk dead-letter directory.
+
+A batch that fails the fused path (post-retries) AND the interpreter
+re-run is poison — no execution mode can process it. Crashing the stream
+on it hands an attacker (or one corrupt record) a denial of service;
+silently dropping it loses data with no trace. The quarantine takes the
+third path: the batch is dumped — replayable chain spec + records +
+both errors — into a bounded dead-letter directory, the counter ticks,
+and the stream advances.
+
+Entry layout (one JSON file per batch, ``dl-<ms>-<seq>.json``)::
+
+    {
+      "ts_ms": 1722672000000,
+      "chain": [{"name", "params", "kind", "source"?}, ...],
+      "errors": {"fused": "...", "interpreter": "..."},
+      "batch": {
+        "base_offset": 0, "base_timestamp": -1,
+        "records": [{"value": <b64>, "key": <b64>|null,
+                     "offset_delta": 0, "timestamp_delta": 0}, ...]
+      }
+    }
+
+Bounded: at most ``FLUVIO_DEADLETTER_MAX`` (default 64) entries; the
+oldest are evicted first. ``FLUVIO_DEADLETTER_DIR`` sets the directory
+(default ``/tmp/fluvio-tpu-deadletter``); an unwritable directory
+degrades to counting-only — quarantine must never crash the stream it
+exists to protect.
+
+`load_entry` rebuilds the `SmartModuleInput` (and returns the chain
+spec) so an operator — or the chaos suite — can replay a quarantined
+batch after a fix.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_DEADLETTER_DIR = "/tmp/fluvio-tpu-deadletter"
+
+
+def deadletter_dir(override: Optional[str] = None) -> str:
+    if override:
+        return override
+    return os.environ.get("FLUVIO_DEADLETTER_DIR", DEFAULT_DEADLETTER_DIR)
+
+
+def deadletter_max(override: Optional[int] = None) -> int:
+    if override is not None:
+        return override
+    return int(os.environ.get("FLUVIO_DEADLETTER_MAX", "64"))
+
+
+_SEQ = [0]
+
+
+def _b64(data: Optional[bytes]) -> Optional[str]:
+    if data is None:
+        return None
+    return base64.b64encode(bytes(data)).decode("ascii")
+
+
+def _unb64(data: Optional[str]) -> Optional[bytes]:
+    if data is None:
+        return None
+    return base64.b64decode(data)
+
+
+def _entry_paths(path: str) -> List[str]:
+    try:
+        names = sorted(
+            n for n in os.listdir(path)
+            if n.startswith("dl-") and n.endswith(".json")
+        )
+    except OSError:
+        return []
+    return [os.path.join(path, n) for n in names]
+
+
+def quarantine_batch(
+    chain_spec: List[dict],
+    inp,
+    fused_error: BaseException,
+    interp_error: BaseException,
+    directory: Optional[str] = None,
+    max_entries: Optional[int] = None,
+) -> Optional[str]:
+    """Write one dead-letter entry; returns its path (None when the
+    directory is unwritable — the caller still counts the quarantine)."""
+    path = deadletter_dir(directory)
+    limit = deadletter_max(max_entries)
+    try:
+        return _write_entry(chain_spec, inp, fused_error, interp_error,
+                            path, limit)
+    except Exception as e:  # noqa: BLE001 — never crash the stream this
+        # path exists to protect: an unserializable chain spec or any
+        # filesystem surprise degrades to counting-only
+        logger.error("dead-letter write failed (%s): %s", path, e)
+        return None
+
+
+def _write_entry(
+    chain_spec, inp, fused_error, interp_error, path: str, limit: int
+) -> str:
+    try:
+        records = inp.into_records()
+    except Exception:  # noqa: BLE001 — a poison batch may not even decode
+        records = []
+    entry = {
+        "ts_ms": int(time.time() * 1000),
+        "chain": chain_spec,
+        "errors": {
+            "fused": f"{type(fused_error).__name__}: {fused_error}",
+            "interpreter": f"{type(interp_error).__name__}: {interp_error}",
+        },
+        "batch": {
+            "base_offset": int(getattr(inp, "base_offset", 0)),
+            "base_timestamp": int(getattr(inp, "base_timestamp", -1)),
+            "records": [
+                {
+                    "value": _b64(r.value),
+                    "key": _b64(r.key),
+                    "offset_delta": int(r.offset_delta),
+                    "timestamp_delta": int(r.timestamp_delta),
+                }
+                for r in records
+            ],
+        },
+    }
+    _SEQ[0] += 1
+    name = f"dl-{entry['ts_ms']:013d}-{_SEQ[0]:06d}.json"
+    os.makedirs(path, exist_ok=True)
+    # evict oldest first so the directory stays bounded even when a
+    # poison storm outpaces any operator
+    existing = _entry_paths(path)
+    while len(existing) >= max(limit, 1):
+        victim = existing.pop(0)
+        try:
+            os.remove(victim)
+        except OSError:  # pragma: no cover — concurrent eviction
+            pass
+    full = os.path.join(path, name)
+    tmp = full + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            # default=repr: a chain spec carrying a non-JSON param value
+            # must degrade to its repr, not abort the quarantine
+            json.dump(entry, f, indent=1, default=repr)
+        os.replace(tmp, full)
+    finally:
+        if os.path.exists(tmp):  # a failed dump must not leave debris
+            os.remove(tmp)
+    return full
+
+
+def load_entry(path: str) -> Tuple[List[dict], "object"]:
+    """Rebuild (chain_spec, SmartModuleInput) from a dead-letter entry."""
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule.types import SmartModuleInput
+
+    with open(path, "r", encoding="utf-8") as f:
+        entry = json.load(f)
+    batch = entry.get("batch") or {}
+    records = []
+    for r in batch.get("records") or []:
+        rec = Record(
+            value=_unb64(r.get("value")) or b"",
+            key=_unb64(r.get("key")),
+            offset_delta=int(r.get("offset_delta", 0)),
+            timestamp_delta=int(r.get("timestamp_delta", 0)),
+        )
+        records.append(rec)
+    inp = SmartModuleInput.from_records(
+        records,
+        base_offset=int(batch.get("base_offset", 0)),
+        base_timestamp=int(batch.get("base_timestamp", -1)),
+    )
+    return entry.get("chain") or [], inp

@@ -169,11 +169,29 @@ class SmartModuleChainBuilder:
                     "backend='native' requires every module in the chain to "
                     "carry a DSL program (or no C++ toolchain is available)"
                 )
+        # replayable chain identity for the dead-letter quarantine: the
+        # module names/kinds/params (and aggregate seeds) are enough to
+        # rebuild the chain from the local store or the models registry
+        import base64
+
+        chain_spec = []
+        for entry in self.entries:
+            spec = {
+                "name": entry.module.name,
+                "kind": entry.module.transform_kind().value,
+                "params": dict(entry.config.params or {}),
+            }
+            if entry.config.initial_data:
+                spec["initial"] = base64.b64encode(
+                    bytes(entry.config.initial_data)
+                ).decode("ascii")
+            chain_spec.append(spec)
         return SmartModuleChainInstance(
             engine=engine,
             instances=instances,
             tpu_chain=tpu_chain,
             native_chain=native_chain,
+            chain_spec=chain_spec,
         )
 
 
@@ -186,15 +204,28 @@ class SmartModuleChainInstance:
         instances: List[PythonInstance],
         tpu_chain=None,
         native_chain=None,
+        chain_spec=None,
     ):
         self.engine = engine
         self.instances = instances
         self.tpu_chain = tpu_chain
         self.native_chain = native_chain
+        self.chain_spec = chain_spec or []
         # set when a fuel trap abandoned a hook thread (metering.py):
         # the chain fails fast with this error instead of re-entering
         # user code whose previous invocation is still running
         self._poisoned = None
+        # per-chain circuit breaker (resilience/policy.py): M fused
+        # failures in a window demote the chain to the interpreter path
+        # outright; probe batches re-promote it after the cooldown. Only
+        # chains with a fused path have anything to break.
+        self.breaker = None
+        self._spill_retry = None
+        if tpu_chain is not None:
+            from fluvio_tpu.resilience.policy import CircuitBreaker, RetryPolicy
+
+            self.breaker = CircuitBreaker()
+            self._spill_retry = RetryPolicy()
 
     def __len__(self) -> int:
         return len(self.instances)
@@ -220,7 +251,18 @@ class SmartModuleChainInstance:
 
         if self.tpu_chain is not None:
             from fluvio_tpu.smartengine.tpu.executor import TpuSpill
+            from fluvio_tpu.telemetry import TELEMETRY
 
+            fused_error = None
+            breaker_failure = False
+            if self.breaker is not None and not self.breaker.allow_fused():
+                # breaker open: no fused attempt at all — the stream
+                # runs interpreted (through the SAME rerun ladder as a
+                # spill: spill_rerun seam, transient retry, quarantine)
+                # until the cooldown half-opens it
+                TELEMETRY.add_breaker_short_circuit()
+                fused_error = RuntimeError("fused path skipped: breaker open")
+                return self._spill_rerun(inp, metrics, fused_error)
             try:
                 output = self.tpu_chain.process(inp, metrics)
             except TpuSpill as e:
@@ -229,12 +271,35 @@ class SmartModuleChainInstance:
                 # batch for exact first-error semantics (device carries
                 # were restored, and are re-mirrored from the instances
                 # after the rerun)
-                from fluvio_tpu.telemetry import TELEMETRY
-
+                # NOT a breaker failure: spills are expected, often
+                # data-dependent demotions (a record that errors under
+                # exact semantics, a too-wide batch) — device health is
+                # what the breaker guards, and tripping it on data would
+                # demote CLEAN batches to interpreter speed
                 TELEMETRY.add_spill(getattr(e, "reason", "transform-error"))
-                return self._process_instances(inp, metrics, spilled=True)
-            metrics.add_records_out(len(output.successes))
-            return output
+                fused_error = e
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # non-spill fused failure (deterministic fault, or a
+                # transient one that exhausted its retry budget): same
+                # demotion as a spill — the executor restored the carry
+                # snapshot before re-raising, so the rerun is exact
+                logger.warning(
+                    "fused path failed (%s: %s); interpreter re-run",
+                    type(e).__name__, e,
+                )
+                TELEMETRY.add_spill("fused-error")
+                fused_error = e
+                breaker_failure = True
+            if fused_error is None:
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                metrics.add_records_out(len(output.successes))
+                return output
+            if self.breaker is not None and breaker_failure:
+                self.breaker.record_failure()
+            return self._spill_rerun(inp, metrics, fused_error)
 
         if self.native_chain is not None:
             output = self.native_chain.process(inp, metrics)
@@ -246,6 +311,85 @@ class SmartModuleChainInstance:
             return SmartModuleOutput.new(inp.into_records())
 
         return self._process_instances(inp, metrics)
+
+    def _spill_rerun(
+        self,
+        inp: SmartModuleInput,
+        metrics: SmartModuleChainMetrics,
+        fused_error: BaseException,
+    ) -> SmartModuleOutput:
+        """The interpreter rerun ladder every fused-path demotion takes
+        (spill, non-spill fused failure, open breaker): rerun with
+        bounded transient retry — a one-off host failure must not
+        condemn the batch as poison — then quarantine. Instance state is
+        exactly (accumulator, window_start) per module, so a snapshot
+        makes every attempt start from the same aggregates, and a
+        quarantined batch contributes nothing to them."""
+        from fluvio_tpu.telemetry import TELEMETRY
+
+        policy = self._spill_retry
+        snapshot = [
+            (i.accumulator, i._window_start) for i in self.instances
+        ]
+        attempt = 0
+        while True:
+            try:
+                return self._process_instances(inp, metrics, spilled=True)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as interp_error:
+                # every exit from a failed rerun restores the snapshot:
+                # a half-advanced accumulator must leak neither into the
+                # next attempt nor — via the quarantine's state re-sync
+                # — into the device carries of a batch the stream
+                # reports as empty
+                self._restore_instances(snapshot)
+                if policy.should_retry(interp_error, attempt):
+                    TELEMETRY.add_retry("spill_rerun")
+                    policy.sleep(attempt)
+                    attempt += 1
+                    continue
+                # poison: BOTH execution paths failed — dead-letter the
+                # batch and advance the stream instead of crashing it
+                return self._quarantine(inp, fused_error, interp_error)
+
+    def _restore_instances(self, snapshot) -> None:
+        """Roll per-instance aggregate state — exactly (accumulator,
+        window_start) — back to a pre-rerun snapshot."""
+        for inst, (acc, win) in zip(self.instances, snapshot):
+            inst.accumulator = acc
+            inst._window_start = win
+
+    def _quarantine(
+        self,
+        inp: SmartModuleInput,
+        fused_error: BaseException,
+        interp_error: BaseException,
+    ) -> SmartModuleOutput:
+        """Poison-batch handling: both execution paths failed.
+
+        The batch is dumped — replayable chain spec + records + both
+        errors — into the bounded dead-letter directory, the counter
+        ticks, and an EMPTY output (no error) lets the stream advance.
+        The python instances (already rolled back to their pre-batch
+        snapshot by the caller) are re-asserted as the authoritative
+        state, so a quarantined batch contributes NOTHING to aggregate
+        carries — replaying its dead-letter entry later cannot
+        double-count."""
+        from fluvio_tpu.resilience.deadletter import quarantine_batch
+        from fluvio_tpu.telemetry import TELEMETRY
+
+        path = quarantine_batch(
+            self.chain_spec, inp, fused_error, interp_error
+        )
+        TELEMETRY.add_quarantine()
+        logger.error(
+            "poison batch quarantined to %s (fused: %s; interpreter: %s)",
+            path or "<dead-letter dir unwritable>", fused_error, interp_error,
+        )
+        if self.tpu_chain is not None:
+            self.tpu_chain.sync_state_from(self.instances)
+        return SmartModuleOutput()
 
     def _process_instances(
         self,
@@ -267,7 +411,12 @@ class SmartModuleChainInstance:
         the ``spill`` phase so fused-vs-interpreter time is comparable
         per batch."""
         from fluvio_tpu.telemetry import TELEMETRY
+        from fluvio_tpu.resilience import faults
 
+        if spilled:
+            # the spill-rerun seam: a batch whose interpreter re-run
+            # also fails is poison — process() quarantines it
+            faults.maybe_fire("spill_rerun")
         span = TELEMETRY.begin_batch(path="interpreter")
         from fluvio_tpu.smartengine.metering import (
             SmartModuleFuelError,

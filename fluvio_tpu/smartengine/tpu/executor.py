@@ -31,6 +31,8 @@ import numpy as np
 from jax import lax
 
 from fluvio_tpu.telemetry import TELEMETRY
+from fluvio_tpu.resilience import faults
+from fluvio_tpu.resilience.policy import RetryPolicy
 
 from fluvio_tpu.protocol.record import Record
 from fluvio_tpu.smartmodule import dsl
@@ -545,6 +547,10 @@ class TpuChainExecutor:
         # on CPU and on the real chip.
         self.h2d_bytes_total = 0
         self.d2h_bytes_total = 0
+        # recovery policy (resilience/policy.py): transient device/link
+        # failures retry against the handle's carry snapshot; budgets
+        # come from the FLUVIO_RETRY_* env knobs at construction
+        self._retry_policy = RetryPolicy()
         # glz link compression (smartengine/tpu/glz.py): record bytes
         # cross the H2D link compressed and inflate ON DEVICE in the
         # same jit as the chain; tests opt in explicitly with
@@ -1063,11 +1069,13 @@ class TpuChainExecutor:
                 reason="record-too-wide-unstripeable",
             )
         t_ph = time.perf_counter() if span is not None else 0.0
+        faults.maybe_fire("stage")
         flat, bucket = self._flat_and_bucket(buf)
         if span is not None:
             now = time.perf_counter()
             span.add("stage", now - t_ph)
             t_ph = now
+        faults.maybe_fire("h2d")
         flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes, flat_h2d = (
             self._stage_flat(buf, flat, bucket)
         )
@@ -1082,6 +1090,11 @@ class TpuChainExecutor:
         ts_up = jnp.asarray(ts_np) if ts_np is not None else None
 
         def _call():
+            if glz_bytes:
+                # the device-decode seam: an InjectedFault here takes the
+                # same self-heal path a real decode failure would
+                faults.maybe_fire("glz_decode")
+            faults.maybe_fire("dispatch")
             args = (
                 flat_up,
                 jnp.asarray(lengths_up),
@@ -1113,6 +1126,11 @@ class TpuChainExecutor:
         t_ph = time.perf_counter() if span is not None else 0.0
         try:
             header, packed, new_carries = _call()
+        except (KeyboardInterrupt, SystemExit):
+            # operator interrupts must unwind, never convert into a
+            # heal/spill (they are BaseException, but be explicit: no
+            # broadened rewrite of this handler may ever swallow them)
+            raise
         except Exception as e:
             if not glz_bytes:
                 raise
@@ -1326,6 +1344,11 @@ class TpuChainExecutor:
         if len(handle) < 4 or handle[3] is None:
             return
         packed, spec = handle[2], handle[3]
+        if spec.get("charged"):
+            # idempotent: the recovery ladders (retry loop, abandon,
+            # discard) may each try to charge the same handle once
+            return
+        spec["charged"] = True
         n = 64  # header + probe scalars
         view = spec.get("view")
         if view is not None:
@@ -1342,6 +1365,7 @@ class TpuChainExecutor:
         path). Accumulates: a batch whose fetch runs twice (fan-out
         capacity retry) reports its total traffic."""
         t_ph = time.perf_counter() if span is not None else 0.0
+        faults.maybe_fire("fetch")
         for s in slices:
             s.copy_to_host_async()
         host = jax.device_get(slices)
@@ -1368,6 +1392,9 @@ class TpuChainExecutor:
         """
         spec = spec or {}
         span = spec.get("span")
+        # device-side failures surface at the first blocking sync on this
+        # batch's results — the seam an armed "device" fault models
+        faults.maybe_fire("device")
         # fan-out source rows are non-decreasing after compaction, so they
         # ship as uint8 deltas + a scalar base whenever the max delta fits
         # (the probe scalars ride the header sync the fetch pays anyway) —
@@ -1765,6 +1792,145 @@ class TpuChainExecutor:
 
         self._sharded = ShardedChainExecutor(self, n_devices, devices)
 
+    # -- recovery (resilience/policy.py) -------------------------------------
+
+    def _dispatch_with_retry(self, call):
+        """Bounded transient retry of the dispatch half.
+
+        Carry-safe by construction: `_dispatch` (and the sharded
+        delegate) only commits new device carries after the jitted call
+        returns, so a staging/transfer/trace failure leaves the carry
+        chain exactly where it was — every attempt starts from the same
+        state. Deterministic faults and exhausted budgets re-raise for
+        the engine's spill/quarantine ladder."""
+        attempt = 0
+        while True:
+            try:
+                return call()
+            except (TpuSpill, KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if not self._retry_policy.should_retry(e, attempt):
+                    raise
+                point = getattr(e, "point", None) or "dispatch"
+                TELEMETRY.add_retry(point)
+                logging.getLogger(__name__).warning(
+                    "transient dispatch failure (retry %d at %s): %s",
+                    attempt + 1, point, e,
+                )
+                self._retry_policy.sleep(attempt)
+                attempt += 1
+
+    def _redispatch_refetch(self, buf: RecordBuffer, handle, span):
+        """Roll device state back to the handle's pre-dispatch carry
+        snapshot and re-run the batch end to end (the glz self-heal's
+        re-dispatch, generalized to every fetch-side recovery).
+
+        The heal-epoch bump marks every OTHER in-flight aggregate
+        dispatch stale — their carry lineage chained through the failed
+        dispatch, so their finishes must re-dispatch from the repaired
+        tip (or spill) instead of fetching diverged results; that same
+        bookkeeping is what makes a replayed batch unable to
+        double-count a carry."""
+        self._device_carries = handle[0]
+        if self.agg_configs:
+            self._heal_epoch += 1
+        header, packed = self._dispatch(
+            buf, fanout_cap=self._fanout_cap(buf), span=span
+        )
+        if span is not None:
+            span.mark_dispatched()
+        if self.agg_configs:
+            self._heal_carries = self._device_carries
+            self._heal_dispatch_seq = self._dispatch_seq
+        return self._fetch(buf, header, packed, {"span": span} if span else None)
+
+    def _finish_retry(self, buf: RecordBuffer, handle, span, exc):
+        """Bounded transient retry of the device/fetch half; carries are
+        restored before every attempt AND before any re-raise, so the
+        interpreter rerun downstream can never double-count."""
+        # the original dispatch's speculative D2H copies crossed the
+        # link but will never be fetched — charge them (idempotently) so
+        # the byte counters reflect real traffic whatever the outcome
+        self._charge_unfetched_spec(handle)
+        attempt = 0
+        while self._retry_policy.should_retry(exc, attempt):
+            point = getattr(exc, "point", None) or "fetch"
+            TELEMETRY.add_retry(point)
+            logging.getLogger(__name__).warning(
+                "transient device/fetch failure (retry %d at %s): %s",
+                attempt + 1, point, exc,
+            )
+            self._retry_policy.sleep(attempt)
+            try:
+                return self._redispatch_refetch(buf, handle, span)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except TpuSpill:
+                # transform error on the replay: restore the snapshot and
+                # hand the batch to the interpreter rerun
+                self._abandon_handle(buf, handle)
+                raise
+            except _FanoutOverflow as o:
+                # compound case (transient fault + capacity overflow in
+                # one batch): the overflow retry machinery is tuned for
+                # the main path — spill instead of compounding retries
+                self._abandon_handle(buf, handle)
+                raise TpuSpill(
+                    f"fanout overflow during retry: {o.total}",
+                    reason="fanout-overflow",
+                )
+            except Exception as e2:
+                exc = e2
+                attempt += 1
+        # deterministic fault or budget exhausted: surface the error with
+        # device state rolled back for the engine's spill/quarantine ladder
+        self._abandon_handle(buf, handle)
+        raise exc
+
+    def _abandon_handle(self, buf: RecordBuffer, handle) -> None:
+        """Restore the handle's pre-dispatch carry snapshot and mark any
+        in-flight aggregate lineage stale (shared by every finish-side
+        giving-up path)."""
+        self._charge_unfetched_spec(handle)
+        self._device_carries = handle[0]
+        if self.agg_configs:
+            self._heal_epoch += 1
+            self._heal_dispatch_seq = -1
+
+    def _finish_sharded(self, buf: RecordBuffer, handle):
+        """finish_buffer's sharded delegation with the same bounded
+        transient retry. A retry is only lineage-safe when no LATER
+        dispatch chained off this handle's carries (`_pending_carries is
+        handle[1]`); otherwise the error re-raises and the interpreter
+        rerun re-syncs authoritative state."""
+        attempt = 0
+        while True:
+            try:
+                return self._sharded.finish_buffer(buf, handle)
+            except (TpuSpill, KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                lineage_ok = (
+                    not self.agg_configs
+                    or self._sharded._pending_carries is handle[1]
+                )
+                if self.agg_configs and lineage_ok:
+                    self._sharded._pending_carries = handle[0]
+                if not (lineage_ok and self._retry_policy.should_retry(e, attempt)):
+                    raise
+                point = getattr(e, "point", None) or "fetch"
+                TELEMETRY.add_retry(point)
+                logging.getLogger(__name__).warning(
+                    "transient sharded fetch failure (retry %d at %s): %s",
+                    attempt + 1, point, e,
+                )
+                self._retry_policy.sleep(attempt)
+                attempt += 1
+                handle = self._sharded.dispatch_buffer(
+                    buf, reuse_span=handle[5]
+                )
+
     def dispatch_buffer(self, buf: RecordBuffer):
         """Phase 1: stage + dispatch without blocking on results.
 
@@ -1775,11 +1941,20 @@ class TpuChainExecutor:
         socket.
         """
         if self._sharded is not None:
-            return self._sharded.dispatch_buffer(buf)
+            # one span threads through every retry attempt (the fan-out
+            # retry convention: phase time accumulates onto the batch's
+            # single span — the batch really paid staging twice — and a
+            # failed attempt's span is never orphaned)
+            sh_span = TELEMETRY.begin_batch()
+            return self._dispatch_with_retry(
+                lambda: self._sharded.dispatch_buffer(buf, reuse_span=sh_span)
+            )
         span = TELEMETRY.begin_batch()
         prev_carries = self._device_carries
-        header, packed = self._dispatch(
-            buf, fanout_cap=self._fanout_cap(buf), span=span
+        header, packed = self._dispatch_with_retry(
+            lambda: self._dispatch(
+                buf, fanout_cap=self._fanout_cap(buf), span=span
+            )
         )
         t_ph = time.perf_counter() if span is not None else 0.0
         spec = self._start_result_copies(buf, header, packed)
@@ -1808,17 +1983,29 @@ class TpuChainExecutor:
         inlines the same pattern around its yields."""
         out = []
         fut = None
-        for i, buf in enumerate(bufs):
+        try:
+            for i, buf in enumerate(bufs):
+                if fut is not None:
+                    fut.result()
+                    fut = None
+                if (
+                    i + 1 < len(bufs)
+                    and self._link_compress
+                    and self._sharded is None
+                ):
+                    fut = _compress_pool().submit(
+                        self._precompress, bufs[i + 1]
+                    )
+                out.append((buf, self.dispatch_buffer(buf)))
+        except BaseException:
+            # a mid-list dispatch failure (post-retries) must not leak
+            # the earlier chunks' in-flight handles: discard them so
+            # carries and byte accounting stay coherent for the rerun
             if fut is not None:
-                fut.result()
-                fut = None
-            if (
-                i + 1 < len(bufs)
-                and self._link_compress
-                and self._sharded is None
-            ):
-                fut = _compress_pool().submit(self._precompress, bufs[i + 1])
-            out.append((buf, self.dispatch_buffer(buf)))
+                fut.cancel()
+            for _, h in reversed(out):
+                self.discard_dispatch(h)
+            raise
         return out
 
     def _start_result_copies(self, buf: RecordBuffer, header, packed) -> Dict:
@@ -1904,7 +2091,7 @@ class TpuChainExecutor:
         exact error semantics.
         """
         if self._sharded is not None:
-            return self._sharded.finish_buffer(buf, handle)
+            return self._finish_sharded(buf, handle)
         prev_carries, header, packed, spec = handle
         if (
             self.agg_configs
@@ -1938,42 +2125,41 @@ class TpuChainExecutor:
             self._charge_unfetched_spec(handle)
             self._device_carries = prev_carries
             raise
+        except (KeyboardInterrupt, SystemExit):
+            raise
         except Exception as e:
-            # async half of the glz self-heal (_dispatch catches trace/
-            # compile errors; device RUNTIME failures surface here when
-            # results are consumed): disable compression, roll carries
-            # back, re-run the batch raw. Unrelated failures re-raise
-            # from the raw retry. Gated on THIS batch's own glz_used —
-            # not the executor-wide latch: under the pipelined loop,
-            # batch k's heal latches compression off while batch k+1
-            # (already dispatched compressed) is still in flight, and
-            # k+1 must heal too instead of re-raising.
-            if not (spec and spec.get("glz_used")):
-                raise
-            logging.getLogger(__name__).warning(
-                "glz decode failed at fetch; link compression disabled: %s", e
-            )
-            TELEMETRY.add_heal()
-            self._link_compress = False
-            buf._glz_cache = None
-            self._device_carries = prev_carries
-            if self.agg_configs:
-                # every aggregate dispatch in flight chained its device
-                # carries off the failed decode: mark their lineage stale
-                # so their finish re-dispatches (or spills) instead of
-                # silently fetching diverged results
-                self._heal_epoch += 1
-            header, packed = self._dispatch(
-                buf, fanout_cap=self._fanout_cap(buf), span=span
-            )
-            if span is not None:
-                span.mark_dispatched()
-            if self.agg_configs:
-                self._heal_carries = self._device_carries
-                self._heal_dispatch_seq = self._dispatch_seq
-            out = self._fetch(
-                buf, header, packed, {"span": span} if span else None
-            )
+            if spec and spec.get("glz_used"):
+                # async half of the glz self-heal (_dispatch catches
+                # trace/compile errors; device RUNTIME failures surface
+                # here when results are consumed): disable compression,
+                # roll carries back, re-run the batch raw (the shared
+                # recovery re-dispatch — `_redispatch_refetch` — owns the
+                # carry snapshot + heal-epoch bookkeeping). Gated on THIS
+                # batch's own glz_used — not the executor-wide latch:
+                # under the pipelined loop, batch k's heal latches
+                # compression off while batch k+1 (already dispatched
+                # compressed) is still in flight, and k+1 must heal too
+                # instead of re-raising.
+                logging.getLogger(__name__).warning(
+                    "glz decode failed at fetch; link compression disabled: %s",
+                    e,
+                )
+                TELEMETRY.add_heal()
+                self._link_compress = False
+                buf._glz_cache = None
+                try:
+                    out = self._redispatch_refetch(buf, handle, span)
+                except (TpuSpill, KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e2:
+                    # the raw rerun failed too: hand off to the bounded
+                    # transient retry (unrelated deterministic failures
+                    # re-raise from there with carries restored)
+                    out = self._finish_retry(buf, handle, span, e2)
+            else:
+                # transient device/fetch failure outside glz: bounded
+                # retry against the handle's carry snapshot
+                out = self._finish_retry(buf, handle, span, e)
         if span is not None:
             # fetch = host materialization time inside this finish call:
             # total minus the device wait (up to ready_t) minus the

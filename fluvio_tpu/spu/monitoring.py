@@ -71,7 +71,12 @@ class MonitoringServer:
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        from fluvio_tpu.resilience import faults
+        from fluvio_tpu.resilience.faults import InjectedFault
+        from fluvio_tpu.telemetry import TELEMETRY
+
         try:
+            faults.maybe_fire("socket_accept")
             mode = "json"
             try:
                 line = await asyncio.wait_for(
@@ -87,8 +92,28 @@ class MonitoringServer:
                 pass
             writer.write(self._payload(mode))
             await writer.drain()
+        except (
+            ConnectionResetError,
+            BrokenPipeError,
+            ConnectionAbortedError,
+            InjectedFault,
+        ) as e:
+            # a scraper that disconnects mid-write (or an armed
+            # socket_accept fault) must never take the accept loop with
+            # it: count it and keep serving the next client
+            logger.warning("monitoring client gone mid-request: %s", e)
+            TELEMETRY.add_decline("client-gone")
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            # any other per-client failure: log with traceback, keep
+            # the endpoint alive — one bad request is not an outage
+            logger.exception("monitoring request failed")
         finally:
-            writer.close()
+            try:
+                writer.close()
+            except Exception:  # pragma: no cover — transport torn down
+                pass
 
     async def stop(self) -> None:
         if self._server is not None:

@@ -11,6 +11,7 @@ a max_bytes cutoff (batch.rs:41-140).
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import time
 from dataclasses import dataclass, field
@@ -414,10 +415,19 @@ def tpu_stage_dispatch(
     from fluvio_tpu.protocol.compression import Compression, decompress
     from fluvio_tpu.smartengine import native_backend
     from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+    from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
     tpu = getattr(chain, "tpu_chain", None)
     if tpu is None or not batches:
         return None
+    breaker = getattr(chain, "breaker", None)
+    if breaker is not None and not breaker.allow_fused():
+        # chain breaker open: no fused slice attempt — the per-record
+        # path (whose own breaker check routes each batch to the
+        # interpreter AND counts the per-batch short-circuits) serves
+        # the stream until probes re-promote; the decline reason below
+        # records the slice-level event once
+        return _decline(metrics, "breaker-open")
     t_stage0 = time.perf_counter() if TELEMETRY.enabled else 0.0
     glz_decode_s = 0.0
     staged: List[tuple] = []
@@ -557,8 +567,23 @@ def tpu_stage_dispatch(
         )
     # executor-owned dispatch: with compression on, the worker
     # glz-compresses chunk k+1 while chunk k dispatches (one-ahead);
-    # with it off this is a plain dispatch loop
-    chunks: List[tuple] = tpu.dispatch_buffers(chunk_bufs)
+    # with it off this is a plain dispatch loop. A dispatch failure that
+    # survived the executor's bounded retries (or a deterministic fault)
+    # must not crash the stream handler: the slice declines to the
+    # per-record path, whose own fused/spill/quarantine ladder decides
+    # per batch (dispatch_buffers discarded any partial handles).
+    try:
+        chunks: List[tuple] = tpu.dispatch_buffers(chunk_bufs)
+    except TpuSpill:
+        return _decline(metrics, "transform-error-spill")
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        logging.getLogger(__name__).warning(
+            "fused slice dispatch failed (%s: %s); per-record fallback",
+            type(e).__name__, e,
+        )
+        return _decline(metrics, "fused-error")
     return PendingSlice(
         batches=batches,
         chunks=chunks,
@@ -659,6 +684,19 @@ def tpu_finish(
         for _, h in pending.chunks[len(outbufs) + 1 :]:
             tpu.discard_dispatch(h)
         return _decline(metrics, "transform-error-spill")
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        # a device/fetch failure that survived the executor's bounded
+        # retries: same containment as a spill — the per-record path
+        # decides per batch (carries were rolled back by the executor)
+        for _, h in pending.chunks[len(outbufs) + 1 :]:
+            tpu.discard_dispatch(h)
+        logging.getLogger(__name__).warning(
+            "fused slice finish failed (%s: %s); per-record fallback",
+            type(e).__name__, e,
+        )
+        return _decline(metrics, "fused-error")
     outbuf = outbufs[0] if len(outbufs) == 1 else _MergedOut(outbufs)
     n_out = outbuf.count
     # survivors keep their stored offsets (deltas are already rebased to
@@ -730,6 +768,12 @@ def tpu_finish(
         metrics.add_fastpath()
     if tpu.agg_configs:
         tpu._ensure_host_state()
+    # a clean fused slice counts toward the chain breaker's health —
+    # half-open probes served through the slice path must be able to
+    # re-promote the chain, not only per-record batches
+    breaker = getattr(chain, "breaker", None)
+    if breaker is not None:
+        breaker.record_success()
     return result
 
 

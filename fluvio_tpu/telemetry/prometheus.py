@@ -79,6 +79,10 @@ def render_prometheus(
         records = dict(t.batch_records)
         heals, stripe = t.heals, t.stripe_fallbacks
         spills, declines = dict(t.spills), dict(t.declines)
+        retries, quarantined = dict(t.retries), t.quarantined
+        breaker_states = dict(t.breaker_states)
+        breaker_transitions = dict(t.breaker_transitions)
+        breaker_shorts = t.breaker_short_circuits
         interp = (t.interp_calls, t.interp_seconds, t.interp_records)
 
     _histogram(
@@ -131,6 +135,48 @@ def render_prometheus(
     )
     for reason, n in sorted(declines.items()):
         w.sample(f"{_PREFIX}_declines_total", {"reason": reason}, n)
+
+    w.header(
+        f"{_PREFIX}_retries_total",
+        "Bounded-retry attempts on the fused path, by failing seam.",
+        "counter",
+    )
+    for point, n in sorted(retries.items()):
+        w.sample(f"{_PREFIX}_retries_total", {"point": point}, n)
+
+    w.header(
+        f"{_PREFIX}_quarantined_total",
+        "Poison batches dead-lettered after failing fused and interpreter paths.",
+        "counter",
+    )
+    w.sample(f"{_PREFIX}_quarantined_total", {}, quarantined)
+
+    w.header(
+        f"{_PREFIX}_breaker_transitions_total",
+        "Circuit-breaker state transitions, by entered state.",
+        "counter",
+    )
+    for state, n in sorted(breaker_transitions.items()):
+        w.sample(f"{_PREFIX}_breaker_transitions_total", {"state": state}, n)
+
+    w.header(
+        f"{_PREFIX}_breaker_state",
+        "Current circuit-breaker state per chain (0=closed 1=half_open 2=open).",
+        "gauge",
+    )
+    for name, state in sorted(breaker_states.items()):
+        w.sample(
+            f"{_PREFIX}_breaker_state",
+            {"chain": name},
+            {"closed": 0, "half_open": 1, "open": 2}.get(state, 0),
+        )
+
+    w.header(
+        f"{_PREFIX}_breaker_short_circuits_total",
+        "Batches routed straight to the interpreter by an open breaker.",
+        "counter",
+    )
+    w.sample(f"{_PREFIX}_breaker_short_circuits_total", {}, breaker_shorts)
 
     for name, help_text, value in (
         ("interp_instance_calls_total",

@@ -50,6 +50,15 @@ class PipelineTelemetry:
         self.spills: Dict[str, int] = {}
         self.declines: Dict[str, int] = {}
         self.batch_records: Dict[str, int] = {"fused": 0, "interpreter": 0}
+        # resilience counters (PR 3): bounded-retry attempts keyed by the
+        # seam that failed, poison batches dead-lettered, and the
+        # per-chain circuit-breaker state machine (current state per
+        # breaker + transition counts + open-state short-circuits)
+        self.retries: Dict[str, int] = {}
+        self.quarantined = 0
+        self.breaker_states: Dict[str, str] = {}
+        self.breaker_transitions: Dict[str, int] = {}
+        self.breaker_short_circuits = 0
         # per-module-instance interpreter accounting (one clock pair per
         # instance per batch): lets fused-vs-interpreter cost comparisons
         # see where interpreter time concentrates without per-record work
@@ -112,6 +121,33 @@ class PipelineTelemetry:
         with self._lock:
             self.declines[reason] = self.declines.get(reason, 0) + 1
 
+    def add_retry(self, point: str) -> None:
+        with self._lock:
+            self.retries[point] = self.retries.get(point, 0) + 1
+
+    def add_quarantine(self) -> None:
+        with self._lock:
+            self.quarantined += 1
+
+    def record_breaker(self, name: str, state: str, transition: bool = True) -> None:
+        with self._lock:
+            # bounded: a broker that builds a chain (and breaker) per
+            # stream must not grow this dict forever — keep the most
+            # recently active 64 breakers (insertion order = recency
+            # here because re-registration re-inserts)
+            self.breaker_states.pop(name, None)
+            self.breaker_states[name] = state
+            while len(self.breaker_states) > 64:
+                self.breaker_states.pop(next(iter(self.breaker_states)))
+            if transition:
+                self.breaker_transitions[state] = (
+                    self.breaker_transitions.get(state, 0) + 1
+                )
+
+    def add_breaker_short_circuit(self) -> None:
+        with self._lock:
+            self.breaker_short_circuits += 1
+
     def add_interp_instance(self, seconds: float, records: int) -> None:
         with self._lock:
             self.interp_calls += 1
@@ -153,6 +189,13 @@ class PipelineTelemetry:
                     "stripe_fallbacks": self.stripe_fallbacks,
                     "spills": dict(self.spills),
                     "declines": dict(self.declines),
+                    "retries": dict(self.retries),
+                    "quarantined": self.quarantined,
+                    "breaker": {
+                        "states": dict(self.breaker_states),
+                        "transitions": dict(self.breaker_transitions),
+                        "short_circuits": self.breaker_short_circuits,
+                    },
                     "interp_instance": {
                         "calls": self.interp_calls,
                         "seconds": round(self.interp_seconds, 6),
@@ -177,6 +220,11 @@ class PipelineTelemetry:
             self.stripe_fallbacks = 0
             self.spills = {}
             self.declines = {}
+            self.retries = {}
+            self.quarantined = 0
+            self.breaker_states = {}
+            self.breaker_transitions = {}
+            self.breaker_short_circuits = 0
             self.batch_records = {"fused": 0, "interpreter": 0}
             self.interp_calls = 0
             self.interp_seconds = 0.0

@@ -103,6 +103,53 @@ def test_telemetry_overhead_under_gate():
     assert rps_on >= rps_off * (1 - GATE) or overhead < 200e-6
 
 
+def test_resilience_seam_overhead_under_gate(monkeypatch):
+    """ISSUE-3 CI satellite: the fault-injection seams (`maybe_fire`
+    calls threaded through stage/h2d/dispatch/device/fetch) must cost
+    <1% rps when nothing is armed. Measured by interleaving the real
+    unarmed seam against a no-op'd one, same methodology as the
+    telemetry gate above (best-of-N, absolute floor, re-measure)."""
+    from fluvio_tpu.resilience import faults
+
+    gate = float(os.environ.get("FLUVIO_RESILIENCE_GATE", "0.01"))
+    assert not faults.FAULTS.armed, "suite must measure the unarmed path"
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    buf = _corpus_buf()
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+
+    real_fire = faults.maybe_fire
+
+    def _measure_seams():
+        times = {"noop": [], "seams": []}
+        for _ in range(PASSES_PER_ARM):
+            for arm in ("noop", "seams"):
+                monkeypatch.setattr(
+                    faults,
+                    "maybe_fire",
+                    (lambda point: None) if arm == "noop" else real_fire,
+                )
+                times[arm].append(_one_pass(executor, buf))
+        monkeypatch.setattr(faults, "maybe_fire", real_fire)
+        return min(times["noop"]), min(times["seams"])
+
+    for attempt in range(3):
+        noop_s, seams_s = _measure_seams()
+        overhead = max(seams_s - noop_s, 0.0)
+        if overhead <= noop_s * gate or overhead < 200e-6:
+            break
+    else:
+        raise AssertionError(
+            f"resilience seams cost {overhead*1e6:.0f}us/batch on a "
+            f"{noop_s*1e3:.2f}ms batch — exceeds the {gate:.0%} gate "
+            f"after 3 measurement rounds"
+        )
+    rps_noop = N_RECORDS / noop_s
+    rps_seams = N_RECORDS / seams_s
+    assert rps_seams >= rps_noop * (1 - gate) or overhead < 200e-6
+
+
 def test_telemetry_disabled_skips_span_capture_entirely():
     """The off switch must mean OFF: no spans, no histogram writes."""
     chain = _headline_chain()
