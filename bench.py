@@ -184,6 +184,10 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     from fluvio_tpu.telemetry import TELEMETRY
 
     executor = chain.tpu_chain
+    # path honesty: diff the telemetry per-path record counters around
+    # the run so each config reports the path it ACTUALLY executed
+    # (fused / striped / interpreter) instead of a static label
+    pr0 = TELEMETRY.path_records()
     t0 = time.time()
     out = executor.process_buffer(buf)
     first_call = time.time() - t0
@@ -223,7 +227,11 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     # bandwidth wanders, so report every pass and take the median across
     # passes rather than trusting one number
     times = []
-    hist0 = TELEMETRY.batch_hist_copy()
+    # e2e latency baselines for EVERY path family: a striped (or
+    # spilled) config records into its own histogram, and reading only
+    # "fused" would silently drop its p50/p99 from the breakdown
+    e2e_paths = ("fused", "striped", "interpreter")
+    hist0 = {p: TELEMETRY.batch_hist_copy(p) for p in e2e_paths}
     for p in range(passes):
         if times and deadline and time.time() > deadline:
             # a degraded tunnel stretches each pass unboundedly; once one
@@ -235,10 +243,23 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
             pass
         times.append((time.time() - t0) / runs)
         log(f"  pass {p}: pipelined {times[-1]*1000:.0f}ms/batch")
-    phases = _phase_breakdown(
-        single, phase_ms, TELEMETRY.batch_hist_copy().diff(hist0)
-    )
-    return out, times, first_call, link_mb, phases
+    e2e_hist = None
+    for p in e2e_paths:
+        d = TELEMETRY.batch_hist_copy(p).diff(hist0[p])
+        e2e_hist = d if e2e_hist is None else e2e_hist.merge(d)
+    phases = _phase_breakdown(single, phase_ms, e2e_hist)
+    deltas = {
+        k: v - pr0.get(k, 0)
+        for k, v in TELEMETRY.path_records().items()
+        if v - pr0.get(k, 0) > 0
+    }
+    # no counter movement (FLUVIO_TELEMETRY=0) must stay "unknown", not
+    # masquerade as fused — that would be the static label all over again
+    path_info = {
+        "path": max(deltas, key=deltas.get) if deltas else "unknown",
+        "records": deltas,
+    }
+    return out, times, first_call, link_mb, phases, path_info
 
 
 def _phase_breakdown(single_s: float, phase_ms: dict, e2e_hist) -> dict:
@@ -396,7 +417,7 @@ def _run_config(
     verify_outputs(cfg["specs"], values, ts, min(n, 512))
     chain = build_chain("tpu", cfg["specs"])
     assert chain.backend_in_use == "tpu", name
-    out, times, first_call, link_mb, phases = bench_tpu(
+    out, times, first_call, link_mb, phases, path_info = bench_tpu(
         chain, buf, runs, passes, deadline
     )
     staging_ab = None
@@ -420,7 +441,7 @@ def _run_config(
             os.environ["FLUVIO_LINK_COMPRESS"] = "off"
             try:
                 chain_b = build_chain("tpu", cfg["specs"])
-                out_b, times_b, first_b, link_b, phases_b = bench_tpu(
+                out_b, times_b, first_b, link_b, phases_b, path_b = bench_tpu(
                     chain_b, buf, runs, passes, deadline
                 )
             except Exception as e:  # noqa: BLE001 — optional re-measure
@@ -434,8 +455,8 @@ def _run_config(
                 }
                 if statistics.median(times_b) < statistics.median(times):
                     staging_ab["chosen"] = "raw"
-                    out, times, first_call, link_mb, phases = (
-                        out_b, times_b, first_b, link_b, phases_b,
+                    out, times, first_call, link_mb, phases, path_info = (
+                        out_b, times_b, first_b, link_b, phases_b, path_b,
                     )
                     chain = chain_b
                 else:
@@ -489,6 +510,11 @@ def _run_config(
         # per-phase breakdown (telemetry subsystem): serial-pass wall +
         # phase attribution + pipelined p50/p99 end-to-end
         "phases": phases,
+        # the ACTUALLY executed path (from telemetry counters, not a
+        # static label): fused / striped / interpreter, plus the raw
+        # per-path record deltas for mixed runs
+        "path": path_info["path"],
+        "path_records": path_info["records"],
     }
     if staging_ab:
         result["staging_ab"] = staging_ab
@@ -795,14 +821,21 @@ def _compact_configs(configs: dict) -> dict:
     for name, c in configs.items():
         if not isinstance(c, dict):
             continue
+        if name == "codecs":
+            # aux section: whole-block detail (including its error form)
+            # stays in BENCH_DETAIL.json — round 5's line overgrew the
+            # driver window carrying it
+            continue
         if "records_per_sec" in c:
             e = {"rps": c["records_per_sec"]}
             if c.get("vs_baseline") is not None:
                 e["x"] = c["vs_baseline"]
             if "vs_engine_only" in c:
                 e["x_engine"] = c["vs_engine_only"]
-            if "fallback" in c:
-                e["fallback"] = c["fallback"]
+            if c.get("path") and c["path"] != "fused":
+                # the executed-path tag (from telemetry counters); fused
+                # is the default and stays implicit to keep the line lean
+                e["path"] = c["path"]
             out[name] = e
         elif "error" in c:
             out[name] = {"error": str(c["error"])[:80]}

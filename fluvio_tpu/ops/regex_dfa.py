@@ -25,6 +25,7 @@ state accepts" after scanning len(record)+1 symbols.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
@@ -547,6 +548,16 @@ def compile_regex(pattern: str) -> CompiledDfa:
         start=0,
         pattern=pattern,
     )
+
+
+# process-wide compiled-table cache: chains rebuild per consumer session
+# (and the striped lowering re-lowers the same programs the narrow build
+# already compiled); subset construction is pure-Python and worth
+# skipping on a re-chain. Tables are immutable once built, so sharing
+# one CompiledDfa across executors is safe; lru_cache is thread-safe,
+# bounds the table count, and does not cache the UnsupportedRegex that
+# callers treat as control flow.
+compile_regex_cached = functools.lru_cache(maxsize=256)(compile_regex)
 
 
 def literal_of(pattern: str):

@@ -100,8 +100,10 @@ class ShardedChainExecutor:
         shard ROW boundaries (whole records per shard). The segment axis
         is the record axis, so the survivor mask, aggregate columns, and
         cross-shard carry collectives are the narrow sharded path's,
-        unchanged."""
-        (_width, kwidth, has_keys, has_offsets, ts_mode, _cap, srows) = cfg
+        unchanged. Span chains (striped JsonGet map) additionally ship
+        per-shard compacted view descriptors; ``kmax`` bounds their
+        cross-stripe carry's outer scan."""
+        (_width, kwidth, has_keys, has_offsets, ts_mode, _cap, srows, kmax) = cfg
         ex = self.executor
         s, v = ex._stripe_s, ex._stripe_v
         lengths = uploads["lengths"].astype(jnp.int32)
@@ -126,8 +128,11 @@ class ShardedChainExecutor:
             "timestamp_deltas": timestamp_deltas,
         }
         seg_state = stripes.seg_state_of(plan, sv, lengths, arrays, s)
-        ctx = {"sv": sv, "plan": plan, "seg_state": seg_state, "n": n_local}
-        valid, seg_state, carries, _fan = ex._striped.run(
+        ctx = {
+            "sv": sv, "plan": plan, "seg_state": seg_state, "n": n_local,
+            "kmax": kmax,
+        }
+        valid, seg_state, carries, _fan, vspan = ex._striped.run(
             ctx, live, carries, base_ts,
             {"fanout_cap": None, "axis_name": RECORD_AXIS, "g0": g0},
         )
@@ -155,6 +160,17 @@ class ShardedChainExecutor:
             if windowed:
                 packed["agg_win"] = compacted[1]
             return header(jnp.int32(0)), packed, carries
+        if vspan is not None:
+            # span-view chain: survivors are sub-record views — ship the
+            # compacted per-shard descriptors (single-device packing,
+            # per shard block)
+            st, ln = vspan
+            _, compacted = kernels.compact_rows(
+                valid, st.astype(jnp.int32), ln.astype(jnp.int32)
+            )
+            packed["span_start"] = compacted[0]
+            packed["span_len"] = compacted[1]
+            return header(jnp.max(compacted[1])), packed, carries
         return header(jnp.max(jnp.where(valid, lengths, 0))), packed, carries
 
     def _local_step_ragged(
@@ -269,7 +285,7 @@ class ShardedChainExecutor:
         )
 
     def _jitted(self, uploads: Dict, cfg: tuple):
-        striped = len(cfg) == 7  # (..., fanout_cap, srows)
+        striped = len(cfg) == 8  # (..., fanout_cap, srows, kmax)
         key = (
             tuple(sorted((k, v.shape, str(v.dtype)) for k, v in uploads.items())),
             cfg,
@@ -314,13 +330,17 @@ class ShardedChainExecutor:
         mat = P(RECORD_AXIS, None)
         ex = self.executor
         if striped:
-            # striped chains ship the segment mask (and, for aggregate
-            # tails, the compacted int columns) — never descriptors
+            # striped chains ship the segment mask, plus the compacted
+            # int columns (aggregate tails) or view descriptors (span
+            # chains)
             out = {"mask": row}
             if ex._int_output:
                 out["agg_int"] = row
                 if bool(ex.stages[-1].window_ms):
                     out["agg_win"] = row
+            elif ex._striped_has_span():
+                out["span_start"] = row
+                out["span_len"] = row
             return out
         if ex._viewable:
             out = {"span_start": row, "span_len": row}
@@ -481,7 +501,9 @@ class ShardedChainExecutor:
                     "and the chain cannot stripe under shard_map",
                     reason="record-too-wide-unstripeable",
                 )
-            cfg = cfg + (self._stripe_rows_shard(buf),)
+            cfg = cfg + (self._stripe_rows_shard(buf), ex._stripe_kmax(buf))
+            if span is not None:
+                span.path = "striped"
         faults.maybe_fire("h2d")
         sharded = {
             k: jax.device_put(
@@ -644,9 +666,10 @@ class ShardedChainExecutor:
             return src_h, groups
 
         if ex._viewable:
-            if ex._needs_stripes(buf):
+            if ex._needs_stripes(buf) and "span_start" not in packed:
                 # striped survivors are whole records: the segment mask
-                # is the entire download; spans derive host-side
+                # is the entire download; spans derive host-side (span
+                # chains DO carry descriptors and take the branch below)
                 src, _ = _fetch_all()
                 st = np.zeros(total, dtype=np.int64)
                 ln = buf.lengths[src[:total]].astype(np.int32)

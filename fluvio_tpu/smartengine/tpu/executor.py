@@ -507,8 +507,8 @@ class TpuChainExecutor:
         self._jit_striped = jax.jit(
             self._chain_fn_striped,
             static_argnames=(
-                "srows", "kwidth", "has_keys", "has_offsets", "ts_mode",
-                "fanout_cap", "glz_bytes",
+                "srows", "kmax", "kwidth", "has_keys", "has_offsets",
+                "ts_mode", "fanout_cap", "glz_bytes",
             ),
         )
         # glz self-heal bookkeeping: a heal invalidates the device carry
@@ -919,6 +919,26 @@ class TpuChainExecutor:
         )
         return self._bucket_bytes(max(exact, 8), floor=8)
 
+    def _stripe_kmax(self, buf: RecordBuffer) -> int:
+        """Static per-record stripe-count bound for the cross-stripe
+        JsonGet carry (stripes.striped_json_span's outer trip count).
+        0 for span-free striped chains, so they keep their
+        width-independent compile key."""
+        sc = self._striped_chain()
+        if sc is None or not sc.has_span:
+            return 0
+        return int(
+            stripes.stripe_counts(
+                np.asarray([buf.width]), self._stripe_s, self._stripe_v
+            )[0]
+        )
+
+    def _striped_has_span(self) -> bool:
+        """Does the striped lowering ship view descriptors (JsonGet map)
+        instead of the whole-record mask? Routing only — callers already
+        know the batch took the striped path."""
+        return self._striped is not None and self._striped.has_span
+
     def _chain_fn_striped(
         self,
         flat,
@@ -935,6 +955,7 @@ class TpuChainExecutor:
         glz_depth=None,
         *,
         srows: int,
+        kmax: int = 0,
         kwidth: int,
         has_keys: bool,
         has_offsets: bool,
@@ -948,7 +969,10 @@ class TpuChainExecutor:
         device from the lengths. Filters reduce per segment, aggregates
         run on the segment axis (the narrow scan stages, reused), and
         outputs ship as the segment survivor bitmask / aggregate ints /
-        fan-out descriptors — the narrow fetch paths consume all three.
+        span view descriptors / fan-out descriptors — the narrow fetch
+        paths consume all four. ``kmax`` is the static per-record
+        stripe-count bound the JsonGet cross-stripe carry scans over
+        (0 when the chain has no span stage).
         """
         if glz_bytes:
             raw = glz.decompress_device(
@@ -975,8 +999,11 @@ class TpuChainExecutor:
             "timestamp_deltas": timestamp_deltas,
         }
         seg_state = stripes.seg_state_of(plan, sv, lengths, arrays, s)
-        ctx = {"sv": sv, "plan": plan, "seg_state": seg_state, "n": n}
-        valid, seg_state, carries, fan = self._striped.run(
+        ctx = {
+            "sv": sv, "plan": plan, "seg_state": seg_state, "n": n,
+            "kmax": kmax,
+        }
+        valid, seg_state, carries, fan, vspan = self._striped.run(
             ctx, live, carries, base_ts, {"fanout_cap": fanout_cap}
         )
         packed: Dict = {}
@@ -1030,6 +1057,18 @@ class TpuChainExecutor:
                 packed["agg_win"] = compacted[1]
             packed["mask"] = kernels.pack_mask(valid)
             return _header(jnp.int32(0)), packed, carries
+        if vspan is not None:
+            # span-view chain (JsonGet map): survivors are sub-record
+            # views — ship compacted (start, length) descriptors + the
+            # mask, the same packing the narrow viewable path uses
+            st, ln = vspan
+            _, compacted = kernels.compact_rows(
+                valid, st.astype(jnp.int32), ln.astype(jnp.int32)
+            )
+            packed["span_start"] = compacted[0]
+            packed["span_len"] = compacted[1]
+            packed["mask"] = kernels.pack_mask(valid)
+            return _header(jnp.max(compacted[1])), packed, carries
         # viewable (filters + postop maps): survivors are whole records,
         # so the 1-bit segment mask is the entire D2H payload
         packed["mask"] = kernels.pack_mask(valid)
@@ -1068,6 +1107,10 @@ class TpuChainExecutor:
                 "the chain is not stripeable",
                 reason="record-too-wide-unstripeable",
             )
+        if striped and span is not None:
+            # telemetry records the path the batch ACTUALLY executed:
+            # striped batches land in their own latency/record family
+            span.path = "striped"
         t_ph = time.perf_counter() if span is not None else 0.0
         faults.maybe_fire("stage")
         flat, bucket = self._flat_and_bucket(buf)
@@ -1119,7 +1162,10 @@ class TpuChainExecutor:
             )
             if striped:
                 return self._jit_striped(
-                    *args, srows=self._stripe_rows(buf), **kwargs
+                    *args,
+                    srows=self._stripe_rows(buf),
+                    kmax=self._stripe_kmax(buf),
+                    **kwargs,
                 )
             return self._jit_ragged(*args, width=buf.width, **kwargs)
 
@@ -1449,12 +1495,18 @@ class TpuChainExecutor:
 
         if self._viewable and (
             self._identity_view
-            or (self._needs_stripes(buf) and not self._fanout)
+            or (
+                self._needs_stripes(buf)
+                and not self._fanout
+                and not self._striped_has_span()
+            )
         ):
             # filter-only (and striped filter/postop chains, whose
             # survivors are whole records): the mask alone crosses the
             # link; spans are (0, input_length) for every survivor by
-            # construction and postops apply host-side
+            # construction and postops apply host-side. Striped SPAN
+            # chains (JsonGet map) fall through to the descriptor
+            # download below instead.
             rows = self._bucket_bytes(max(count, 1), 8)
             host = self._download([packed["mask"]], span)
             src = self._mask_to_src(host[0], buf)[:count]

@@ -10,6 +10,7 @@ tests assert bit-equality against those references.
 
 from __future__ import annotations
 
+import os
 from typing import Tuple
 
 import jax
@@ -62,6 +63,187 @@ def dfa_match(values: jnp.ndarray, lengths: jnp.ndarray, dfa: CompiledDfa) -> jn
 
 
 # ---------------------------------------------------------------------------
+# Associative-scan DFA engine (parallel-prefix automaton evaluation)
+# ---------------------------------------------------------------------------
+#
+# Each byte column maps to a TRANSITION VECTOR over DFA states
+# (tv[s] = next state from s on this column's symbol); vectors compose
+# under an associative operator ((b . a)[s] = b[a[s]]), so a whole
+# record's automaton run is a composition reduction — O(log L) depth via
+# `lax.associative_scan` instead of the O(L) sequential `lax.scan` above,
+# fully parallel across the record-lane axis. The trade is S x the work
+# and S x the live material, hence the state-count gate
+# (FLUVIO_DFA_ASSOC_MAX_STATES) and the column blocking below. The same
+# composition is what a stripe-boundary carry needs: stripes.py composes
+# per-stripe-row vectors across a segment's rows to chain DFA state
+# across stripes.
+
+DFA_ASSOC_MAX_STATES = 16  # default FLUVIO_DFA_ASSOC_MAX_STATES
+_DFA_ASSOC_BLOCK = 256  # max columns composed per parallel tree
+_DFA_ASSOC_BLOCK_ELEMS = 1 << 25  # live transition-vector element budget
+
+
+def dfa_assoc_max_states() -> int:
+    """State-count gate for the associative path: past it, the S x work
+    multiplier loses to the sequential scan (and the transition material
+    stops fitting VMEM-friendly tiles)."""
+    return int(
+        os.environ.get("FLUVIO_DFA_ASSOC_MAX_STATES", DFA_ASSOC_MAX_STATES)
+    )
+
+
+def dfa_compose(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Compose transition vectors along the trailing state axis:
+    ``(b . a)[s] = b[a[s]]`` — ``a`` applied first. Associative, which is
+    the whole trick."""
+    return jnp.take_along_axis(b, a, axis=-1)
+
+
+def dfa_classes(values: jnp.ndarray, lengths: jnp.ndarray, dfa: CompiledDfa) -> jnp.ndarray:
+    """Per-position byte-class symbols int32[n, width+1], including the
+    end-of-record tail column (EOS at t == len, PAD beyond) — the same
+    symbol stream `dfa_match` scans sequentially."""
+    n, width = values.shape
+    lengths = lengths.astype(jnp.int32)
+    byte_class = jnp.asarray(dfa.byte_class.astype(np.int32))
+    t = jnp.arange(width, dtype=jnp.int32)[None, :]
+    cls = jnp.take(byte_class, values.astype(jnp.int32))
+    cls = jnp.where(
+        t < lengths[:, None],
+        cls,
+        jnp.where(t == lengths[:, None], dfa.eos_class, dfa.pad_class),
+    )
+    tail = jnp.where(lengths == width, dfa.eos_class, dfa.pad_class)
+    return jnp.concatenate([cls, tail[:, None]], axis=1)
+
+
+def _dfa_column_blocks(cls: jnp.ndarray, s_states: int):
+    """Shared column-blocking scaffold for the composition scans below.
+
+    Splits the column axis into blocks sized so live transition material
+    stays under the element budget (rows x block x S), padding the tail
+    with the -1 identity class. Returns ``(blocks [nb, rows, block],
+    tv_of)`` where ``tv_of(cls_blk, table_t)`` builds the block's
+    transition vectors ([rows, block, S]; identity where cls < 0). One
+    home for the budget math and the identity encoding — the two scans
+    must never diverge on them.
+    """
+    rows, t_len = cls.shape
+    per_col = max(rows * s_states, 1)
+    block = max(8, min(_DFA_ASSOC_BLOCK, _DFA_ASSOC_BLOCK_ELEMS // per_col))
+    nb = -(-t_len // block)
+    pad = nb * block - t_len
+    if pad:
+        cls = jnp.pad(cls, ((0, 0), (0, pad)), constant_values=-1)
+    blocks = cls.reshape(rows, nb, block).transpose(1, 0, 2)
+
+    def tv_of(cls_blk, table_t):
+        return jnp.where(
+            cls_blk[:, :, None] >= 0,
+            jnp.take(
+                table_t, jnp.clip(cls_blk, 0, table_t.shape[0] - 1), axis=0
+            ),
+            jnp.arange(s_states, dtype=jnp.int32)[None, None, :],
+        )
+
+    return blocks, tv_of
+
+
+def dfa_compose_columns(
+    cls: jnp.ndarray, table_t: jnp.ndarray, n_states: int
+) -> jnp.ndarray:
+    """Total transition function of each row's column sequence.
+
+    ``cls`` int32[rows, T] (symbol class per column; -1 = identity, used
+    for padding and un-owned stripe bytes), ``table_t`` int32[C, S] (the
+    transposed transition table). Returns int32[rows, S].
+
+    Columns split into blocks: within a block the per-column vectors
+    compose in a log-depth `lax.associative_scan`, and one sequential
+    `lax.scan` folds block results into the running composition. That
+    bounds live transition material at rows x block x S elements
+    (block shrinks as rows x S grows) instead of rows x T x S, while
+    keeping the sequential depth at T/block instead of T.
+    """
+    rows = cls.shape[0]
+    blocks, tv_of = _dfa_column_blocks(cls, n_states)
+    ident = jnp.broadcast_to(
+        jnp.arange(n_states, dtype=jnp.int32), (rows, n_states)
+    )
+
+    def one_block(carry, cls_blk):
+        comp = lax.associative_scan(dfa_compose, tv_of(cls_blk, table_t), axis=1)[:, -1]
+        return dfa_compose(carry, comp), None
+
+    out, _ = lax.scan(one_block, ident, blocks)
+    return out
+
+
+def dfa_match_assoc(
+    values: jnp.ndarray, lengths: jnp.ndarray, dfa: CompiledDfa
+) -> jnp.ndarray:
+    """`dfa_match` semantics via transition composition (bit-equal).
+
+    Gate on `dfa_assoc_max_states` before choosing this path — see the
+    section comment for the work/depth trade."""
+    cls = dfa_classes(values, lengths, dfa)
+    table_t = jnp.asarray(dfa.table.T.astype(np.int32))
+    f = dfa_compose_columns(cls, table_t, dfa.n_states)
+    return jnp.take(jnp.asarray(dfa.accept), f[:, dfa.start])
+
+
+def dfa_prefix_states(
+    cls: jnp.ndarray, table_t: jnp.ndarray, n_states: int, start: int
+) -> jnp.ndarray:
+    """EXCLUSIVE automaton state before each column: out[j] = the state
+    after consuming columns [0, j) from ``start``.
+
+    Same blocked composition as `dfa_compose_columns` (shared scaffold
+    `_dfa_column_blocks`), but the block carry is the actual state (one
+    int per row) and every within-block prefix evaluates at it —
+    int32[rows, T] of per-position states for tiny automata used as
+    structural masks (e.g. the 3-state JSON string/escape machine
+    below)."""
+    rows, t_len = cls.shape
+    blocks, tv_of = _dfa_column_blocks(cls, n_states)
+
+    def one_block(carry, cls_blk):
+        pf = lax.associative_scan(dfa_compose, tv_of(cls_blk, table_t), axis=1)
+        incl = jnp.take_along_axis(pf, carry[:, None, None], axis=2)[..., 0]
+        excl = jnp.concatenate([carry[:, None], incl[:, :-1]], axis=1)
+        return incl[:, -1], excl
+
+    carry0 = jnp.full((rows,), start, dtype=jnp.int32)
+    _, ys = lax.scan(one_block, carry0, blocks)
+    return ys.transpose(1, 0, 2).reshape(rows, -1)[:, :t_len]
+
+
+# the JSON string/escape automaton (exclusive-state form): 0 = outside
+# any string, 1 = inside a string, 2 = inside with an escape pending.
+# Mirrors the sequential machine's (in_str, esc) updates exactly —
+# escapes exist only INSIDE strings, which is what the backslash-run
+# parity heuristic it replaces got wrong on malformed input.
+_STR_OUT, _STR_IN, _STR_ESC = 0, 1, 2
+_STRING_TABLE_T = np.array(
+    [
+        [0, 1, 1],  # other:     OUT->OUT, IN->IN,  ESC->IN
+        [1, 0, 1],  # quote:     OUT->IN,  IN->OUT, ESC->IN
+        [0, 2, 1],  # backslash: OUT->OUT, IN->ESC, ESC->IN
+    ],
+    dtype=np.int32,
+)
+
+
+def string_state_excl(c: jnp.ndarray, inrec: jnp.ndarray) -> jnp.ndarray:
+    """Per-position exclusive string-automaton state (int32[n, width])."""
+    is_q = (c == 0x22) & inrec
+    is_b = (c == 0x5C) & inrec
+    cls = jnp.where(is_q, 1, jnp.where(is_b, 2, 0))
+    cls = jnp.where(inrec, cls, -1)
+    return dfa_prefix_states(cls, jnp.asarray(_STRING_TABLE_T), 3, _STR_OUT)
+
+
+# ---------------------------------------------------------------------------
 # JSON top-level field extraction (structural scan)
 # ---------------------------------------------------------------------------
 
@@ -109,131 +291,20 @@ def json_get(
     return extract_span(values, start, out_lengths), out_lengths
 
 
-def json_get_span(
-    values: jnp.ndarray, lengths: jnp.ndarray, key: str
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Field span (start, length) within each record's value bytes.
-
-    Bit-identical to `dsl.json_get_bytes`: a byte state machine tracking
-    (in-string, escape, brace depth, progressive needle match, value phase)
-    as N-lane vectors, scanned over the L byte columns.
-    """
+def json_needle(key: str) -> Tuple[jnp.ndarray, int]:
+    """The quoted key byte needle the structural machine matches."""
     needle = b'"' + key.encode("utf-8") + b'"'
-    klen = len(needle)
-    needle_arr = jnp.asarray(np.frombuffer(needle, dtype=np.uint8).astype(np.int32))
-    n, width = values.shape
-    lengths = lengths.astype(jnp.int32)
+    return (
+        jnp.asarray(np.frombuffer(needle, dtype=np.uint8).astype(np.int32)),
+        len(needle),
+    )
 
-    def step(carry, xs):
-        (phase, kmatch, in_str, esc, depth, d2, vesc, start, end, lastnw) = carry
-        col, t = xs
-        c = col.astype(jnp.int32)
-        active = t < lengths
-        is_ws = (c == 32) | (c == 9) | (c == 13) | (c == 10)
-        is_quote = c == 0x22
-        is_bslash = c == 0x5C
 
-        # ---- phase COLON: ws -> stay; ':' -> WS phase; else abort+reprocess
-        colon_here = (phase == _P_COLON) & (c == 0x3A)
-        colon_stay = (phase == _P_COLON) & is_ws
-        colon_abort = (phase == _P_COLON) & ~is_ws & (c != 0x3A)
-
-        # ---- general scan applies in SCAN phase or on COLON abort
-        g = (phase == _P_SCAN) | colon_abort
-
-        # inside string
-        gs = g & in_str
-        s_esc_consume = gs & esc
-        s_set_esc = gs & ~esc & is_bslash
-        s_close = gs & ~esc & is_quote
-        s_key_done = s_close & (kmatch == klen - 1)
-        # progressive needle match on ordinary string bytes
-        s_ordinary = gs & ~esc & ~is_bslash & ~is_quote
-        expected = jnp.take(needle_arr, jnp.clip(kmatch, 0, klen - 1))
-        k_next = jnp.where(
-            (kmatch > 0) & (kmatch < klen - 1) & (c == expected), kmatch + 1, 0
-        )
-
-        # outside string
-        go = g & ~in_str
-        o_open = go & is_quote
-        o_depth_up = go & (c == 0x7B)
-        o_depth_dn = go & (c == 0x7D)
-
-        new_in_str = jnp.where(
-            active & s_close, False, jnp.where(active & o_open, True, in_str)
-        )
-        new_esc = jnp.where(active & gs, s_set_esc, esc)
-        new_depth = (
-            depth
-            + jnp.where(active & o_depth_up, 1, 0)
-            - jnp.where(active & o_depth_dn, 1, 0)
-        )
-        new_kmatch = kmatch
-        new_kmatch = jnp.where(active & s_ordinary, k_next, new_kmatch)
-        new_kmatch = jnp.where(
-            active & (s_set_esc | s_esc_consume | s_close), 0, new_kmatch
-        )
-        new_kmatch = jnp.where(
-            active & o_open, jnp.where(depth == 1, 1, 0), new_kmatch
-        )
-
-        # ---- phase WS (after colon): skip ws, classify value start
-        w = (phase == _P_WS) & active
-        w_go = w & ~is_ws
-        w_str = w_go & is_quote
-        is_closer = (c == 0x5D) | (c == 0x7D) | (c == 0x2C)  # ] } ,
-        w_empty = w_go & ~is_quote & is_closer
-        w_raw = w_go & ~is_quote & ~is_closer
-        w_raw_open = w_raw & ((c == 0x5B) | (c == 0x7B))
-
-        # ---- phase STR (string value)
-        s3 = (phase == _P_STR) & active
-        s3_esc_consume = s3 & vesc
-        s3_set_esc = s3 & ~vesc & is_bslash
-        s3_close = s3 & ~vesc & is_quote
-
-        # ---- phase RAW (scalar / nested value)
-        s4 = (phase == _P_RAW) & active
-        r_open = s4 & ((c == 0x5B) | (c == 0x7B))
-        r_close = s4 & ((c == 0x5D) | (c == 0x7D))
-        r_comma = s4 & (c == 0x2C)
-        r_end = (r_close & (d2 == 0)) | (r_comma & (d2 == 0))
-        r_dec = r_close & (d2 > 0)
-
-        # ---- transitions
-        new_phase = phase
-        new_phase = jnp.where(active & s_key_done, _P_COLON, new_phase)
-        new_phase = jnp.where(active & colon_here, _P_WS, new_phase)
-        new_phase = jnp.where(active & colon_abort, _P_SCAN, new_phase)
-        new_phase = jnp.where(w_str, _P_STR, new_phase)
-        new_phase = jnp.where(w_empty, _P_DONE, new_phase)
-        new_phase = jnp.where(w_raw, _P_RAW, new_phase)
-        new_phase = jnp.where(s3_close, _P_DONE, new_phase)
-        new_phase = jnp.where(r_end, _P_DONE, new_phase)
-
-        new_vesc = jnp.where(s3, ~vesc & is_bslash, vesc)
-        new_d2 = d2 + jnp.where(w_raw_open, 1, 0) + jnp.where(r_open, 1, 0) - jnp.where(r_dec, 1, 0)
-        new_start = jnp.where(w_str, t + 1, jnp.where(w_raw | w_empty, t, start))
-        new_end = jnp.where(s3_close, t, jnp.where(r_end, lastnw + 1, jnp.where(w_empty, t, end)))
-        new_lastnw = jnp.where((w_raw & ~is_ws) | (s4 & ~r_end & ~is_ws), t, lastnw)
-
-        return (
-            new_phase,
-            new_kmatch,
-            new_in_str,
-            new_esc,
-            new_depth,
-            new_d2,
-            new_vesc,
-            new_start,
-            new_end,
-            new_lastnw,
-        ), None
-
+def json_span_carry0(n: int):
+    """Initial machine state, one lane per record (see `json_step`)."""
     zeros_i = jnp.zeros((n,), dtype=jnp.int32)
     zeros_b = jnp.zeros((n,), dtype=bool)
-    carry0 = (
+    return (
         jnp.full((n,), _P_SCAN, dtype=jnp.int32),  # phase
         zeros_i,  # kmatch
         zeros_b,  # in_str
@@ -245,18 +316,157 @@ def json_get_span(
         zeros_i,  # end
         jnp.full((n,), -1, dtype=jnp.int32),  # lastnw
     )
-    final, _ = lax.scan(
-        step, carry0, (values.T, jnp.arange(width, dtype=jnp.int32))
-    )
-    phase, _, _, _, _, _, _, start, end, lastnw = final
 
-    # end-of-record fixups (unterminated values run to the end)
+
+def json_span_finalize(final, lengths: jnp.ndarray, start_cap):
+    """End-of-record fixups (unterminated values run to the end) +
+    (start, length) extraction from the machine's final state."""
+    phase, _, _, _, _, _, _, start, end, lastnw = final
     end = jnp.where(phase == _P_STR, lengths, end)
     end = jnp.where(phase == _P_RAW, lastnw + 1, end)
     found = (phase == _P_DONE) | (phase == _P_STR) | (phase == _P_RAW)
-
     out_lengths = jnp.where(found, jnp.maximum(end - start, 0), 0).astype(jnp.int32)
-    return jnp.clip(start, 0, width), out_lengths
+    return jnp.clip(start, 0, start_cap), out_lengths
+
+
+def json_get_span(
+    values: jnp.ndarray, lengths: jnp.ndarray, key: str
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Field span (start, length) within each record's value bytes.
+
+    Bit-identical to `dsl.json_get_bytes`: a byte state machine tracking
+    (in-string, escape, brace depth, progressive needle match, value phase)
+    as N-lane vectors, scanned over the L byte columns. The per-column
+    update lives in `json_step` so the striped layout can run the same
+    machine with a cross-stripe state carry (stripes.striped_json_span).
+    """
+    needle_arr, klen = json_needle(key)
+    n, width = values.shape
+    lengths = lengths.astype(jnp.int32)
+
+    def step(carry, xs):
+        col, t = xs
+        return (
+            json_step(carry, col.astype(jnp.int32), t, t < lengths, needle_arr, klen),
+            None,
+        )
+
+    final, _ = lax.scan(
+        step, json_span_carry0(n), (values.T, jnp.arange(width, dtype=jnp.int32))
+    )
+    return json_span_finalize(final, lengths, width)
+
+
+def json_step(carry, c: jnp.ndarray, t, active: jnp.ndarray, needle_arr, klen: int):
+    """One byte column through the structural machine.
+
+    ``c`` int32 byte values, ``t`` the column's position WITHIN THE
+    RECORD (a scalar or per-lane vector — the striped runner feeds
+    absolute positions), ``active`` which lanes this column belongs to.
+    Returns the updated carry tuple (shape of `json_span_carry0`).
+    """
+    (phase, kmatch, in_str, esc, depth, d2, vesc, start, end, lastnw) = carry
+    is_ws = (c == 32) | (c == 9) | (c == 13) | (c == 10)
+    is_quote = c == 0x22
+    is_bslash = c == 0x5C
+
+    # ---- phase COLON: ws -> stay; ':' -> WS phase; else abort+reprocess
+    colon_here = (phase == _P_COLON) & (c == 0x3A)
+    colon_stay = (phase == _P_COLON) & is_ws
+    colon_abort = (phase == _P_COLON) & ~is_ws & (c != 0x3A)
+
+    # ---- general scan applies in SCAN phase or on COLON abort
+    g = (phase == _P_SCAN) | colon_abort
+
+    # inside string
+    gs = g & in_str
+    s_esc_consume = gs & esc
+    s_set_esc = gs & ~esc & is_bslash
+    s_close = gs & ~esc & is_quote
+    s_key_done = s_close & (kmatch == klen - 1)
+    # progressive needle match on ordinary string bytes
+    s_ordinary = gs & ~esc & ~is_bslash & ~is_quote
+    expected = jnp.take(needle_arr, jnp.clip(kmatch, 0, klen - 1))
+    k_next = jnp.where(
+        (kmatch > 0) & (kmatch < klen - 1) & (c == expected), kmatch + 1, 0
+    )
+
+    # outside string
+    go = g & ~in_str
+    o_open = go & is_quote
+    o_depth_up = go & (c == 0x7B)
+    o_depth_dn = go & (c == 0x7D)
+
+    new_in_str = jnp.where(
+        active & s_close, False, jnp.where(active & o_open, True, in_str)
+    )
+    new_esc = jnp.where(active & gs, s_set_esc, esc)
+    new_depth = (
+        depth
+        + jnp.where(active & o_depth_up, 1, 0)
+        - jnp.where(active & o_depth_dn, 1, 0)
+    )
+    new_kmatch = kmatch
+    new_kmatch = jnp.where(active & s_ordinary, k_next, new_kmatch)
+    new_kmatch = jnp.where(
+        active & (s_set_esc | s_esc_consume | s_close), 0, new_kmatch
+    )
+    new_kmatch = jnp.where(
+        active & o_open, jnp.where(depth == 1, 1, 0), new_kmatch
+    )
+
+    # ---- phase WS (after colon): skip ws, classify value start
+    w = (phase == _P_WS) & active
+    w_go = w & ~is_ws
+    w_str = w_go & is_quote
+    is_closer = (c == 0x5D) | (c == 0x7D) | (c == 0x2C)  # ] } ,
+    w_empty = w_go & ~is_quote & is_closer
+    w_raw = w_go & ~is_quote & ~is_closer
+    w_raw_open = w_raw & ((c == 0x5B) | (c == 0x7B))
+
+    # ---- phase STR (string value)
+    s3 = (phase == _P_STR) & active
+    s3_esc_consume = s3 & vesc
+    s3_set_esc = s3 & ~vesc & is_bslash
+    s3_close = s3 & ~vesc & is_quote
+
+    # ---- phase RAW (scalar / nested value)
+    s4 = (phase == _P_RAW) & active
+    r_open = s4 & ((c == 0x5B) | (c == 0x7B))
+    r_close = s4 & ((c == 0x5D) | (c == 0x7D))
+    r_comma = s4 & (c == 0x2C)
+    r_end = (r_close & (d2 == 0)) | (r_comma & (d2 == 0))
+    r_dec = r_close & (d2 > 0)
+
+    # ---- transitions
+    new_phase = phase
+    new_phase = jnp.where(active & s_key_done, _P_COLON, new_phase)
+    new_phase = jnp.where(active & colon_here, _P_WS, new_phase)
+    new_phase = jnp.where(active & colon_abort, _P_SCAN, new_phase)
+    new_phase = jnp.where(w_str, _P_STR, new_phase)
+    new_phase = jnp.where(w_empty, _P_DONE, new_phase)
+    new_phase = jnp.where(w_raw, _P_RAW, new_phase)
+    new_phase = jnp.where(s3_close, _P_DONE, new_phase)
+    new_phase = jnp.where(r_end, _P_DONE, new_phase)
+
+    new_vesc = jnp.where(s3, ~vesc & is_bslash, vesc)
+    new_d2 = d2 + jnp.where(w_raw_open, 1, 0) + jnp.where(r_open, 1, 0) - jnp.where(r_dec, 1, 0)
+    new_start = jnp.where(w_str, t + 1, jnp.where(w_raw | w_empty, t, start))
+    new_end = jnp.where(s3_close, t, jnp.where(r_end, lastnw + 1, jnp.where(w_empty, t, end)))
+    new_lastnw = jnp.where((w_raw & ~is_ws) | (s4 & ~r_end & ~is_ws), t, lastnw)
+
+    return (
+        new_phase,
+        new_kmatch,
+        new_in_str,
+        new_esc,
+        new_depth,
+        new_d2,
+        new_vesc,
+        new_start,
+        new_end,
+        new_lastnw,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -854,15 +1064,16 @@ def json_get_parallel_span(
     """Structural-index JSON field span — scan-free.
 
     simdjson-style: build per-byte structural masks with parallel
-    prefixes (escape parity, in-string parity, brace depth), find the
-    first colon-confirmed ``"key"`` occurrence at depth 1 by windowed
-    compare, then resolve the value span with next/prev index fills.
+    prefixes (the 3-state string/escape automaton via transition
+    composition, brace depth), find the first colon-confirmed ``"key"``
+    occurrence at depth 1 by windowed compare, then resolve the value
+    span with next/prev index fills.
 
-    Matches `dsl.json_get_bytes` on well-formed input (and on the garbage
-    in our corpora). Known deviation: a quote immediately preceded by
-    backslashes *outside* any string (malformed JSON) is treated as
-    escaped, where the sequential reference treats it as a string opener.
-    The scan kernel (`json_get`) remains the exact-semantics fallback.
+    Matches `dsl.json_get_bytes` bit-for-bit — `string_state_excl`
+    replaced the backslash-run parity heuristic whose escaped-quote
+    handling outside strings was this kernel's one documented deviation
+    (fuzzed against the scan kernel on structural-garbage corpora in
+    tests/test_tpu_kernels.py).
     """
     needle = b'"' + key.encode("utf-8") + b'"'
     klen = len(needle)
@@ -872,22 +1083,15 @@ def json_get_parallel_span(
     jidx = jnp.arange(width, dtype=jnp.int32)[None, :]
     inrec = jidx < lengths[:, None]
 
-    is_bs = (c == 0x5C) & inrec
     is_q = (c == 0x22) & inrec
     is_ws = ((c == 32) | (c == 9) | (c == 13) | (c == 10)) & inrec
 
-    # escape parity: odd run of backslashes immediately before j
-    last_non_bs = _prev_index_le(~is_bs, width)  # index of last non-backslash <= j
-    # backslashes strictly before j: run length = (j-1) - last_non_bs[j-1]
-    lnb_shift = jnp.concatenate(
-        [jnp.full((n, 1), -1, dtype=jnp.int32), last_non_bs[:, :-1]], axis=1
-    )
-    run_before = (jidx - 1) - lnb_shift
-    escaped = (run_before % 2) == 1
-
-    q_real = is_q & ~escaped
-    q_before = _excl_cumsum(q_real.astype(jnp.int32))
-    outside = (q_before % 2) == 0  # true at opening quotes and between strings
+    # exact in-string/escape tracking: the 3-state string automaton
+    # evaluated by transition composition — a quote is real unless an
+    # escape is pending, and escapes exist only inside strings
+    str_state = string_state_excl(c, inrec)
+    q_real = is_q & (str_state != _STR_ESC)
+    outside = str_state == _STR_OUT  # true at opening quotes and between strings
 
     brace_open = (c == 0x7B) & outside & inrec
     brace_close = (c == 0x7D) & outside & inrec

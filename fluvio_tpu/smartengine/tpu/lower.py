@@ -16,9 +16,10 @@ from typing import Callable, Dict, Tuple
 
 import jax.numpy as jnp
 
-from fluvio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex, literal_of
+from fluvio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex_cached, literal_of
 from fluvio_tpu.smartmodule import dsl
 from fluvio_tpu.smartengine.tpu import kernels, pallas_kernels
+from fluvio_tpu.telemetry import TELEMETRY
 
 
 class Unlowerable(Exception):
@@ -29,17 +30,32 @@ class Unlowerable(Exception):
 BytesVal = Tuple[jnp.ndarray, jnp.ndarray]
 
 
+def _depth_over_work(env: str) -> bool:
+    """Resolve an auto/1/0 kernel-policy knob the way link compression
+    resolves: "auto" (default) picks the log-depth parallel kernel
+    off-CPU only — the TPU's VPU is latency-bound on sequential column
+    scans, while CPU lanes are work-bound and the parallel forms' S x
+    work multiplier measurably loses there (4-20x on the headline
+    shapes). Explicit off values pin the sequential kernel; anything
+    else pins the parallel one."""
+    mode = os.environ.get(env, "auto").lower()
+    if mode in ("auto", ""):
+        import jax
+
+        return jax.default_backend() != "cpu"
+    return mode not in ("0", "off", "false", "no")
+
+
 def _json_span_fn(key: str):
     """Span kernel chooser shared by byte and descriptor lowering.
 
-    Default XLA fallback is the sequential scan kernel: exact on all
-    inputs, same semantics as the pallas kernel, so a record's extraction
-    never depends on which path (pallas / XLA / sharded) its batch took.
-    FLUVIO_TPU_FAST_JSON=1 opts the fallback into the structural-index
-    kernel, which is faster under XLA but has a documented malformed-JSON
-    deviation.
+    Both XLA kernels are bit-identical on every input (the
+    structural-index kernel's string/escape automaton runs on the exact
+    transition-composition engine), so the choice is pure policy:
+    ``FLUVIO_TPU_FAST_JSON`` auto/1/0 via `_depth_over_work` — scan-free
+    structural indexing off-CPU, the sequential scan on CPU.
     """
-    fast = os.environ.get("FLUVIO_TPU_FAST_JSON") == "1"
+    fast = _depth_over_work("FLUVIO_TPU_FAST_JSON")
     xla_kernel = kernels.json_get_parallel_span if fast else kernels.json_get_span
 
     def span(v, l):
@@ -202,23 +218,36 @@ def lower_expr(expr: dsl.Expr) -> Callable[[Dict[str, jnp.ndarray]], object]:
             return _literal_fn(expr.literal, False, True)
 
         # RegexMatch: windowed-compare fast path for pure literals,
-        # DFA byte-class scan otherwise
+        # DFA execution otherwise
         lit_info = literal_of(expr.pattern)
         if lit_info is not None:
             return _literal_fn(*lit_info)
         try:
-            dfa = compile_regex(expr.pattern)
+            dfa = compile_regex_cached(expr.pattern)
         except UnsupportedRegex as e:
             raise Unlowerable(str(e)) from e
+        # backend policy first (FLUVIO_DFA_ASSOC auto/1/0: the S x work
+        # multiplier loses on the work-bound CPU backend — same policy
+        # as the JSON kernel above), then the state-count gate; only a
+        # gate trip on a backend that WANTED the associative path counts
+        # as a decline
+        assoc_ok = _depth_over_work("FLUVIO_DFA_ASSOC")
+        if assoc_ok and dfa.n_states > kernels.dfa_assoc_max_states():
+            assoc_ok = False
+            TELEMETRY.add_decline("dfa-assoc-states")
 
         def regex_fn(s):
             v, l = inner(s)
-            # pallas select-chain scan (2 primitives) over the XLA
-            # per-step-gather scan when the platform + DFA size allow
+            # pallas select-chain scan (2 primitives) over any XLA path
+            # when the platform + DFA size allow
             if pallas_kernels.pallas_active(v.shape[1]) and pallas_kernels.dfa_supported(dfa):
                 return pallas_kernels.dfa_match_pallas(
                     v, l, dfa, interpret=pallas_kernels.interpret_mode()
                 )
+            if assoc_ok:
+                # associative transition composition: O(log L) depth
+                # instead of the sequential scan's O(L) steps
+                return kernels.dfa_match_assoc(v, l, dfa)
             return kernels.dfa_match(v, l, dfa)
 
         return regex_fn

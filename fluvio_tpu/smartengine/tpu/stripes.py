@@ -32,11 +32,17 @@ HBM-resident ``RecordBuffer``:
 Exactness bounds (build-time checked where possible, documented where
 data-dependent):
 
-- filter/regex literals must be no longer than ``STRIPE_OVERLAP``
-  (start-anchored: the stripe width); non-literal regexes (DFA scans),
-  ``JsonGet``-sourced predicates and transforms, ``word_count``, and
-  ``json_array`` explodes are NOT stripeable — chains containing them
-  keep the interpreter spill for wide batches;
+- filter literals within ``STRIPE_OVERLAP`` (start-anchored: the stripe
+  width) evaluate by windowed compare + segment reduce; non-literal
+  regexes (and overlap-exceeding literals, whose ~1-state-per-byte
+  DFAs need the gate raised) chain DFA state ACROSS stripes via
+  transition composition (`striped_dfa_verdict` — exact at
+  stripe joints, gated on ``FLUVIO_DFA_ASSOC_MAX_STATES``); a
+  single-level ``JsonGet`` map carries the structural machine state
+  across stripes (`striped_json_span`) and ships view descriptors;
+  ``JsonGet``-sourced predicates, ``word_count``, and ``json_array``
+  explodes remain outside the subset — chains containing them keep the
+  interpreter spill for wide batches;
 - ``ParseInt`` contributions parse the record's leading int from the
   first stripe: a record whose int prefix (whitespace + sign + digits)
   extends past ``STRIPE_WIDTH`` bytes parses only the in-stripe prefix.
@@ -51,11 +57,13 @@ from typing import Callable, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
-from fluvio_tpu.ops.regex_dfa import literal_of
+from fluvio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex_cached, literal_of
 from fluvio_tpu.smartmodule import dsl
 from fluvio_tpu.smartengine.tpu import kernels
 from fluvio_tpu.smartengine.tpu.lower import Unlowerable, apply_postops, lower_expr
+from fluvio_tpu.telemetry import TELEMETRY
 
 STRIPE_WIDTH = 8192    # bytes per device row (pow2; must be 4-aligned)
 STRIPE_OVERLAP = 128   # shared bytes between consecutive stripes
@@ -153,6 +161,107 @@ def striped_repad_words(flat, lengths, plan, s: int):
     jidx = jnp.arange(s, dtype=jnp.int32)[None, :]
     mask = jidx < plan["stripe_len"][:, None]
     return jnp.where(mask, gathered, 0).astype(jnp.uint8)
+
+
+def owned_lengths(plan):
+    """Bytes of each stripe row OWNED by that row: the overlap tail
+    belongs to the next stripe; the last stripe owns through record end.
+    Every record byte is owned by exactly one row, in (segment,
+    stripe_idx) order — the invariant the split fan-out, the DFA chain,
+    and the JsonGet carry all build on (ownership must not fork)."""
+    return jnp.where(
+        plan["is_last"],
+        plan["stripe_len"],
+        jnp.minimum(plan["step"], plan["stripe_len"]),
+    )
+
+
+def striped_dfa_verdict(sv, plan, dfa, n: int):
+    """Regex match per segment via cross-stripe DFA state chaining.
+
+    Each stripe row reduces its OWNED bytes to one transition function
+    over DFA states (kernels.dfa_compose_columns — the associative-scan
+    engine); a segmented `associative_scan` over the row axis composes
+    them across each segment's rows, so the automaton state chains
+    through stripe joints exactly — no overlap containment needed, which
+    is what lifts the literal-only restriction on striped regex filters.
+    The EOS symbol applies once per segment after the composition (PAD
+    never runs: un-owned columns compose as identity, and `dfa_match`'s
+    trailing PADs only preserve acceptance, which EOS-then-accept-check
+    reproduces because accept states are absorbing).
+    """
+    r, s = sv.shape
+    byte_class = jnp.asarray(dfa.byte_class.astype(np.int32))
+    cls = jnp.take(byte_class, sv.astype(jnp.int32))
+    jidx = jnp.arange(s, dtype=jnp.int32)[None, :]
+    cls = jnp.where(jidx < owned_lengths(plan)[:, None], cls, -1)
+    table_t = jnp.asarray(dfa.table.T.astype(np.int32))
+    rowf = kernels.dfa_compose_columns(cls, table_t, dfa.n_states)  # [r, S]
+
+    reset = plan["stripe_idx"] == 0
+
+    def comb(a, b):
+        ra, fa = a
+        rb, fb = b
+        return ra | rb, jnp.where(rb[..., None], fb, kernels.dfa_compose(fa, fb))
+
+    _, f_incl = jax.lax.associative_scan(comb, (reset, rowf))
+    last_row = jnp.clip(plan["first_row"] + plan["k"] - 1, 0, r - 1)
+    seg_f = jnp.take(f_incl, last_row, axis=0)  # [n, S]
+    state = seg_f[:, dfa.start]
+    table_flat = jnp.asarray(dfa.table.reshape(-1).astype(np.int32))
+    state = jnp.take(table_flat, state * dfa.n_classes + dfa.eos_class)
+    return jnp.take(jnp.asarray(dfa.accept), state) & (plan["k"] > 0)
+
+
+def striped_json_span(sv, plan, lengths, key: str, kmax: int, n: int):
+    """Per-SEGMENT JsonGet field span over striped record bytes.
+
+    The same structural machine as `kernels.json_get_span`
+    (`kernels.json_step`), with the state carried ACROSS STRIPES: the
+    outer scan walks stripe positions 0..kmax-1 and at position k feeds
+    every segment's k-th stripe row through the machine simultaneously
+    (n lanes), so a segment's carry flows from its stripe k into its
+    stripe k+1 — spans that straddle stripe joints resolve exactly.
+    Only OWNED columns are active (overlap bytes process once), and
+    positions are absolute within the record, so the returned
+    (start, length) are slab-valid view descriptors. ``kmax`` is the
+    static per-record stripe-count bound (from the batch width bucket).
+    """
+    needle_arr, klen = kernels.json_needle(key)
+    r, s = sv.shape
+    step = plan["step"]
+    ol = owned_lengths(plan)
+    lengths = lengths.astype(jnp.int32)
+
+    def outer(carry, k):
+        rows = jnp.clip(plan["first_row"] + k, 0, r - 1)
+        sm = jnp.take(sv, rows, axis=0)  # [n, s]
+        ol_k = jnp.take(ol, rows)
+        seg_active = k < plan["k"]
+        base = k * step
+
+        def inner(c, xs):
+            col, j = xs
+            active = seg_active & (j < ol_k)
+            return (
+                kernels.json_step(
+                    c, col.astype(jnp.int32), base + j, active, needle_arr, klen
+                ),
+                None,
+            )
+
+        carry, _ = lax.scan(
+            inner, carry, (sm.T, jnp.arange(s, dtype=jnp.int32))
+        )
+        return carry, None
+
+    final, _ = lax.scan(
+        outer,
+        kernels.json_span_carry0(n),
+        jnp.arange(max(kmax, 1), dtype=jnp.int32),
+    )
+    return kernels.json_span_finalize(final, lengths, lengths)
 
 
 def seg_any(verdict, plan, n: int):
@@ -309,19 +418,51 @@ def lower_striped_predicate(expr, s: int, v: int) -> Callable:
         if postops is None:
             raise Unlowerable("striped regex must read the record value")
         info = literal_of(expr.pattern)
-        if info is None:
-            raise Unlowerable("non-literal regex needs the DFA scan")
-        lit, a_start, a_end = info
-        if a_start and a_end:
-            kind = "equals"
-        elif a_start:
-            kind = "startswith"
-        elif a_end:
-            kind = "endswith"
-        else:
-            kind = "contains"
-        return _lower_striped_literal(kind, lit, postops, s, v)
+        if info is not None:
+            lit, a_start, a_end = info
+            if a_start and a_end:
+                kind = "equals"
+            elif a_start:
+                kind = "startswith"
+            elif a_end:
+                kind = "endswith"
+            else:
+                kind = "contains"
+            try:
+                return _lower_striped_literal(kind, lit, postops, s, v)
+            except Unlowerable:
+                # literal longer than the overlap: chain it as a DFA
+                # instead of spilling (containment no longer needed)
+                pass
+        return _lower_striped_dfa(expr.pattern, postops)
     raise Unlowerable(f"{type(expr).__name__} not stripeable as a predicate")
+
+
+def _lower_striped_dfa(pattern: str, postops):
+    """Non-literal regex (or an overlap-exceeding literal) as a
+    cross-stripe DFA chain — the composition trick that lifts the
+    literal-only restriction on striped regex filters. Same state-count
+    gate as the narrow associative path; past it the chain spills to the
+    interpreter (with the decline reason on the telemetry counter)."""
+    try:
+        dfa = compile_regex_cached(pattern)
+    except UnsupportedRegex as e:
+        raise Unlowerable(str(e)) from e
+    if dfa.n_states > kernels.dfa_assoc_max_states():
+        # distinct reason from the narrow lowering's "dfa-assoc-states":
+        # one gate trip would otherwise double-count across the two
+        # builds, and the consequences differ (sequential scan vs spill)
+        TELEMETRY.add_decline("dfa-stripe-states")
+        raise Unlowerable(
+            f"DFA of {dfa.n_states} states exceeds the associative gate "
+            "(FLUVIO_DFA_ASSOC_MAX_STATES)"
+        )
+
+    def fn(ctx):
+        sv = apply_postops(ctx["sv"], postops)
+        return striped_dfa_verdict(sv, ctx["plan"], dfa, ctx["n"])
+
+    return fn
 
 
 def _fold(fns, ctx, op):
@@ -331,15 +472,48 @@ def _fold(fns, ctx, op):
     return out
 
 
-def _map_postops(prog) -> Tuple[str, ...]:
-    """A map program stripeable iff it rewrites neither keys nor spans:
-    a pure postop chain over the record value."""
-    if prog.key is not None:
-        raise Unlowerable("striped map cannot rewrite keys")
-    post = _value_postops(prog.value)
+def _striped_view(value):
+    """Classify a striped map value.
+
+    ``("postops", ops)`` for a pure postop chain over the record value;
+    ``("span", key, pre, total)`` for a single-level JsonGet view —
+    ``pre`` are the folds the structural machine must see (those inside
+    the JsonGet arg), ``total`` the full host-side view postops, which
+    must equal the narrow build's `lower_span` postops for the same
+    program (the executor cross-checks). Anything else (key/const
+    sources, Concat, nested JsonGet) raises Unlowerable.
+    """
+    outer: List[str] = []
+    expr = value
+    while isinstance(expr, (dsl.Upper, dsl.Lower)):
+        outer.append("upper" if isinstance(expr, dsl.Upper) else "lower")
+        expr = expr.arg
+    outer.reverse()  # application order is innermost-first
+    if isinstance(expr, dsl.JsonGet):
+        # _value_postops raises for a nested JsonGet arg (one structural
+        # level) and returns None for key/const sources
+        pre = _value_postops(expr.arg)
+        if pre is None:
+            raise Unlowerable("striped JsonGet must read the record value")
+        return ("span", expr.key, pre, pre + tuple(outer))
+    post = _value_postops(value)
     if post is None:
         raise Unlowerable("striped map must transform the record value")
-    return post
+    return ("postops", post)
+
+
+def _make_span_fn(key: str, pre: Tuple[str, ...]):
+    """JsonGet span op over the striped ctx: the machine consumes the
+    (postop-folded) stripe bytes and emits slab-absolute descriptors."""
+
+    def fn(ctx):
+        sv = apply_postops(ctx["sv"], pre)
+        return striped_json_span(
+            sv, ctx["plan"], ctx["seg_state"]["lengths"], key,
+            ctx["kmax"], ctx["n"],
+        )
+
+    return fn
 
 
 def _check_contribution(prog) -> None:
@@ -373,10 +547,7 @@ def striped_split_bounds(sv, plan, sep: int, n: int):
     r, s = sv.shape
     step = plan["step"]
     jidx = jnp.arange(s, dtype=jnp.int32)[None, :]
-    owned_len = jnp.where(
-        plan["is_last"], plan["stripe_len"], jnp.minimum(step, plan["stripe_len"])
-    )
-    owned = jidx < owned_len[:, None]
+    owned = jidx < owned_lengths(plan)[:, None]
     m = (sv == sep) & owned
 
     # record-order predecessor of column 0: the previous stripe's last
@@ -436,21 +607,27 @@ class StripedChain:
     """Stripe-capable lowering of a whole SmartModule chain.
 
     ``ops`` entries: ("filter", fn) | ("postops", tuple) |
-    ("agg", aggregate_stage) | ("fanout", sep_byte). Postops accumulate
-    into ``postops`` — the executor's host-side view materialization
-    applies them (they must equal the narrow build's ``_view_postops``).
+    ("span", fn) | ("agg", aggregate_stage) | ("fanout", sep_byte).
+    Postops accumulate into ``postops`` — the executor's host-side view
+    materialization applies them (they must equal the narrow build's
+    ``_view_postops``). A span op (JsonGet map) makes output values
+    sub-record views: the executor ships its (start, length)
+    descriptors instead of the whole-record mask.
     """
 
     ops: List = field(default_factory=list)
     postops: Tuple[str, ...] = ()
     fanout: bool = False
     has_agg: bool = False
+    has_span: bool = False
 
     def run(self, ctx, valid, carries, base_ts, agg_ctx):
         """Execute the striped chain; returns (valid[n], seg_state,
-        carries, fan) — ``fan`` is the (flag, start, elen) emission grid
-        for fan-out chains, else None."""
+        carries, fan, vspan) — ``fan`` is the (flag, start, elen)
+        emission grid for fan-out chains, ``vspan`` the per-segment
+        (start, length) view descriptors for span chains (else None)."""
         fan = None
+        vspan = None
         for kind, arg in self.ops:
             if kind == "filter":
                 valid = valid & arg(ctx)
@@ -459,6 +636,8 @@ class StripedChain:
                 ctx["seg_state"]["values"] = apply_postops(
                     ctx["seg_state"]["values"], arg
                 )
+            elif kind == "span":
+                vspan = arg(ctx)
             elif kind == "agg":
                 st = dict(ctx["seg_state"])
                 st["valid"] = valid
@@ -468,7 +647,7 @@ class StripedChain:
                 fan = striped_split_bounds(
                     ctx["sv"], ctx["plan"], arg, ctx["n"]
                 )
-        return valid, ctx["seg_state"], carries, fan
+        return valid, ctx["seg_state"], carries, fan, vspan
 
 
 def try_build_striped(programs, stages, s: int, v: int) -> Optional[StripedChain]:
@@ -489,23 +668,43 @@ def try_build_striped(programs, stages, s: int, v: int) -> Optional[StripedChain
                 # aggregates only as a chain suffix; fan-out only last
                 raise Unlowerable("stage after a striped terminal stage")
             if isinstance(prog, dsl.FilterProgram):
+                if chain.has_span:
+                    # downstream filters would read the extracted view,
+                    # not the stripe bytes the striped predicates scan
+                    raise Unlowerable("filter after a striped span map")
                 chain.ops.append(
                     ("filter", lower_striped_predicate(prog.predicate, s, v))
                 )
-            elif isinstance(prog, dsl.MapProgram):
-                post = _map_postops(prog)
-                if post:
-                    chain.ops.append(("postops", post))
-                chain.postops += post
-            elif isinstance(prog, dsl.FilterMapProgram):
-                chain.ops.append(
-                    ("filter", lower_striped_predicate(prog.predicate, s, v))
-                )
-                post = _map_postops(prog)
-                if post:
-                    chain.ops.append(("postops", post))
-                chain.postops += post
+            elif isinstance(prog, (dsl.MapProgram, dsl.FilterMapProgram)):
+                if isinstance(prog, dsl.FilterMapProgram):
+                    if chain.has_span:
+                        raise Unlowerable("filter after a striped span map")
+                    chain.ops.append(
+                        ("filter", lower_striped_predicate(prog.predicate, s, v))
+                    )
+                if prog.key is not None:
+                    raise Unlowerable("striped map cannot rewrite keys")
+                view = _striped_view(prog.value)
+                if view[0] == "postops":
+                    post = view[1]
+                    if post:
+                        if not chain.has_span:
+                            # after a span map the stripe bytes are dead;
+                            # the fold applies host-side via `postops`
+                            chain.ops.append(("postops", post))
+                        chain.postops += post
+                else:
+                    _, key, pre, total = view
+                    if chain.has_span:
+                        raise Unlowerable("one striped span map per chain")
+                    chain.ops.append(("span", _make_span_fn(key, pre)))
+                    chain.has_span = True
+                    chain.postops += total
             elif isinstance(prog, dsl.AggregateProgram):
+                if chain.has_span:
+                    # contributions evaluate on the segment state's
+                    # stripe-0 prefix, not the extracted view
+                    raise Unlowerable("aggregate after a striped span map")
                 _check_contribution(prog)
                 stage = stages[i]
                 assert isinstance(stage, _ex._AggregateStage)
@@ -516,8 +715,8 @@ def try_build_striped(programs, stages, s: int, v: int) -> Optional[StripedChain
                     raise Unlowerable(
                         "striped array_map supports single-byte split only"
                     )
-                if chain.has_agg:
-                    raise Unlowerable("striped fan-out after aggregate")
+                if chain.has_agg or chain.has_span:
+                    raise Unlowerable("striped fan-out after aggregate/span")
                 chain.ops.append(("fanout", prog.sep[0]))
                 chain.fanout = True
             else:

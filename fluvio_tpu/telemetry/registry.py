@@ -4,7 +4,8 @@ One `PipelineTelemetry` per process (module-global ``TELEMETRY``),
 recording:
 
 - batch end-to-end latency histograms, split by path (``fused`` /
-  ``interpreter``) so the two execution modes are directly comparable,
+  ``striped`` / ``interpreter``) so the execution modes are directly
+  comparable,
 - per-phase latency histograms + running time totals (the bench's
   per-phase breakdown reads the totals; histograms answer "is the
   d2h tail bimodal"),
@@ -38,6 +39,7 @@ class PipelineTelemetry:
         self._lock = threading.Lock()
         self.batch_latency: Dict[str, LatencyHistogram] = {
             "fused": LatencyHistogram(),
+            "striped": LatencyHistogram(),
             "interpreter": LatencyHistogram(),
         }
         self.phase_hist: Dict[str, LatencyHistogram] = {
@@ -49,7 +51,9 @@ class PipelineTelemetry:
         self.stripe_fallbacks = 0
         self.spills: Dict[str, int] = {}
         self.declines: Dict[str, int] = {}
-        self.batch_records: Dict[str, int] = {"fused": 0, "interpreter": 0}
+        self.batch_records: Dict[str, int] = {
+            "fused": 0, "striped": 0, "interpreter": 0
+        }
         # resilience counters (PR 3): bounded-retry attempts keyed by the
         # seam that failed, poison batches dead-lettered, and the
         # per-chain circuit-breaker state machine (current state per
@@ -168,6 +172,12 @@ class PipelineTelemetry:
         with self._lock:
             return self.batch_latency[path].copy()
 
+    def path_records(self) -> Dict[str, int]:
+        """{path: records} — the bench diffs two of these around a timed
+        run to report the path each config ACTUALLY executed on."""
+        with self._lock:
+            return dict(self.batch_records)
+
     def snapshot(self) -> dict:
         """The ONE snapshot shape every export surface renders from
         (monitoring JSON, Prometheus text, CLI table) — they must not
@@ -225,7 +235,9 @@ class PipelineTelemetry:
             self.breaker_states = {}
             self.breaker_transitions = {}
             self.breaker_short_circuits = 0
-            self.batch_records = {"fused": 0, "interpreter": 0}
+            self.batch_records = {
+                "fused": 0, "striped": 0, "interpreter": 0
+            }
             self.interp_calls = 0
             self.interp_seconds = 0.0
             self.interp_records = 0

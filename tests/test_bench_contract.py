@@ -128,7 +128,7 @@ def test_link_calibration_rides_every_emit():
         b._LINK.clear()
 
 
-def _full_config(rps: int, x: float) -> dict:
+def _full_config(rps: int, x: float, path: str = "fused") -> dict:
     """A config entry with every field a real healthy run carries."""
     return {
         "records_per_sec": rps,
@@ -141,6 +141,8 @@ def _full_config(rps: int, x: float) -> dict:
         "link_floor_ms": 777,
         "link_saturation": 0.45,
         "glz_ratio": 0.476,
+        "path": path,
+        "path_records": {path: rps * 7},
         "phases": {
             "wall_ms": 1693.4,
             "phase_sum_ms": 1650.2,
@@ -159,15 +161,15 @@ def _full_results() -> dict:
     """Results shaped like round 5's real capture — the size class that
     overgrew the driver's tail window and came back ``parsed: null``."""
     results = {
-        name: _full_config(rps, x)
-        for name, rps, x in [
-            ("1_filter", 552722, 0.41),
-            ("2_filter_map", 577711, 1.12),
-            ("3_aggregate", 820770, 3.48),
-            ("4_array_map", 160755, 2.73),
-            ("5_windowed", 599025, 3.63),
-            ("6_wide300", 218726, 0.32),
-            ("7_fat70k", 190253, 19.94),
+        name: _full_config(rps, x, path)
+        for name, rps, x, path in [
+            ("1_filter", 552722, 0.41, "fused"),
+            ("2_filter_map", 577711, 1.12, "fused"),
+            ("3_aggregate", 820770, 3.48, "fused"),
+            ("4_array_map", 160755, 2.73, "fused"),
+            ("5_windowed", 599025, 3.63, "fused"),
+            ("6_wide300", 218726, 0.32, "fused"),
+            ("7_fat70k", 190253, 19.94, "striped"),
         ]
     }
     results["2_filter_map"]["staging_ab"] = {
@@ -219,6 +221,11 @@ def test_compact_line_fits_driver_window():
     assert parsed["configs"]["6_wide300"] == {"rps": 218726, "x": 0.32}
     assert parsed["configs"]["broker_e2e"]["x_engine"] == 0.52
     assert "codecs" not in parsed["configs"]  # aux detail stays in the file
+    # executed-path honesty: the telemetry-derived path tag rides the
+    # line for non-fused configs only (fused stays implicit)
+    assert parsed["configs"]["7_fat70k"]["path"] == "striped"
+    assert "path" not in parsed["configs"]["1_filter"]
+    assert "fallback" not in parsed["configs"]["7_fat70k"]  # static label is gone
     assert parsed["link"]["glz"] == "on"
     assert parsed["detail"] == "BENCH_DETAIL.json"
     # telemetry satellite: ONE compact phases key (the headline's p50/p99
@@ -249,6 +256,29 @@ def test_compact_line_trims_pathological_blowup_keeps_link():
     # link.glz survives trimming: the sentinel A/B pin reads it, and the
     # emit contract says it rides unconditionally
     assert parsed["link"]["glz"] == "on"
+
+
+def test_compact_line_fits_with_codecs_in_cpu_fallback():
+    """Round 5's actual failure mode: a chip-unreachable run wrapped the
+    FULL suite (codecs block included) under cpu_fallback and the line
+    outgrew the driver's tail window (``parsed: null``). The compact
+    line must stay under 1500 chars with codecs present — trimmed from
+    stdout, kept in BENCH_DETAIL.json."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "cpu_fallback"
+    out, rc = b._build_output(_full_results())
+    assert rc == 1
+    line = json.dumps(b._compact_line(out))
+    assert len(line) <= 1500, f"cpu_fallback compact line is {len(line)} chars"
+    parsed = json.loads(line)
+    assert parsed["value"] == 0  # honest zero survives compaction
+    inner = parsed["cpu_fallback"]
+    assert inner["configs"]["2_filter_map"]["rps"] == 577711
+    assert "codecs" not in inner["configs"]
+    # the detail file still carries the full codecs block
+    assert "codecs" in out["cpu_fallback"]["configs"]
 
 
 def test_compact_line_keeps_cpu_fallback_honest_zero():

@@ -1,0 +1,434 @@
+"""Associative-scan DFA engine + cross-stripe state chaining.
+
+Differential suite for the parallel regex path (kernels.dfa_match_assoc:
+transition-vector composition via `lax.associative_scan`) and the
+striped chains built on the same composition trick — DFA state chained
+across stripe rows (stripes.striped_dfa_verdict) and the JsonGet
+structural machine carried across stripe joints
+(stripes.striped_json_span). Every path is pinned three ways: against
+the sequential scan kernel, against Python ``re`` on bytes, and (for
+chain-level runs) against the interpreting backend — including matches
+that span stripe joints and records ending exactly at a stripe
+boundary. The state-count gate (FLUVIO_DFA_ASSOC_MAX_STATES) and its
+telemetry decline, the compiled-table cache, and the compile-size smoke
+gate for the headline shape ride along.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluvio_tpu.models import lookup
+from fluvio_tpu.ops import regex_dfa
+from fluvio_tpu.ops.regex_dfa import compile_regex, compile_regex_cached
+from fluvio_tpu.protocol.record import Record
+from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+from fluvio_tpu.smartengine.tpu import kernels
+from fluvio_tpu.smartmodule import SmartModuleInput, dsl
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef
+from fluvio_tpu.smartmodule.types import SmartModuleKind
+from fluvio_tpu.telemetry import TELEMETRY
+
+# same shrunken geometry as test_stripes.py: 48-byte step, so short
+# corpora exercise multi-stripe segments and joint-straddling matches
+STRIPE_ENV = {
+    "FLUVIO_STRIPE_THRESHOLD": "64",
+    "FLUVIO_STRIPE_WIDTH": "64",
+    "FLUVIO_STRIPE_OVERLAP": "16",
+}
+
+
+@pytest.fixture
+def small_stripes(monkeypatch):
+    for k, v in STRIPE_ENV.items():
+        monkeypatch.setenv(k, v)
+
+
+def _pack(data):
+    w = max(max((len(d) for d in data), default=1), 1)
+    m = np.zeros((len(data), w), np.uint8)
+    lens = np.zeros(len(data), np.int32)
+    for i, d in enumerate(data):
+        m[i, : len(d)] = np.frombuffer(d, np.uint8)
+        lens[i] = len(d)
+    return jnp.asarray(m), jnp.asarray(lens)
+
+
+def filter_module(pattern: str) -> SmartModuleDef:
+    m = SmartModuleDef(name="dfa-filter")
+    m.dsl[SmartModuleKind.FILTER] = dsl.FilterProgram(
+        predicate=dsl.RegexMatch(arg=dsl.Value(), pattern=pattern)
+    )
+    return m
+
+
+def _build(backend: str, mods, mesh=None):
+    eng = (
+        SmartEngine(backend=backend, mesh_devices=mesh)
+        if mesh
+        else SmartEngine(backend=backend)
+    )
+    b = eng.builder()
+    for mod, params in mods:
+        b.add_smart_module(SmartModuleConfig(params=params or {}), mod)
+    return b.initialize()
+
+
+def _run(chain, vals):
+    records = [Record(value=v) for v in vals]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+    out = chain.process(SmartModuleInput.from_records(records, 0, 1_000_000))
+    assert out.error is None, out.error
+    return [(r.value, r.key, r.offset_delta) for r in out.successes]
+
+
+PATTERNS = [
+    "fluvio",
+    "flu[vV]io",
+    "a+b",
+    "(ab)+c?",
+    "[0-9]+-[0-9]+",
+    "^top[ic]*",
+    "fluvio$",
+    "a.c",
+    r"\d{2,4}x?",
+    r"(foo|ba[rz])\s+\w+",
+]
+
+
+def _random_corpus(rng, pattern: str, n: int = 220):
+    """Random bytes plus planted near-matches so both verdicts appear."""
+    data = [
+        bytes(rng.integers(32, 127, size=int(rng.integers(0, 60))).astype(np.uint8))
+        for _ in range(n)
+    ]
+    seeds = [b"fluvio", b"fluVio", b"aab", b"ababc", b"12-34", b"topic",
+             b"foo  bar", b"baz x1", b"99x", b"a_c", b"abc"]
+    for i, s in enumerate(seeds):
+        pad = bytes(rng.integers(32, 127, size=int(rng.integers(0, 20))).astype(np.uint8))
+        data.append(pad + s + pad)
+    data += [b"", b"a", b"x" * 59]
+    return data
+
+
+class TestAssocKernel:
+    def test_differential_random_patterns(self):
+        """assoc scan == sequential scan == Python re, pattern x corpus."""
+        rng = np.random.default_rng(42)
+        for pattern in PATTERNS:
+            dfa = compile_regex(pattern)
+            data = _random_corpus(rng, pattern)
+            values, lengths = _pack(data)
+            seq = np.asarray(kernels.dfa_match(values, lengths, dfa))
+            assoc = np.asarray(kernels.dfa_match_assoc(values, lengths, dfa))
+            pyref = np.array(
+                [re.search(pattern.encode(), d) is not None for d in data]
+            )
+            assert (assoc == seq).all(), pattern
+            assert (assoc == pyref).all(), pattern
+
+    def test_record_exactly_at_width(self):
+        # EOS rides the trailing symbol column when len == width
+        dfa = compile_regex("fluvio$")
+        data = [b"xfluvio", b"fluviox", b"fluvio"]
+        w = max(len(d) for d in data)
+        m = np.zeros((len(data), w), np.uint8)
+        lens = np.array([len(d) for d in data], np.int32)
+        for i, d in enumerate(data):
+            m[i, : len(d)] = np.frombuffer(d, np.uint8)
+        assoc = np.asarray(
+            kernels.dfa_match_assoc(jnp.asarray(m), jnp.asarray(lens), dfa)
+        )
+        assert assoc.tolist() == [True, False, True]
+
+    def test_block_boundary_composition(self, monkeypatch):
+        """Compositions crossing the column-block seam stay exact."""
+        monkeypatch.setattr(kernels, "_DFA_ASSOC_BLOCK", 8)
+        dfa = compile_regex("(ab)+c")
+        data = [b"x" * k + b"ababababc" for k in range(0, 20)] + [
+            b"ab" * 12, b"ab" * 12 + b"c"
+        ]
+        values, lengths = _pack(data)
+        seq = np.asarray(kernels.dfa_match(values, lengths, dfa))
+        assoc = np.asarray(kernels.dfa_match_assoc(values, lengths, dfa))
+        assert (assoc == seq).all()
+
+    def test_lowering_gate_falls_back_sequential(self, monkeypatch):
+        """Past FLUVIO_DFA_ASSOC_MAX_STATES the lowering keeps the
+        sequential scan (same verdicts) and counts the decline — the
+        gate only fires on a backend whose policy WANTED the associative
+        path (pinned on here; CPU's auto policy never reaches it)."""
+        monkeypatch.setenv("FLUVIO_DFA_ASSOC", "1")
+        monkeypatch.setenv("FLUVIO_DFA_ASSOC_MAX_STATES", "1")
+        from fluvio_tpu.smartengine.tpu.lower import lower_expr
+
+        before = TELEMETRY.snapshot()["counters"]["declines"].get(
+            "dfa-assoc-states", 0
+        )
+        fn = lower_expr(dsl.RegexMatch(arg=dsl.Value(), pattern="flu[vV]io"))
+        after = TELEMETRY.snapshot()["counters"]["declines"].get(
+            "dfa-assoc-states", 0
+        )
+        assert after == before + 1
+        data = [b"fluvio", b"fluVio", b"flubio", b""]
+        values, lengths = _pack(data)
+        got = np.asarray(fn({"values": values, "lengths": lengths}))
+        assert got.tolist() == [True, True, False, False]
+
+    def test_cpu_auto_policy_keeps_sequential_without_decline(self, monkeypatch):
+        """FLUVIO_DFA_ASSOC=auto on the CPU backend picks the sequential
+        scan by policy (the composition's S x work multiplier loses on a
+        work-bound backend) — correct verdicts, and NOT a gate decline."""
+        monkeypatch.delenv("FLUVIO_DFA_ASSOC", raising=False)
+        from fluvio_tpu.smartengine.tpu.lower import lower_expr
+
+        before = TELEMETRY.snapshot()["counters"]["declines"].get(
+            "dfa-assoc-states", 0
+        )
+        fn = lower_expr(dsl.RegexMatch(arg=dsl.Value(), pattern="flu[vV]io"))
+        data = [b"fluvio", b"fluVio", b"flubio"]
+        values, lengths = _pack(data)
+        assert np.asarray(
+            fn({"values": values, "lengths": lengths})
+        ).tolist() == [True, True, False]
+        after = TELEMETRY.snapshot()["counters"]["declines"].get(
+            "dfa-assoc-states", 0
+        )
+        assert after == before
+
+    def test_compile_cache_shares_tables(self):
+        a = compile_regex_cached("cache[d]?-pattern")
+        b = compile_regex_cached("cache[d]?-pattern")
+        assert a is b
+        with pytest.raises(regex_dfa.UnsupportedRegex):
+            compile_regex_cached("(?P<named>x)")  # unsupported: not cached
+
+
+class TestStripedDfaChain:
+    def test_non_literal_regex_runs_striped_wide_batch(self, small_stripes):
+        """Acceptance pin: a non-literal regex filter on a wide batch
+        executes STRIPED (no interpreter spill), proven by the telemetry
+        path counter, and matches the interpreting backend exactly."""
+        rng = np.random.default_rng(3)
+        vals = [
+            (b"x" * int(rng.integers(0, 140)))
+            + (b"fluVio" if i % 3 else b"flub")
+            + b"y" * 30
+            for i in range(300)
+        ]
+        mods = lambda: [(filter_module("flu[vV]io"), None)]
+        tpu = _build("tpu", mods())
+        assert tpu.backend_in_use == "tpu"
+        assert tpu.tpu_chain._striped_chain() is not None
+        pr0 = TELEMETRY.path_records()
+        got = _run(tpu, vals)
+        pr1 = TELEMETRY.path_records()
+        assert got == _run(_build("python", mods()), vals)
+        assert pr1["striped"] - pr0["striped"] >= len(vals)
+        assert pr1["interpreter"] == pr0["interpreter"]  # no spill
+
+    def test_matches_span_stripe_joints(self, small_stripes):
+        # the match window crosses the 48-byte stripe step at every
+        # offset, in both directions; plus records ending exactly at a
+        # stripe boundary (len == k*step and len == k*step + overlap)
+        vals = [b"x" * pad + b"flu7io" + b"y" * 40 for pad in range(0, 120)]
+        vals += [b"x" * pad + b"flu77io" + b"y" * 40 for pad in range(0, 60)]
+        for k in (1, 2, 3):
+            body = b"z" * (48 * k - 6) + b"flu9io"
+            vals += [body, body + b"q" * 16]
+        mods = lambda: [(filter_module(r"flu\d+io"), None)]
+        got = _run(_build("tpu", mods()), vals)
+        ref = _run(_build("python", mods()), vals)
+        assert got == ref
+
+    def test_anchored_patterns_striped(self, small_stripes):
+        vals = (
+            [b"topic" + b"x" * n for n in (0, 10, 50, 100, 150)]
+            + [b"x" * n + b"end7" for n in (0, 10, 47, 48, 100, 141)]
+            + [b"", b"x" * 200]
+        )
+        for pattern in (r"^top[ic]+", r"end\d$"):
+            mods = lambda: [(filter_module(pattern), None)]
+            tpu = _build("tpu", mods())
+            assert tpu.tpu_chain._striped_chain() is not None
+            assert _run(tpu, vals) == _run(_build("python", mods()), vals)
+
+    def test_long_literal_chains_past_overlap(self, small_stripes, monkeypatch):
+        """A literal longer than the 16-byte overlap used to spill; with
+        the gate raised it chains across stripes as a DFA instead."""
+        monkeypatch.setenv("FLUVIO_DFA_ASSOC_MAX_STATES", "64")
+        lit = b"qwertyuiopasdfghjklz"  # 20 bytes > overlap
+        vals = [b"x" * n + lit + b"y" * 30 for n in range(0, 90, 5)]
+        vals += [b"x" * n + lit[:-1] + b"y" * 30 for n in range(0, 45, 5)]
+        mods = lambda: [(filter_module(lit.decode()), None)]
+        tpu = _build("tpu", mods())
+        assert tpu.tpu_chain._striped_chain() is not None
+        assert _run(tpu, vals) == _run(_build("python", mods()), vals)
+
+    def test_state_gate_spills_with_decline(self, small_stripes, monkeypatch):
+        """Past the gate the striped build declines (reason counted) and
+        wide batches spill to the interpreter — still exact."""
+        monkeypatch.setenv("FLUVIO_DFA_ASSOC_MAX_STATES", "2")
+        before = TELEMETRY.snapshot()["counters"]["declines"].get(
+            "dfa-stripe-states", 0
+        )
+        vals = [b"x" * n + b"flu7io" + b"y" * 40 for n in range(0, 80, 7)]
+        mods = lambda: [(filter_module(r"flu\d+io"), None)]
+        tpu = _build("tpu", mods())
+        assert tpu.tpu_chain._striped_chain() is None
+        # the striped gate counts under its own reason — one logical trip
+        # must not double-count with the narrow lowering's decline
+        after = TELEMETRY.snapshot()["counters"]["declines"].get(
+            "dfa-stripe-states", 0
+        )
+        assert after == before + 1
+        assert _run(tpu, vals) == _run(_build("python", mods()), vals)
+
+    @pytest.mark.skipif(
+        len(jax.devices()) < 4, reason="needs 4 virtual devices"
+    )
+    def test_sharded_striped_dfa(self, small_stripes):
+        rng = np.random.default_rng(11)
+        vals = [
+            (b"x" * int(rng.integers(0, 120)))
+            + (b"fluVio" if i % 2 else b"kafka")
+            + b"t" * 20
+            for i in range(400)
+        ]
+        mods = lambda: [(filter_module("flu[vV]io"), None)]
+        tpu = _build("tpu", mods(), mesh=4)
+        assert tpu.tpu_chain._sharded is not None
+        assert _run(tpu, vals) == _run(_build("python", mods()), vals)
+
+
+class TestStripedJsonGet:
+    HEADLINE = staticmethod(
+        lambda: [
+            (lookup("regex-filter"), {"regex": "fluvio"}),
+            (lookup("json-map"), {"field": "name"}),
+        ]
+    )
+
+    def test_headline_chain_runs_striped_at_width(self, small_stripes):
+        """regex-filter + json-map on wide records: striped end to end
+        (telemetry path counter), byte-equal to the interpreter."""
+        vals = [
+            (f'{{"name":"fluvio-{i}","pad":"{"x" * (40 + i)}"}}').encode()
+            for i in range(80)
+        ] + [
+            (f'{{"pad":"{"y" * 130}","name":"kafka-{i}"}}').encode()
+            for i in range(40)
+        ]
+        tpu = _build("tpu", self.HEADLINE())
+        sc = tpu.tpu_chain._striped_chain()
+        assert sc is not None and sc.has_span
+        pr0 = TELEMETRY.path_records()
+        got = _run(tpu, vals)
+        pr1 = TELEMETRY.path_records()
+        assert got == _run(_build("python", self.HEADLINE()), vals)
+        assert pr1["striped"] - pr0["striped"] >= len(vals)
+        assert pr1["interpreter"] == pr0["interpreter"]
+
+    def test_field_values_straddle_stripe_joints(self, small_stripes):
+        # pad the prefix so the needle, the colon, and the value each
+        # land across the 48-byte stripe step in turn
+        vals = []
+        for pad in range(0, 100, 3):
+            vals.append(
+                (
+                    f'{{"pad":"{"p" * pad}","name":"fluvio-{pad:03d}-'
+                    f'{"v" * 30}","n":{pad}}}'
+                ).encode()
+            )
+        # records ending exactly at stripe boundaries (len == k*48)
+        for k in (1, 2, 3):
+            body = f'{{"name":"fluvio-{k}","pad":"'.encode()
+            fill = 48 * k - len(body) - 2
+            if fill > 0:
+                vals.append(body + b"f" * fill + b'"}')
+        got = _run(_build("tpu", self.HEADLINE()), vals)
+        ref = _run(_build("python", self.HEADLINE()), vals)
+        assert got == ref
+
+    def test_fuzz_random_json(self, small_stripes):
+        rng = np.random.default_rng(23)
+        keys = ["name", "pad", "n", "zz"]
+        vals = []
+        for i in range(250):
+            fields = []
+            for k in rng.permutation(keys)[: int(rng.integers(1, 5))]:
+                if rng.random() < 0.3:
+                    fields.append(f'"{k}":{int(rng.integers(0, 9999))}')
+                else:
+                    fields.append(
+                        f'"{k}":"{"s" * int(rng.integers(0, 90))}fluvio"'
+                    )
+            vals.append(("{" + ",".join(fields) + "}").encode())
+        vals += [b"", b"not json", b'{"name":', b'{"name"}', b'{"name":}']
+        got = _run(_build("tpu", self.HEADLINE()), vals)
+        ref = _run(_build("python", self.HEADLINE()), vals)
+        assert got == ref
+
+    def test_upper_fold_over_json_view(self, small_stripes):
+        # outer postop over the JsonGet view: spans computed on folded
+        # bytes are valid in the original; the fold applies host-side
+        m = SmartModuleDef(name="upper-json-map")
+        m.dsl[SmartModuleKind.MAP] = dsl.MapProgram(
+            value=dsl.Upper(arg=dsl.JsonGet(arg=dsl.Value(), key="name"))
+        )
+        m.hooks[SmartModuleKind.MAP] = lambda record: dsl.ascii_upper(
+            dsl.json_get_bytes(record.value, "name") or b""
+        )
+        vals = [
+            (f'{{"name":"fluvio-{i}","pad":"{"x" * 100}"}}').encode()
+            for i in range(40)
+        ]
+        mods = lambda: [(m, None)]
+        tpu = _build("tpu", mods())
+        assert tpu.tpu_chain._striped_chain() is not None
+        assert _run(tpu, vals) == _run(_build("python", mods()), vals)
+
+    @pytest.mark.skipif(
+        len(jax.devices()) < 4, reason="needs 4 virtual devices"
+    )
+    def test_sharded_headline_striped(self, small_stripes):
+        rng = np.random.default_rng(31)
+        vals = [
+            (
+                f'{{"name":"fluvio-{i}","pad":"{"x" * int(rng.integers(20, 120))}"}}'
+            ).encode()
+            for i in range(200)
+        ] + [
+            (f'{{"pad":"{"y" * 100}","name":"kafka-{i}"}}').encode()
+            for i in range(100)
+        ]
+        tpu = _build("tpu", self.HEADLINE(), mesh=4)
+        assert tpu.tpu_chain._sharded is not None
+        got = _run(tpu, vals)
+        ref = _run(_build("python", self.HEADLINE()), vals)
+        assert got == ref
+
+
+class TestCompileGate:
+    def test_assoc_compile_time_bounded(self):
+        """Compile-size smoke gate: the jitted associative `dfa_match`
+        for a headline-chain-like shape must compile in bounded time on
+        CPU CI — the log-depth composition must not regress into the
+        pathological 20-120 s first calls the sequential scan showed
+        on-chip (per-config ``first_call_s`` lands in BENCH_DETAIL.json
+        for the on-chip deltas)."""
+        dfa = compile_regex("fluvio[0-9]+")
+        values = jnp.zeros((2048, 512), jnp.uint8)
+        lengths = jnp.full((2048,), 500, jnp.int32)
+        fn = jax.jit(lambda v, l: kernels.dfa_match_assoc(v, l, dfa))
+        t0 = time.time()
+        fn(values, lengths).block_until_ready()
+        elapsed = time.time() - t0
+        assert elapsed < 60.0, f"assoc dfa_match compiled in {elapsed:.1f}s"

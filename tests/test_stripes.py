@@ -315,20 +315,35 @@ class TestStripedEquivalence:
             vals,
         )
 
-    def test_json_chain_spills_gracefully(self, small_stripes):
-        # JsonGet is outside the stripeable subset: wide batches take the
-        # interpreter spill and outputs still match exactly
+    def test_json_chain_runs_striped(self, small_stripes):
+        # the headline regex-filter + json-map chain stripes at width:
+        # the JsonGet structural machine carries state across stripes
+        # (striped_json_span) and ships view descriptors
         vals = [
             (f'{{"name":"fluvio-{i}","pad":"{"x" * 120}"}}').encode()
             for i in range(60)
         ]
-        _assert_equivalent(
+        ex = _assert_equivalent(
             lambda: [
                 (lookup("regex-filter"), {"regex": "fluvio"}),
                 (lookup("json-map"), {"field": "name"}),
             ],
             vals,
-            striped=False,
+        )
+        assert ex._striped_chain().has_span
+
+    def test_json_sourced_predicate_spills(self, small_stripes):
+        # JsonGet-SOURCED predicates stay outside the stripeable subset
+        # (the striped filters scan stripe bytes, not the extracted view)
+        pred = dsl.Contains(
+            arg=dsl.JsonGet(arg=dsl.Value(), key="name"), literal=b"fluvio"
+        )
+        vals = [
+            (f'{{"name":"fluvio-{i}","pad":"{"x" * 120}"}}').encode()
+            for i in range(40)
+        ]
+        _assert_equivalent(
+            lambda: [(predicate_module(pred), None)], vals, striped=False
         )
 
     def test_literal_longer_than_overlap_spills(self, small_stripes):
@@ -433,12 +448,17 @@ class TestWideDefaults:
             "tpu", [(filter_module("fluvio"), None)]
         ).tpu_chain
         assert striped.max_stageable_width() == MAX_RECORD_WIDTH
-        unstripeable = _build(
+        # the headline json chain now stripes too (cross-stripe JsonGet)
+        json_chain = _build(
             "tpu",
             [
                 (lookup("regex-filter"), {"regex": "fluvio"}),
                 (lookup("json-map"), {"field": "name"}),
             ],
+        ).tpu_chain
+        assert json_chain.max_stageable_width() == MAX_RECORD_WIDTH
+        unstripeable = _build(
+            "tpu", [(lookup("word-count"), None)]
         ).tpu_chain
         assert unstripeable.max_stageable_width() == MAX_WIDTH
 

@@ -82,6 +82,60 @@ class TestJsonGet:
                 assert out_v[i, : out_l[i]].tobytes() == expected, (doc, key)
 
 
+class TestJsonParallelExactness:
+    """The structural-index kernel is now the DEFAULT XLA span path: its
+    string/escape tracking runs on the exact 3-state automaton
+    (kernels.string_state_excl, transition composition) instead of the
+    backslash-run parity heuristic — so it must match the scan kernel
+    AND the DSL reference on arbitrary structural garbage, including the
+    heuristic's old escaped-quote-outside-strings deviation."""
+
+    def test_old_deviation_shapes(self):
+        docs = [
+            b'\\"name":1}',        # backslash before a quote, outside any string
+            b'{\\\\"name":2}',
+            b'{\\"name":"v"}',
+            b'{"a":"b\\\\","name":"c"}',
+            b'{"name":"a\\"b"}',   # escape inside a string (both paths agree)
+            b'{"na\\"me":"x","name":"y"}',
+            b'{"name":"\\\\"}',
+        ]
+        buf = stage(docs)
+        for key in ("name", "a"):
+            st_s, ln_s = kernels.json_get_span(buf.values, buf.lengths, key)
+            st_p, ln_p = kernels.json_get_parallel_span(
+                buf.values, buf.lengths, key
+            )
+            for i, d in enumerate(docs):
+                a = d[int(st_s[i]) : int(st_s[i]) + int(ln_s[i])]
+                b = d[int(st_p[i]) : int(st_p[i]) + int(ln_p[i])]
+                ref = dsl.json_get_bytes(d, key) or b""
+                assert a == b == ref, (d, key, a, b, ref)
+
+    def test_fuzz_structural_garbage(self):
+        rng = np.random.default_rng(99)
+        alphabet = list(b'{}[]":\\, abn0123x')
+        docs = [
+            bytes(
+                rng.choice(alphabet, size=rng.integers(1, 70)).astype(np.uint8)
+            )
+            for _ in range(600)
+        ]
+        buf = stage(docs)
+        for key in ("name", "a"):
+            st_s, ln_s = kernels.json_get_span(buf.values, buf.lengths, key)
+            st_p, ln_p = kernels.json_get_parallel_span(
+                buf.values, buf.lengths, key
+            )
+            st_s, ln_s = np.asarray(st_s), np.asarray(ln_s)
+            st_p, ln_p = np.asarray(st_p), np.asarray(ln_p)
+            for i, d in enumerate(docs):
+                a = d[st_s[i] : st_s[i] + ln_s[i]]
+                b = d[st_p[i] : st_p[i] + ln_p[i]]
+                ref = dsl.json_get_bytes(d, key) or b""
+                assert a == b == ref, (d, key, a, b, ref)
+
+
 class TestParseInt:
     def test_matches_reference(self):
         cases = [b"42", b"-7", b"  13x", b"+5", b"abc", b"", b"12.9", b"-",
