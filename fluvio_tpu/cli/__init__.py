@@ -2,7 +2,7 @@
 
 Run as ``python -m fluvio_tpu.cli <command>``. Commands: produce, consume,
 topic, partition, smartmodule, tableformat, spu, profile, cluster, run,
-metrics, version.
+metrics, trace, version.
 """
 
 from __future__ import annotations
@@ -21,6 +21,7 @@ def build_parser() -> argparse.ArgumentParser:
     from fluvio_tpu.cli import hub as hub_cmd
     from fluvio_tpu.cli import metrics as metrics_cmd
     from fluvio_tpu.cli import produce as produce_cmd
+    from fluvio_tpu.cli import trace as trace_cmd
     from fluvio_tpu.cli.common import add_connection_args
 
     parser = argparse.ArgumentParser(
@@ -42,6 +43,7 @@ def build_parser() -> argparse.ArgumentParser:
         cluster_cmd.add_run_parser,
         hub_cmd.add_hub_parser,
         metrics_cmd.add_metrics_parser,
+        trace_cmd.add_trace_parser,
     ):
         add(sub)
 

@@ -124,6 +124,45 @@ def render_metrics_table(data: dict) -> str:
             "breaker state\n" + _rows_to_table(rows, header=("chain", "state"))
         )
 
+    comp = tel.get("compile") or {}
+    by_kind = comp.get("by_kind") or {}
+    if by_kind:
+        secs = comp.get("seconds_by_kind") or {}
+        rows = [
+            (kind, _fmt_count(n), round(secs.get(kind, 0.0), 3))
+            for kind, n in sorted(by_kind.items())
+        ]
+        rows.append(
+            ("(persistent-cache hit/miss)",
+             f"{_fmt_count(comp.get('persistent_cache_hits', 0))}/"
+             f"{_fmt_count(comp.get('persistent_cache_misses', 0))}",
+             "")
+        )
+        rows.append(
+            ("(trace-cache hits)",
+             _fmt_count(comp.get("jit_cache_hits", 0)), "")
+        )
+        sections.append(
+            "jit compiles\n"
+            + _rows_to_table(rows, header=("kind", "count", "seconds"))
+        )
+
+    gauges = tel.get("gauges") or {}
+    if gauges:
+        rows = [(name, _fmt_count(v)) for name, v in sorted(gauges.items())]
+        sections.append(
+            "gauges\n" + _rows_to_table(rows, header=("gauge", "value"))
+        )
+    dropped = tel.get("spans_dropped", 0)
+    if dropped:
+        sections.append(
+            "spans\n"
+            + _rows_to_table(
+                [("dropped (ring wrapped)", _fmt_count(dropped))],
+                header=("spans", "count"),
+            )
+        )
+
     batches = tel.get("batches") or {}
     rows = []
     for path, b in sorted(batches.items()):

@@ -1,0 +1,56 @@
+"""`trace` subcommand — export the engine flight recorder.
+
+Dumps the SPU's recent per-batch spans and instant events (heals,
+spills, retries, breaker transitions, compiles) as one Chrome-trace /
+Perfetto JSON document, read over the monitoring unix socket's
+``trace`` mode line. Load the file in https://ui.perfetto.dev (or
+chrome://tracing): each execution path (fused/striped/interpreter) gets
+its own lane group, overlapping batches render on separate lanes, and
+each pipeline phase is a duration event — the pipelined overlap (batch
+k's ``device`` span under batch k+1's ``dispatch``) is directly
+visible.
+
+For continuous capture without a CLI in the loop, set
+``FLUVIO_TRACE=<path>`` on the engine process instead (bounded +
+rotated; see telemetry/trace.py).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def add_trace_parser(sub) -> None:
+    p = sub.add_parser(
+        "trace",
+        help="export the flight recorder as Chrome-trace/Perfetto JSON",
+    )
+    p.add_argument(
+        "--out",
+        help="write the trace to this file (default: stdout)",
+    )
+    p.add_argument(
+        "--path",
+        help="monitoring unix-socket path (default: FLUVIO_METRIC_SPU)",
+    )
+    p.set_defaults(fn=trace)
+
+
+async def trace(args) -> int:
+    from fluvio_tpu.spu.monitoring import read_trace
+
+    doc = await read_trace(args.path)
+    text = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+        n = len(doc.get("traceEvents", []))
+        print(
+            f"wrote {n} trace events to {args.out} — load it in "
+            "https://ui.perfetto.dev",
+            file=sys.stderr,
+        )
+    else:
+        print(text)
+    return 0

@@ -26,6 +26,8 @@ state accepts" after scanning len(record)+1 symbols.
 from __future__ import annotations
 
 import functools
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
@@ -557,7 +559,49 @@ def compile_regex(pattern: str) -> CompiledDfa:
 # one CompiledDfa across executors is safe; lru_cache is thread-safe,
 # bounds the table count, and does not cache the UnsupportedRegex that
 # callers treat as control flow.
-compile_regex_cached = functools.lru_cache(maxsize=256)(compile_regex)
+_compile_regex_lru = functools.lru_cache(maxsize=256)(compile_regex)
+# largest miss count already accounted for as a compile event: a thread
+# whose cache hit races another thread's miss observes no NEW growth
+# past this mark and records nothing (same dedupe as instrument_jit)
+_dfa_seen_misses = [0]
+_dfa_seen_lock = threading.Lock()
+
+
+def compile_regex_cached(pattern: str) -> "CompiledDfa":
+    """Cached table build, with compile observability: an lru miss
+    records a "dfa_table" compile event (the signature carries table
+    size, never the pattern text). The cache-hit path costs one
+    cache_info read — this runs per chain build, never per batch."""
+    from fluvio_tpu.telemetry.registry import TELEMETRY
+
+    t0 = time.perf_counter()
+    dfa = _compile_regex_lru(pattern)
+    if TELEMETRY.enabled:
+        misses = _compile_regex_lru.cache_info().misses
+        with _dfa_seen_lock:
+            grew = misses > _dfa_seen_misses[0]
+            _dfa_seen_misses[0] = max(_dfa_seen_misses[0], misses)
+        if grew:
+            TELEMETRY.add_compile(
+                "dfa_table",
+                f"pattern_len={len(pattern)} states={dfa.table.shape[0]} "
+                f"classes={dfa.table.shape[1]}",
+                time.perf_counter() - t0,
+            )
+    return dfa
+
+
+# tests reach the raw cache for isolation (cache_clear between fuzz
+# rounds); keep the attribute shape lru_cache exposed. Clearing the lru
+# resets its miss count, so the dedupe mark resets with it.
+def _cache_clear() -> None:
+    with _dfa_seen_lock:
+        _dfa_seen_misses[0] = 0
+    _compile_regex_lru.cache_clear()
+
+
+compile_regex_cached.cache_clear = _cache_clear
+compile_regex_cached.cache_info = _compile_regex_lru.cache_info
 
 
 def literal_of(pattern: str):

@@ -314,13 +314,26 @@ class ShardedChainExecutor:
             def step(uploads, count, base_ts, carries):
                 return local_step(uploads, count, base_ts, carries, cfg=cfg)
 
-            fn = jax.jit(
-                _shard_map(
-                    step,
-                    mesh=self.mesh,
-                    in_specs=in_specs,
-                    out_specs=out_specs,
-                )
+            from fluvio_tpu.telemetry import instrument_jit
+
+            # compile observability: a fresh (shapes, cfg) key means a
+            # fresh shard_map program — the wrapper records the compile
+            # with the chain signature + mesh width + static cfg tuple
+            sig = (
+                f"{getattr(self.executor, '_chain_sig', '?')} "
+                f"n={self.n} cfg={cfg}"
+            )
+            fn = instrument_jit(
+                jax.jit(
+                    _shard_map(
+                        step,
+                        mesh=self.mesh,
+                        in_specs=in_specs,
+                        out_specs=out_specs,
+                    )
+                ),
+                "sharded",
+                describe=lambda *a, _sig=sig, **k: _sig,
             )
             self._jit_cache[key] = fn
         return fn

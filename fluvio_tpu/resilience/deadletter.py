@@ -157,7 +157,17 @@ def _write_entry(
     finally:
         if os.path.exists(tmp):  # a failed dump must not leave debris
             os.remove(tmp)
+    _update_occupancy_gauge(path)
     return full
+
+
+def _update_occupancy_gauge(path: str) -> None:
+    """Refresh the dead-letter occupancy gauge (entries resident after
+    this write + eviction pass). A gauge, not a counter: replayed or
+    operator-removed entries show as a drop on the next quarantine."""
+    from fluvio_tpu.telemetry.registry import TELEMETRY
+
+    TELEMETRY.gauge_set("deadletter_entries", len(_entry_paths(path)))
 
 
 def load_entry(path: str) -> Tuple[List[dict], "object"]:

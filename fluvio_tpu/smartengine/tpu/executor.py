@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from fluvio_tpu.telemetry import TELEMETRY
+from fluvio_tpu.telemetry import TELEMETRY, instrument_jit
 from fluvio_tpu.resilience import faults
 from fluvio_tpu.resilience.policy import RetryPolicy
 
@@ -486,12 +486,29 @@ class TpuChainExecutor:
                 self.carries.append((acc, 0, True))
         self._instances: List = []
         self._device_carries = None
-        self._jit_ragged = jax.jit(
-            self._chain_fn_ragged,
-            static_argnames=(
-                "width", "kwidth", "has_keys", "has_offsets", "ts_mode",
-                "fanout_cap", "glz_bytes",
+        # short chain signature for compile-event attribution: which
+        # chain shape a trace-cache miss compiled for
+        self._chain_sig = (
+            "+".join(
+                type(s).__name__.lstrip("_").replace("Stage", "").lower()
+                for s in stages
+            )
+            or "empty"
+        )
+        # jit entry points wrapped for compile observability: every
+        # trace-cache miss records {kind, chain signature + shape
+        # bucket, wall seconds, persistent-cache outcome} (free when
+        # FLUVIO_TELEMETRY=0 — see telemetry/compiles.py)
+        self._jit_ragged = instrument_jit(
+            jax.jit(
+                self._chain_fn_ragged,
+                static_argnames=(
+                    "width", "kwidth", "has_keys", "has_offsets", "ts_mode",
+                    "fanout_cap", "glz_bytes",
+                ),
             ),
+            "ragged",
+            describe=self._describe_ragged,
         )
         # striped wide-record layout (stripes.py): records wider than the
         # narrow layout stage as fixed-width stripe rows sharing a
@@ -504,12 +521,16 @@ class TpuChainExecutor:
         self._stripe_threshold = int(
             os.environ.get("FLUVIO_STRIPE_THRESHOLD", MAX_WIDTH)
         )
-        self._jit_striped = jax.jit(
-            self._chain_fn_striped,
-            static_argnames=(
-                "srows", "kmax", "kwidth", "has_keys", "has_offsets",
-                "ts_mode", "fanout_cap", "glz_bytes",
+        self._jit_striped = instrument_jit(
+            jax.jit(
+                self._chain_fn_striped,
+                static_argnames=(
+                    "srows", "kmax", "kwidth", "has_keys", "has_offsets",
+                    "ts_mode", "fanout_cap", "glz_bytes",
+                ),
             ),
+            "striped",
+            describe=self._describe_striped,
         )
         # glz self-heal bookkeeping: a heal invalidates the device carry
         # lineage of every aggregate dispatch already in flight; the
@@ -547,6 +568,10 @@ class TpuChainExecutor:
         # on CPU and on the real chip.
         self.h2d_bytes_total = 0
         self.d2h_bytes_total = 0
+        # gauge bookkeeping: staged link bytes per in-flight handle, so
+        # the HBM/live-handle gauges go down by exactly what went up
+        # (keyed by id(); entries live only dispatch->finish/discard)
+        self._handle_gauge: Dict[int, int] = {}
         # recovery policy (resilience/policy.py): transient device/link
         # failures retry against the handle's carry snapshot; budgets
         # come from the FLUVIO_RETRY_* env knobs at construction
@@ -1074,6 +1099,40 @@ class TpuChainExecutor:
         packed["mask"] = kernels.pack_mask(valid)
         mx = jnp.max(jnp.where(valid, lengths, 0))
         return _header(mx), packed, carries
+
+    def _describe_ragged(self, *a, **k) -> str:
+        """Compile-event signature for the narrow jit: chain + the
+        static shape-bucket kwargs (never touches array values)."""
+        return (
+            f"{self._chain_sig} w={k.get('width')} "
+            f"glz={k.get('glz_bytes', 0)} cap={k.get('fanout_cap')}"
+        )
+
+    def _describe_striped(self, *a, **k) -> str:
+        return (
+            f"{self._chain_sig} srows={k.get('srows')} "
+            f"kmax={k.get('kmax', 0)} glz={k.get('glz_bytes', 0)}"
+        )
+
+    # -- device-memory / in-flight gauges ------------------------------------
+
+    def _gauge_track(self, handle, nbytes: int) -> None:
+        """A dispatch went up: its staged link bytes are HBM-resident
+        until the fetch (or discard) releases them."""
+        if not TELEMETRY.enabled:
+            return
+        self._handle_gauge[id(handle)] = nbytes
+        TELEMETRY.gauge_add("hbm_staged_bytes", nbytes)
+        TELEMETRY.gauge_add("live_batch_handles", 1)
+
+    def _gauge_release(self, handle) -> None:
+        """Idempotent: finish and discard may both see a handle on the
+        recovery ladders — only the first release moves the gauges."""
+        nbytes = self._handle_gauge.pop(id(handle), None)
+        if nbytes is None:
+            return
+        TELEMETRY.gauge_add("hbm_staged_bytes", -nbytes)
+        TELEMETRY.gauge_add("live_batch_handles", -1)
 
     def _dispatch(
         self,
@@ -1998,11 +2057,15 @@ class TpuChainExecutor:
             # single span — the batch really paid staging twice — and a
             # failed attempt's span is never orphaned)
             sh_span = TELEMETRY.begin_batch()
-            return self._dispatch_with_retry(
+            h0 = self.h2d_bytes_total
+            handle = self._dispatch_with_retry(
                 lambda: self._sharded.dispatch_buffer(buf, reuse_span=sh_span)
             )
+            self._gauge_track(handle, self.h2d_bytes_total - h0)
+            return handle
         span = TELEMETRY.begin_batch()
         prev_carries = self._device_carries
+        h0 = self.h2d_bytes_total
         header, packed = self._dispatch_with_retry(
             lambda: self._dispatch(
                 buf, fanout_cap=self._fanout_cap(buf), span=span
@@ -2021,7 +2084,9 @@ class TpuChainExecutor:
         # and the heal epoch its carry lineage belongs to
         spec["glz_used"] = getattr(self, "_glz_last", False)
         spec["epoch"] = self._heal_epoch
-        return (prev_carries, header, packed, spec)
+        handle = (prev_carries, header, packed, spec)
+        self._gauge_track(handle, self.h2d_bytes_total - h0)
+        return handle
 
     def dispatch_buffers(self, bufs: List[RecordBuffer]) -> List[tuple]:
         """Dispatch several buffers with ONE-AHEAD compress-ahead:
@@ -2116,6 +2181,7 @@ class TpuChainExecutor:
 
     def discard_dispatch(self, handle) -> None:
         """Drop a speculative dispatch, restoring pre-dispatch carries."""
+        self._gauge_release(handle)
         if self._sharded is not None:
             self._sharded.discard_dispatch(handle)
             return
@@ -2142,6 +2208,14 @@ class TpuChainExecutor:
         `TpuSpill` (carries restored) for the interpreter to re-run with
         exact error semantics.
         """
+        try:
+            return self._finish_buffer_inner(buf, handle)
+        finally:
+            # EVERY finish outcome (materialized output, spill, retry
+            # exhaustion) retires the handle's HBM/live-handle gauges
+            self._gauge_release(handle)
+
+    def _finish_buffer_inner(self, buf: RecordBuffer, handle) -> RecordBuffer:
         if self._sharded is not None:
             return self._finish_sharded(buf, handle)
         prev_carries, header, packed, spec = handle

@@ -27,6 +27,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:  # jax moved the context manager out of the top-level namespace
+    from jax.experimental import enable_x64 as _enable_x64
+except ImportError:  # pragma: no cover — older jax keeps the alias
+    _enable_x64 = jax.enable_x64
+
+from fluvio_tpu.telemetry import instrument_jit
+
 try:  # pallas availability is platform-dependent
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -137,26 +144,28 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
         instr_now = scanning & in_str_b
         new_esc = (instr_now & ~esc_b & (c == 92)).astype(jnp.int32)
         exit_str = instr_now & ~esc_b & (c == 34)
-        in_str1 = jnp.where(instr_now, jnp.where(exit_str, 0, in_str), in_str)
+        in_str1 = jnp.where(
+            instr_now, jnp.where(exit_str, jnp.int32(0), in_str), in_str
+        )
         esc1 = jnp.where(instr_now, new_esc, esc)
 
         outside = scanning & ~in_str_b
         quote_here = outside & (c == 34)
         matched = quote_here & (depth == 1) & wc_j
         open_str = quote_here & ~matched
-        in_str2 = jnp.where(open_str, 1, in_str1)
+        in_str2 = jnp.where(open_str, jnp.int32(1), in_str1)
         depth1 = jnp.where(
             outside & (c == 123), depth + 1,
             jnp.where(outside & (c == 125), depth - 1, depth),
         )
 
-        phase1 = jnp.where(matched, _SKIP_KEY, phase)
-        skip1 = jnp.where(matched, klen - 1, skip)
+        phase1 = jnp.where(matched, jnp.int32(_SKIP_KEY), phase)
+        skip1 = jnp.where(matched, jnp.int32(klen - 1), skip)
 
         # ---- skip over the needle bytes --------------------------------
         skipping = (phase == _SKIP_KEY) & inrec
         skip2 = jnp.where(skipping, skip - 1, skip1)
-        phase2 = jnp.where(skipping & (skip <= 1), _SEEK_COLON, phase1)
+        phase2 = jnp.where(skipping & (skip <= 1), jnp.int32(_SEEK_COLON), phase1)
 
         # ---- whitespace to the colon -----------------------------------
         seek_c = (phase == _SEEK_COLON) & inrec
@@ -177,16 +186,16 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
             phase3,
         )
         start1 = jnp.where(str_val, j + 1, jnp.where(val_here, j, start))
-        esc2 = jnp.where(str_val, 0, esc1)
-        d2a = jnp.where(val_here & ~str_val, 0, d2)
+        esc2 = jnp.where(str_val, jnp.int32(0), esc1)
+        d2a = jnp.where(val_here & ~str_val, jnp.int32(0), d2)
         raw_now = val_here & ~str_val
 
         # ---- string value: to the closing quote ------------------------
         instrval = (phase == _STR_VAL) & inrec
-        esc_sv = jnp.where(instrval & ~esc_b & (c == 92), 1,
-                           jnp.where(instrval, 0, esc2))
+        esc_sv = jnp.where(instrval & ~esc_b & (c == 92), jnp.int32(1),
+                           jnp.where(instrval, jnp.int32(0), esc2))
         close = instrval & ~esc_b & (c == 34)
-        phase5 = jnp.where(close, _DONE, phase4)
+        phase5 = jnp.where(close, jnp.int32(_DONE), phase4)
         end1 = jnp.where(close, j, end)
 
         # ---- raw value: to top-level , ] } -----------------------------
@@ -198,7 +207,7 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
             | ((c == 44) & (d2a == 0))
         )
         d2b = jnp.where(opens, d2a + 1, jnp.where(closes & ~term, d2a - 1, d2a))
-        phase6 = jnp.where(term, _DONE, phase5)
+        phase6 = jnp.where(term, jnp.int32(_DONE), phase5)
         end2 = jnp.where(term, j, end1)
         last_nonws1 = jnp.where(inraw & ~is_ws & ~term, j, last_nonws)
 
@@ -206,7 +215,7 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
         at_end = (j + 1 >= lengths) & inrec
         raw_eof = at_end & (phase6 == _RAW_VAL)
         str_eof = at_end & (phase6 == _STR_VAL)
-        phase7 = jnp.where(raw_eof | str_eof, _DONE, phase6)
+        phase7 = jnp.where(raw_eof | str_eof, jnp.int32(_DONE), phase6)
         end3 = jnp.where(raw_eof | str_eof, lengths, end2)
 
         return (
@@ -243,8 +252,8 @@ def _json_scan_kernel(needle: bytes, width: int, vt_ref, len_ref,
     end = jnp.where(
         raw_trim & (last_nonws + 1 < end), last_nonws + 1, end
     )
-    vlen = jnp.where(found, jnp.maximum(end - start, 0), 0)
-    start = jnp.where(found, start, 0)
+    vlen = jnp.where(found, jnp.maximum(end - start, 0), jnp.int32(0))
+    start = jnp.where(found, start, jnp.int32(0))
     start_ref[0:1, :] = start
     vlen_ref[0:1, :] = vlen
 
@@ -272,7 +281,7 @@ def _extract_kernel(width: int, vt_ref, start_ref, vlen_ref, out_ref):
         cond = ((start >> bit) & 1) == 1  # (1, n)
         shifted = jnp.where(cond, take, shifted)
     rows = jax.lax.broadcasted_iota(jnp.int32, (width, n), 0)
-    out_ref[:, :] = jnp.where(rows < vlen, shifted, 0)
+    out_ref[:, :] = jnp.where(rows < vlen, shifted, jnp.int32(0))
 
 
 def json_get_span_pallas(
@@ -303,7 +312,7 @@ def json_get_span_pallas(
     # kernels trace with x64 off: under the package-wide x64 every weak
     # Python-int literal becomes i64 and Mosaic's convert lowering recurses
     # infinitely on the resulting i64->i32 casts
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         start, vlen = pl.pallas_call(
             scan,
             grid=(blocks,),
@@ -344,7 +353,7 @@ def extract_pallas(
         vt = jnp.pad(vt, ((0, 0), (0, padded_n - n)))
         start = jnp.pad(start, (0, padded_n - n))
         vlen = jnp.pad(vlen, (0, padded_n - n))
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         extract = functools.partial(_extract_kernel, width)
         outT = pl.pallas_call(
             extract,
@@ -377,6 +386,20 @@ def json_get_pallas(
     start, vlen = json_get_span_pallas(values, lengths, key, interpret=interpret)
     out_values = extract_pallas(values, start, vlen, interpret=interpret)
     return out_values, vlen
+
+
+def _describe_json_get(*a, **k) -> str:
+    key = k.get("key", a[2] if len(a) > 2 else "?")
+    shape = getattr(a[0], "shape", ("?",)) if a else ("?",)
+    return f"json_get key={key} shape={tuple(shape)}"
+
+
+# compile observability: this is the one module-level jit entry point in
+# the pallas layer — trace-cache misses record "pallas" compile events
+# (telemetry/compiles.py; free when FLUVIO_TELEMETRY=0)
+json_get_pallas = instrument_jit(
+    json_get_pallas, "pallas", describe=_describe_json_get
+)
 
 
 # ---------------------------------------------------------------------------
@@ -433,7 +456,7 @@ def _dfa_scan_kernel(
     def classify(c):
         cls = jnp.full_like(c, default_class)
         for b, k in byte_to_class:
-            cls = jnp.where(c == b, k, cls)
+            cls = jnp.where(c == b, jnp.int32(k), cls)
         return cls
 
     def transition(state, cls):
@@ -441,7 +464,7 @@ def _dfa_scan_kernel(
         nxt = jnp.full_like(state, default)
         for k, v in enumerate(table_flat):
             if v != default:
-                nxt = jnp.where(idx == k, v, nxt)
+                nxt = jnp.where(idx == k, jnp.int32(v), nxt)
         return nxt
 
     eos_i32, pad_i32 = jnp.int32(eos_class), jnp.int32(pad_class)
@@ -464,7 +487,7 @@ def _dfa_scan_kernel(
 
     acc = jnp.zeros((1, n), dtype=jnp.int32)
     for s in accept_states:
-        acc = jnp.where(state == s, 1, acc)
+        acc = jnp.where(state == s, jnp.int32(1), acc)
     out_ref[0:1, :] = acc
 
 
@@ -514,7 +537,7 @@ def dfa_match_pallas(
         dfa.start,
         width,
     )
-    with jax.enable_x64(False):  # see the x64/Mosaic note in json_get_pallas
+    with _enable_x64(False):  # see the x64/Mosaic note in json_get_pallas
         out = pl.pallas_call(
             kernel,
             grid=(blocks,),

@@ -10,7 +10,9 @@ Protocol: the client MAY send one mode line before reading:
 - ``json``  (or nothing — the legacy reader) → the metrics JSON dump,
   now including the pipeline-telemetry snapshot,
 - ``prom``  → Prometheus text-format exposition of the same snapshot,
-- ``spans`` → the recent per-batch span ring as a JSON array.
+- ``spans`` → the recent per-batch span ring as a JSON array,
+- ``trace`` → the flight recorder's span/event rings as one complete
+  Chrome-trace/Perfetto JSON document (load it in ui.perfetto.dev).
 
 A client that sends nothing still gets JSON after a short grace wait,
 so pre-existing scrapers keep working unchanged. One document per
@@ -56,7 +58,7 @@ class MonitoringServer:
         logger.info("monitoring started on %s", self.path)
 
     def _payload(self, mode: str) -> bytes:
-        from fluvio_tpu.telemetry import TELEMETRY, render_prometheus
+        from fluvio_tpu.telemetry import TELEMETRY, render_prometheus, trace_json
 
         if mode == "prom":
             # the renderer reads the telemetry registry directly; only
@@ -66,6 +68,8 @@ class MonitoringServer:
             ).encode()
         if mode == "spans":
             return (json.dumps(TELEMETRY.spans_json(), indent=1) + "\n").encode()
+        if mode == "trace":
+            return (trace_json() + "\n").encode()
         return json.dumps(self.ctx.metrics.to_dict(), indent=2).encode()
 
     async def _handle(
@@ -83,7 +87,7 @@ class MonitoringServer:
                     reader.readline(), _MODE_LINE_TIMEOUT_S
                 )
                 requested = line.decode("ascii", "replace").strip().lower()
-                if requested in ("prom", "spans", "json"):
+                if requested in ("prom", "spans", "trace", "json"):
                     mode = requested
             except (asyncio.TimeoutError, ValueError):
                 # legacy client (no mode line) or a line exceeding the
@@ -150,3 +154,8 @@ async def read_prometheus(path: Optional[str] = None) -> str:
 async def read_spans(path: Optional[str] = None) -> list:
     """Fetch the recent per-batch span ring as a list of dicts."""
     return json.loads(await _read_mode(path, "spans"))
+
+
+async def read_trace(path: Optional[str] = None) -> dict:
+    """Fetch the flight recorder as one Chrome-trace JSON document."""
+    return json.loads(await _read_mode(path, "trace"))

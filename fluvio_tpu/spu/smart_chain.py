@@ -376,8 +376,17 @@ class PendingSlice:
     ts0: int
     count: int  # staged input records across all chunks
     read_from: Optional[int] = None  # consume cursor (drop outputs below)
+    # chunks currently counted in the inflight_queue_depth gauge (set at
+    # dispatch; release is idempotent — finish and discard both call it)
+    tracked_depth: int = 0
+
+    def release_depth(self) -> None:
+        if self.tracked_depth:
+            TELEMETRY.gauge_add("inflight_queue_depth", -self.tracked_depth)
+            self.tracked_depth = 0
 
     def discard(self, tpu) -> None:
+        self.release_depth()
         for _, handle in self.chunks:
             tpu.discard_dispatch(handle)
 
@@ -584,7 +593,7 @@ def tpu_stage_dispatch(
             type(e).__name__, e,
         )
         return _decline(metrics, "fused-error")
-    return PendingSlice(
+    pending = PendingSlice(
         batches=batches,
         chunks=chunks,
         planned_next=staged[-1][0].computed_last_offset(),
@@ -594,6 +603,12 @@ def tpu_stage_dispatch(
         count=n_total,
         read_from=start_offset,
     )
+    # pipelined occupancy gauge: every dispatched chunk counts until its
+    # finish (tpu_finish) or the slice's discard retires it
+    if TELEMETRY.enabled:
+        TELEMETRY.gauge_add("inflight_queue_depth", len(chunks))
+        pending.tracked_depth = len(chunks)
+    return pending
 
 
 class _MergedOut:
@@ -669,6 +684,9 @@ def tpu_finish(
     base0, ts0 = pending.base0, pending.ts0
     result = BatchProcessResult()
     result.next_offset = pending.planned_next
+    # whatever the outcome below (outputs, spill, fused-error decline),
+    # this slice's chunks leave the pipelined queue now
+    pending.release_depth()
     outbufs = []
     try:
         for b, h in pending.chunks:

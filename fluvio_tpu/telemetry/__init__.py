@@ -7,12 +7,19 @@ telemetry); this package gives the engine exactly that without touching
 per-record work:
 
 - `spans`     — per-batch pipeline spans with FIXED phase labels,
-                captured in a bounded ring buffer,
+                captured in a bounded ring buffer (plus the instant-
+                event ring the flight recorder draws markers from),
 - `histogram` — log-bucketed (HDR-style) latency histograms: fixed
                 bucket array, mergeable, percentile interpolation,
 - `registry`  — the process-wide `TELEMETRY` singleton the hot paths
                 record into and the export surfaces snapshot from,
-- `prometheus`— text-format exposition of a snapshot.
+- `prometheus`— text-format exposition of a snapshot,
+- `compiles`  — jit entry-point wrappers that turn trace-cache misses
+                into compile events (count/seconds/persistent-cache
+                outcome),
+- `trace`     — Chrome-trace/Perfetto export: continuous bounded file
+                sink via ``FLUVIO_TRACE=<path>`` plus the on-demand
+                renderer behind the monitoring socket and CLI.
 
 Always-on contract: one monotonic clock pair per phase per batch, no
 per-record work; ``FLUVIO_TELEMETRY=0`` disables span/histogram capture
@@ -21,16 +28,40 @@ entirely (event counters stay on — they are as cheap as the existing
 """
 
 from fluvio_tpu.telemetry.histogram import LatencyHistogram
-from fluvio_tpu.telemetry.spans import PHASES, BatchSpan, SpanRing
+from fluvio_tpu.telemetry.spans import (
+    PHASES,
+    BatchSpan,
+    EventRing,
+    InstantEvent,
+    SpanRing,
+)
 from fluvio_tpu.telemetry.registry import TELEMETRY, PipelineTelemetry
 from fluvio_tpu.telemetry.prometheus import render_prometheus
+from fluvio_tpu.telemetry.compiles import instrument_jit
+from fluvio_tpu.telemetry.trace import (
+    TraceFileSink,
+    install_env_sink,
+    render_trace,
+    trace_json,
+)
+
+# continuous flight recorder: arm the file sink when FLUVIO_TRACE names
+# a path (no-op otherwise; bounded + rotated, see telemetry/trace.py)
+install_env_sink()
 
 __all__ = [
     "LatencyHistogram",
     "PHASES",
     "BatchSpan",
+    "EventRing",
+    "InstantEvent",
     "SpanRing",
     "TELEMETRY",
     "PipelineTelemetry",
     "render_prometheus",
+    "instrument_jit",
+    "TraceFileSink",
+    "install_env_sink",
+    "render_trace",
+    "trace_json",
 ]

@@ -1,0 +1,139 @@
+"""JIT-compile observability: wrap jitted entry points so every
+trace-cache miss becomes a compile event.
+
+The engine's dominant invisible cost is XLA compilation (0.5–16.5 s per
+config on CPU; 85–119 s aggregate on-chip — see kernels.py's compile
+notes), and before this module the only evidence was a crude
+`.xla_cache` direntry diff around a whole bench run. `instrument_jit`
+detects a compile by the jitted callable's trace-cache growing across a
+call (`fn._cache_size()`, stable in the jax this repo pins), times it,
+attributes the persistent `.xla_cache` outcome, and records it all into
+`TELEMETRY` (counters + compile-latency histogram + an instant event
+for the trace view).
+
+Cost contract: with ``FLUVIO_TELEMETRY=0`` the wrapper is a single
+truthiness check and a tail call — the seam is free. Enabled, a
+trace-cache HIT costs one `_cache_size()` read and one clock pair per
+batch (never per record); the listdir-based persistent-cache probe runs
+only on compile events.
+
+Persistent-cache attribution is best-effort by design: a compile that
+wrote a new entry into the cache dir is a miss; one that didn't (the
+executable loaded from disk, or the compile was under jax's
+min-compile-time persistence threshold) counts as a hit. When the
+cache is disabled the outcome is None and neither counter moves.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from fluvio_tpu.telemetry.registry import TELEMETRY
+
+# lazily-initialized persistent-cache direntry baseline: None until the
+# first instrumented call snapshots it (one listdir, paid once)
+_pc_entries: Optional[int] = None
+
+
+def _cache_dir() -> str:
+    """The engine's resolved persistent-cache dir, without importing the
+    engine package at module load (it configures jax on import)."""
+    try:
+        from fluvio_tpu.smartengine.tpu import XLA_CACHE_DIR
+
+        return XLA_CACHE_DIR
+    except Exception:  # pragma: no cover — engine package unavailable
+        return ""
+
+
+def _count_entries() -> Optional[int]:
+    d = _cache_dir()
+    if not d:
+        return None
+    try:
+        return sum(1 for f in os.listdir(d) if not f.startswith("."))
+    except OSError:
+        return None
+
+
+def _persistent_outcome() -> Optional[bool]:
+    """Did the compile that just finished hit the persistent cache?
+    Compares the dir's entry count against the last known baseline:
+    unchanged = hit (loaded from disk or under the persistence
+    threshold), grown = miss (a fresh compile wrote its entry)."""
+    global _pc_entries
+    now = _count_entries()
+    if now is None:
+        return None
+    prev, _pc_entries = _pc_entries, now
+    if prev is None:
+        return None  # no baseline: the very first compile is unknowable
+    return now <= prev
+
+
+def prime_persistent_baseline() -> None:
+    """Snapshot the persistent-cache entry count so the NEXT compile's
+    hit/miss attribution has a baseline (idempotent, one listdir)."""
+    global _pc_entries
+    if _pc_entries is None:
+        _pc_entries = _count_entries()
+
+
+def instrument_jit(
+    fn: Callable, kind: str, describe: Optional[Callable] = None
+) -> Callable:
+    """Wrap a jitted callable so trace-cache misses record compile
+    events under ``kind``; ``describe(*args, **kwargs) -> str`` builds
+    the event's chain/shape-bucket signature (static kwargs only — it
+    must not touch array values).
+
+    Concurrency-safe detection: a compile counts only when the cache
+    grows past the LARGEST size any call has already accounted for
+    (``seen``, under a small lock held around the counter check, never
+    around the jit call) — a thread whose cache hit merely blocked
+    behind another thread's in-flight compile observes no new growth
+    and records a hit, not a duplicate compile."""
+    import threading
+
+    lock = threading.Lock()
+    state = {"seen": None}
+
+    def wrapper(*args, **kwargs):
+        t = TELEMETRY
+        if not t.enabled:
+            return fn(*args, **kwargs)
+        prime_persistent_baseline()
+        try:
+            with lock:
+                if state["seen"] is None:
+                    state["seen"] = fn._cache_size()
+        except Exception:  # pragma: no cover — unexpected jax surface
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        # the jit call returns after trace+compile with execution still
+        # async-dispatched, so the call's wall time IS the compile cost
+        # (plus the trace, which is part of the miss)
+        seconds = time.perf_counter() - t0
+        with lock:
+            size = fn._cache_size()
+            grew = size > state["seen"]
+            if grew:
+                state["seen"] = size
+        if grew:
+            sig = ""
+            if describe is not None:
+                try:
+                    sig = describe(*args, **kwargs)
+                except Exception:  # pragma: no cover — never break a call
+                    sig = "?"
+            t.add_compile(kind, sig, seconds, _persistent_outcome())
+        else:
+            t.add_jit_hit()
+        return out
+
+    wrapper.__wrapped__ = fn
+    wrapper.__name__ = getattr(fn, "__name__", kind)
+    return wrapper

@@ -84,6 +84,13 @@ def render_prometheus(
         breaker_transitions = dict(t.breaker_transitions)
         breaker_shorts = t.breaker_short_circuits
         interp = (t.interp_calls, t.interp_seconds, t.interp_records)
+        compiles = dict(t.compiles)
+        compile_seconds = dict(t.compile_seconds)
+        compile_hist = t.compile_hist.copy()
+        pc_hits, pc_misses = t.persistent_cache_hits, t.persistent_cache_misses
+        jit_hits = t.jit_cache_hits
+        gauges = dict(t.gauges)
+    spans_dropped = t.spans.dropped
 
     _histogram(
         w,
@@ -188,6 +195,63 @@ def render_prometheus(
     ):
         w.header(f"{_PREFIX}_{name}", help_text, "counter")
         w.sample(f"{_PREFIX}_{name}", {}, value)
+
+    # -- JIT-compile telemetry ----------------------------------------------
+    w.header(
+        f"{_PREFIX}_compiles_total",
+        "XLA trace-cache misses (compiles) on instrumented jit entry "
+        "points, by kind.",
+        "counter",
+    )
+    for kind, n in sorted(compiles.items()):
+        w.sample(f"{_PREFIX}_compiles_total", {"kind": kind}, n)
+    w.header(
+        f"{_PREFIX}_compile_seconds_total",
+        "Wall seconds spent compiling, by kind.",
+        "counter",
+    )
+    for kind, s in sorted(compile_seconds.items()):
+        w.sample(f"{_PREFIX}_compile_seconds_total", {"kind": kind}, s)
+    _histogram(
+        w,
+        f"{_PREFIX}_compile_latency_seconds",
+        "Per-compile wall latency across all instrumented entry points.",
+        [({}, compile_hist)],
+    )
+    for name, help_text, value in (
+        ("persistent_cache_hits_total",
+         "Compiles satisfied by the persistent .xla_cache.", pc_hits),
+        ("persistent_cache_misses_total",
+         "Compiles that wrote a fresh persistent-cache entry.", pc_misses),
+        ("jit_cache_hits_total",
+         "Instrumented jit calls that hit the in-process trace cache.",
+         jit_hits),
+        ("spans_dropped_total",
+         "Batch spans overwritten by the bounded ring (dump is lossy "
+         "when nonzero).", spans_dropped),
+    ):
+        w.header(f"{_PREFIX}_{name}", help_text, "counter")
+        w.sample(f"{_PREFIX}_{name}", {}, value)
+
+    # -- gauges --------------------------------------------------------------
+    for name, help_text in (
+        ("hbm_staged_bytes",
+         "Device-memory bytes currently staged by in-flight batches."),
+        ("live_batch_handles",
+         "Dispatched batches whose results have not been fetched."),
+        ("inflight_queue_depth",
+         "Pipelined broker slice chunks dispatched and not yet finished."),
+        ("deadletter_entries",
+         "Quarantined poison batches resident in the dead-letter dir."),
+    ):
+        w.header(f"{_PREFIX}_{name}", help_text, "gauge")
+        w.sample(f"{_PREFIX}_{name}", {}, gauges.get(name, 0))
+    for name in sorted(set(gauges) - {
+        "hbm_staged_bytes", "live_batch_handles",
+        "inflight_queue_depth", "deadletter_entries",
+    }):
+        w.header(f"{_PREFIX}_{name}", "Engine gauge.", "gauge")
+        w.sample(f"{_PREFIX}_{name}", {}, gauges[name])
 
     if spu_metrics is not None:
         _render_spu(w, spu_metrics)

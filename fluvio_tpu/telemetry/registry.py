@@ -11,7 +11,15 @@ recording:
   d2h tail bimodal"),
 - event counters: glz heals, interpreter spills keyed by reason,
   stripe fallbacks, fast-path declines keyed by reason,
-- a bounded ring of recent `BatchSpan`s for debugging dumps.
+- JIT-compile telemetry: per-kind compile counts + wall seconds +
+  a compile-latency histogram, persistent-`.xla_cache` hit/miss
+  attribution, and a recompile-storm decline counter,
+- gauges (point-in-time, not monotone): HBM-resident staged bytes,
+  live dispatch handles, pipelined in-flight queue depth, dead-letter
+  dir occupancy,
+- a bounded ring of recent `BatchSpan`s plus a ring of instant events
+  (heals/spills/retries/breaker/compiles) feeding the flight-recorder
+  trace export (telemetry/trace.py).
 
 Hot-path contract: `begin_batch` returns None when capture is disabled
 (``FLUVIO_TELEMETRY=0``) and every instrumentation site guards on that;
@@ -28,9 +36,26 @@ import time
 from typing import Dict, List, Optional
 
 from fluvio_tpu.telemetry.histogram import LatencyHistogram
-from fluvio_tpu.telemetry.spans import PHASES, BatchSpan, SpanRing
+from fluvio_tpu.telemetry.spans import (
+    PHASES,
+    BatchSpan,
+    EventRing,
+    InstantEvent,
+    SpanRing,
+)
 
 SPAN_RING_CAPACITY = 256
+EVENT_RING_CAPACITY = 512
+
+# recompile-storm detection: more than N compile events inside the
+# window means shape buckets are churning (a stream whose widths wander
+# across bucket boundaries recompiles per batch) — each compile past the
+# threshold counts a "recompile-storm" decline so the storm is visible
+# on every decline surface (Prometheus, CLI table, snapshot)
+COMPILE_STORM_N = int(os.environ.get("FLUVIO_COMPILE_STORM_N", "8"))
+COMPILE_STORM_WINDOW_S = float(
+    os.environ.get("FLUVIO_COMPILE_STORM_WINDOW_S", "60")
+)
 
 
 class PipelineTelemetry:
@@ -69,6 +94,30 @@ class PipelineTelemetry:
         self.interp_calls = 0
         self.interp_seconds = 0.0
         self.interp_records = 0
+        # JIT-compile observability: every trace-cache miss on an
+        # instrumented entry point (executor ragged/striped jits, the
+        # sharded shard_map jit, pallas kernels, DFA table builds)
+        # records {kind, wall seconds, persistent-cache outcome}
+        self.compiles: Dict[str, int] = {}
+        self.compile_seconds: Dict[str, float] = {}
+        self.compile_hist = LatencyHistogram()
+        self.persistent_cache_hits = 0
+        self.persistent_cache_misses = 0
+        self.jit_cache_hits = 0  # unlocked add: see add_jit_hit
+        self._compile_times: List[float] = []  # storm-window timestamps
+        # gauges (point-in-time values, not monotone): HBM-resident
+        # staged bytes / live dispatch handles / pipelined in-flight
+        # queue depth / dead-letter dir occupancy. Updates go through
+        # gauge_add/gauge_set, which are no-ops when capture is off —
+        # the FLUVIO_TELEMETRY=0 zero-cost contract covers them.
+        self.gauges: Dict[str, float] = {}
+        # instant events (heals, spills, retries, breaker transitions,
+        # compiles, quarantines) for the flight recorder's trace view
+        self.events = EventRing(EVENT_RING_CAPACITY)
+        # optional flight-recorder sink (telemetry/trace.py installs it
+        # from FLUVIO_TRACE): completed spans and instant events stream
+        # into it as they happen
+        self.trace_sink = None
 
     # -- span lifecycle ------------------------------------------------------
 
@@ -97,6 +146,9 @@ class PipelineTelemetry:
                 if s > 0.0:
                     self.phase_hist[name].record(s)
         self.spans.push(span)
+        sink = self.trace_sink
+        if sink is not None:
+            sink.on_span(span)
 
     def add_phase(self, name: str, seconds: float) -> None:
         """Record phase time measured outside a span (slice-level host
@@ -107,11 +159,29 @@ class PipelineTelemetry:
         with self._lock:
             self.phase_hist[name].record(seconds)
 
+    # -- instant events (flight recorder) ------------------------------------
+
+    def _event(self, kind: str, detail: str = "") -> None:
+        """Capture a point-in-time event for the trace view. Gated on
+        ``enabled`` like span capture (the counters the event annotates
+        stay always-on either way)."""
+        if not self.enabled:
+            return
+        ev = InstantEvent(kind, detail)
+        self.events.push(ev)
+        sink = self.trace_sink
+        if sink is not None:
+            sink.on_event(ev)
+
+    def events_json(self, limit: Optional[int] = None) -> List[dict]:
+        return [e.to_dict() for e in self.events.recent(limit)]
+
     # -- counters ------------------------------------------------------------
 
     def add_heal(self) -> None:
         with self._lock:
             self.heals += 1
+        self._event("heal")
 
     def add_stripe_fallback(self) -> None:
         with self._lock:
@@ -120,6 +190,7 @@ class PipelineTelemetry:
     def add_spill(self, reason: str) -> None:
         with self._lock:
             self.spills[reason] = self.spills.get(reason, 0) + 1
+        self._event("spill", reason)
 
     def add_decline(self, reason: str) -> None:
         with self._lock:
@@ -128,12 +199,16 @@ class PipelineTelemetry:
     def add_retry(self, point: str) -> None:
         with self._lock:
             self.retries[point] = self.retries.get(point, 0) + 1
+        self._event("retry", point)
 
     def add_quarantine(self) -> None:
         with self._lock:
             self.quarantined += 1
+        self._event("quarantine")
 
     def record_breaker(self, name: str, state: str, transition: bool = True) -> None:
+        if transition:
+            self._event("breaker", f"{name}->{state}")
         with self._lock:
             # bounded: a broker that builds a chain (and breaker) per
             # stream must not grow this dict forever — keep the most
@@ -157,6 +232,93 @@ class PipelineTelemetry:
             self.interp_calls += 1
             self.interp_seconds += seconds
             self.interp_records += records
+
+    # -- compile telemetry ---------------------------------------------------
+
+    def add_compile(
+        self,
+        kind: str,
+        signature: str,
+        seconds: float,
+        persistent_hit: Optional[bool] = None,
+    ) -> None:
+        """One trace-cache miss on an instrumented jit entry point:
+        ``kind`` names the entry (ragged/striped/sharded/pallas/
+        dfa_table), ``signature`` the chain + shape bucket it compiled
+        for, ``persistent_hit`` whether the persistent ``.xla_cache``
+        already held the executable (None = cache disabled/unknown)."""
+        storm = False
+        with self._lock:
+            self.compiles[kind] = self.compiles.get(kind, 0) + 1
+            self.compile_seconds[kind] = (
+                self.compile_seconds.get(kind, 0.0) + seconds
+            )
+            self.compile_hist.record(seconds)
+            if persistent_hit is not None:
+                if persistent_hit:
+                    self.persistent_cache_hits += 1
+                else:
+                    self.persistent_cache_misses += 1
+            now = time.perf_counter()
+            cutoff = now - COMPILE_STORM_WINDOW_S
+            self._compile_times = [
+                t for t in self._compile_times if t >= cutoff
+            ]
+            self._compile_times.append(now)
+            if len(self._compile_times) > COMPILE_STORM_N:
+                self.declines["recompile-storm"] = (
+                    self.declines.get("recompile-storm", 0) + 1
+                )
+                storm = True
+        pc = (
+            ""
+            if persistent_hit is None
+            else (" pc=hit" if persistent_hit else " pc=miss")
+        )
+        self._event("compile", f"{kind} {signature} {seconds:.3f}s{pc}")
+        if storm:
+            self._event("recompile-storm", kind)
+
+    def add_jit_hit(self) -> None:
+        """Trace-cache hit on an instrumented jit entry point. Unlocked
+        on purpose: this runs once per batch on the hot path, the GIL
+        keeps the int add safe enough for a monitoring counter, and a
+        lock here would be the seam's whole cost."""
+        self.jit_cache_hits += 1
+
+    def compile_totals(self) -> dict:
+        """Monotone compile counters for differs (the bench wraps a
+        timed run in two of these to attribute compile-vs-execute)."""
+        with self._lock:
+            return {
+                "compiles": sum(self.compiles.values()),
+                "by_kind": dict(self.compiles),
+                "seconds": round(sum(self.compile_seconds.values()), 6),
+                "persistent_hits": self.persistent_cache_hits,
+                "persistent_misses": self.persistent_cache_misses,
+                "jit_cache_hits": self.jit_cache_hits,
+            }
+
+    # -- gauges --------------------------------------------------------------
+
+    def gauge_add(self, name: str, delta: float) -> None:
+        """Move a gauge by ``delta`` (up at dispatch, down at finish).
+        No-op when capture is off — the FLUVIO_TELEMETRY=0 contract is
+        zero cost, and a half-tracked gauge would read as a leak."""
+        if not self.enabled or delta == 0:
+            return
+        with self._lock:
+            self.gauges[name] = self.gauges.get(name, 0) + delta
+
+    def gauge_set(self, name: str, value: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self.gauges[name] = value
+
+    def gauge_value(self, name: str) -> float:
+        with self._lock:
+            return self.gauges.get(name, 0)
 
     # -- reads ---------------------------------------------------------------
 
@@ -212,8 +374,23 @@ class PipelineTelemetry:
                         "records": self.interp_records,
                     },
                 },
+                "compile": {
+                    "by_kind": dict(self.compiles),
+                    "seconds_by_kind": {
+                        k: round(s, 6)
+                        for k, s in self.compile_seconds.items()
+                    },
+                    "latency": self.compile_hist.to_dict(),
+                    "persistent_cache_hits": self.persistent_cache_hits,
+                    "persistent_cache_misses": self.persistent_cache_misses,
+                    "jit_cache_hits": self.jit_cache_hits,
+                },
+                "gauges": dict(self.gauges),
                 "spans_retained": len(self.spans),
                 "spans_total": self.spans.total,
+                "spans_dropped": self.spans.dropped,
+                "events_total": self.events.total,
+                "events_dropped": self.events.dropped,
             }
 
     def spans_json(self, limit: Optional[int] = None) -> List[dict]:
@@ -241,7 +418,16 @@ class PipelineTelemetry:
             self.interp_calls = 0
             self.interp_seconds = 0.0
             self.interp_records = 0
+            self.compiles = {}
+            self.compile_seconds = {}
+            self.compile_hist = LatencyHistogram()
+            self.persistent_cache_hits = 0
+            self.persistent_cache_misses = 0
+            self.jit_cache_hits = 0
+            self._compile_times = []
+            self.gauges = {}
         self.spans = SpanRing(self.spans.capacity)
+        self.events = EventRing(self.events.capacity)
 
 
 TELEMETRY = PipelineTelemetry()

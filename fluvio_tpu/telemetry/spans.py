@@ -51,13 +51,18 @@ class BatchSpan:
     driving the batch (ring insertion at `end` is what synchronizes)."""
 
     __slots__ = (
-        "t0", "t_end", "phase_s", "records", "path", "dispatch_end", "ready_t",
+        "t0", "t_end", "phase_s", "phase_t0", "records", "path",
+        "dispatch_end", "ready_t",
     )
 
     def __init__(self, path: str = "fused") -> None:
         self.t0 = time.perf_counter()
         self.t_end: Optional[float] = None
         self.phase_s: List[float] = [0.0] * len(PHASES)
+        # first-add start time per phase (0.0 = never recorded): the
+        # trace renderer places each phase's duration event at its real
+        # wall position instead of reconstructing a serial layout
+        self.phase_t0: List[float] = [0.0] * len(PHASES)
         self.records = 0
         self.path = path
         # set by mark_dispatched; the device phase measures from here
@@ -68,7 +73,12 @@ class BatchSpan:
 
     def add(self, phase: str, seconds: float) -> None:
         if seconds > 0.0:
-            self.phase_s[_PHASE_INDEX[phase]] += seconds
+            i = _PHASE_INDEX[phase]
+            if self.phase_s[i] == 0.0:
+                # callers measure `seconds` against a clock read taken
+                # just before this call, so now-seconds is the start
+                self.phase_t0[i] = time.perf_counter() - seconds
+            self.phase_s[i] += seconds
 
     def mark_dispatched(self) -> None:
         self.dispatch_end = time.perf_counter()
@@ -105,21 +115,23 @@ class BatchSpan:
         return d
 
 
-class SpanRing:
-    """Bounded ring of completed spans: O(1) push, keeps the most
-    recent ``capacity`` spans in completion order."""
+class _BoundedRing:
+    """Bounded ring: O(1) push, most recent ``capacity`` items retained
+    in completion order, overwrites counted (``dropped``). One
+    implementation for the span and instant-event rings — a fix to the
+    slicing or lock discipline cannot land in one and miss the other."""
 
-    def __init__(self, capacity: int = 256) -> None:
+    def __init__(self, capacity: int) -> None:
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
-        self._slots: List[Optional[BatchSpan]] = [None] * capacity
+        self._slots: List = [None] * capacity
         self._next = 0  # total pushes (monotone)
         self._lock = threading.Lock()
 
-    def push(self, span: BatchSpan) -> None:
+    def push(self, item) -> None:
         with self._lock:
-            self._slots[self._next % self.capacity] = span
+            self._slots[self._next % self.capacity] = item
             self._next += 1
 
     def __len__(self) -> int:
@@ -127,17 +139,57 @@ class SpanRing:
 
     @property
     def total(self) -> int:
-        """Spans ever pushed (wrapped ones included)."""
+        """Items ever pushed (wrapped ones included)."""
         return self._next
 
-    def recent(self, limit: Optional[int] = None) -> List[BatchSpan]:
-        """Most-recent-last list of retained spans."""
+    @property
+    def dropped(self) -> int:
+        """Items the ring has overwritten (total − retained): nonzero
+        means a dump/trace of this ring is missing history — detectable
+        instead of silently lossy."""
+        return max(self._next - self.capacity, 0)
+
+    def recent(self, limit: Optional[int] = None) -> List:
+        """Most-recent-last list of retained items."""
         with self._lock:
             n = min(self._next, self.capacity)
             start = self._next - n
-            spans = [
+            items = [
                 self._slots[i % self.capacity] for i in range(start, self._next)
             ]
-        if limit is not None and limit < len(spans):
-            spans = spans[-limit:]
-        return spans
+        if limit is not None and limit < len(items):
+            items = items[-limit:]
+        return items
+
+
+class SpanRing(_BoundedRing):
+    """Bounded ring of completed `BatchSpan`s."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        super().__init__(capacity)
+
+
+class InstantEvent:
+    """One point-in-time pipeline event (heal, spill, retry, breaker
+    transition, compile, quarantine) for the flight recorder: the trace
+    renders these as instant markers over the batch tracks."""
+
+    __slots__ = ("t", "kind", "detail")
+
+    def __init__(self, kind: str, detail: str = "") -> None:
+        self.t = time.perf_counter()
+        self.kind = kind
+        self.detail = detail
+
+    def to_dict(self) -> Dict:
+        d = {"t": round(self.t, 6), "kind": self.kind}
+        if self.detail:
+            d["detail"] = self.detail
+        return d
+
+
+class EventRing(_BoundedRing):
+    """Bounded ring of `InstantEvent`s."""
+
+    def __init__(self, capacity: int = 512) -> None:
+        super().__init__(capacity)

@@ -143,6 +143,19 @@ def _full_config(rps: int, x: float, path: str = "fused") -> dict:
         "glz_ratio": 0.476,
         "path": path,
         "path_records": {path: rps * 7},
+        # ISSUE-5: per-config compile breakdown from the telemetry jit
+        # instrumentation (replaces the crude suite-level direntry diff
+        # as the per-config compile evidence)
+        "compile": {
+            "compiles": 3,
+            "compile_s": 19.42,
+            "by_kind": {"ragged": 2, "dfa_table": 1},
+            "persistent_hits": 1,
+            "persistent_misses": 2,
+            "cache_hits": 41,
+            "first_call_compile_s": 19.42,
+            "first_call_execute_s": 2.26,
+        },
         "phases": {
             "wall_ms": 1693.4,
             "phase_sum_ms": 1650.2,
@@ -233,6 +246,11 @@ def test_compact_line_fits_driver_window():
     assert parsed["phases"]["e2e_p50_ms"] == 1554.0
     assert parsed["phases"]["top"][0][0] == "device"
     assert "phase_ms" not in parsed["phases"]  # full table is detail-only
+    # ISSUE-5 satellite: a tiny headline compile key (count/seconds +
+    # persistent-cache [hits, misses]); full per-config breakdowns stay
+    # in BENCH_DETAIL.json
+    assert parsed["compile"] == {"n": 3, "s": 19.42, "pc": [1, 2]}
+    assert "compile" not in parsed["configs"]["2_filter_map"]
 
 
 def test_compact_line_trims_pathological_blowup_keeps_link():

