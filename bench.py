@@ -444,6 +444,21 @@ def _run_config(
     values = cfg["corpus"](n)
     ts = cfg["ts"](n) if "ts" in cfg else None
 
+    # preflight static analysis (fluvio_tpu/analysis/): predict the
+    # executed path for THIS corpus's width before dispatching anything;
+    # after the run the telemetry-observed path lands next to it so
+    # BENCH_DETAIL.json shows predicted-vs-actual per config
+    preflight = None
+    try:
+        from fluvio_tpu.analysis import preflight_for_specs
+
+        preflight = preflight_for_specs(
+            cfg["specs"], max(len(v) for v in values)
+        )
+        log(f"  preflight: predicted path {preflight['path']}")
+    except Exception as e:  # noqa: BLE001 — analysis must never cost a run
+        log(f"  preflight analysis failed: {type(e).__name__}: {e}")
+
     if name == "7_fat70k":
         # sanity: the striped layout must engage (no record-too-wide
         # spill left in the matrix) — a chain that silently fell back
@@ -568,6 +583,16 @@ def _run_config(
         "path": path_info["path"],
         "path_records": path_info["records"],
     }
+    if preflight is not None:
+        # predicted-vs-actual agreement: "unknown" actual (telemetry
+        # off) is unjudgeable, not a disagreement
+        preflight["actual"] = path_info["path"]
+        preflight["agree"] = (
+            preflight["path"] == path_info["path"]
+            if path_info["path"] != "unknown"
+            else None
+        )
+        result["preflight"] = preflight
     if staging_ab:
         result["staging_ab"] = staging_ab
     # glz link compression attribution: which form the flat crossed in
@@ -904,6 +929,21 @@ def _compact_configs(configs: dict) -> dict:
     return out
 
 
+def _preflight_counts(configs: dict):
+    """Predicted-vs-actual path agreement across a results dict: the
+    compact line's tiny ``preflight`` key ({"agree": n, "of": m}); full
+    per-config hazard reports stay in BENCH_DETAIL.json."""
+    judged = [
+        c["preflight"].get("agree")
+        for c in configs.values()
+        if isinstance(c, dict) and isinstance(c.get("preflight"), dict)
+        and c["preflight"].get("agree") is not None
+    ]
+    if not judged:
+        return None
+    return {"agree": sum(1 for a in judged if a), "of": len(judged)}
+
+
 def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     """Compress the full output object into the driver-facing summary
     line: headline numbers, per-config rps/ratio pairs, link weather,
@@ -956,6 +996,11 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         }
     if "configs" in out:
         compact["configs"] = _compact_configs(out["configs"])
+        # preflight satellite: ONE compact predicted-vs-actual agreement
+        # count (analyzer honesty at a glance; detail stays in the file)
+        pf = _preflight_counts(out["configs"])
+        if pf:
+            compact["preflight"] = pf
     if "cpu_fallback" in out:
         inner = out["cpu_fallback"]
         compact["cpu_fallback"] = {
@@ -968,8 +1013,8 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "compile", "phases", "error",
-        "xla_cache", "link",
+        "configs", "cpu_fallback", "preflight", "compile", "phases",
+        "error", "xla_cache", "link",
     ):
         if len(json.dumps(compact)) <= limit:
             break

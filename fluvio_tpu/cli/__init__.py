@@ -2,7 +2,7 @@
 
 Run as ``python -m fluvio_tpu.cli <command>``. Commands: produce, consume,
 topic, partition, smartmodule, tableformat, spu, profile, cluster, run,
-metrics, trace, version.
+metrics, trace, analyze, version.
 """
 
 from __future__ import annotations
@@ -15,6 +15,7 @@ from fluvio_tpu.cli.common import CliError
 
 
 def build_parser() -> argparse.ArgumentParser:
+    from fluvio_tpu.cli import analyze as analyze_cmd
     from fluvio_tpu.cli import cluster as cluster_cmd
     from fluvio_tpu.cli import consume as consume_cmd
     from fluvio_tpu.cli import crud
@@ -44,6 +45,7 @@ def build_parser() -> argparse.ArgumentParser:
         hub_cmd.add_hub_parser,
         metrics_cmd.add_metrics_parser,
         trace_cmd.add_trace_parser,
+        analyze_cmd.add_analyze_parser,
     ):
         add(sub)
 

@@ -1,0 +1,178 @@
+"""`analyze` subcommand — chain preflight static analysis.
+
+Runs the three-level analyzer (fluvio_tpu/analysis/) without touching a
+cluster or a device queue:
+
+- ``fluvio-tpu analyze --module 'regex-filter:regex=fluvio' --module
+  'json-map:field=name'`` — Level-1 path prediction for a chain of
+  built-in modules (``name:key=value,key=value`` syntax), at one or
+  more record widths (``--width``, repeatable; default probes one
+  narrow and one past-threshold width), plus ``--jaxpr`` to
+  abstract-trace the jit entry points and lint the lowered program.
+- ``fluvio-tpu analyze --lint [PATH ...]`` — the repo-invariant AST
+  linter (kernel literal pinning, host-sync bans, telemetry seams,
+  hygiene) over the given paths (default: the installed package).
+
+Exit codes make it a pre-deploy gate: 0 clean, 1 when any
+ERROR-severity hazard (a predicted interpreter spill, a weak-64bit
+promotion, a host callback) or lint violation is found — and also on
+usage errors such as an unknown module name (only argparse-level
+errors exit 2) — so ``fluvio-tpu analyze ... && deploy`` refuses to
+ship a chain that would run interpreted.
+"""
+
+from __future__ import annotations
+
+import json
+
+from fluvio_tpu.cli.common import CliError
+
+
+def add_analyze_parser(sub) -> None:
+    p = sub.add_parser(
+        "analyze",
+        help="preflight static analysis: predict a chain's executed path",
+    )
+    p.add_argument(
+        "--module",
+        action="append",
+        default=[],
+        metavar="NAME[:k=v,...]",
+        help="chain module by registry name with params "
+        "(repeatable, in chain order), e.g. regex-filter:regex=fluvio",
+    )
+    p.add_argument(
+        "--width",
+        action="append",
+        type=int,
+        default=[],
+        help="max record value width (bytes) to probe (repeatable; "
+        "default: one narrow and one past-threshold width)",
+    )
+    p.add_argument(
+        "--sharded",
+        action="store_true",
+        help="predict for the multi-device (shard_map) engine mode",
+    )
+    p.add_argument(
+        "--jaxpr",
+        action="store_true",
+        help="abstract-trace the jit entry points and lint the jaxprs",
+    )
+    p.add_argument(
+        "--lint",
+        nargs="*",
+        metavar="PATH",
+        help="run the repo AST linter over PATHs instead of analyzing "
+        "a chain (no PATH = the installed fluvio_tpu package)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("table", "json"),
+        default="table",
+        help="output format (default: table)",
+    )
+    p.set_defaults(fn=analyze)
+
+
+def _parse_module(spec: str):
+    name, _, rest = spec.partition(":")
+    params = {}
+    if rest:
+        for pair in rest.split(","):
+            k, eq, v = pair.partition("=")
+            if not eq:
+                raise CliError(
+                    f"bad module param {pair!r} (want key=value) in {spec!r}"
+                )
+            params[k.strip()] = v.strip()
+    return name.strip(), params
+
+
+def _render_report(report) -> str:
+    from fluvio_tpu.cli.metrics import _rows_to_table
+
+    sections = [f"chain: {report.chain_sig}"]
+    rows = [(k, str(v)) for k, v in report.gates.items()]
+    sections.append("gates\n" + _rows_to_table(rows, header=("gate", "value")))
+    rows = []
+    for p in report.predictions:
+        notes = "; ".join(
+            [f"spill:{r}" for r in p.spill_reasons]
+            + [f"decline:{d}" for d in p.declines]
+        ) or "-"
+        rows.append((p.width, p.width_bucket, p.path, notes))
+    sections.append(
+        "path predictions\n"
+        + _rows_to_table(rows, header=("width", "bucket", "path", "reasons"))
+    )
+    if report.jaxprs:
+        rows = [
+            (j.kind, j.signature, j.n_eqns,
+             sum(1 for h in j.hazards if h.level == "error"))
+            for j in report.jaxprs
+        ]
+        sections.append(
+            "jit entry points (AOT warmup work list)\n"
+            + _rows_to_table(
+                rows, header=("kind", "shape-bucket signature", "eqns", "errs")
+            )
+        )
+    hazards = sorted(
+        report.hazards, key=lambda h: ("error", "warn", "info").index(h.level)
+    )
+    if hazards:
+        rows = [(h.level.upper(), h.code, h.message) for h in hazards]
+        sections.append(
+            "hazards\n" + _rows_to_table(rows, header=("sev", "code", "detail"))
+        )
+    else:
+        sections.append("hazards\n(none)")
+    return "\n\n".join(sections)
+
+
+async def analyze(args) -> int:
+    if args.lint is not None:
+        return _run_lint(args)
+    if not args.module:
+        raise CliError("nothing to analyze: pass --module (or --lint)")
+    from fluvio_tpu.analysis import analyze_chain
+    from fluvio_tpu.models import lookup
+    from fluvio_tpu.smartengine.config import SmartModuleConfig
+
+    specs = [_parse_module(m) for m in args.module]
+    try:
+        entries = [
+            (lookup(n), SmartModuleConfig(params=dict(p))) for n, p in specs
+        ]
+    except KeyError as e:
+        raise CliError(str(e)) from e
+    report = analyze_chain(
+        entries, widths=args.width or None, sharded=args.sharded,
+        jaxpr=args.jaxpr,
+    )
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        print(_render_report(report))
+    errors = report.errors()
+    if errors and args.format != "json":
+        print(f"\n{len(errors)} ERROR-severity hazard(s)")
+    return 1 if errors else 0
+
+
+def _run_lint(args) -> int:
+    import os
+
+    import fluvio_tpu
+    from fluvio_tpu.analysis import lint_paths
+
+    paths = args.lint or [os.path.dirname(os.path.abspath(fluvio_tpu.__file__))]
+    violations = lint_paths(paths)
+    if args.format == "json":
+        print(json.dumps([v.to_dict() for v in violations], indent=1))
+    else:
+        for v in violations:
+            print(v)
+        print(f"{len(violations)} violation(s)")
+    return 1 if violations else 0

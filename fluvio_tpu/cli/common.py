@@ -9,7 +9,7 @@ Capability parity: fluvio-cli's common target resolution (profile or
 from __future__ import annotations
 
 import argparse
-from typing import List, Optional
+from typing import List
 
 from fluvio_tpu.client import Fluvio
 from fluvio_tpu.schema.smartmodule import (

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import argparse
 
-from fluvio_tpu.cli.common import CliError, connect, parse_params
+from fluvio_tpu.cli.common import CliError, connect
 from fluvio_tpu.cli.output import OUTPUT_FORMATS, render_objects, render_table
 from fluvio_tpu.client.config import ConfigFile
 from fluvio_tpu.metadata.topic import (

@@ -13,7 +13,6 @@ from fluvio_tpu.metadata.smartmodule import SmartModuleSpec
 from fluvio_tpu.metadata.spu import Endpoint, SpuSpec, SpuType
 from fluvio_tpu.metadata.topic import TopicSpec
 from fluvio_tpu.schema.admin import (
-    AdminObject,
     AdminStatus,
     CreateRequest,
     DeleteRequest,

@@ -20,7 +20,7 @@ import tarfile
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, Optional
 
 MANIFEST_NAME = "package-meta.json"
 SIGNATURE_NAME = "package-meta.json.sig"

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import os
-from typing import Dict, Generic, List, Optional, TypeVar
+from typing import Dict, List, TypeVar
 
 import yaml
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import ClassVar, Dict, List, Optional
+from typing import ClassVar, List, Optional
 
 from fluvio_tpu.metadata.topic import CleanupPolicy, Deduplication, TopicStorageConfig
 from fluvio_tpu.stream_model.core import Spec, Status

@@ -10,7 +10,7 @@ transform format), with the format field kept for future kinds.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import ClassVar, Dict, List, Optional
+from typing import ClassVar, List, Optional
 
 from fluvio_tpu.stream_model.core import Spec, Status
 

@@ -16,7 +16,7 @@ row-local. Selected by ``SmartEngine(mesh_devices=N)`` /
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Optional
 
 from fluvio_tpu.metadata.partition import PartitionSpec, parse_partition_key
 from fluvio_tpu.metadata.topic import TopicResolution, TopicSpec

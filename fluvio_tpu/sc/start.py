@@ -11,7 +11,6 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from fluvio_tpu.metadata.client import (
-    InMemoryMetadataClient,
     LocalMetadataClient,
     MetadataClient,
 )

@@ -34,7 +34,6 @@ from fluvio_tpu.telemetry import TELEMETRY, instrument_jit
 from fluvio_tpu.resilience import faults
 from fluvio_tpu.resilience.policy import RetryPolicy
 
-from fluvio_tpu.protocol.record import Record
 from fluvio_tpu.smartmodule import dsl
 from fluvio_tpu.smartmodule.sdk import SmartModuleDef
 from fluvio_tpu.smartmodule.types import (

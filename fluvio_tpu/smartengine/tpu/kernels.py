@@ -13,7 +13,6 @@ from __future__ import annotations
 import os
 from typing import Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -238,7 +237,9 @@ def string_state_excl(c: jnp.ndarray, inrec: jnp.ndarray) -> jnp.ndarray:
     """Per-position exclusive string-automaton state (int32[n, width])."""
     is_q = (c == 0x22) & inrec
     is_b = (c == 0x5C) & inrec
-    cls = jnp.where(is_q, 1, jnp.where(is_b, 2, 0))
+    # pinned: the unpinned pair would make cls (and the whole prefix
+    # automaton's state arrays) weak i64 under the package-wide x64
+    cls = jnp.where(is_q, jnp.int32(1), jnp.where(is_b, jnp.int32(2), jnp.int32(0)))
     cls = jnp.where(inrec, cls, -1)
     return dfa_prefix_states(cls, jnp.asarray(_STRING_TABLE_T), 3, _STR_OUT)
 
@@ -401,10 +402,13 @@ def json_step(carry, c: jnp.ndarray, t, active: jnp.ndarray, needle_arr, klen: i
         active & s_close, False, jnp.where(active & o_open, True, in_str)
     )
     new_esc = jnp.where(active & gs, s_set_esc, esc)
+    # both-literal where branches pin int32: under the package-wide x64
+    # an unpinned pair is a weak i64 select (silent 64-bit emulation on
+    # the VPU; the preflight jaxpr lint flags it as weak-64bit-promotion)
     new_depth = (
         depth
-        + jnp.where(active & o_depth_up, 1, 0)
-        - jnp.where(active & o_depth_dn, 1, 0)
+        + jnp.where(active & o_depth_up, jnp.int32(1), jnp.int32(0))
+        - jnp.where(active & o_depth_dn, jnp.int32(1), jnp.int32(0))
     )
     new_kmatch = kmatch
     new_kmatch = jnp.where(active & s_ordinary, k_next, new_kmatch)
@@ -412,7 +416,9 @@ def json_step(carry, c: jnp.ndarray, t, active: jnp.ndarray, needle_arr, klen: i
         active & (s_set_esc | s_esc_consume | s_close), 0, new_kmatch
     )
     new_kmatch = jnp.where(
-        active & o_open, jnp.where(depth == 1, 1, 0), new_kmatch
+        active & o_open,
+        jnp.where(depth == 1, jnp.int32(1), jnp.int32(0)),
+        new_kmatch,
     )
 
     # ---- phase WS (after colon): skip ws, classify value start
@@ -450,7 +456,12 @@ def json_step(carry, c: jnp.ndarray, t, active: jnp.ndarray, needle_arr, klen: i
     new_phase = jnp.where(r_end, _P_DONE, new_phase)
 
     new_vesc = jnp.where(s3, ~vesc & is_bslash, vesc)
-    new_d2 = d2 + jnp.where(w_raw_open, 1, 0) + jnp.where(r_open, 1, 0) - jnp.where(r_dec, 1, 0)
+    new_d2 = (
+        d2
+        + jnp.where(w_raw_open, jnp.int32(1), jnp.int32(0))
+        + jnp.where(r_open, jnp.int32(1), jnp.int32(0))
+        - jnp.where(r_dec, jnp.int32(1), jnp.int32(0))
+    )
     new_start = jnp.where(w_str, t + 1, jnp.where(w_raw | w_empty, t, start))
     new_end = jnp.where(s3_close, t, jnp.where(r_end, lastnw + 1, jnp.where(w_empty, t, end)))
     new_lastnw = jnp.where((w_raw & ~is_ws) | (s4 & ~r_end & ~is_ws), t, lastnw)

@@ -11,7 +11,6 @@ DFA tables at lowering time; an unsupported pattern raises
 from __future__ import annotations
 
 import os
-import re as _re
 from typing import Callable, Dict, Tuple
 
 import jax.numpy as jnp

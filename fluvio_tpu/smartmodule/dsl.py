@@ -49,7 +49,7 @@ from __future__ import annotations
 
 import re as _re
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 # ---------------------------------------------------------------------------
 # Shared byte-level primitive semantics (single source of truth for both

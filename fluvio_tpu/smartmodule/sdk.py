@@ -31,7 +31,7 @@ from __future__ import annotations
 import hashlib
 import threading
 from dataclasses import dataclass, field as dc_field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 from fluvio_tpu.smartmodule.types import TRANSFORM_KIND_ORDER, SmartModuleKind
 

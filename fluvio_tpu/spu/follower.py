@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import TYPE_CHECKING, Dict, List, Optional
+from typing import TYPE_CHECKING, Dict, List
 
 from fluvio_tpu.protocol.record import RecordSet
 from fluvio_tpu.schema.internal_spu import (

@@ -23,7 +23,7 @@ from fluvio_tpu.protocol.api import (
 )
 from fluvio_tpu.protocol.codec import ByteWriter
 from fluvio_tpu.protocol.error import ErrorCode, FluvioError
-from fluvio_tpu.protocol.record import Batch, RecordSet
+from fluvio_tpu.protocol.record import RecordSet
 from fluvio_tpu.schema.spu import (
     FetchablePartitionResponse,
     FetchOffsetsRequest,
@@ -61,7 +61,6 @@ from fluvio_tpu.spu.smart_chain import (
 )
 from fluvio_tpu.smartengine.engine import EngineError, SmartModuleChainInitError
 from fluvio_tpu.smartengine.metering import SmartModuleFuelError
-from fluvio_tpu.smartmodule.types import SmartModuleInput
 from fluvio_tpu.transport.service import FluvioService
 from fluvio_tpu.transport.sink import ExclusiveSink, FluvioSink
 from fluvio_tpu.transport.socket import FluvioSocket, SocketClosed

@@ -11,9 +11,9 @@ stream-fetch select loops wake up. Follower-offset tracking
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, List
+from typing import Dict
 
-from fluvio_tpu.protocol.record import Batch, RecordSet
+from fluvio_tpu.protocol.record import RecordSet
 from fluvio_tpu.schema.spu import Isolation
 from fluvio_tpu.storage.config import ReplicaConfig
 from fluvio_tpu.storage.replica import (

@@ -89,10 +89,8 @@ from fluvio_tpu.schema.smartmodule import (
     SmartModuleInvocation,
     SmartModuleInvocationWasm,
 )
-from fluvio_tpu.schema.spu import Isolation
 from fluvio_tpu.smartengine.config import Lookback
 from fluvio_tpu.smartengine.engine import (
-    SmartEngine,
     SmartModuleChainInstance,
     SmartModuleChainInitError,
 )

@@ -16,7 +16,7 @@ from fluvio_tpu.protocol.error import ErrorCode, FluvioError
 from fluvio_tpu.protocol.record import Batch, Record, RecordSet
 from fluvio_tpu.storage.checkpoint import CheckPoint
 from fluvio_tpu.storage.config import ReplicaConfig
-from fluvio_tpu.storage.segment import Segment, log_name
+from fluvio_tpu.storage.segment import Segment
 from fluvio_tpu.types import NO_TIMESTAMP
 
 ISOLATION_READ_UNCOMMITTED = "read_uncommitted"

@@ -12,9 +12,8 @@ from __future__ import annotations
 import os
 import struct
 from dataclasses import dataclass
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Optional
 
-from fluvio_tpu.protocol.codec import ByteReader
 from fluvio_tpu.protocol.record import (
     BATCH_HEADER_SIZE,
     BATCH_PREAMBLE_SIZE,

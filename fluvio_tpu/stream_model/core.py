@@ -11,8 +11,8 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from dataclasses import dataclass, field
-from typing import Any, ClassVar, Dict, Generic, Optional, Type, TypeVar
+from dataclasses import dataclass
+from typing import Any, ClassVar, Dict, Generic, Type, TypeVar
 
 
 def _to_plain(value: Any) -> Any:

@@ -12,7 +12,6 @@ from __future__ import annotations
 import asyncio
 import multiprocessing
 import os
-import sys
 import time
 import traceback
 from dataclasses import dataclass

@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 import os
 import struct
-from typing import TYPE_CHECKING, List, Optional
+from typing import TYPE_CHECKING, List
 
 from fluvio_tpu.protocol.api import RequestMessage, ResponseMessage
 from fluvio_tpu.protocol.codec import ByteWriter, Version

@@ -10,7 +10,6 @@ a typed error instead of an undecodable frame.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from fluvio_tpu.protocol.api import ApiRequest, ApiVersionsRequest, ApiVersionsResponse
 from fluvio_tpu.transport.multiplexing import MultiplexerSocket

@@ -12,7 +12,7 @@ wakes consumer streams when the leader's HW/LEO advances).
 from __future__ import annotations
 
 import asyncio
-from typing import List, Optional
+from typing import Optional
 
 # ---------------------------------------------------------------------------
 # Aliases & defaults

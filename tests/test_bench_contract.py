@@ -167,6 +167,9 @@ def _full_config(rps: int, x: float, path: str = "fused") -> dict:
             "e2e_p50_ms": 1554.0,
             "e2e_p99_ms": 1698.0,
         },
+        # ISSUE-6: per-config preflight record (predicted-vs-actual
+        # executed path from the static analyzer, full detail file-only)
+        "preflight": {"path": path, "actual": path, "agree": True},
     }
 
 
@@ -251,6 +254,11 @@ def test_compact_line_fits_driver_window():
     # in BENCH_DETAIL.json
     assert parsed["compile"] == {"n": 3, "s": 19.42, "pc": [1, 2]}
     assert "compile" not in parsed["configs"]["2_filter_map"]
+    # ISSUE-6 satellite: ONE compact preflight key — predicted-vs-actual
+    # path agreement across the matrix; per-config hazard detail stays
+    # in BENCH_DETAIL.json
+    assert parsed["preflight"] == {"agree": 7, "of": 7}
+    assert "preflight" not in parsed["configs"]["2_filter_map"]
 
 
 def test_compact_line_trims_pathological_blowup_keeps_link():
@@ -340,3 +348,38 @@ def test_staging_ab_and_glz_fields_survive_the_emit():
     assert got["staging_ab"]["chosen"] == "glz"
     assert got["glz_ratio"] == 0.476
 
+
+
+def test_preflight_counts_disagreement_and_unjudged():
+    """The compact preflight key counts only judgeable configs: an
+    ``agree: None`` (telemetry off -> actual unknown) is excluded, a
+    real disagreement counts against the analyzer."""
+    b = _bench()
+    configs = {
+        "a": {"preflight": {"path": "fused", "actual": "fused",
+                            "agree": True}},
+        "b": {"preflight": {"path": "fused", "actual": "interpreter",
+                            "agree": False}},
+        "c": {"preflight": {"path": "fused", "actual": "unknown",
+                            "agree": None}},
+        "d": {"records_per_sec": 1},  # no preflight at all
+    }
+    assert b._preflight_counts(configs) == {"agree": 1, "of": 2}
+    assert b._preflight_counts({"d": {"records_per_sec": 1}}) is None
+
+
+def test_preflight_survives_emit_and_line_trim_order():
+    """The per-config preflight record rides BENCH_DETAIL.json through
+    _build_output untouched, and the compact key drops BEFORE link in
+    the blowup trim ladder (link.glz is the contract field)."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    cfg = dict(GOOD)
+    cfg["preflight"] = {"path": "fused", "actual": "fused", "agree": True}
+    out, rc = b._build_output({"2_filter_map": cfg})
+    assert rc == 0
+    assert out["configs"]["2_filter_map"]["preflight"]["agree"] is True
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["preflight"] == {"agree": 1, "of": 1}

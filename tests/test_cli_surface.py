@@ -63,3 +63,74 @@ def test_leaf_count_is_substantial():
         1 for _, parser in _parsers().items() for _ in _walk(parser, [])
     )
     assert total >= 40, total
+
+
+class TestAnalyzeExitCodes:
+    """`fluvio-tpu analyze` is a pre-deploy gate: rc 0 for clean chains,
+    rc 1 on ERROR-severity hazards (or lint violations), so
+    ``analyze && deploy`` refuses to ship an interpreter-bound chain."""
+
+    def _main(self, argv):
+        from fluvio_tpu.cli import main
+
+        return main(argv)
+
+    def test_clean_chain_exits_zero(self, capsys):
+        rc = self._main(
+            ["analyze", "--module", "regex-filter:regex=fluvio",
+             "--module", "json-map:field=name", "--format", "json"]
+        )
+        assert rc == 0
+        import json
+
+        report = json.loads(capsys.readouterr().out)
+        assert report["chain"] == "filter+map"
+        assert {p["path"] for p in report["predictions"]} <= {
+            "fused", "striped"
+        }
+
+    def test_spill_prediction_exits_nonzero(self, capsys):
+        # word_count cannot stripe: past-threshold widths predict an
+        # interpreter spill, which is an ERROR for a pre-deploy gate
+        rc = self._main(
+            ["analyze", "--module", "word-count", "--width", "200000"]
+        )
+        assert rc == 1
+        assert "record-too-wide-unstripeable" in capsys.readouterr().out
+
+    def test_unknown_module_is_cli_error(self, capsys):
+        rc = self._main(["analyze", "--module", "no-such-module"])
+        assert rc == 1
+        assert "no-such-module" in capsys.readouterr().err
+
+    def test_bad_param_syntax_is_cli_error(self, capsys):
+        rc = self._main(["analyze", "--module", "regex-filter:oops"])
+        assert rc == 1
+        assert "key=value" in capsys.readouterr().err
+
+    def test_no_module_is_cli_error(self, capsys):
+        rc = self._main(["analyze"])
+        assert rc == 1
+        assert "--module" in capsys.readouterr().err
+
+    def test_lint_mode_clean_repo_exits_zero(self, capsys):
+        import os
+
+        import fluvio_tpu
+
+        pkg = os.path.dirname(os.path.abspath(fluvio_tpu.__file__))
+        rc = self._main(
+            ["analyze", "--lint", os.path.join(pkg, "analysis")]
+        )
+        assert rc == 0
+        assert "0 violation(s)" in capsys.readouterr().out
+
+    def test_lint_mode_flags_violations(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import os\ndef f(a=[]):\n    return a\n")
+        rc = self._main(["analyze", "--lint", str(bad), "--format", "json"])
+        assert rc == 1
+        import json
+
+        found = json.loads(capsys.readouterr().out)
+        assert {v["code"] for v in found} == {"FLV101", "FLV102"}
