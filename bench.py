@@ -863,6 +863,7 @@ def _build_output(results: dict, extra_error: str = "") -> tuple:
     if extra_error:
         inner["error"] = extra_error
     inner["xla_cache"] = _cache_stats()
+    inner["concurrency"] = _concurrency_verdict()
     if _LINK:
         inner["link"] = dict(_LINK)
     if _BACKEND_MODE == "cpu_fallback":
@@ -927,6 +928,26 @@ def _compact_configs(configs: dict) -> dict:
         elif "skipped" in c:
             out[name] = {"skipped": c["skipped"]}
     return out
+
+
+def _concurrency_verdict():
+    """Whole-package lock-discipline verdict for BENCH_DETAIL.json ONLY
+    — the compact driver line never grows a key for it (`_compact_line`
+    is allowlist-based). A bench run that ships with a lock-order cycle
+    or an unguarded shared write should say so next to its numbers."""
+    try:
+        from fluvio_tpu.analysis import analyze_concurrency
+
+        report = analyze_concurrency()
+        return {
+            "errors": len(report.errors()),
+            "warnings": len(report.warnings()),
+            "locks": len(report.locks),
+            "order_edges": len(report.edges),
+            "cycles": len(report.cycles),
+        }
+    except Exception as e:  # noqa: BLE001 — analysis must never cost a run
+        return {"error": f"{type(e).__name__}: {e}"[:120]}
 
 
 def _preflight_counts(configs: dict):

@@ -30,17 +30,6 @@ from fluvio_tpu.analysis.ast_lint import (
     lint_repo,
     lint_source,
 )
-from fluvio_tpu.analysis.spec import (
-    ERROR,
-    INFO,
-    WARN,
-    ChainReport,
-    Hazard,
-    PathPrediction,
-    analyze_entries,
-    analyze_named,
-    resolve_gates,
-)
 
 __all__ = [
     "ERROR", "INFO", "WARN",
@@ -48,7 +37,33 @@ __all__ = [
     "analyze_entries", "analyze_named", "analyze_chain", "resolve_gates",
     "lint_source", "lint_file", "lint_paths", "lint_repo",
     "preflight_for_specs",
+    "ConcurrencyReport", "analyze_concurrency", "static_lock_graph",
 ]
+
+# spec re-exports resolve lazily (PEP 562): engine modules import the
+# lockwatch shim from this package at THEIR import time, and an eager
+# spec import here would close a cycle back through ops/regex_dfa
+_SPEC_EXPORTS = {
+    "ERROR", "INFO", "WARN", "ChainReport", "Hazard", "PathPrediction",
+    "analyze_entries", "analyze_named", "resolve_gates",
+}
+_CONCURRENCY_EXPORTS = {
+    "ConcurrencyReport": "ConcurrencyReport",
+    "analyze_concurrency": "analyze_package",
+    "static_lock_graph": "static_lock_graph",
+}
+
+
+def __getattr__(name: str):
+    if name in _SPEC_EXPORTS:
+        from fluvio_tpu.analysis import spec
+
+        return getattr(spec, name)
+    if name in _CONCURRENCY_EXPORTS:
+        from fluvio_tpu.analysis import concurrency
+
+        return getattr(concurrency, _CONCURRENCY_EXPORTS[name])
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def analyze_chain(
@@ -62,6 +77,10 @@ def analyze_chain(
     entries: the Level-1 spec pass, plus (``jaxpr=True``) the Level-2
     abstract trace of every jit entry point the chain would compile at
     the probed widths."""
+    # function-level import: module __getattr__ serves ATTRIBUTE access
+    # only, not global-name lookup inside this module's own functions
+    from fluvio_tpu.analysis.spec import analyze_entries
+
     report = analyze_entries(entries, widths=widths, sharded=sharded)
     if not jaxpr:
         return report
@@ -93,6 +112,8 @@ def preflight_for_specs(
     """Compact per-config preflight record for the bench: the predicted
     path + reason strings for one chain spec at one record width.
     ``specs`` is the bench-matrix format: ``[(model name, params)]``."""
+    from fluvio_tpu.analysis.spec import analyze_named
+
     report = analyze_named(specs, widths=(width,))
     pred = report.predictions[0]
     out = {"path": pred.path}

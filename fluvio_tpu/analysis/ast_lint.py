@@ -52,7 +52,8 @@ KERNEL_MODULES = ("kernels.py", "pallas_kernels.py", "stripes.py", "lower.py")
 # executor functions on the dispatch side of the pipeline (stage ->
 # h2d -> device): a host sync here stalls the async dispatch overlap
 DISPATCH_HOT_FUNCS = {
-    "_dispatch", "dispatch_buffer", "_stage_flat", "_flat_and_bucket",
+    "_dispatch", "_dispatch_inner", "dispatch_buffer", "_stage_flat",
+    "_flat_and_bucket",
     "_chain_fn", "_chain_fn_ragged", "_chain_fn_striped",
     "ragged_repad_words", "derived_meta_columns", "stage_link_columns",
 }

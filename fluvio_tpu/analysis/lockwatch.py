@@ -1,0 +1,272 @@
+"""LockWatch: a zero-cost-when-disabled shim over ``threading.Lock``.
+
+The static half of the concurrency pass (`analysis/concurrency.py`)
+predicts a global lock-acquisition-order graph from the AST. This
+module is the runtime half of that differential: every lock the engine
+creates goes through `make_lock(name)`, and when ``FLUVIO_LOCKWATCH``
+is armed the returned lock records REAL acquisition orders — which
+lock was held when another was acquired — into a process-global edge
+set that tier-1 compares against the static prediction (the same
+pattern as PR 6's path-prediction-vs-telemetry pins).
+
+Cost contract: with ``FLUVIO_LOCKWATCH`` unset (the default),
+`make_lock` returns a plain ``threading.Lock``/``RLock`` — not a
+wrapper, not a subclass — so the armed-off seam is exactly one env
+read at LOCK CREATION time and zero per acquire/release. The overhead
+gate (tests/test_telemetry_overhead.py) pins this.
+
+Modes (``FLUVIO_LOCKWATCH``):
+
+- unset/``0`` — plain locks, zero cost (production default),
+- ``1``/``record`` — watched locks record acquisition-order edges,
+- ``assert`` — additionally raise `LockOrderViolation` the moment an
+  acquisition closes a cycle in the observed graph (an A→B edge when
+  B→…→A is already recorded is a potential deadlock: two threads
+  running the two paths concurrently can block forever).
+
+Lock names are the SAME string literals the static analyzer reads out
+of the `make_lock("...")` call sites, so the observed and predicted
+graphs share one vocabulary by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "LockOrderViolation",
+    "enabled",
+    "make_lock",
+    "observed_edges",
+    "observed_locks",
+    "find_cycle",
+    "reset_observations",
+]
+
+
+def _mode() -> str:
+    return os.environ.get("FLUVIO_LOCKWATCH", "0").strip().lower()
+
+
+def enabled() -> bool:
+    return _mode() in ("1", "record", "assert")
+
+
+class LockOrderViolation(AssertionError):
+    """An acquisition closed a cycle in the observed lock-order graph."""
+
+    def __init__(self, cycle: List[str]):
+        super().__init__(
+            "lock-order cycle observed at runtime: "
+            + " -> ".join(cycle + cycle[:1])
+        )
+        self.cycle = cycle
+
+
+# -- observation store --------------------------------------------------------
+#
+# The meta-lock below guards the edge store only; it is deliberately a
+# plain threading.Lock (never watched — watching the watcher would
+# recurse) and is never held while any engine lock is acquired.
+
+_meta_lock = threading.Lock()
+_edges: Set[Tuple[str, str]] = set()
+_edge_sites: Dict[Tuple[str, str], int] = {}
+_known_locks: Set[str] = set()
+_held = threading.local()  # per-thread stack of held (name, lock-id) pairs
+
+
+def _held_stack() -> List[Tuple[str, int]]:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = []
+        _held.stack = stack
+    return stack
+
+
+def observed_edges() -> Set[Tuple[str, str]]:
+    """The runtime acquisition-order edges seen so far: ``(a, b)`` means
+    some thread acquired ``b`` while holding ``a``."""
+    with _meta_lock:
+        return set(_edges)
+
+
+def observed_locks() -> Set[str]:
+    """Names of every watched lock created since the last reset."""
+    with _meta_lock:
+        return set(_known_locks)
+
+
+def reset_observations() -> None:
+    with _meta_lock:
+        _edges.clear()
+        _edge_sites.clear()
+        _known_locks.clear()
+
+
+def find_cycle(edges) -> Optional[List[str]]:
+    """First cycle in a directed edge set, as the node list along it
+    (None when acyclic). Deterministic: nodes visit in sorted order."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    for outs in graph.values():
+        outs.sort()
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: 0 for n in graph}
+    stack: List[str] = []
+
+    def visit(n: str) -> Optional[List[str]]:
+        color[n] = GREY
+        stack.append(n)
+        for m in graph[n]:
+            if color[m] == GREY:
+                return stack[stack.index(m):]
+            if color[m] == WHITE:
+                cyc = visit(m)
+                if cyc is not None:
+                    return cyc
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            cyc = visit(n)
+            if cyc is not None:
+                return cyc
+    return None
+
+
+def _cycle_through(edges, new_edges) -> Optional[List[str]]:
+    """First cycle that passes through one of ``new_edges``, as the node
+    list along it (None if none). Assert mode checks only cycles closed
+    by the acquisition that just added those edges: edges persist in the
+    process-global store, so a raised-and-caught violation must not make
+    every later, correctly-ordered nested acquisition re-raise against
+    the stale cycle."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    for outs in graph.values():
+        outs.sort()
+    for a, b in sorted(new_edges):
+        # a path b ->* a means (a, b) closes a cycle
+        path = _find_path(graph, b, a)
+        if path is not None:
+            return [a] + path[:-1]
+    return None
+
+
+def _find_path(
+    graph: Dict[str, List[str]], src: str, dst: str
+) -> Optional[List[str]]:
+    """Deterministic DFS path ``src -> ... -> dst`` (node list incl. both
+    endpoints), or None."""
+    seen = {src}
+    stack: List[Tuple[str, List[str]]] = [(src, [src])]
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for m in reversed(graph.get(node, ())):
+            if m not in seen:
+                seen.add(m)
+                stack.append((m, path + [m]))
+    return None
+
+
+class _WatchedLock:
+    """Records acquisition order around a real ``threading`` lock.
+
+    Re-entry is tracked per lock INSTANCE: re-acquiring the same RLock
+    records nothing (not an ordering event), but acquiring a DIFFERENT
+    instance that shares the canonical name (e.g. two chains'
+    ``smartengine.metrics``) records a ``(name, name)`` self-edge —
+    nothing distinguishes the instances to other threads, so nesting
+    them is an ambiguous-order ABBA hazard assert mode must catch."""
+
+    __slots__ = ("name", "_inner", "_assert")
+
+    def __init__(self, name: str, inner, assert_mode: bool):
+        self.name = name
+        self._inner = inner
+        self._assert = assert_mode
+        with _meta_lock:
+            _known_locks.add(name)
+
+    # -- lock protocol -------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._record_acquire()
+        return got
+
+    def release(self) -> None:
+        self._record_release()
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _record_acquire(self) -> None:
+        stack = _held_stack()
+        me = (self.name, id(self))
+        if me not in stack:
+            new_edges = {(h, self.name) for h, _lid in stack}
+            if new_edges:
+                with _meta_lock:
+                    for e in new_edges:
+                        _edges.add(e)
+                        _edge_sites[e] = _edge_sites.get(e, 0) + 1
+                    cycle = (
+                        _cycle_through(_edges, new_edges)
+                        if self._assert
+                        else None
+                    )
+                if cycle is not None:
+                    # release before raising: a `with` statement never
+                    # runs __exit__ when __enter__ raises, and a
+                    # permanently-held engine lock would wedge the
+                    # process instead of reporting the deadlock risk
+                    self._inner.release()
+                    raise LockOrderViolation(cycle)
+        stack.append(me)
+
+    def _record_release(self) -> None:
+        stack = _held_stack()
+        me = (self.name, id(self))
+        # remove the most recent entry (locks release LIFO in `with`
+        # blocks; out-of-order manual release still stays consistent)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == me:
+                del stack[i]
+                break
+
+
+def make_lock(name: str, rlock: bool = False):
+    """The ONE lock constructor for engine modules.
+
+    Disabled (default): returns a plain ``threading.Lock``/``RLock`` —
+    the watch seam costs nothing per acquire. Armed: returns a
+    `_WatchedLock` recording acquisition-order edges under ``name``
+    (the same literal the static analyzer keys its graph on)."""
+    inner = threading.RLock() if rlock else threading.Lock()
+    mode = _mode()
+    if mode in ("1", "record", "assert"):
+        return _WatchedLock(name, inner, assert_mode=(mode == "assert"))
+    return inner

@@ -12,6 +12,14 @@ cluster or a device queue:
 - ``fluvio-tpu analyze --lint [PATH ...]`` — the repo-invariant AST
   linter (kernel literal pinning, host-sync bans, telemetry seams,
   hygiene) over the given paths (default: the installed package).
+- ``fluvio-tpu analyze --concurrency`` — the whole-package
+  lock-discipline pass (analysis/concurrency.py): inferred guard map,
+  lock-acquisition-order graph + cycle detection, work-under-lock and
+  implicit-D2H hazards (FLV2xx).
+
+Combining passes is fine (``--lint --concurrency``, ``--module ...
+--concurrency``); with ``--format json`` multiple passes merge into ONE
+top-level document keyed ``concurrency`` / ``lint`` / ``chain``.
 
 Exit codes make it a pre-deploy gate: 0 clean, 1 when any
 ERROR-severity hazard (a predicted interpreter spill, a weak-64bit
@@ -65,6 +73,12 @@ def add_analyze_parser(sub) -> None:
         metavar="PATH",
         help="run the repo AST linter over PATHs instead of analyzing "
         "a chain (no PATH = the installed fluvio_tpu package)",
+    )
+    p.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="run the whole-package lock-discipline analysis "
+        "(guard map, lock-order graph, FLV2xx hazards)",
     )
     p.add_argument(
         "--format",
@@ -132,10 +146,40 @@ def _render_report(report) -> str:
 
 
 async def analyze(args) -> int:
-    if args.lint is not None:
-        return _run_lint(args)
-    if not args.module:
-        raise CliError("nothing to analyze: pass --module (or --lint)")
+    jobs = [
+        name for name, wanted in (
+            ("concurrency", args.concurrency),
+            ("lint", args.lint is not None),
+            ("chain", bool(args.module)),
+        ) if wanted
+    ]
+    if not jobs:
+        raise CliError(
+            "nothing to analyze: pass --module (or --lint / --concurrency)"
+        )
+    # several passes in json mode merge into ONE top-level document —
+    # two concatenated dumps would be unparseable machine output
+    merge = args.format == "json" and len(jobs) > 1
+    merged = {}
+    rc = 0
+    if "concurrency" in jobs:
+        crc, payload = _run_concurrency(args, emit=not merge)
+        rc = max(rc, crc)
+        merged["concurrency"] = payload
+    if "lint" in jobs:
+        lrc, payload = _run_lint(args, emit=not merge)
+        rc = max(rc, lrc)
+        merged["lint"] = payload
+    if "chain" in jobs:
+        arc, payload = _run_chain(args, emit=not merge)
+        rc = max(rc, arc)
+        merged["chain"] = payload
+    if merge:
+        print(json.dumps(merged, indent=1))
+    return rc
+
+
+def _run_chain(args, emit: bool = True):
     from fluvio_tpu.analysis import analyze_chain
     from fluvio_tpu.models import lookup
     from fluvio_tpu.smartengine.config import SmartModuleConfig
@@ -151,17 +195,67 @@ async def analyze(args) -> int:
         entries, widths=args.width or None, sharded=args.sharded,
         jaxpr=args.jaxpr,
     )
-    if args.format == "json":
-        print(json.dumps(report.to_dict(), indent=1))
-    else:
-        print(_render_report(report))
     errors = report.errors()
-    if errors and args.format != "json":
-        print(f"\n{len(errors)} ERROR-severity hazard(s)")
-    return 1 if errors else 0
+    if emit:
+        if args.format == "json":
+            print(json.dumps(report.to_dict(), indent=1))
+        else:
+            print(_render_report(report))
+            if errors:
+                print(f"\n{len(errors)} ERROR-severity hazard(s)")
+    return (1 if errors else 0), report.to_dict()
 
 
-def _run_lint(args) -> int:
+def _run_concurrency(args, emit: bool = True):
+    from fluvio_tpu.analysis import analyze_concurrency
+    from fluvio_tpu.cli.metrics import _rows_to_table
+
+    report = analyze_concurrency()
+    rc = 1 if report.errors() else 0
+    if args.format == "json":
+        if emit:
+            print(json.dumps(report.to_dict(), indent=1))
+        return rc, report.to_dict()
+    sections = []
+    rows = sorted(
+        (state, g["lock"], g["accesses"], g["unguarded"],
+         "yes" if g["concurrent"] else "-")
+        for state, g in report.guard_map.items()
+    )
+    sections.append(
+        "guard map (inferred lock per shared attribute)\n"
+        + _rows_to_table(
+            rows, header=("shared state", "lock", "uses", "unguarded", "conc")
+        )
+    )
+    rows = [(e.src, e.dst, f"{e.path}:{e.line}") for e in report.edges]
+    sections.append(
+        "lock-acquisition-order graph\n"
+        + (_rows_to_table(rows, header=("held", "acquired", "site"))
+           if rows else "(no nested acquisitions)")
+    )
+    if report.cycles:
+        sections.append(
+            "CYCLES\n" + "\n".join(" -> ".join(c) for c in report.cycles)
+        )
+    if report.findings:
+        rows = [
+            (f.level.upper(), f.code, f"{f.path}:{f.line}", f.message)
+            for f in report.findings
+        ]
+        sections.append(
+            "findings\n"
+            + _rows_to_table(rows, header=("sev", "code", "site", "detail"))
+        )
+    else:
+        sections.append("findings\n(none)")
+    print("\n\n".join(sections))
+    if rc:
+        print(f"\n{len(report.errors())} ERROR-severity concurrency finding(s)")
+    return rc, report.to_dict()
+
+
+def _run_lint(args, emit: bool = True):
     import os
 
     import fluvio_tpu
@@ -169,10 +263,12 @@ def _run_lint(args) -> int:
 
     paths = args.lint or [os.path.dirname(os.path.abspath(fluvio_tpu.__file__))]
     violations = lint_paths(paths)
+    payload = [v.to_dict() for v in violations]
     if args.format == "json":
-        print(json.dumps([v.to_dict() for v in violations], indent=1))
+        if emit:
+            print(json.dumps(payload, indent=1))
     else:
         for v in violations:
             print(v)
         print(f"{len(violations)} violation(s)")
-    return 1 if violations else 0
+    return (1 if violations else 0), payload

@@ -26,12 +26,13 @@ state accepts" after scanning len(record)+1 symbols.
 from __future__ import annotations
 
 import functools
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 import numpy as np
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 EOS = 256
 PAD = 257
@@ -564,7 +565,7 @@ _compile_regex_lru = functools.lru_cache(maxsize=256)(compile_regex)
 # whose cache hit races another thread's miss observes no NEW growth
 # past this mark and records nothing (same dedupe as instrument_jit)
 _dfa_seen_misses = [0]
-_dfa_seen_lock = threading.Lock()
+_dfa_seen_lock = make_lock("regex_dfa.seen")
 
 
 def compile_regex_cached(pattern: str) -> "CompiledDfa":

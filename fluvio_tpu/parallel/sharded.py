@@ -487,6 +487,16 @@ class ShardedChainExecutor:
         return ex._bucket_bytes(worst, floor=8)
 
     def dispatch_buffer(self, buf: RecordBuffer, cap_shard=None, reuse_span=None):
+        # The dispatch-side transfer-guard scope lives HERE, not at the
+        # call sites: every entry point — the executor delegation, the
+        # fanout-cap re-dispatch inside finish_buffer, the transient
+        # retry in _finish_sharded_inner (both of which otherwise run
+        # inside the fetch ALLOW scope), and direct process_buffer
+        # drivers — is dispatch-hot and must not be allowlisted.
+        with kernels_executor.transfer_guard_dispatch():
+            return self._dispatch_buffer_inner(buf, cap_shard, reuse_span)
+
+    def _dispatch_buffer_inner(self, buf: RecordBuffer, cap_shard, reuse_span):
         from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
         ex = self.executor

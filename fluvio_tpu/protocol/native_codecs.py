@@ -18,8 +18,9 @@ import hashlib
 import logging
 import os
 import subprocess
-import threading
 from pathlib import Path
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -27,7 +28,7 @@ _SOURCE = Path(__file__).resolve().parents[1] / "native" / "codecs.cpp"
 _BUILD_DIR = Path(
     os.environ.get("FLUVIO_TPU_NATIVE_BUILD", str(_SOURCE.parent / "_build"))
 )
-_lock = threading.Lock()
+_lock = make_lock("native_codecs.build")
 _lib = None
 _lib_failed = False
 

@@ -47,8 +47,9 @@ from __future__ import annotations
 import logging
 import os
 import random
-import threading
 from typing import Dict, Optional
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -149,7 +150,7 @@ class FaultRegistry:
     firing reads a snapshot dict, so seams never take the lock)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.registry")
         self._rules: Dict[str, FaultRule] = {}
 
     @property

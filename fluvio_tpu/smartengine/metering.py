@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Callable
 
+from fluvio_tpu.analysis.lockwatch import make_lock
+
 #: how long to keep re-injecting before abandoning the hook thread
 _KILL_GRACE_SECONDS = 5.0
 
@@ -44,7 +46,7 @@ _MODULE_ABANDONED_LIMIT = 4
 #: SPU monitoring socket so an operator can see why.
 _ABANDONED_LIMIT = 16
 
-_abandoned_lock = threading.Lock()
+_abandoned_lock = make_lock("metering.abandoned")
 #: module key -> list of live abandoned hook threads
 _abandoned_by_module: dict = {}
 

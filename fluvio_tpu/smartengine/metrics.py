@@ -10,8 +10,9 @@ instance) or device kernel records processed (tpu backend).
 from __future__ import annotations
 
 import json
-import threading
 from dataclasses import dataclass, field
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 
 @dataclass
@@ -27,7 +28,9 @@ class SmartModuleChainMetrics:
     fastpath_slices: int = 0
     fallback_slices: int = 0
     fallback_reasons: dict = field(default_factory=dict)
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _lock: object = field(
+        default_factory=lambda: make_lock("smartengine.metrics"), repr=False
+    )
 
     def add_bytes_in(self, n: int) -> None:
         with self._lock:

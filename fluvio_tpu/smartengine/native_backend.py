@@ -20,7 +20,6 @@ import hashlib
 import logging
 import os
 import subprocess
-import threading
 from pathlib import Path
 from typing import List, Optional, Tuple
 
@@ -35,13 +34,15 @@ from fluvio_tpu.smartmodule.types import (
     SmartModuleTransformRuntimeError,
 )
 
+from fluvio_tpu.analysis.lockwatch import make_lock
+
 logger = logging.getLogger(__name__)
 
 _SOURCE = Path(__file__).resolve().parents[1] / "native" / "baseline_engine.cpp"
 _BUILD_DIR = Path(
     os.environ.get("FLUVIO_TPU_NATIVE_BUILD", str(_SOURCE.parent / "_build"))
 )
-_lock = threading.Lock()
+_lock = make_lock("native_backend.build")
 _lib = None
 _lib_failed = False
 

@@ -18,9 +18,9 @@ with a single XLA program per shape bucket.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
-import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
@@ -58,6 +58,8 @@ from fluvio_tpu.smartengine.tpu.lower import (
     lower_span,
     materialize_span,
 )
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 _AGG_OP = {
     "sum_int": "add",
@@ -446,8 +448,59 @@ def effective_link_compress() -> bool:
     return mode == "on" or (mode == "auto" and jax.default_backend() != "cpu")
 
 
+# -- transfer-guard strictness (FLUVIO_TRANSFER_GUARD) ------------------------
+#
+# The static arm (analysis FLV003/FLV214) bans implicit D2H syncs in
+# dispatch-side hot code syntactically; this is the dynamic arm. Armed
+# ("disallow" | "log"), every dispatch-side region runs under
+# ``jax.transfer_guard_device_to_host(mode)`` so an implicit
+# device->host materialization (np.asarray on a jit result, int() on a
+# device scalar) raises/logs at the exact offending line instead of
+# silently stalling the async dispatch overlap. The fetch side is the
+# ONE intentional D2H seam: when the env arm is set, it runs under an
+# explicit "allow" scope. Unarmed (default): both helpers return a
+# shared nullcontext — one env read + one context enter per BATCH
+# dispatched, nothing per record — so a guard armed process-globally
+# via jax.config alone is NOT allowlisted at the fetch seam; arm via
+# FLUVIO_TRANSFER_GUARD to get the seam selection.
+
+_TRANSFER_GUARD_ENV = "FLUVIO_TRANSFER_GUARD"
+_TRANSFER_GUARD_MODES = ("disallow", "log")
+_TRANSFER_GUARD_OFF = ("", "0", "off", "none", "allow")
+_NULL_CTX = contextlib.nullcontext()
+
+
+def _transfer_guard_mode() -> str:
+    raw = os.environ.get(_TRANSFER_GUARD_ENV, "").strip().lower()
+    if raw in _TRANSFER_GUARD_OFF:
+        return ""
+    if raw not in _TRANSFER_GUARD_MODES:
+        raise ValueError(
+            f"{_TRANSFER_GUARD_ENV}={raw!r}: expected one of "
+            f"{list(_TRANSFER_GUARD_MODES)} (or 0/off to disable)"
+        )
+    return raw
+
+
+def transfer_guard_dispatch():
+    """Guard context for dispatch-side hot regions: forbids (or logs)
+    implicit D2H while staging/dispatching; free when unarmed."""
+    mode = _transfer_guard_mode()
+    if mode:
+        return jax.transfer_guard_device_to_host(mode)
+    return _NULL_CTX
+
+
+def transfer_guard_fetch():
+    """Guard context for the intentional fetch/d2h seam: explicitly
+    allowed even when the guard is armed process-wide."""
+    if _transfer_guard_mode():
+        return jax.transfer_guard_device_to_host("allow")
+    return _NULL_CTX
+
+
 _GLZ_POOL = None
-_GLZ_POOL_LOCK = threading.Lock()
+_GLZ_POOL_LOCK = make_lock("executor.glz_pool")
 
 
 def _compress_pool():
@@ -457,7 +510,10 @@ def _compress_pool():
     discarded executor; lazily created so non-streaming processes never
     spawn it."""
     global _GLZ_POOL
-    if _GLZ_POOL is None:
+    # double-checked lazy init: the unlocked fast-path read is a
+    # GIL-atomic reference load (a stale None just falls through to the
+    # locked re-check), so the per-dispatch cost is one attribute read
+    if _GLZ_POOL is None:  # noqa: FLV202 — double-checked lazy init
         from concurrent.futures import ThreadPoolExecutor
 
         with _GLZ_POOL_LOCK:
@@ -465,7 +521,7 @@ def _compress_pool():
                 _GLZ_POOL = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="glz-compress"
                 )
-    return _GLZ_POOL
+    return _GLZ_POOL  # noqa: FLV202 — published once, never rebound
 
 
 class TpuChainExecutor:
@@ -1139,6 +1195,18 @@ class TpuChainExecutor:
         fanout_cap: Optional[int] = None,
         span=None,
     ):
+        """Async-dispatch one batch under the transfer-guard scope (see
+        `transfer_guard_dispatch`): armed, an implicit D2H sync anywhere
+        in the staging/dispatch path raises at the offending line."""
+        with transfer_guard_dispatch():
+            return self._dispatch_inner(buf, fanout_cap=fanout_cap, span=span)
+
+    def _dispatch_inner(
+        self,
+        buf: RecordBuffer,
+        fanout_cap: Optional[int] = None,
+        span=None,
+    ):
         """Async-dispatch one batch.
 
         Values go up ragged (flat bytes + starts) and are re-padded on
@@ -1350,7 +1418,8 @@ class TpuChainExecutor:
     def _ensure_host_state(self) -> None:
         if self._device_carries is None:
             return
-        host = jax.device_get(self._device_carries)
+        with transfer_guard_fetch():
+            host = jax.device_get(self._device_carries)
         self.carries = [(int(a), int(w), bool(h)) for a, w, h in host]
         self._sync_instances()
 
@@ -1479,6 +1548,14 @@ class TpuChainExecutor:
         return host
 
     def _fetch(
+        self, buf: RecordBuffer, header, packed, spec: Optional[Dict] = None
+    ) -> RecordBuffer:
+        """The intentional D2H seam: `_fetch_inner` under the explicit
+        transfer-guard allow scope (see `transfer_guard_fetch`)."""
+        with transfer_guard_fetch():
+            return self._fetch_inner(buf, header, packed, spec)
+
+    def _fetch_inner(
         self, buf: RecordBuffer, header, packed, spec: Optional[Dict] = None
     ) -> RecordBuffer:
         """Minimal-D2H materialization.
@@ -2008,12 +2085,26 @@ class TpuChainExecutor:
             self._heal_epoch += 1
             self._heal_dispatch_seq = -1
 
+    def _sharded_dispatch(self, buf: RecordBuffer, reuse_span=None):
+        """Sharded dispatch delegation. The dispatch-side transfer-guard
+        scope lives inside `ShardedChainExecutor.dispatch_buffer` so
+        every entry point — including the retry re-dispatch in
+        `_finish_sharded_inner`, which runs inside the fetch ALLOW
+        scope — re-enters it without per-call-site wrapping."""
+        return self._sharded.dispatch_buffer(buf, reuse_span=reuse_span)
+
     def _finish_sharded(self, buf: RecordBuffer, handle):
         """finish_buffer's sharded delegation with the same bounded
         transient retry. A retry is only lineage-safe when no LATER
         dispatch chained off this handle's carries (`_pending_carries is
         handle[1]`); otherwise the error re-raises and the interpreter
-        rerun re-syncs authoritative state."""
+        rerun re-syncs authoritative state. Runs under the fetch-side
+        transfer-guard allow scope: the sharded download is the same
+        intentional D2H seam as `_fetch`."""
+        with transfer_guard_fetch():
+            return self._finish_sharded_inner(buf, handle)
+
+    def _finish_sharded_inner(self, buf: RecordBuffer, handle):
         attempt = 0
         while True:
             try:
@@ -2037,9 +2128,7 @@ class TpuChainExecutor:
                 )
                 self._retry_policy.sleep(attempt)
                 attempt += 1
-                handle = self._sharded.dispatch_buffer(
-                    buf, reuse_span=handle[5]
-                )
+                handle = self._sharded_dispatch(buf, reuse_span=handle[5])
 
     def dispatch_buffer(self, buf: RecordBuffer):
         """Phase 1: stage + dispatch without blocking on results.
@@ -2058,7 +2147,7 @@ class TpuChainExecutor:
             sh_span = TELEMETRY.begin_batch()
             h0 = self.h2d_bytes_total
             handle = self._dispatch_with_retry(
-                lambda: self._sharded.dispatch_buffer(buf, reuse_span=sh_span)
+                lambda: self._sharded_dispatch(buf, reuse_span=sh_span)
             )
             self._gauge_track(handle, self.h2d_bytes_total - h0)
             return handle

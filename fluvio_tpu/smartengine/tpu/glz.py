@@ -32,11 +32,12 @@ import hashlib
 import logging
 import os
 import subprocess
-import threading
 from pathlib import Path
 from typing import NamedTuple, Optional
 
 import numpy as np
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -44,7 +45,7 @@ _SOURCE = Path(__file__).resolve().parents[2] / "native" / "glz.cpp"
 _BUILD_DIR = Path(
     os.environ.get("FLUVIO_TPU_NATIVE_BUILD", str(_SOURCE.parent / "_build"))
 )
-_lock = threading.Lock()
+_lock = make_lock("glz.build")
 _lib = None
 _lib_failed = False
 

@@ -3,17 +3,20 @@
 from __future__ import annotations
 
 import json
-import threading
 from dataclasses import dataclass, field
 
 from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 
 @dataclass
 class RecordCounter:
     records: int = 0
     bytes: int = 0
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _lock: object = field(
+        default_factory=lambda: make_lock("spu.metrics"), repr=False
+    )
 
     def add(self, records: int, nbytes: int) -> None:
         with self._lock:

@@ -32,6 +32,8 @@ from typing import Callable, Optional
 
 from fluvio_tpu.telemetry.registry import TELEMETRY
 
+from fluvio_tpu.analysis.lockwatch import make_lock
+
 # lazily-initialized persistent-cache direntry baseline: None until the
 # first instrumented call snapshots it (one listdir, paid once)
 _pc_entries: Optional[int] = None
@@ -95,9 +97,7 @@ def instrument_jit(
     around the jit call) — a thread whose cache hit merely blocked
     behind another thread's in-flight compile observes no new growth
     and records a hit, not a duplicate compile."""
-    import threading
-
-    lock = threading.Lock()
+    lock = make_lock("telemetry.compiles")
     state = {"seen": None}
 
     def wrapper(*args, **kwargs):

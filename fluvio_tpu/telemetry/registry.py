@@ -31,7 +31,6 @@ as the existing `SmartModuleChainMetrics` adds.
 from __future__ import annotations
 
 import os
-import threading
 import time
 from typing import Dict, List, Optional
 
@@ -43,6 +42,8 @@ from fluvio_tpu.telemetry.spans import (
     InstantEvent,
     SpanRing,
 )
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 SPAN_RING_CAPACITY = 256
 EVENT_RING_CAPACITY = 512
@@ -61,7 +62,7 @@ COMPILE_STORM_WINDOW_S = float(
 class PipelineTelemetry:
     def __init__(self, ring_capacity: int = SPAN_RING_CAPACITY) -> None:
         self.enabled = os.environ.get("FLUVIO_TELEMETRY", "1") != "0"
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.registry")
         self.batch_latency: Dict[str, LatencyHistogram] = {
             "fused": LatencyHistogram(),
             "striped": LatencyHistogram(),
@@ -386,12 +387,21 @@ class PipelineTelemetry:
                     "jit_cache_hits": self.jit_cache_hits,
                 },
                 "gauges": dict(self.gauges),
-                "spans_retained": len(self.spans),
-                "spans_total": self.spans.total,
-                "spans_dropped": self.spans.dropped,
-                "events_total": self.events.total,
-                "events_dropped": self.events.dropped,
-            }
+            } | self._ring_stats()
+
+    def _ring_stats(self) -> dict:
+        """Span/event ring bookkeeping, each triple read under ONE ring
+        lock acquisition so total == retained + dropped holds even while
+        a concurrent end_batch pushes mid-snapshot."""
+        spans_total, spans_retained, spans_dropped = self.spans.stats()
+        events_total, _, events_dropped = self.events.stats()
+        return {
+            "spans_retained": spans_retained,
+            "spans_total": spans_total,
+            "spans_dropped": spans_dropped,
+            "events_total": events_total,
+            "events_dropped": events_dropped,
+        }
 
     def spans_json(self, limit: Optional[int] = None) -> List[dict]:
         return [s.to_dict() for s in self.spans.recent(limit)]

@@ -28,9 +28,10 @@ adds one clock pair. No per-record work anywhere.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 PHASES = (
     "stage",
@@ -127,7 +128,7 @@ class _BoundedRing:
         self.capacity = capacity
         self._slots: List = [None] * capacity
         self._next = 0  # total pushes (monotone)
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.ring")
 
     def push(self, item) -> None:
         with self._lock:
@@ -135,19 +136,31 @@ class _BoundedRing:
             self._next += 1
 
     def __len__(self) -> int:
-        return min(self._next, self.capacity)
+        with self._lock:
+            return min(self._next, self.capacity)
 
     @property
     def total(self) -> int:
         """Items ever pushed (wrapped ones included)."""
-        return self._next
+        with self._lock:
+            return self._next
 
     @property
     def dropped(self) -> int:
         """Items the ring has overwritten (total − retained): nonzero
         means a dump/trace of this ring is missing history — detectable
         instead of silently lossy."""
-        return max(self._next - self.capacity, 0)
+        with self._lock:
+            return max(self._next - self.capacity, 0)
+
+    def stats(self) -> "tuple":
+        """(total, retained, dropped) under ONE lock acquisition — the
+        scrape-visible invariant total == retained + dropped can tear
+        across separate property reads when a push lands between them."""
+        with self._lock:
+            total = self._next
+            retained = min(total, self.capacity)
+            return total, retained, total - retained
 
     def recent(self, limit: Optional[int] = None) -> List:
         """Most-recent-last list of retained items."""

@@ -30,12 +30,13 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Dict, List, Optional
 
 from fluvio_tpu.telemetry.registry import TELEMETRY, PipelineTelemetry
 from fluvio_tpu.telemetry.spans import PHASES, BatchSpan, InstantEvent
+
+from fluvio_tpu.analysis.lockwatch import make_lock
 
 TRACE_ENV = "FLUVIO_TRACE"
 TRACE_MAX_MB_ENV = "FLUVIO_TRACE_MAX_MB"
@@ -225,7 +226,11 @@ class TraceFileSink:
     def __init__(self, path: str, max_bytes: int) -> None:
         self.path = path
         self.max_bytes = max(int(max_bytes), 4096)
-        self._lock = threading.Lock()
+        # the sink lock IS the file serializer: appends, flushes,
+        # and rotation must be mutually exclusive, so holding it
+        # across the write is its documented job (io-designated
+        # name: the FLV212 work-under-lock rule exempts it)
+        self._lock = make_lock("trace_sink.io")
         self._alloc = _LaneAllocator()
         self._seen_tracks: set = set()
         self._base: Optional[float] = None

@@ -62,6 +62,44 @@ def test_pyproject_carries_ruff_config():
     assert "F401" in text and "B006" in text
 
 
+def test_cli_lint_and_concurrency_gate_is_clean():
+    """ISSUE-7 CI satellite: `fluvio-tpu analyze --lint --concurrency`
+    over the repo must exit 0 — the AST invariants AND the whole-package
+    lock-discipline pass (guard map, lock-order graph, FLV2xx hazards)
+    are both pre-deploy gates, enforced through the same CLI the
+    operator runs."""
+    import json
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluvio_tpu.cli",
+         "analyze", "--lint", "--concurrency", "--format", "json"],
+        cwd=_REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    # combined passes must emit ONE parseable document, not two
+    # concatenated dumps
+    doc = json.loads(proc.stdout)
+    assert doc["lint"] == []
+    assert doc["concurrency"]["cycles"] == []
+    assert not [
+        f for f in doc["concurrency"]["findings"] if f["level"] == "error"
+    ]
+
+
+def test_concurrency_pass_clean_in_process():
+    """Same gate without the subprocess: ERROR-severity FLV2xx findings
+    anywhere in fluvio_tpu/ fail tier-1."""
+    from fluvio_tpu.analysis import analyze_concurrency
+
+    report = analyze_concurrency()
+    assert not report.errors(), "\n".join(str(f) for f in report.errors())
+
+
 # ---------------------------------------------------------------------------
 # FLV001/FLV002 — kernel literal pinning
 # ---------------------------------------------------------------------------

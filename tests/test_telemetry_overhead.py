@@ -204,6 +204,28 @@ def test_resilience_seam_overhead_under_gate(monkeypatch):
     assert rps_seams >= rps_noop * (1 - gate) or overhead < 200e-6
 
 
+def test_lockwatch_seam_zero_cost_when_disabled(monkeypatch):
+    """ISSUE-7 CI satellite: with ``FLUVIO_LOCKWATCH`` unset,
+    `make_lock` must hand back a PLAIN ``threading`` primitive — not a
+    wrapper, not a subclass — so the watch seam costs exactly nothing
+    per acquire/release on every engine lock."""
+    import threading
+
+    from fluvio_tpu.analysis import lockwatch
+    from fluvio_tpu.analysis.lockwatch import make_lock
+
+    was_armed = lockwatch.enabled()  # process-start state, pre-delenv
+    monkeypatch.delenv("FLUVIO_LOCKWATCH", raising=False)
+    assert not lockwatch.enabled()
+    assert type(make_lock("gate.probe")) is type(threading.Lock())
+    assert isinstance(make_lock("gate.probe", rlock=True),
+                      type(threading.RLock()))
+    if not was_armed:
+        # the locks the live engine created at import time are plain too
+        # (tier-1 runs unarmed; the armed differential is a subprocess)
+        assert type(TELEMETRY._lock) is type(threading.Lock())
+
+
 def test_telemetry_disabled_skips_span_capture_entirely():
     """The off switch must mean OFF: no spans, no histogram writes."""
     chain = _headline_chain()

@@ -4,6 +4,7 @@ The §4(b)-style gate from SURVEY.md: the same chain on both engines must
 produce byte-identical outputs on the baseline configs.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -401,3 +402,258 @@ class TestDispatchPrefetch:
         wasted = spec[1].nbytes + spec[2].nbytes
         assert tpu.d2h_bytes_total - d2h_before >= wasted
         assert tpu._spec_rows != rows_guess
+
+
+class TestTransferGuardArm:
+    """ISSUE-7 tier-1 arm: with ``FLUVIO_TRANSFER_GUARD=disallow`` the
+    executor runs every dispatch-side region under
+    ``jax.transfer_guard_device_to_host("disallow")`` while the
+    intentional fetch/d2h seam stays on an explicit allow scope. On an
+    accelerator an implicit D2H raises at the offending line; on the
+    host-resident CPU backend the scopes are structurally exercised and
+    these tests pin the seam selection itself."""
+
+    def test_unarmed_seams_are_shared_nullcontext(self, monkeypatch):
+        from fluvio_tpu.smartengine.tpu import executor as ex
+
+        monkeypatch.delenv("FLUVIO_TRANSFER_GUARD", raising=False)
+        assert ex.transfer_guard_dispatch() is ex._NULL_CTX
+        assert ex.transfer_guard_fetch() is ex._NULL_CTX
+        # explicit off-spellings disarm BOTH seams consistently
+        for off in ("0", "off", "none", "allow", " OFF "):
+            monkeypatch.setenv("FLUVIO_TRANSFER_GUARD", off)
+            assert ex.transfer_guard_dispatch() is ex._NULL_CTX
+            assert ex.transfer_guard_fetch() is ex._NULL_CTX
+
+    def test_invalid_mode_rejected_loudly(self, monkeypatch):
+        """A typo'd arm must not silently half-arm the guard (dispatch
+        unguarded while fetch enters the allow scope)."""
+        from fluvio_tpu.smartengine.tpu import executor as ex
+
+        monkeypatch.setenv("FLUVIO_TRANSFER_GUARD", "disalow")
+        with pytest.raises(ValueError, match="FLUVIO_TRANSFER_GUARD"):
+            ex.transfer_guard_dispatch()
+        with pytest.raises(ValueError, match="FLUVIO_TRANSFER_GUARD"):
+            ex.transfer_guard_fetch()
+
+    def test_armed_scopes_select_guard_modes(self, monkeypatch):
+        from jax._src import config as jcfg
+
+        from fluvio_tpu.smartengine.tpu import executor as ex
+
+        monkeypatch.setenv("FLUVIO_TRANSFER_GUARD", "disallow")
+        with ex.transfer_guard_dispatch():
+            assert jcfg.transfer_guard_device_to_host.value == "disallow"
+            # the allowlist: the fetch seam re-opens D2H even inside an
+            # armed dispatch scope (and under a process-global arm)
+            with ex.transfer_guard_fetch():
+                assert jcfg.transfer_guard_device_to_host.value == "allow"
+            assert jcfg.transfer_guard_device_to_host.value == "disallow"
+
+    def _spy_seams(self, monkeypatch):
+        """Record the ACTIVE guard mode at entry to the real dispatch
+        and fetch bodies during live traffic."""
+        from jax._src import config as jcfg
+
+        from fluvio_tpu.smartengine.tpu.executor import TpuChainExecutor
+
+        seen = {"dispatch": set(), "fetch": set()}
+        orig_dispatch = TpuChainExecutor._dispatch_inner
+        orig_fetch = TpuChainExecutor._fetch_inner
+
+        def spy_dispatch(self, *a, **k):
+            seen["dispatch"].add(jcfg.transfer_guard_device_to_host.value)
+            return orig_dispatch(self, *a, **k)
+
+        def spy_fetch(self, *a, **k):
+            seen["fetch"].add(jcfg.transfer_guard_device_to_host.value)
+            return orig_fetch(self, *a, **k)
+
+        monkeypatch.setattr(TpuChainExecutor, "_dispatch_inner", spy_dispatch)
+        monkeypatch.setattr(TpuChainExecutor, "_fetch_inner", spy_fetch)
+        return seen
+
+    def test_fused_path_clean_under_disallow(self, monkeypatch):
+        monkeypatch.setenv("FLUVIO_TRANSFER_GUARD", "disallow")
+        seen = self._spy_seams(monkeypatch)
+        mods = [
+            (lookup("regex-filter"), SmartModuleConfig(params={"regex": "fluvio"})),
+            (lookup("json-map"), SmartModuleConfig(params={"field": "name"})),
+        ]
+
+        def gen():
+            yield recs(
+                b'{"name":"fluvio-a","n":1}',
+                b'{"name":"kafka-b","n":2}',
+                b'{"name":"fluvio-c","n":3}',
+            ), 0, 0
+
+        run_both([(m, c) for m, c in mods], gen)
+        assert seen["dispatch"] == {"disallow"}
+        assert seen["fetch"] == {"allow"}
+
+    def test_striped_path_clean_under_disallow(self, monkeypatch):
+        """The striped lowering's dispatch runs under the same guard
+        scope (stripe gates forced low so a ~300 B corpus stripes)."""
+        monkeypatch.setenv("FLUVIO_TRANSFER_GUARD", "disallow")
+        monkeypatch.setenv("FLUVIO_STRIPE_THRESHOLD", "64")
+        monkeypatch.setenv("FLUVIO_STRIPE_WIDTH", "64")
+        monkeypatch.setenv("FLUVIO_STRIPE_OVERLAP", "16")
+        seen = self._spy_seams(monkeypatch)
+        pad = "p" * 240
+        values = [
+            f'{{"name":"fluvio-{i}","pad":"{pad}","n":{i}}}'.encode()
+            for i in range(40)
+        ]
+
+        def run(backend):
+            chain = build(
+                backend,
+                (lookup("regex-filter"),
+                 SmartModuleConfig(params={"regex": "fluvio"})),
+                (lookup("json-map"),
+                 SmartModuleConfig(params={"field": "name"})),
+            )
+            out = chain.process(
+                SmartModuleInput.from_records(
+                    [Record(value=v) for v in values]
+                )
+            )
+            assert out.error is None
+            return [r.value for r in out.successes]
+
+        tpu_chain = build(
+            "tpu",
+            (lookup("regex-filter"),
+             SmartModuleConfig(params={"regex": "fluvio"})),
+            (lookup("json-map"),
+             SmartModuleConfig(params={"field": "name"})),
+        )
+        assert tpu_chain.tpu_chain._striped_chain() is not None
+        got = run("tpu")
+        assert got == run("python")
+        assert len(got) == 40
+        assert seen["dispatch"] == {"disallow"}
+        assert seen["fetch"] == {"allow"}
+
+    def _spy_sharded_seams(self, monkeypatch):
+        """Record the ACTIVE guard mode inside the sharded delegate's
+        dispatch and finish bodies. The dispatch spy hooks
+        `_dispatch_buffer_inner` — `dispatch_buffer` enters the guard
+        scope itself, so the mode INSIDE the body is the invariant,
+        whatever scope the caller was in."""
+        from jax._src import config as jcfg
+
+        from fluvio_tpu.parallel.sharded import ShardedChainExecutor
+
+        seen = {"dispatch": [], "finish": []}
+        orig_dispatch = ShardedChainExecutor._dispatch_buffer_inner
+        orig_finish = ShardedChainExecutor.finish_buffer
+
+        def spy_dispatch(self, *a, **k):
+            seen["dispatch"].append(jcfg.transfer_guard_device_to_host.value)
+            return orig_dispatch(self, *a, **k)
+
+        def spy_finish(self, *a, **k):
+            seen["finish"].append(jcfg.transfer_guard_device_to_host.value)
+            return orig_finish(self, *a, **k)
+
+        monkeypatch.setattr(
+            ShardedChainExecutor, "_dispatch_buffer_inner", spy_dispatch
+        )
+        monkeypatch.setattr(ShardedChainExecutor, "finish_buffer", spy_finish)
+        return seen
+
+    @pytest.mark.skipif(
+        len(jax.devices()) < 8, reason="needs 8 virtual devices"
+    )
+    def test_sharded_path_clean_under_disallow(self, monkeypatch):
+        """The sharded delegate's dispatch runs under the dispatch
+        guard; only the finish/download half sees the allow seam."""
+        monkeypatch.setenv("FLUVIO_TRANSFER_GUARD", "disallow")
+        seen = self._spy_sharded_seams(monkeypatch)
+        chain = build(
+            "tpu",
+            (lookup("regex-filter"),
+             SmartModuleConfig(params={"regex": "fluvio"})),
+        )
+        ex = chain.tpu_chain
+        ex.enable_sharded(8)
+        values = [
+            (f'fluvio-{i}' if i % 2 else f'kafka-{i}').encode()
+            for i in range(64)
+        ]
+        inp = SmartModuleInput.from_records([Record(value=v) for v in values])
+        out = chain.process(inp)
+        assert out.error is None and len(out.successes) == 32
+        assert set(seen["dispatch"]) == {"disallow"}
+        assert set(seen["finish"]) == {"allow"}
+
+    @pytest.mark.skipif(
+        len(jax.devices()) < 8, reason="needs 8 virtual devices"
+    )
+    def test_sharded_direct_process_buffer_guarded(self, monkeypatch):
+        """Regression: `ShardedChainExecutor.process_buffer` drives
+        `dispatch_buffer` with no executor delegation in between — the
+        guard scope lives inside `dispatch_buffer`, so the direct
+        entry point dispatches guarded too."""
+        from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+
+        monkeypatch.setenv("FLUVIO_TRANSFER_GUARD", "disallow")
+        seen = self._spy_sharded_seams(monkeypatch)
+        chain = build(
+            "tpu",
+            (lookup("regex-filter"),
+             SmartModuleConfig(params={"regex": "fluvio"})),
+        )
+        ex = chain.tpu_chain
+        ex.enable_sharded(8)
+        records = [
+            Record(value=(f'fluvio-{i}' if i % 2 else f'kafka-{i}').encode())
+            for i in range(64)
+        ]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+        buf = RecordBuffer.from_records(
+            records, base_offset=0, base_timestamp=1000
+        )
+        out = ex._sharded.process_buffer(buf)
+        assert len(out.to_records()) == 32
+        assert set(seen["dispatch"]) == {"disallow"}
+
+    @pytest.mark.skipif(
+        len(jax.devices()) < 8, reason="needs 8 virtual devices"
+    )
+    def test_sharded_retry_redispatch_stays_guarded(self, monkeypatch):
+        """Regression: the transient-retry re-dispatch inside
+        `_finish_sharded_inner` fires from within the fetch ALLOW scope
+        — it must re-enter the dispatch guard, not inherit the
+        allowlist (an implicit D2H during a retry is exactly the class
+        the arm exists to reject)."""
+        from fluvio_tpu.resilience import faults
+
+        monkeypatch.setenv("FLUVIO_TRANSFER_GUARD", "disallow")
+        monkeypatch.setenv("FLUVIO_RETRY_BASE_MS", "0")
+        seen = self._spy_sharded_seams(monkeypatch)
+        chain = build(
+            "tpu",
+            (lookup("regex-filter"),
+             SmartModuleConfig(params={"regex": "fluvio"})),
+        )
+        ex = chain.tpu_chain
+        ex.enable_sharded(8)
+        faults.FAULTS.inject("device", first=1)
+        try:
+            inp = SmartModuleInput.from_records(
+                [Record(value=b"fluvio-x")] * 64
+            )
+            out = chain.process(inp)
+        finally:
+            faults.FAULTS.clear()
+        assert out.error is None and len(out.successes) == 64
+        # initial dispatch + the retry re-dispatch: BOTH under disallow
+        assert len(seen["dispatch"]) == 2
+        assert set(seen["dispatch"]) == {"disallow"}
+        # the failed finish attempt and its retry both ran on the seam
+        assert len(seen["finish"]) == 2
+        assert set(seen["finish"]) == {"allow"}
