@@ -197,6 +197,25 @@ def _compile_delta(a: dict, b: dict) -> dict:
     }
 
 
+def _link_deltas(lv0: dict, dc0: dict) -> tuple:
+    """(link-variant deltas, glz-decline deltas) since the captured
+    baselines — the bench's per-config link attribution (which form the
+    flat actually crossed in, and WHY batches shipped raw)."""
+    from fluvio_tpu.telemetry import TELEMETRY
+
+    lv = {
+        k: v - lv0.get(k, 0)
+        for k, v in TELEMETRY.link_variant_counts().items()
+        if v - lv0.get(k, 0) > 0
+    }
+    dc = {
+        k: v - dc0.get(k, 0)
+        for k, v in dict(TELEMETRY.declines).items()
+        if k.startswith("glz-") and v - dc0.get(k, 0) > 0
+    }
+    return lv, dc
+
+
 def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     import jax
 
@@ -207,6 +226,11 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     # the run so each config reports the path it ACTUALLY executed
     # (fused / striped / interpreter) instead of a static label
     pr0 = TELEMETRY.path_records()
+    # link attribution: which staging variant each dispatch used and
+    # which glz decline reasons fired (feeds the per-config `link`
+    # record in BENCH_DETAIL.json)
+    lv0 = TELEMETRY.link_variant_counts()
+    dc0 = dict(TELEMETRY.declines)
     # compile attribution: the instrumented jit entry points record
     # every trace-cache miss, so the first call splits into
     # compile-vs-execute instead of one opaque number
@@ -300,7 +324,19 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
         f"pc {compile_info['persistent_hits']}h/"
         f"{compile_info['persistent_misses']}m)"
     )
-    return out, times, first_call, link_mb, phases, path_info, compile_info
+    variants, glz_declines = _link_deltas(lv0, dc0)
+    link_info = {
+        "up_mb": round(link_mb[0], 2),
+        "down_mb": round(link_mb[1], 2),
+        # majority engaged variant (mixed runs keep the full histogram)
+        "variant": max(variants, key=variants.get) if variants else "off",
+        "variants": variants,
+    }
+    if glz_declines:
+        link_info["declines"] = glz_declines
+    log(f"  link: {link_info}")
+    return (out, times, first_call, link_mb, phases, path_info,
+            compile_info, link_info)
 
 
 def _phase_breakdown(single_s: float, phase_ms: dict, e2e_hist) -> dict:
@@ -473,9 +509,21 @@ def _run_config(
     verify_outputs(cfg["specs"], values, ts, min(n, 512))
     chain = build_chain("tpu", cfg["specs"])
     assert chain.backend_in_use == "tpu", name
-    out, times, first_call, link_mb, phases, path_info, compile_info = (
-        bench_tpu(chain, buf, runs, passes, deadline)
-    )
+    try:
+        (out, times, first_call, link_mb, phases, path_info, compile_info,
+         link_info) = bench_tpu(chain, buf, runs, passes, deadline)
+    except Exception as e:
+        # hardening vs the round-5 parsed:null class: a config that
+        # dies mid-measurement still contributes its link evidence to
+        # the emitted line (run_suite merges `bench_partial` into the
+        # error entry)
+        e.bench_partial = {
+            "link": {
+                "up_mb": round(chain.tpu_chain.h2d_bytes_total / 1e6, 2),
+                "glz": "on" if chain.tpu_chain._link_compress else "off",
+            }
+        }
+        raise
     staging_ab = None
     if ab_eligible:
         # staging A/B: nobody re-runs this after the round, so the
@@ -499,7 +547,7 @@ def _run_config(
                 chain_b = build_chain("tpu", cfg["specs"])
                 (
                     out_b, times_b, first_b, link_b, phases_b, path_b,
-                    compile_b,
+                    compile_b, link_info_b,
                 ) = bench_tpu(chain_b, buf, runs, passes, deadline)
             except Exception as e:  # noqa: BLE001 — optional re-measure
                 # must never destroy the headline measurement in hand
@@ -514,10 +562,10 @@ def _run_config(
                     staging_ab["chosen"] = "raw"
                     (
                         out, times, first_call, link_mb, phases, path_info,
-                        compile_info,
+                        compile_info, link_info,
                     ) = (
                         out_b, times_b, first_b, link_b, phases_b, path_b,
-                        compile_b,
+                        compile_b, link_info_b,
                     )
                     chain = chain_b
                 else:
@@ -574,6 +622,10 @@ def _run_config(
         # cache-direntry diff as the only compile evidence
         "compile": compile_info,
         "link_mb": [round(m, 2) for m in link_mb],
+        # per-config link breakdown (ISSUE-8): which staging variant
+        # the batches actually shipped under (telemetry link_variants
+        # deltas) and which glz decline reasons fired
+        "link": link_info,
         # per-phase breakdown (telemetry subsystem): serial-pass wall +
         # phase attribution + pipelined p50/p99 end-to-end
         "phases": phases,
@@ -830,6 +882,11 @@ def _build_output(results: dict, extra_error: str = "") -> tuple:
         and "skipped" not in v
     }
     degraded = bool(extra_error) or any("error" in v for v in results.values())
+    # the exit code reflects suite-level failure only (watchdog error or
+    # no measurable headline); a single errored config keeps its
+    # `degraded` marker on the entry but must not fail the emit — the
+    # round-5 lesson is that partial evidence beats a dead run
+    exit_degraded = bool(extra_error)
     if good:
         headline_name = (
             "2_filter_map" if "2_filter_map" in good else next(iter(good))
@@ -851,6 +908,7 @@ def _build_output(results: dict, extra_error: str = "") -> tuple:
         return None, 2
     else:
         degraded = True
+        exit_degraded = True
         inner = {
             "metric": "smartmodule_chain_records_per_sec",
             "value": 0,
@@ -891,7 +949,7 @@ def _build_output(results: dict, extra_error: str = "") -> tuple:
         }
         return out, 1
     inner["backend"] = "cpu" if _BACKEND_MODE == "cpu" else "tpu"
-    return inner, (1 if degraded else 0)
+    return inner, (1 if exit_degraded else 0)
 
 
 # the driver captures only the TAIL of stdout (~2000 chars) and parses
@@ -925,6 +983,10 @@ def _compact_configs(configs: dict) -> dict:
             out[name] = e
         elif "error" in c:
             out[name] = {"error": str(c["error"])[:80]}
+            if isinstance(c.get("link"), dict) and "up_mb" in c["link"]:
+                # the errored config's partial byte evidence (from
+                # `bench_partial`) still rides the line
+                out[name]["up_mb"] = c["link"]["up_mb"]
         elif "skipped" in c:
             out[name] = {"skipped": c["skipped"]}
     return out
@@ -982,7 +1044,7 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     if "error" in out:
         compact["error"] = str(out["error"])[:160]
     if "link" in out:
-        compact["link"] = out["link"]
+        compact["link"] = dict(out["link"])  # copy: up_mb is added below
     if isinstance(out.get("xla_cache"), dict) and "entries_written" in out["xla_cache"]:
         compact["xla_cache"] = {
             "entries_written": out["xla_cache"]["entries_written"]
@@ -993,6 +1055,22 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     headline_cfg = (out.get("configs") or {}).get(
         out.get("headline_config", "2_filter_map")
     )
+    # the tiny link:{up_mb, glz} key (ISSUE-8 hardening): the headline's
+    # measured upload MB and engaged variant ride the line even when
+    # other configs errored — byte evidence survives a degraded run
+    if isinstance(headline_cfg, dict) and isinstance(
+        headline_cfg.get("link"), dict
+    ):
+        hl = headline_cfg["link"]
+        compact.setdefault("link", {})
+        if "up_mb" in hl:
+            compact["link"]["up_mb"] = hl["up_mb"]
+        # link.glz speaks on/off (the sentinel A/B pin's vocabulary),
+        # never the variant names — those stay in BENCH_DETAIL.json
+        compact["link"].setdefault(
+            "glz",
+            "on" if str(hl.get("variant", "off")).startswith("glz") else "off",
+        )
     if isinstance(headline_cfg, dict) and isinstance(
         headline_cfg.get("phases"), dict
     ):
@@ -1040,6 +1118,18 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         if len(json.dumps(compact)) <= limit:
             break
         compact.pop(drop, None)
+    if len(json.dumps(compact)) > limit:
+        # last resort (round-5 hardening): some irreducible field still
+        # blew the window — the driver MUST get a parseable line, so
+        # collapse to the bare headline core
+        core = {
+            k: compact[k]
+            for k in ("metric", "value", "unit", "vs_baseline",
+                      "backend", "degraded")
+            if k in compact
+        }
+        core["detail"] = "BENCH_DETAIL.json"
+        compact = core
     return compact
 
 
@@ -1252,7 +1342,13 @@ def run_suite(results: dict, n: int, smoke: bool, budget: float, only) -> None:
             )
         except Exception as e:  # noqa: BLE001 — one config must not lose the run
             traceback.print_exc(file=sys.stderr)
-            results[name] = {"error": f"{type(e).__name__}: {e}"}
+            entry = {"error": f"{type(e).__name__}: {e}"}
+            partial = getattr(e, "bench_partial", None)
+            if isinstance(partial, dict):
+                # a mid-measurement death still reports what crossed
+                # the link (the compact line's per-config link key)
+                entry.update(partial)
+            results[name] = entry
     # re-order in PLACE: the watchdog holds a reference to this dict and
     # must keep seeing every later write (broker_e2e below)
     ordered = {k: results[k] for k in CONFIGS if k in results}

@@ -35,6 +35,7 @@ __all__ = [
     "ERROR", "INFO", "WARN",
     "ChainReport", "Hazard", "PathPrediction", "LintViolation",
     "analyze_entries", "analyze_named", "analyze_chain", "resolve_gates",
+    "predict_link_variant",
     "lint_source", "lint_file", "lint_paths", "lint_repo",
     "preflight_for_specs",
     "ConcurrencyReport", "analyze_concurrency", "static_lock_graph",
@@ -46,6 +47,7 @@ __all__ = [
 _SPEC_EXPORTS = {
     "ERROR", "INFO", "WARN", "ChainReport", "Hazard", "PathPrediction",
     "analyze_entries", "analyze_named", "resolve_gates",
+    "predict_link_variant",
 }
 _CONCURRENCY_EXPORTS = {
     "ConcurrencyReport": "ConcurrencyReport",
@@ -116,7 +118,7 @@ def preflight_for_specs(
 
     report = analyze_named(specs, widths=(width,))
     pred = report.predictions[0]
-    out = {"path": pred.path}
+    out = {"path": pred.path, "link_variant": pred.link_variant}
     if pred.spill_reasons:
         out["spill_reasons"] = list(pred.spill_reasons)
     if pred.declines:

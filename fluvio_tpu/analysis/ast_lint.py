@@ -63,7 +63,8 @@ DISPATCH_HOT_FUNCS = {
 # counter adds whose cost contract telemetry/registry.py documents)
 ALLOWED_TELEMETRY_SEAMS = {
     "enabled", "begin_batch", "end_batch", "add_phase",
-    "add_spill", "add_decline", "add_heal", "add_stripe_fallback",
+    "add_spill", "add_decline", "add_link_variant", "add_heal",
+    "add_stripe_fallback",
     "add_retry", "add_quarantine", "add_compile", "add_jit_hit",
     "add_interp_instance", "add_breaker_short_circuit", "record_breaker",
     "gauge_add", "gauge_set",

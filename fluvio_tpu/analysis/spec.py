@@ -84,6 +84,12 @@ class PathPrediction:
     spill_reasons: Tuple[str, ...] = ()  # expected TELEMETRY.spills keys
     declines: Tuple[str, ...] = ()  # expected TELEMETRY.declines keys
     causes: Tuple[str, ...] = ()  # human explanations for the above
+    # predicted H2D staging form for batches in this bucket: "raw" |
+    # "glz-gather" | "glz-pallas" (the TELEMETRY.link_variants keys).
+    # This is the CONFIGURED variant — corpus-dependent declines
+    # (glz-ratio, glz-below-min) resolve per batch at runtime and the
+    # executor then ships raw with the reason on the decline counter.
+    link_variant: str = "raw"
 
     def to_dict(self) -> dict:
         return {
@@ -93,6 +99,7 @@ class PathPrediction:
             "spill_reasons": list(self.spill_reasons),
             "declines": list(self.declines),
             "causes": list(self.causes),
+            "link_variant": self.link_variant,
         }
 
 
@@ -135,8 +142,9 @@ def resolve_gates() -> dict:
     the runtime resolves them (one vocabulary with the knobs' homes)."""
     import jax
 
-    from fluvio_tpu.smartengine.tpu import kernels
+    from fluvio_tpu.smartengine.tpu import glz, kernels, pallas_kernels
     from fluvio_tpu.smartengine.tpu.buffer import MAX_RECORD_WIDTH, MAX_WIDTH
+    from fluvio_tpu.smartengine.tpu.executor import effective_link_compress
     from fluvio_tpu.smartengine.tpu.lower import _depth_over_work
 
     return {
@@ -148,6 +156,13 @@ def resolve_gates() -> dict:
             os.environ.get("FLUVIO_STRIPE_THRESHOLD", MAX_WIDTH)
         ),
         "max_record_width": MAX_RECORD_WIDTH,
+        # link-staging gates: the H2D variant ladder the executor
+        # resolves at build time (FLUVIO_LINK_COMPRESS / the native
+        # compressor / FLUVIO_GLZ_PALLAS), mirrored here so the
+        # preflight can predict which form each batch's flat crosses in
+        "link_compress": effective_link_compress(),
+        "glz_available": glz.available(),
+        "glz_pallas": pallas_kernels.glz_pallas_active(),
     }
 
 
@@ -617,6 +632,21 @@ def predict_path(
     )
 
 
+def predict_link_variant(gates: dict, path: str, sharded: bool) -> str:
+    """Which form a batch's flat crosses the H2D link in on this path —
+    the mirror of the executor's build-time variant resolution plus the
+    sharded staging's wide-path exclusion (sharded striped batches ship
+    raw with the ``glz-wide-unsupported`` decline). Interpreter batches
+    never stage, so they report "raw"."""
+    if path == "interpreter":
+        return "raw"
+    if not gates.get("link_compress") or not gates.get("glz_available"):
+        return "raw"
+    if sharded and path == "striped":
+        return "raw"
+    return "glz-pallas" if gates.get("glz_pallas") else "glz-gather"
+
+
 def analyze_entries(
     entries,
     widths: Optional[Sequence[int]] = None,
@@ -674,6 +704,9 @@ def analyze_entries(
             striped_ok, striped_declines, striped_causes,
             has_fanout, sharded=sharded,
         )
+        pred.link_variant = predict_link_variant(gates, pred.path, sharded)
+        if sharded and pred.path == "striped" and gates.get("link_compress"):
+            pred.declines = pred.declines + ("glz-wide-unsupported",)
         report.predictions.append(pred)
         if pred.path == "interpreter" and narrow_ok:
             report.hazards.append(

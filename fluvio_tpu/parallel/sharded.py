@@ -26,9 +26,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fluvio_tpu.parallel.mesh import RECORD_AXIS, make_record_mesh
 from fluvio_tpu.resilience import faults
+from fluvio_tpu.resilience.policy import TRANSIENT, classify
 from fluvio_tpu.telemetry import TELEMETRY
 from fluvio_tpu.smartengine.tpu import executor as kernels_executor
-from fluvio_tpu.smartengine.tpu import kernels, stripes
+from fluvio_tpu.smartengine.tpu import glz, kernels, stripes
 from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer, apply_postops_host
 
 try:  # jax>=0.4.35 exposes shard_map at the top level
@@ -103,7 +104,8 @@ class ShardedChainExecutor:
         unchanged. Span chains (striped JsonGet map) additionally ship
         per-shard compacted view descriptors; ``kmax`` bounds their
         cross-stripe carry's outer scan."""
-        (_width, kwidth, has_keys, has_offsets, ts_mode, _cap, srows, kmax) = cfg
+        (_width, kwidth, has_keys, has_offsets, ts_mode,
+         _glz_bytes, _glz_variant, _glz_chunk, _cap, srows, kmax) = cfg
         ex = self.executor
         s, v = ex._stripe_s, ex._stripe_v
         lengths = uploads["lengths"].astype(jnp.int32)
@@ -173,16 +175,45 @@ class ShardedChainExecutor:
             return header(jnp.max(compacted[1])), packed, carries
         return header(jnp.max(jnp.where(valid, lengths, 0))), packed, carries
 
+    @staticmethod
+    def _shard_flat_words(uploads: Dict, glz_bytes: int, glz_variant: str,
+                          glz_chunk: int):
+        """This shard's flat i32 words: the raw upload, or the shard's
+        own glz stream inflated on device (traced inside the shard
+        body; each shard's token rows arrive as its block of the
+        row-sharded token matrices)."""
+        if not glz_bytes:
+            return uploads["flat_words"]
+        seqs = (
+            uploads["glz_ll"][0],
+            uploads["glz_ml"][0],
+            uploads["glz_srcs"][0],
+        )
+        raw = glz.decode_link_flat(
+            seqs, uploads["glz_lits"][0], uploads["glz_depth"][0],
+            glz_bytes, glz_variant, glz_chunk,
+        )
+        return lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.int32)
+
     def _local_step_ragged(
         self, uploads: Dict, count, base_ts, carries, *, cfg: tuple
     ):
         """Rebuild this shard's padded arrays from its ragged upload, then
         run the stage pipeline (same device-side re-pad as the single
         device `_chain_fn_ragged`: the host link carries sum(lengths)
-        bytes per shard, not rows x width)."""
-        (width, kwidth, has_keys, has_offsets, ts_mode, fanout_cap) = cfg
+        bytes per shard, not rows x width). Compressed staging
+        (``glz_bytes > 0``): each shard's flat segment crossed the link
+        as its OWN glz stream (per-shard token rows) and inflates
+        shard-locally through the same decode ladder the single-device
+        paths use — pallas kernels run per shard under shard_map, which
+        GSPMD tracing cannot."""
+        (width, kwidth, has_keys, has_offsets, ts_mode,
+         glz_bytes, glz_variant, glz_chunk, fanout_cap) = cfg
+        flat_words = self._shard_flat_words(
+            uploads, glz_bytes, glz_variant, glz_chunk
+        )
         values, lengths = kernels_executor.ragged_repad_words(
-            uploads["flat_words"], uploads["lengths"], width
+            flat_words, uploads["lengths"], width
         )
         n_local = lengths.shape[0]
         g0 = lax.axis_index(RECORD_AXIS) * n_local
@@ -285,7 +316,7 @@ class ShardedChainExecutor:
         )
 
     def _jitted(self, uploads: Dict, cfg: tuple):
-        striped = len(cfg) == 8  # (..., fanout_cap, srows, kmax)
+        striped = len(cfg) == 11  # (..., fanout_cap, srows, kmax)
         key = (
             tuple(sorted((k, v.shape, str(v.dtype)) for k, v in uploads.items())),
             cfg,
@@ -399,7 +430,7 @@ class ShardedChainExecutor:
         need = max(step, ((rows + step - 1) // step) * step)
         return need, need // self.n
 
-    def _stage_ragged(self, buf: RecordBuffer) -> tuple:
+    def _stage_ragged(self, buf: RecordBuffer, compress_ok: bool = False) -> tuple:
         """Ragged H2D staging (the single-device link diet, per shard).
 
         The aligned flat is cut at shard row boundaries; every shard's
@@ -408,6 +439,14 @@ class ShardedChainExecutor:
         never cross the link: arange offsets and zero timestamps are
         synthesized on device, timestamps narrow to i32 when they fit,
         lengths ride the narrowest of u8/u16 the record width allows.
+
+        ``compress_ok``: attempt glz compressed staging — each shard's
+        padded segment compresses as its OWN chunked stream (uniform
+        decoded size = the bucketed segment length) and the token
+        arrays ship as row-sharded matrices padded to the worst shard's
+        bucketed counts. ALL shards must compress (shard_map needs
+        uniform shapes); any shard's decline ships the whole batch raw
+        with its reason on the telemetry decline counter.
         Returns (uploads dict, static cfg, H2D byte count).
         """
         ex = self.executor
@@ -431,6 +470,24 @@ class ShardedChainExecutor:
         segs = np.zeros((self.n, seg_len), dtype=np.uint8)
         for s in range(self.n):
             segs[s, : seg_sizes[s]] = flat[cuts[s] : cuts[s + 1]]
+        glz_up, glz_bytes, glz_chunk = None, 0, 0
+        if compress_ok:
+            # per-buffer cache (the single-device `_glz_cache` precedent):
+            # heal/fanout-cap/transient-retry re-dispatches of the same
+            # buffer re-use the compressed form instead of paying the
+            # n-shard compressor again; the cached decline reason counts
+            # on EVERY dispatch that ships raw because of it
+            key = (self.n, seg_len)
+            cached = getattr(buf, "_glz_shard_cache", None)
+            if cached is not None and cached[0] == key:
+                glz_up, reason = cached[1], cached[2]
+            else:
+                glz_up, reason = self._compress_segments(segs, seg_len)
+                buf._glz_shard_cache = (key, glz_up, reason)
+            if reason is not None:
+                TELEMETRY.add_decline(reason)
+            if glz_up is not None:
+                glz_bytes, glz_chunk = seg_len, ex._glz_chunk
         flat_words = segs.reshape(-1).view(np.int32)
 
         def pad_rows(a, fill=0):
@@ -445,7 +502,10 @@ class ShardedChainExecutor:
         lengths_np, has_keys, has_offsets, ts_mode, ts_np = (
             kernels_executor.stage_link_columns(buf)
         )
-        uploads = {"flat_words": flat_words, "lengths": pad_rows(lengths_np)}
+        if glz_up is not None:
+            uploads = dict(glz_up, lengths=pad_rows(lengths_np))
+        else:
+            uploads = {"flat_words": flat_words, "lengths": pad_rows(lengths_np)}
         if has_keys:
             uploads["keys"] = pad_rows(buf.keys)
             uploads["key_lengths"] = pad_rows(buf.key_lengths, fill=-1)
@@ -453,8 +513,53 @@ class ShardedChainExecutor:
             uploads["offset_deltas"] = pad_rows(buf.offset_deltas)
         if ts_np is not None:
             uploads["timestamp_deltas"] = pad_rows(ts_np)
-        cfg = (buf.width, buf.keys.shape[1], has_keys, has_offsets, ts_mode)
+        cfg = (
+            buf.width, buf.keys.shape[1], has_keys, has_offsets, ts_mode,
+            glz_bytes, ex._glz_variant if glz_bytes else "gather", glz_chunk,
+        )
         return uploads, cfg, sum(v.nbytes for v in uploads.values())
+
+    def _compress_segments(self, segs: np.ndarray, seg_len: int):
+        """(per-shard glz token matrices, None) for the compressed
+        staging, or (None, decline reason) when any shard declines or
+        the padded token bytes fail the ratio gate the single-device
+        staging applies. Every shard's stream decodes to exactly
+        ``seg_len`` bytes (the zero tail compresses to almost nothing),
+        so the decode output shapes stay uniform under shard_map."""
+        comps = []
+        for s in range(self.n):
+            comp, reason = glz.compress_link(segs[s])
+            if comp is None:
+                return None, reason
+            comps.append(comp)
+        ex = self.executor
+        # worst-shard buckets so every shard's token rows share one
+        # shape; the padding itself is the single-device staging's
+        # `pad_glz_tokens` (one implementation of the bucket rules)
+        seq_pad = ex._bucket_bytes(
+            max(max(len(c.lit_lens) for c in comps), 8), floor=256
+        )
+        lit_pad = ex._bucket_bytes(
+            max(max(c.lits.size for c in comps), 8), floor=256
+        )
+        token_bytes = self.n * (seq_pad * 6 + lit_pad)
+        if token_bytes > segs.nbytes * glz.MAX_RATIO:
+            # worst-shard padding can sink a ratio every shard passed
+            # individually — re-check at the shipped (padded) sizes
+            return None, glz.DECLINE_RATIO
+        padded = [
+            kernels_executor.TpuChainExecutor.pad_glz_tokens(
+                c, seq_pad=seq_pad, lit_pad=lit_pad
+            )
+            for c in comps
+        ]
+        return {
+            "glz_ll": np.stack([p[0] for p in padded]),
+            "glz_ml": np.stack([p[1] for p in padded]),
+            "glz_srcs": np.stack([p[2] for p in padded]),
+            "glz_lits": np.stack([p[3] for p in padded]),
+            "glz_depth": np.array([c.depth for c in comps], np.int32),
+        }, None
 
     def _shard_fanout_cap(self, buf: RecordBuffer, cap_total=None) -> int:
         """Per-shard explode capacity: the learned global capacity split
@@ -506,7 +611,16 @@ class ShardedChainExecutor:
         span = reuse_span if reuse_span is not None else TELEMETRY.begin_batch()
         t_ph = time.perf_counter() if span is not None else 0.0
         faults.maybe_fire("stage")
-        uploads, cfg, nbytes = self._stage_ragged(buf)
+        striped = ex._needs_stripes(buf)
+        # compressed staging covers the sharded NARROW layout; sharded
+        # striped batches ship raw — their per-shard stripe shapes
+        # already compile against the worst shard, and stacking the
+        # token-bucket axis on top would square that compile matrix
+        # (the one wide-path exclusion left; counted per batch below)
+        uploads, cfg, nbytes = self._stage_ragged(
+            buf, compress_ok=ex._link_compress and not striped
+        )
+        glz_bytes, glz_variant = cfg[5], cfg[6]
         if span is not None:
             now = time.perf_counter()
             span.add("stage", now - t_ph)
@@ -514,7 +628,7 @@ class ShardedChainExecutor:
         if ex._fanout and cap_shard is None:
             cap_shard = self._shard_fanout_cap(buf)
         cfg = cfg + (cap_shard,)
-        if ex._needs_stripes(buf):
+        if striped:
             if ex._striped_chain() is None or ex._fanout:
                 # wide batch outside the sharded stripeable subset
                 # (fan-out explodes stay single-device or interpret)
@@ -524,6 +638,8 @@ class ShardedChainExecutor:
                     "and the chain cannot stripe under shard_map",
                     reason="record-too-wide-unstripeable",
                 )
+            if ex._link_compress:
+                TELEMETRY.add_decline(glz.DECLINE_WIDE)
             cfg = cfg + (self._stripe_rows_shard(buf), ex._stripe_kmax(buf))
             if span is not None:
                 span.path = "striped"
@@ -544,12 +660,34 @@ class ShardedChainExecutor:
         fn = self._jitted(sharded, cfg)
         faults.maybe_fire("dispatch")
         prev_carries = self._pending_carries
-        header, packed, new_carries = fn(
-            sharded,
-            jnp.int32(buf.count),
-            jnp.int64(buf.base_timestamp),
-            self._carries(),
-        )
+        try:
+            header, packed, new_carries = fn(
+                sharded,
+                jnp.int32(buf.count),
+                jnp.int64(buf.base_timestamp),
+                self._carries(),
+            )
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            if not glz_bytes:
+                raise
+            if classify(e) == TRANSIENT:
+                # a recoverable device hiccup, not a decode failure:
+                # re-raise so the executor's bounded dispatch retry
+                # re-ships the SAME compressed form (from the buffer's
+                # cache) — a transient fault must not cost this
+                # executor a ladder rung
+                raise
+            # the single-device decode ladder, sharded: a pallas chunk
+            # decode that cannot lower under shard_map demotes this
+            # executor to the gather rounds; a gather failure latches
+            # compression off. Either way the batch re-stages and
+            # re-dispatches down-ladder (the compressed token arrays
+            # that already crossed are on the counter below).
+            ex.h2d_bytes_total += nbytes
+            ex._glz_demote(e, glz_variant, buf, where="sharded dispatch")
+            return self._dispatch_buffer_inner(buf, cap_shard, span)
         if span is not None:
             span.add("dispatch", time.perf_counter() - t_ph)
             span.mark_dispatched()
@@ -560,7 +698,13 @@ class ShardedChainExecutor:
             # carries chain through device futures at dispatch time so
             # streams pipeline; the host mirror commits at finish
             self._pending_carries = new_carries
-        return (prev_carries, new_carries, header, packed, cap_shard, span)
+        TELEMETRY.add_link_variant(
+            f"glz-{glz_variant}" if glz_bytes else "raw"
+        )
+        return (
+            prev_carries, new_carries, header, packed, cap_shard, span,
+            glz_variant if glz_bytes else None,
+        )
 
     def discard_dispatch(self, handle) -> None:
         """Drop a speculative dispatch, restoring pre-dispatch carries."""
@@ -598,7 +742,7 @@ class ShardedChainExecutor:
     def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
         from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
-        _prev, new_carries, header, packed, cap_shard, span = handle
+        _prev, new_carries, header, packed, cap_shard, span, _glz = handle
         t_f0 = time.perf_counter() if span is not None else 0.0
         d2h0 = span.phase("d2h") if span is not None else 0.0
         ex = self.executor
@@ -635,7 +779,8 @@ class ShardedChainExecutor:
                 handle = self.dispatch_buffer(
                     buf, cap_shard=retry_cap, reuse_span=span
                 )
-                _prev, new_carries, header, packed, cap_shard, _ = handle
+                (_prev, new_carries, header, packed, cap_shard, _,
+                 _glz) = handle
                 hdrs = np.asarray(jax.device_get(header))
                 if span is not None:
                     span.mark_device_ready()

@@ -559,7 +559,7 @@ class TpuChainExecutor:
                 self._chain_fn_ragged,
                 static_argnames=(
                     "width", "kwidth", "has_keys", "has_offsets", "ts_mode",
-                    "fanout_cap", "glz_bytes",
+                    "fanout_cap", "glz_bytes", "glz_variant", "glz_chunk",
                 ),
             ),
             "ragged",
@@ -581,7 +581,8 @@ class TpuChainExecutor:
                 self._chain_fn_striped,
                 static_argnames=(
                     "srows", "kmax", "kwidth", "has_keys", "has_offsets",
-                    "ts_mode", "fanout_cap", "glz_bytes",
+                    "ts_mode", "fanout_cap", "glz_bytes", "glz_variant",
+                    "glz_chunk",
                 ),
             ),
             "striped",
@@ -636,6 +637,21 @@ class TpuChainExecutor:
         # same jit as the chain; tests opt in explicitly with
         # FLUVIO_LINK_COMPRESS=on
         self._link_compress = effective_link_compress()
+        # decode-variant ladder: "pallas" (per-chunk VMEM resolve) ->
+        # "gather" (whole-buffer rounds) -> raw staging; the self-heal
+        # demotes one rung per failure. Resolved ONCE here — the
+        # per-dispatch staging reads executor state only, so the
+        # chooser costs nothing when compression is off (overhead-gate
+        # pinned) and nothing per batch when it is on.
+        self._glz_variant = "gather"
+        self._glz_chunk = 0
+        self._glz_last_variant: Optional[str] = None
+        if self._link_compress:
+            from fluvio_tpu.smartengine.tpu import pallas_kernels
+
+            if pallas_kernels.glz_pallas_active():
+                self._glz_variant = "pallas"
+            self._glz_chunk = glz.chunk_bytes()
         self._viewable = not agg_configs and all(
             isinstance(s, (_FilterStage, _ArrayMapStage))
             or (
@@ -908,6 +924,8 @@ class TpuChainExecutor:
         ts_mode: str,
         fanout_cap: Optional[int] = None,
         glz_bytes: int = 0,
+        glz_variant: str = "gather",
+        glz_chunk: int = 0,
     ):
         """Reconstruct the padded matrix on device from the flat upload.
 
@@ -923,14 +941,16 @@ class TpuChainExecutor:
 
         glz staging (``glz_bytes > 0``): the flat crossed the link
         COMPRESSED — ``glz_seqs`` is (lit_lens u8, match_lens u8,
-        srcs i32) and ``glz_lits`` the literal stream; the gather-round
-        decode inflates to ``glz_bytes`` raw bytes on device, then
-        bitcasts to the same i32 words the raw path ships.
+        srcs i32) and ``glz_lits`` the literal stream; the decode
+        ladder (``glz_variant``: Pallas per-chunk VMEM resolve, or the
+        gather-round formulation) inflates to ``glz_bytes`` raw bytes
+        on device, then bitcasts to the same i32 words the raw path
+        ships.
         """
         if glz_bytes:
-            raw = glz.decompress_device(
-                glz_seqs[0], glz_seqs[1], glz_seqs[2], glz_lits,
-                glz_depth, glz_bytes,
+            raw = glz.decode_link_flat(
+                glz_seqs, glz_lits, glz_depth, glz_bytes,
+                glz_variant, glz_chunk,
             )
             flat = lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.int32)
         values, lengths = ragged_repad_words(flat, lengths, width)
@@ -1042,6 +1062,8 @@ class TpuChainExecutor:
         ts_mode: str,
         fanout_cap: Optional[int] = None,
         glz_bytes: int = 0,
+        glz_variant: str = "gather",
+        glz_chunk: int = 0,
     ):
         """Striped chain body: same ragged flat upload as the narrow
         path (glz decode included), re-padded into ``srows`` stripe rows
@@ -1055,9 +1077,9 @@ class TpuChainExecutor:
         (0 when the chain has no span stage).
         """
         if glz_bytes:
-            raw = glz.decompress_device(
-                glz_seqs[0], glz_seqs[1], glz_seqs[2], glz_lits,
-                glz_depth, glz_bytes,
+            raw = glz.decode_link_flat(
+                glz_seqs, glz_lits, glz_depth, glz_bytes,
+                glz_variant, glz_chunk,
             )
             flat = lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.int32)
         lengths = lengths.astype(jnp.int32)
@@ -1160,13 +1182,23 @@ class TpuChainExecutor:
         static shape-bucket kwargs (never touches array values)."""
         return (
             f"{self._chain_sig} w={k.get('width')} "
-            f"glz={k.get('glz_bytes', 0)} cap={k.get('fanout_cap')}"
+            f"glz={k.get('glz_bytes', 0)}"
+            f"{self._glz_sig(k)} cap={k.get('fanout_cap')}"
         )
+
+    @staticmethod
+    def _glz_sig(k) -> str:
+        """Variant tag for compile-event signatures: the pallas and
+        gather decodes are distinct XLA programs per shape bucket."""
+        if not k.get("glz_bytes"):
+            return ""
+        return f"/{k.get('glz_variant', 'gather')}"
 
     def _describe_striped(self, *a, **k) -> str:
         return (
             f"{self._chain_sig} srows={k.get('srows')} "
             f"kmax={k.get('kmax', 0)} glz={k.get('glz_bytes', 0)}"
+            f"{self._glz_sig(k)}"
         )
 
     # -- device-memory / in-flight gauges ------------------------------------
@@ -1245,9 +1277,9 @@ class TpuChainExecutor:
             span.add("stage", now - t_ph)
             t_ph = now
         faults.maybe_fire("h2d")
-        flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes, flat_h2d = (
-            self._stage_flat(buf, flat, bucket)
-        )
+        (flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes, glz_chunk,
+         flat_h2d) = self._stage_flat(buf, flat, bucket)
+        glz_variant = self._glz_variant
         if span is not None:
             now = time.perf_counter()
             # the compressed form's staging IS the compressor (plus token
@@ -1285,6 +1317,8 @@ class TpuChainExecutor:
                 ts_mode=ts_mode,
                 fanout_cap=fanout_cap,
                 glz_bytes=glz_bytes,
+                glz_variant=glz_variant if glz_bytes else "gather",
+                glz_chunk=glz_chunk if glz_bytes else 0,
             )
             if striped:
                 return self._jit_striped(
@@ -1296,37 +1330,43 @@ class TpuChainExecutor:
             return self._jit_ragged(*args, width=buf.width, **kwargs)
 
         t_ph = time.perf_counter() if span is not None else 0.0
-        try:
-            header, packed, new_carries = _call()
-        except (KeyboardInterrupt, SystemExit):
-            # operator interrupts must unwind, never convert into a
-            # heal/spill (they are BaseException, but be explicit: no
-            # broadened rewrite of this handler may ever swallow them)
-            raise
-        except Exception as e:
-            if not glz_bytes:
+        while True:
+            try:
+                header, packed, new_carries = _call()
+                break
+            except (KeyboardInterrupt, SystemExit):
+                # operator interrupts must unwind, never convert into a
+                # heal/spill (they are BaseException, but be explicit: no
+                # broadened rewrite of this handler may ever swallow them)
                 raise
-            # self-healing: a backend that cannot compile/run the
-            # gather-round decode must not take the engine down —
-            # disable link compression for this executor and re-ship
-            # the batch raw (trace/compile errors surface at call time;
-            # async runtime failures heal in finish_buffer)
-            logging.getLogger(__name__).warning(
-                "glz device decode failed; link compression disabled: %s", e
-            )
-            TELEMETRY.add_heal()
-            self._link_compress = False
-            buf._glz_cache = None
-            # the compressed token arrays already crossed the link
-            # before the failure — keep them on the counter
-            self.h2d_bytes_total += flat_h2d
-            flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes, flat_h2d = (
-                self._stage_flat(buf, flat, bucket)
-            )
-            header, packed, new_carries = _call()
+            except Exception as e:
+                if not glz_bytes:
+                    raise
+                # self-healing decode ladder (trace/compile errors
+                # surface at call time; async runtime failures heal in
+                # finish_buffer). A backend that cannot lower the Pallas
+                # chunk kernel demotes to the gather-round decode — the
+                # SAME staged token arrays re-dispatch, nothing new
+                # crosses the link; a backend that cannot run the
+                # gather rounds either ships the batch raw and latches
+                # compression off for this executor.
+                if self._glz_demote(e, glz_variant, buf) == "gather":
+                    glz_variant = "gather"
+                    continue
+                # the compressed token arrays already crossed the link
+                # before the failure — keep them on the counter
+                self.h2d_bytes_total += flat_h2d
+                (flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes,
+                 glz_chunk, flat_h2d) = self._stage_flat(buf, flat, bucket)
         if span is not None:
             span.add("dispatch", time.perf_counter() - t_ph)
         self._glz_last = bool(glz_bytes)
+        self._glz_last_variant = glz_variant if glz_bytes else None
+        # link-variant attribution (always-on counter, like declines):
+        # which form THIS batch's flat actually crossed the link in
+        TELEMETRY.add_link_variant(
+            f"glz-{glz_variant}" if glz_bytes else "raw"
+        )
         # keep aggregate state device-resident; host mirrors sync on demand
         self._device_carries = new_carries
         self._dispatch_seq += 1
@@ -1369,38 +1409,85 @@ class TpuChainExecutor:
         cached = getattr(buf, "_glz_cache", None)
         if cached is not None and cached[0] == bucket:
             return
-        buf._glz_cache = (bucket, glz.compress(self._padded(flat, bucket)))
+        comp, reason = glz.compress_link(self._padded(flat, bucket))
+        buf._glz_cache = (bucket, comp, reason)
+
+    def _glz_demote(self, e, variant: str, buf=None, where: str = "dispatch"):
+        """One rung down the decode ladder after a failure of a
+        compressed batch — the sync/async halves of the glz self-heal
+        (single-device dispatch + fetch, sharded dispatch + finish) all
+        route here so the ladder cannot diverge per seam: pallas ->
+        gather (the SAME staged tokens re-ship; compression stays on),
+        gather -> raw (compression latched off for this executor, the
+        buffer's cached compressed forms dropped so restaging ships
+        raw). Counts the heal; returns the new variant."""
+        TELEMETRY.add_heal()
+        log = logging.getLogger(__name__)
+        if variant == "pallas":
+            log.warning(
+                "glz pallas decode failed at %s; demoting this executor "
+                "to the gather-round decode: %s", where, e,
+            )
+            self._glz_variant = "gather"
+            return "gather"
+        log.warning(
+            "glz decode failed at %s; link compression disabled: %s",
+            where, e,
+        )
+        self._link_compress = False
+        if buf is not None:
+            buf._glz_cache = None
+            buf._glz_shard_cache = None
+        return "raw"
+
+    @staticmethod
+    def pad_glz_tokens(comp, seq_pad=None, lit_pad=None):
+        """Pad a compressed stream's token arrays to pow2/8 buckets
+        (bounded compile variants, like every other link array). One
+        implementation for the single-device staging and the per-shard
+        sharded staging — the sharded caller passes its worst-shard
+        buckets so every shard's rows share one shape. Returns
+        (ll, ml, srcs, lits) numpy arrays."""
+        n_seq = len(comp.lit_lens)
+        if seq_pad is None:
+            seq_pad = TpuChainExecutor._bucket_bytes(max(n_seq, 8), floor=256)
+        if lit_pad is None:
+            lit_pad = TpuChainExecutor._bucket_bytes(
+                max(comp.lits.size, 8), floor=256
+            )
+        ll = np.zeros(seq_pad, np.uint8)
+        ll[:n_seq] = comp.lit_lens
+        ml = np.zeros(seq_pad, np.uint8)
+        ml[:n_seq] = comp.match_lens
+        srcs = np.zeros(seq_pad, np.int32)
+        srcs[:n_seq] = comp.srcs
+        lits = np.zeros(lit_pad, np.uint8)
+        lits[: comp.lits.size] = comp.lits
+        return ll, ml, srcs, lits
 
     def _stage_flat(self, buf: RecordBuffer, flat: np.ndarray, bucket: int):
         """Pick the flat's link form: glz-compressed or raw i32 words.
 
         Returns (flat_up, glz_seqs, glz_lits, glz_depth, glz_bytes,
-        h2d_bytes) — exactly one of flat_up / the glz arrays is
-        non-None. The compressed form is cached on the buffer (same
+        glz_chunk, h2d_bytes) — exactly one of flat_up / the glz arrays
+        is non-None. The compressed form is cached on the buffer (same
         precedent as RecordBuffer.ragged_values caching the flat):
         stream loops that re-dispatch one buffer pay the compressor
-        once. Token arrays bucket at pow2/8 like every other link
-        array so compile variants stay bounded.
+        once; the cached decline REASON feeds the per-batch telemetry
+        decline counter on every dispatch that ships raw because of it.
+        Token arrays bucket at pow2/8 like every other link array so
+        compile variants stay bounded.
         """
         if self._link_compress:
             cached = getattr(buf, "_glz_cache", None)
             if cached is not None and cached[0] == bucket:
                 comp = cached[1]
+                reason = cached[2] if len(cached) > 2 else None
             else:
-                comp = glz.compress(self._padded(flat, bucket))
-                buf._glz_cache = (bucket, comp)
+                comp, reason = glz.compress_link(self._padded(flat, bucket))
+                buf._glz_cache = (bucket, comp, reason)
             if comp is not None:
-                n_seq = len(comp.lit_lens)
-                seq_pad = self._bucket_bytes(max(n_seq, 8), floor=256)
-                lit_pad = self._bucket_bytes(max(comp.lits.size, 8), floor=256)
-                ll = np.zeros(seq_pad, np.uint8)
-                ll[:n_seq] = comp.lit_lens
-                ml = np.zeros(seq_pad, np.uint8)
-                ml[:n_seq] = comp.match_lens
-                srcs = np.zeros(seq_pad, np.int32)
-                srcs[:n_seq] = comp.srcs
-                lits = np.zeros(lit_pad, np.uint8)
-                lits[: comp.lits.size] = comp.lits
+                ll, ml, srcs, lits = self.pad_glz_tokens(comp)
                 h2d = ll.nbytes + ml.nbytes + srcs.nbytes + lits.nbytes
                 return (
                     None,
@@ -1408,12 +1495,17 @@ class TpuChainExecutor:
                     jnp.asarray(lits),
                     jnp.int32(comp.depth),
                     bucket,
+                    comp.chunk_bytes,
                     h2d,
                 )
+            # per-batch decline attribution: WHY this batch ships raw
+            # (glz-ratio / glz-below-min / glz-unavailable)
+            if reason is not None:
+                TELEMETRY.add_decline(reason)
         # ship the aligned flat as i32 words (see _chain_fn_ragged);
         # derivable columns stay off the link (synthesized on device)
         words = self._padded(flat, bucket).view(np.int32)
-        return jnp.asarray(words), None, None, None, 0, words.nbytes
+        return jnp.asarray(words), None, None, None, 0, 0, words.nbytes
 
     def _ensure_host_state(self) -> None:
         if self._device_carries is None:
@@ -2119,6 +2211,28 @@ class TpuChainExecutor:
                 if self.agg_configs and lineage_ok:
                     self._sharded._pending_carries = handle[0]
                 if not (lineage_ok and self._retry_policy.should_retry(e, attempt)):
+                    glz_form = handle[6] if len(handle) > 6 else None
+                    if glz_form is not None and lineage_ok:
+                        # async half of the sharded glz ladder: a
+                        # DETERMINISTIC failure of a compressed batch
+                        # surfacing at the stacked-header sync makes the
+                        # decode the prime suspect — demote one rung
+                        # (pallas -> gather, gather -> raw w/ compression
+                        # latched off) and re-dispatch the same batch
+                        # down-ladder. Transient faults never reach this
+                        # branch: the bounded retry below re-ships the
+                        # SAME compressed form, so a recoverable fetch
+                        # hiccup cannot cost the executor its link
+                        # compression. The ladder bounds the loop: the
+                        # raw re-dispatch has glz_form None and a repeat
+                        # failure re-raises.
+                        self._glz_demote(
+                            e, glz_form, buf, where="sharded fetch"
+                        )
+                        handle = self._sharded_dispatch(
+                            buf, reuse_span=handle[5]
+                        )
+                        continue
                     raise
                 point = getattr(e, "point", None) or "fetch"
                 TELEMETRY.add_retry(point)
@@ -2171,6 +2285,7 @@ class TpuChainExecutor:
         # glz-compressed flat (async runtime failures surface at fetch),
         # and the heal epoch its carry lineage belongs to
         spec["glz_used"] = getattr(self, "_glz_last", False)
+        spec["glz_variant"] = getattr(self, "_glz_last_variant", None)
         spec["epoch"] = self._heal_epoch
         handle = (prev_carries, header, packed, spec)
         self._gauge_track(handle, self.h2d_bytes_total - h0)
@@ -2353,14 +2468,15 @@ class TpuChainExecutor:
                 # under the pipelined loop, batch k's heal latches
                 # compression off while batch k+1 (already dispatched
                 # compressed) is still in flight, and k+1 must heal too
-                # instead of re-raising.
-                logging.getLogger(__name__).warning(
-                    "glz decode failed at fetch; link compression disabled: %s",
-                    e,
+                # instead of re-raising. The decode LADDER applies here
+                # too: a batch that shipped under the pallas variant
+                # demotes this executor to the gather rounds (the
+                # cached compressed form re-ships — compression stays
+                # on); a gather-variant batch latches compression off.
+                self._glz_demote(
+                    e, spec.get("glz_variant") or "gather", buf,
+                    where="fetch",
                 )
-                TELEMETRY.add_heal()
-                self._link_compress = False
-                buf._glz_cache = None
                 try:
                     out = self._redispatch_refetch(buf, handle, span)
                 except (TpuSpill, KeyboardInterrupt, SystemExit):

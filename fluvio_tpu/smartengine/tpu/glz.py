@@ -33,7 +33,7 @@ import logging
 import os
 import subprocess
 from pathlib import Path
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -56,6 +56,30 @@ MIN_INPUT = 4096    # below this the link time is noise — ship raw
 # under this fraction of raw before the executor switches the jit to
 # the compressed staging variant
 MAX_RATIO = 0.75
+# link streams compress in independent CHUNKS of this many output
+# bytes: every match source stays inside its own chunk, so the Pallas
+# decode can resolve each chunk entirely in VMEM (the whole-buffer
+# gather rounds and the host oracle read the same merged stream —
+# sources are absolute — and never need the sidecar)
+GLZ_CHUNK = 256 * 1024
+
+# decline-reason vocabulary (telemetry counter keys — the bench's
+# per-config link breakdown and the preflight analyzer must speak the
+# same strings)
+DECLINE_UNAVAILABLE = "glz-unavailable"
+DECLINE_BELOW_MIN = "glz-below-min"
+DECLINE_RATIO = "glz-ratio"
+DECLINE_WIDE = "glz-wide-unsupported"
+
+
+def chunk_bytes() -> int:
+    """Configured link-chunk size (``FLUVIO_GLZ_CHUNK``); must stay a
+    multiple of 1024 so the Pallas per-chunk block reshapes onto whole
+    (sublane, 128-lane) tiles and chunk starts stay word-aligned."""
+    c = int(os.environ.get("FLUVIO_GLZ_CHUNK", GLZ_CHUNK))
+    if c < 4096 or c % 1024:
+        raise ValueError(f"FLUVIO_GLZ_CHUNK={c}: need a multiple of 1024 >= 4096")
+    return c
 
 
 class _GlzResult(ctypes.Structure):
@@ -122,6 +146,13 @@ class Compressed(NamedTuple):
     lits: np.ndarray        # uint8[n_lits]
     depth: int              # gather rounds needed (<= MAX_DEPTH)
     out_len: int            # decompressed size == len(raw)
+    # chunked-stream sidecar (compress_link): 0/None for a whole-buffer
+    # stream. chunk_seqs[c] is the first sequence of chunk c (host-side
+    # bookkeeping + test surface for the chunk-locality invariant; the
+    # device decode derives everything from positions, so the sidecar
+    # never crosses the link)
+    chunk_bytes: int = 0
+    chunk_seqs: Optional[np.ndarray] = None  # int32[n_chunks + 1]
 
     @property
     def nbytes(self) -> int:
@@ -167,6 +198,78 @@ def compress(raw: np.ndarray, max_ratio: float = MAX_RATIO) -> Optional[Compress
     )
 
 
+def compress_link(
+    raw: np.ndarray,
+    max_ratio: float = MAX_RATIO,
+    chunk: Optional[int] = None,
+) -> Tuple[Optional[Compressed], Optional[str]]:
+    """Chunked link compression: (stream, None) or (None, decline reason).
+
+    The input compresses in independent ``chunk``-byte windows so every
+    match source lands inside its own chunk — the invariant the Pallas
+    per-chunk VMEM decode needs. Sources are emitted ABSOLUTE (chunk
+    base added), so the merged stream is also a valid whole-buffer glz
+    stream for the gather-round decode and the host oracle. The decline
+    reason is one of the telemetry counter keys (`glz-unavailable`,
+    `glz-below-min`, `glz-ratio`) so staging sites can surface exactly
+    why a batch shipped raw.
+    """
+    lib = _load()
+    n = int(raw.size)
+    if lib is None:
+        return None, DECLINE_UNAVAILABLE
+    if n < MIN_INPUT:
+        return None, DECLINE_BELOW_MIN
+    chunk = chunk or chunk_bytes()
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    n_chunks = (n + chunk - 1) // chunk
+    seq_cap = n // 4 + 64 * n_chunks
+    lit_cap = n + 64 * n_chunks
+    lit_lens = np.empty(seq_cap, dtype=np.uint8)
+    match_lens = np.empty(seq_cap, dtype=np.uint8)
+    srcs = np.empty(seq_cap, dtype=np.int32)
+    lits = np.empty(lit_cap, dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    bounds = np.zeros(n_chunks + 1, dtype=np.int32)
+    n_seq = n_lit = 0
+    depth = 1
+    for c in range(n_chunks):
+        base = c * chunk
+        clen = min(chunk, n - base)
+        res = lib.glz_compress(
+            raw[base:].ctypes.data_as(u8p), clen,
+            lit_lens[n_seq:].ctypes.data_as(u8p),
+            match_lens[n_seq:].ctypes.data_as(u8p),
+            srcs[n_seq:].ctypes.data_as(i32p), seq_cap - n_seq,
+            lits[n_lit:].ctypes.data_as(u8p), lit_cap - n_lit,
+            MAX_DEPTH, MIN_MATCH,
+        )
+        if res.status != 0:
+            # one incompressible window sinks the stream: a mixed ship
+            # (some chunks raw) would fork the wire format for a corner
+            # the ratio gate already rejects
+            return None, DECLINE_RATIO
+        ns = int(res.n_seqs)
+        srcs[n_seq : n_seq + ns] += base  # chunk-local -> absolute
+        n_seq += ns
+        n_lit += int(res.n_lits)
+        depth = max(depth, int(res.depth), 1)
+        bounds[c + 1] = n_seq
+    if n_seq * 6 + n_lit > n * max_ratio:
+        return None, DECLINE_RATIO
+    return (
+        Compressed(
+            lit_lens=lit_lens[:n_seq].copy(),
+            match_lens=match_lens[:n_seq].copy(),
+            srcs=srcs[:n_seq].copy(), lits=lits[:n_lit].copy(),
+            depth=depth, out_len=n,
+            chunk_bytes=chunk, chunk_seqs=bounds,
+        ),
+        None,
+    )
+
+
 def decompress_host(comp: Compressed) -> np.ndarray:
     """Native reference decompressor (tests / debugging oracle)."""
     lib = _load()
@@ -193,7 +296,9 @@ def decompress_numpy(comp: Compressed) -> np.ndarray:
 
     Exists so tests can pin the traced program's semantics against an
     executable spec without a jax dependency; must stay in lockstep
-    with ``decompress_device``.
+    with ``byte_plan_device`` + ``decompress_device``: literal (and
+    pad) bytes carry ``midx == their own index``, so ``out = out[midx]``
+    is the decode's fixpoint iteration with no literal mask.
     """
     out_len = comp.out_len
     ll = comp.lit_lens.astype(np.int64)
@@ -205,32 +310,43 @@ def decompress_numpy(comp: Compressed) -> np.ndarray:
     valid = (dst_start < out_len) & (total > 0)
     np.add.at(marks, dst_start[valid], 1)
     seq_id = np.cumsum(marks) - 1
-    within = np.arange(out_len, dtype=np.int64) - dst_start[seq_id]
+    idx = np.arange(out_len, dtype=np.int64)
+    within = idx - dst_start[seq_id]
     in_lit = within < ll[seq_id]
     nlit = max(comp.lits.size, 1)
     lit_idx = np.clip(lit_start[seq_id] + within, 0, nlit - 1)
     lits = comp.lits if comp.lits.size else np.zeros(1, np.uint8)
     out = np.where(in_lit, lits[lit_idx], 0).astype(np.uint8)
-    midx = np.clip(
-        comp.srcs.astype(np.int64)[seq_id] + (within - ll[seq_id]),
-        0, out_len - 1,
+    midx = np.where(
+        in_lit,
+        idx,
+        np.clip(
+            comp.srcs.astype(np.int64)[seq_id] + (within - ll[seq_id]),
+            0, out_len - 1,
+        ),
     )
     for _ in range(comp.depth):
-        out = np.where(in_lit, out, out[midx])
+        out = out[midx]
     return out
 
 
-def decompress_device(lit_lens, match_lens, srcs, lits, depth, out_len: int):
-    """Traced gather-round decode: uint8[out_len] from sequence arrays.
+def byte_plan_device(lit_lens, match_lens, srcs, lits, out_len: int):
+    """Traced per-byte decode plan: (base, midx), both [out_len].
+
+    ``base`` is the literal-resolved output (literal bytes placed, match
+    bytes zero); ``midx`` the gather source per byte, with literal and
+    pad bytes pointing AT THEMSELVES — so ``out = out[midx]`` iterates
+    to the decoded buffer as its fixpoint (over-application past the
+    stream's real depth is a no-op). Shared setup for BOTH device
+    decoders: the gather-round formulation runs ``depth`` rounds of it
+    through HBM, the Pallas kernel resolves it per chunk in VMEM — one
+    plan, so the two can only differ in where the rounds run.
 
     Sequence arrays may be zero-padded past the real count (link
     bucketing) — pad sequences have lit_len == match_len == 0, land at
-    dst == out_len, and drop out of the scatter. ``depth`` is a traced
-    scalar so batches with different chain depths share one compiled
-    program (fori_loop dynamic bound).
+    dst == out_len, and drop out of the scatter.
     """
     import jax.numpy as jnp
-    from jax import lax
 
     ll = lit_lens.astype(jnp.int32)
     ml = match_lens.astype(jnp.int32)
@@ -242,18 +358,66 @@ def decompress_device(lit_lens, match_lens, srcs, lits, depth, out_len: int):
     marks_at = jnp.where(total > 0, dst_start, out_len)
     marks = jnp.zeros((out_len,), jnp.int32).at[marks_at].add(1, mode="drop")
     seq_id = jnp.cumsum(marks) - 1
-    within = jnp.arange(out_len, dtype=jnp.int32) - jnp.take(dst_start, seq_id)
+    idx = jnp.arange(out_len, dtype=jnp.int32)
+    within = idx - jnp.take(dst_start, seq_id)
     seq_ll = jnp.take(ll, seq_id)
     in_lit = within < seq_ll
     lit_idx = jnp.clip(
         jnp.take(lit_start, seq_id) + within, 0, lits.shape[0] - 1
     )
-    out = jnp.where(in_lit, jnp.take(lits, lit_idx), 0).astype(jnp.uint8)
-    midx = jnp.clip(
-        jnp.take(srcs, seq_id) + (within - seq_ll), 0, out_len - 1
+    base = jnp.where(in_lit, jnp.take(lits, lit_idx), 0).astype(jnp.uint8)
+    midx = jnp.where(
+        in_lit,
+        idx,
+        jnp.clip(jnp.take(srcs, seq_id) + (within - seq_ll), 0, out_len - 1),
     )
+    return base, midx
+
+
+def decompress_device(lit_lens, match_lens, srcs, lits, depth, out_len: int):
+    """Traced gather-round decode: uint8[out_len] from sequence arrays.
+
+    ``depth`` is a traced scalar so batches with different chain depths
+    share one compiled program (fori_loop dynamic bound). Each round
+    materializes the full buffer through HBM — the cost the Pallas
+    variant (`decode_link_flat` with variant="pallas") keeps in VMEM.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    base, midx = byte_plan_device(lit_lens, match_lens, srcs, lits, out_len)
 
     def round_(_, o):
-        return jnp.where(in_lit, o, jnp.take(o, midx))
+        return jnp.take(o, midx)
 
-    return lax.fori_loop(0, depth, round_, out)
+    return lax.fori_loop(0, depth, round_, base)
+
+
+def decode_link_flat(
+    glz_seqs, glz_lits, depth, out_len: int, variant: str,
+    chunk: int = 0, interpret: Optional[bool] = None,
+):
+    """The device half of the decode ladder, by staging variant.
+
+    ``variant`` is "pallas" (per-chunk VMEM resolve; requires the
+    stream to be chunk-local, i.e. produced by `compress_link`) or
+    "gather" (whole-buffer gather rounds). Host decode is the ladder's
+    final rung and lives on the staging side: the host already holds
+    the raw bytes, so "falling back to host decode" is shipping raw.
+    Returns uint8[out_len].
+    """
+    lit_lens, match_lens, srcs = glz_seqs
+    if variant == "pallas":
+        from fluvio_tpu.smartengine.tpu import pallas_kernels
+
+        if interpret is None:  # resolved at trace time, like json_get
+            interpret = pallas_kernels.interpret_mode()
+        base, midx = byte_plan_device(
+            lit_lens, match_lens, srcs, glz_lits, out_len
+        )
+        return pallas_kernels.glz_decode_pallas(
+            base, midx, chunk or chunk_bytes(), interpret=interpret
+        )
+    return decompress_device(
+        lit_lens, match_lens, srcs, glz_lits, depth, out_len
+    )

@@ -403,6 +403,111 @@ json_get_pallas = instrument_jit(
 
 
 # ---------------------------------------------------------------------------
+# glz link decompression (per-chunk VMEM chain resolve)
+# ---------------------------------------------------------------------------
+
+# pointer-squaring rounds: after k rounds every byte's source index has
+# followed its match chain 2^k links, and literal bytes are fixpoints
+# (midx == self), so ceil(log2(MAX_DEPTH=6)) = 3 rounds flatten every
+# chain to its literal root regardless of the stream's actual depth
+GLZ_SQUARE_ROUNDS = 3
+GLZ_CHUNK_LANES = 128  # lane width of the per-chunk block layout
+
+
+def glz_pallas_active() -> bool:
+    """Should the executor's compressed staging decode with the Pallas
+    chunk kernel? ``FLUVIO_GLZ_PALLAS``: ``0`` disables (gather rounds
+    only), ``1``/``interpret`` forces it (interpreted on CPU for
+    equivalence testing), ``auto`` (default) enables off-CPU only —
+    the same ladder shape as ``FLUVIO_TPU_PALLAS``. Resolved once per
+    executor build, never per dispatch."""
+    if _disable_depth or not _PALLAS:
+        return False
+    mode = os.environ.get("FLUVIO_GLZ_PALLAS", "auto")
+    if mode == "0":
+        return False
+    if mode in ("interpret", "1"):
+        return True
+    return not interpret_mode()
+
+
+def _glz_resolve_kernel(rows: int, base_ref, midx_ref, out_ref):
+    """One chunk: resolve glz match chains entirely in VMEM.
+
+    ``base_ref`` is the literal-resolved chunk (match bytes zero),
+    ``midx_ref`` the per-byte gather source — CHUNK-LOCAL by the
+    `compress_link` invariant (chunks compress independently, so no
+    match reaches outside its own chunk). Both are (rows, 128) int32
+    blocks. Pointer squaring (`GLZ_SQUARE_ROUNDS`) flattens every match
+    chain to its literal root, then ONE byte gather materializes the
+    chunk — the whole-buffer formulation's depth× HBM round trips
+    collapse to in-VMEM resolves plus a single output write.
+
+    NOTE: the in-kernel gathers index the flattened VMEM block with a
+    vector of dynamic indices. Mosaic's dynamic-gather lowering is
+    version-dependent; a backend that rejects it fails at compile time
+    and the executor's self-heal ladder demotes the batch to the
+    gather-round variant (tested seam) — correctness never rides on
+    this kernel lowering.
+    """
+    n = rows * GLZ_CHUNK_LANES
+    base = base_ref[:, :].reshape(n)
+    idx = midx_ref[:, :].reshape(n)
+    for _ in range(GLZ_SQUARE_ROUNDS):
+        idx = jnp.take(idx, idx)
+    out = jnp.take(base, idx)
+    out_ref[:, :] = out.reshape(rows, GLZ_CHUNK_LANES)
+
+
+def glz_decode_pallas(base, midx, chunk: int, interpret: bool = False):
+    """Inflate a chunk-local glz byte plan with the Pallas resolver.
+
+    ``base``/``midx`` come from `glz.byte_plan_device` over a stream
+    produced by `glz.compress_link` (absolute sources, chunk-local by
+    construction). The grid walks chunks; each grid step resolves one
+    chunk in VMEM. Returns uint8[len(base)].
+    """
+    if not _PALLAS:
+        raise RuntimeError("pallas unavailable")
+    out_len = base.shape[0]
+    if chunk % GLZ_CHUNK_LANES:
+        raise ValueError(f"glz chunk {chunk} not lane-aligned")
+    n_chunks = max(1, (out_len + chunk - 1) // chunk)
+    padded = n_chunks * chunk
+    rows = chunk // GLZ_CHUNK_LANES
+    base_i = base.astype(jnp.int32)
+    idx = jnp.arange(out_len, dtype=jnp.int32)
+    # chunk-local sources; literal/pad bytes stay self-referencing so
+    # the squaring rounds fix them in place
+    local = midx.astype(jnp.int32) - (idx // jnp.int32(chunk)) * jnp.int32(chunk)
+    if padded != out_len:
+        base_i = jnp.pad(base_i, (0, padded - out_len))
+        # pad bytes live in the last chunk and self-reference: their
+        # within-chunk offset continues where the real bytes stopped
+        tail0 = out_len - (n_chunks - 1) * chunk
+        tail = tail0 + jnp.arange(padded - out_len, dtype=jnp.int32)
+        local = jnp.concatenate([local, tail])
+    base2 = base_i.reshape(n_chunks * rows, GLZ_CHUNK_LANES)
+    local2 = local.reshape(n_chunks * rows, GLZ_CHUNK_LANES)
+    resolve = functools.partial(_glz_resolve_kernel, rows)
+    with _enable_x64(False):  # see the x64/Mosaic note in json_get_pallas
+        out2 = pl.pallas_call(
+            resolve,
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((rows, GLZ_CHUNK_LANES), lambda b: (b, 0)),
+                pl.BlockSpec((rows, GLZ_CHUNK_LANES), lambda b: (b, 0)),
+            ],
+            out_specs=pl.BlockSpec((rows, GLZ_CHUNK_LANES), lambda b: (b, 0)),
+            out_shape=jax.ShapeDtypeStruct(
+                (n_chunks * rows, GLZ_CHUNK_LANES), jnp.int32
+            ),
+            interpret=interpret,
+        )(base2, local2)
+    return out2.reshape(padded)[:out_len].astype(jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
 # DFA regex scan
 # ---------------------------------------------------------------------------
 

@@ -79,6 +79,7 @@ def render_prometheus(
         records = dict(t.batch_records)
         heals, stripe = t.heals, t.stripe_fallbacks
         spills, declines = dict(t.spills), dict(t.declines)
+        link_variants = dict(t.link_variants)
         retries, quarantined = dict(t.retries), t.quarantined
         breaker_states = dict(t.breaker_states)
         breaker_transitions = dict(t.breaker_transitions)
@@ -142,6 +143,15 @@ def render_prometheus(
     )
     for reason, n in sorted(declines.items()):
         w.sample(f"{_PREFIX}_declines_total", {"reason": reason}, n)
+
+    w.header(
+        f"{_PREFIX}_link_variants_total",
+        "Dispatched batches by H2D link staging form "
+        "(raw / glz-gather / glz-pallas).",
+        "counter",
+    )
+    for variant, n in sorted(link_variants.items()):
+        w.sample(f"{_PREFIX}_link_variants_total", {"variant": variant}, n)
 
     w.header(
         f"{_PREFIX}_retries_total",

@@ -77,6 +77,11 @@ class PipelineTelemetry:
         self.stripe_fallbacks = 0
         self.spills: Dict[str, int] = {}
         self.declines: Dict[str, int] = {}
+        # which form each dispatched batch's flat crossed the H2D link
+        # in: "raw" | "glz-gather" | "glz-pallas" (the bench's per-config
+        # link breakdown and the preflight link-variant prediction both
+        # read this family)
+        self.link_variants: Dict[str, int] = {}
         self.batch_records: Dict[str, int] = {
             "fused": 0, "striped": 0, "interpreter": 0
         }
@@ -196,6 +201,18 @@ class PipelineTelemetry:
     def add_decline(self, reason: str) -> None:
         with self._lock:
             self.declines[reason] = self.declines.get(reason, 0) + 1
+
+    def add_link_variant(self, variant: str) -> None:
+        with self._lock:
+            self.link_variants[variant] = (
+                self.link_variants.get(variant, 0) + 1
+            )
+
+    def link_variant_counts(self) -> Dict[str, int]:
+        """{variant: batches} — the bench diffs two of these around a
+        run to report which link form each config actually shipped."""
+        with self._lock:
+            return dict(self.link_variants)
 
     def add_retry(self, point: str) -> None:
         with self._lock:
@@ -362,6 +379,7 @@ class PipelineTelemetry:
                     "stripe_fallbacks": self.stripe_fallbacks,
                     "spills": dict(self.spills),
                     "declines": dict(self.declines),
+                    "link_variants": dict(self.link_variants),
                     "retries": dict(self.retries),
                     "quarantined": self.quarantined,
                     "breaker": {
@@ -417,6 +435,7 @@ class PipelineTelemetry:
             self.stripe_fallbacks = 0
             self.spills = {}
             self.declines = {}
+            self.link_variants = {}
             self.retries = {}
             self.quarantined = 0
             self.breaker_states = {}

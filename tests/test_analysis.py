@@ -145,12 +145,14 @@ def test_bench_matrix_predicted_path_matches_observed(name):
 
 
 def test_bench_preflight_record_shape():
-    """The record bench.py embeds per config: path + optional reasons."""
+    """The record bench.py embeds per config: path + link variant +
+    optional reasons. On the CPU test backend link compression resolves
+    off (auto), so the predicted variant is raw."""
     b = _bench()
     pred = preflight_for_specs(
         b.CONFIGS["2_filter_map"]["specs"], 64
     )
-    assert pred == {"path": "fused"}
+    assert pred == {"path": "fused", "link_variant": "raw"}
 
 
 # ---------------------------------------------------------------------------

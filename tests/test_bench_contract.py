@@ -141,6 +141,15 @@ def _full_config(rps: int, x: float, path: str = "fused") -> dict:
         "link_floor_ms": 777,
         "link_saturation": 0.45,
         "glz_ratio": 0.476,
+        # ISSUE-8: per-config link breakdown (engaged staging variant +
+        # glz decline attribution from the telemetry counters)
+        "link": {
+            "up_mb": 34.62,
+            "down_mb": 4.33,
+            "variant": "glz-pallas",
+            "variants": {"glz-pallas": 7},
+            "declines": {},
+        },
         "path": path,
         "path_records": {path: rps * 7},
         # ISSUE-5: per-config compile breakdown from the telemetry jit
@@ -243,6 +252,9 @@ def test_compact_line_fits_driver_window():
     assert "path" not in parsed["configs"]["1_filter"]
     assert "fallback" not in parsed["configs"]["7_fat70k"]  # static label is gone
     assert parsed["link"]["glz"] == "on"
+    # ISSUE-8: the tiny link key carries the headline's measured upload
+    # MB next to the resolved glz mode
+    assert parsed["link"]["up_mb"] == 34.62
     assert parsed["detail"] == "BENCH_DETAIL.json"
     # telemetry satellite: ONE compact phases key (the headline's p50/p99
     # + top-3 phase shares); the per-config phase tables stay in the file
@@ -317,6 +329,53 @@ def test_compact_line_keeps_cpu_fallback_honest_zero():
     assert parsed["value"] == 0 and parsed["degraded"] is True
     assert parsed["cpu_fallback"]["value"] == 1000
     assert parsed["cpu_fallback"]["configs"]["2_filter_map"]["rps"] == 1000
+
+
+def test_errored_config_keeps_link_evidence_on_the_line():
+    """ISSUE-8 hardening vs the round-5 ``parsed: null`` class: a
+    config that died mid-measurement still reports its partial link
+    bytes (run_suite merges `bench_partial` into the error entry), and
+    the compact line carries them."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    b._LINK.update(rtt_ms=65.0, h2d_mb_s=49.0, d2h_mb_s=37.0, glz="on")
+    results = {
+        "2_filter_map": dict(GOOD),
+        "6_wide300": {
+            "error": "RuntimeError: device stalled mid-pass",
+            "link": {"up_mb": 12.4, "glz": "on"},
+        },
+    }
+    try:
+        out, rc = b._build_output(results)
+        line = json.loads(json.dumps(b._compact_line(out)))
+    finally:
+        b._LINK.clear()
+    assert rc == 0  # per-config errors degrade the entry, not the emit
+    assert out["configs"]["6_wide300"]["link"]["up_mb"] == 12.4
+    assert line["configs"]["6_wide300"]["up_mb"] == 12.4
+    assert "error" in line["configs"]["6_wide300"]
+
+
+def test_compact_line_hard_trim_always_parseable():
+    """Even a pathological object whose irreducible fields exceed the
+    window must collapse to a parseable headline core."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    out, _ = b._build_output(
+        {"2_filter_map": dict(GOOD)}, extra_error="x" * 5000
+    )
+    # sabotage: force an un-droppable giant value into the compact core
+    out["headline_config"] = "2_filter_map" + "y" * 5000
+    line = json.dumps(b._compact_line(out))
+    assert len(line) <= b.COMPACT_LINE_LIMIT
+    parsed = json.loads(line)
+    assert parsed["value"] == 1000
+    assert parsed["detail"] == "BENCH_DETAIL.json"
 
 
 def test_effective_link_compress_resolution(monkeypatch):

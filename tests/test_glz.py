@@ -400,13 +400,13 @@ def test_stream_compress_ahead_no_double_work(monkeypatch):
     import threading
 
     calls = []
-    real_compress = glz.compress
+    real_compress = glz.compress_link
 
     def counting(raw, *a, **k):
         calls.append(threading.current_thread().name)
         return real_compress(raw, *a, **k)
 
-    monkeypatch.setattr(glz, "compress", counting)
+    monkeypatch.setattr(glz, "compress_link", counting)
 
     def mkbuf(seed):
         vals = [f'{{"name":"fluvio-{(i * seed) & 255}","n":{i}}}'.encode()

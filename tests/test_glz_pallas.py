@@ -1,0 +1,635 @@
+"""Pallas glz decode + compressed-staging ladder (ISSUE-8).
+
+Differential contract: FOUR decoders must agree byte-for-byte on every
+corpus — the native sequential oracle (glz.cpp), the numpy mirror of
+the gather rounds, the traced gather-round device decode, and the
+Pallas per-chunk VMEM resolver — including chunked streams, padded
+token arrays, striped wide records, sharded staging, and the
+heal/retry interleavings that demote the decode ladder mid-stream.
+
+The Pallas kernel runs interpreted on the CPU test backend
+(``FLUVIO_GLZ_PALLAS=interpret``), exactly like the json_get kernel
+equivalence suite.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from fluvio_tpu.smartengine.tpu import glz
+from fluvio_tpu.smartengine.tpu import pallas_kernels as pk
+
+pytestmark = pytest.mark.skipif(
+    not glz.available(), reason="native glz library unavailable"
+)
+
+
+def _json_corpus(n, seed=2024):
+    rng = np.random.default_rng(seed)
+    names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "redpanda", "flink"]
+    vals = [
+        f'{{"name":"{names[rng.integers(0, 6)]}-{i & 255}",'
+        f'"n":{rng.integers(0, 100000)}}}'.encode()
+        for i in range(n)
+    ]
+    return np.frombuffer(b"".join(vals), dtype=np.uint8).copy()
+
+
+CORPORA = {
+    "json": lambda: _json_corpus(6000),
+    "zeros": lambda: np.zeros(96 * 1024, np.uint8),
+    "period28": lambda: np.frombuffer(
+        b'{"name":"fluvio-1","n":123}\n' * 5000, np.uint8
+    ).copy(),
+    "mixed": lambda: np.concatenate(
+        [
+            _json_corpus(2000),
+            np.random.default_rng(3).integers(0, 256, 8192).astype(np.uint8),
+            _json_corpus(2000, seed=5),
+        ]
+    ),
+    # wide-record shape: few records, each ~30 KB (the striped regime's
+    # byte layout — long runs + a repeated header)
+    "wide": lambda: np.frombuffer(
+        b"".join(
+            (b'{"name":"fluvio-%d","body":"' % (i & 7))
+            + b"x" * 30000
+            + b'"}'
+            for i in range(8)
+        ),
+        np.uint8,
+    ).copy(),
+}
+
+
+def _pallas_decode(comp, chunk=None, seq_extra=0, lit_extra=0):
+    """Decode via the Pallas ladder rung, optionally with zero-padded
+    token arrays (the executor's bucketed staging form)."""
+    import jax.numpy as jnp
+
+    ns = len(comp.lit_lens)
+    ll = np.zeros(ns + seq_extra, np.uint8)
+    ll[:ns] = comp.lit_lens
+    ml = np.zeros(ns + seq_extra, np.uint8)
+    ml[:ns] = comp.match_lens
+    srcs = np.zeros(ns + seq_extra, np.int32)
+    srcs[:ns] = comp.srcs
+    lits = np.zeros(comp.lits.size + lit_extra, np.uint8)
+    lits[: comp.lits.size] = comp.lits
+    return np.asarray(
+        glz.decode_link_flat(
+            (jnp.asarray(ll), jnp.asarray(ml), jnp.asarray(srcs)),
+            jnp.asarray(lits),
+            jnp.int32(comp.depth),
+            comp.out_len,
+            "pallas",
+            chunk or comp.chunk_bytes,
+            interpret=True,
+        )
+    )
+
+
+def _gather_decode(comp):
+    import jax.numpy as jnp
+
+    return np.asarray(
+        glz.decompress_device(
+            jnp.asarray(comp.lit_lens), jnp.asarray(comp.match_lens),
+            jnp.asarray(comp.srcs), jnp.asarray(comp.lits),
+            jnp.int32(comp.depth), comp.out_len,
+        )
+    )
+
+
+@pytest.mark.parametrize("name", sorted(CORPORA))
+@pytest.mark.parametrize("chunk", [16 * 1024, 64 * 1024])
+def test_four_decoder_differential(name, chunk):
+    raw = CORPORA[name]()
+    comp, reason = glz.compress_link(raw, max_ratio=1.0, chunk=chunk)
+    assert comp is not None, f"{name}: {reason}"
+    assert comp.depth <= glz.MAX_DEPTH
+    assert comp.chunk_bytes == chunk
+    assert np.array_equal(glz.decompress_host(comp), raw), "host oracle"
+    assert np.array_equal(glz.decompress_numpy(comp), raw), "numpy mirror"
+    assert np.array_equal(_gather_decode(comp), raw), "gather rounds"
+    assert np.array_equal(_pallas_decode(comp), raw), "pallas chunks"
+    # the executor's padded-token staging form must decode identically
+    assert np.array_equal(
+        _pallas_decode(comp, seq_extra=37, lit_extra=11), raw
+    ), "pallas w/ padded tokens"
+
+
+def test_chunk_locality_invariant():
+    """Every match source stays inside its own chunk — the invariant
+    the Pallas per-chunk resolve is built on."""
+    raw = CORPORA["json"]()
+    comp, _ = glz.compress_link(raw, max_ratio=1.0, chunk=16 * 1024)
+    cs = comp.chunk_seqs
+    assert cs is not None and cs[-1] == len(comp.lit_lens)
+    for c in range(len(cs) - 1):
+        lo, hi = int(cs[c]), int(cs[c + 1])
+        live = comp.match_lens[lo:hi] > 0
+        assert (comp.srcs[lo:hi][live] >= c * comp.chunk_bytes).all(), c
+        assert (
+            comp.srcs[lo:hi][live] < (c + 1) * comp.chunk_bytes
+        ).all(), c
+
+
+def test_deep_match_chains_at_max_depth():
+    """A corpus whose greedy parse chains matches to the depth cap —
+    the pathological case the pointer-squaring rounds must still cover
+    (GLZ_SQUARE_ROUNDS flattens chains up to 2**3 = 8 >= MAX_DEPTH)."""
+    raw = _json_corpus(9000)
+    comp, _ = glz.compress_link(raw, max_ratio=1.0, chunk=64 * 1024)
+    assert comp.depth == glz.MAX_DEPTH, comp.depth
+    assert (1 << pk.GLZ_SQUARE_ROUNDS) >= glz.MAX_DEPTH
+    assert np.array_equal(_pallas_decode(comp), raw)
+    assert np.array_equal(_gather_decode(comp), raw)
+
+
+def test_compress_link_decline_reasons():
+    assert glz.compress_link(np.zeros(64, np.uint8)) == (
+        None, glz.DECLINE_BELOW_MIN
+    )
+    rng = np.random.default_rng(11)
+    noise = rng.integers(0, 256, 128 * 1024).astype(np.uint8)
+    comp, reason = glz.compress_link(noise)
+    assert comp is None and reason == glz.DECLINE_RATIO
+    comp, reason = glz.compress_link(_json_corpus(4000))
+    assert comp is not None and reason is None
+
+
+def test_merged_stream_valid_for_legacy_decoders():
+    """A chunked stream is a plain glz stream (absolute sources): the
+    whole-buffer decoders need no sidecar, so the gather/host ladder
+    rungs work on the exact arrays the pallas rung ships."""
+    raw = CORPORA["period28"]()
+    comp, _ = glz.compress_link(raw, max_ratio=1.0, chunk=16 * 1024)
+    legacy = glz.Compressed(
+        lit_lens=comp.lit_lens, match_lens=comp.match_lens,
+        srcs=comp.srcs, lits=comp.lits, depth=comp.depth,
+        out_len=comp.out_len,
+    )
+    assert np.array_equal(glz.decompress_host(legacy), raw)
+    assert np.array_equal(glz.decompress_numpy(legacy), raw)
+
+
+# ---------------------------------------------------------------------------
+# Executor-level: compressed staging through the pallas rung
+# ---------------------------------------------------------------------------
+
+
+def _build(backend, specs, mesh=None):
+    from fluvio_tpu.models import lookup
+    from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+
+    eng = (
+        SmartEngine(backend=backend, mesh_devices=mesh)
+        if mesh
+        else SmartEngine(backend=backend)
+    )
+    b = eng.builder()
+    for name, params in specs:
+        b.add_smart_module(SmartModuleConfig(params=params or {}), lookup(name))
+    return b.initialize()
+
+
+def _run_chain(chain, vals, ts=None):
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    records = [Record(value=v) for v in vals]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+        if ts is not None:
+            r.timestamp_delta = int(ts[i])
+    out = chain.process(SmartModuleInput.from_records(records, 0, 1_000_000))
+    assert out.error is None, out.error
+    return [(r.value, r.key, r.offset_delta) for r in out.successes]
+
+
+def _json_vals(n=6000, seed=7):
+    rng = np.random.default_rng(seed)
+    names = ["fluvio", "kafka", "pulsar", "fluvio-tpu", "redpanda", "flink"]
+    return [
+        f'{{"name":"{names[rng.integers(0, 6)]}-{i & 255}",'
+        f'"n":{rng.integers(0, 100000)}}}'.encode()
+        for i in range(n)
+    ]
+
+
+@pytest.fixture
+def glz_pallas_env(monkeypatch):
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    monkeypatch.setenv("FLUVIO_GLZ_PALLAS", "interpret")
+
+
+@pytest.mark.parametrize(
+    "specs",
+    [
+        [("regex-filter", {"regex": "fluvio"}), ("json-map", {"field": "name"})],
+        [("aggregate-field", {"field": "n", "combine": "add"})],
+        [("array-map-json", None)],
+    ],
+    ids=["filter_map", "aggregate", "array_map"],
+)
+def test_executor_pallas_staging_parity(glz_pallas_env, specs):
+    from fluvio_tpu.telemetry import TELEMETRY
+
+    if specs[0][0] == "array-map-json":
+        vals = [
+            f'["a{i & 31}","b{i % 997}",{i},"x"]'.encode() for i in range(6000)
+        ]
+    else:
+        vals = _json_vals()
+    lv0 = TELEMETRY.link_variant_counts()
+    chain = _build("tpu", specs)
+    got = _run_chain(chain, vals)
+    ex = chain.tpu_chain
+    assert ex._glz_variant == "pallas"
+    assert ex._link_compress
+    lv = TELEMETRY.link_variant_counts()
+    assert lv.get("glz-pallas", 0) > lv0.get("glz-pallas", 0), (
+        "pallas variant should have shipped this batch"
+    )
+    ref = _run_chain(_build("python", specs), vals)
+    assert got == ref
+
+
+def test_striped_wide_records_ship_compressed(glz_pallas_env, monkeypatch):
+    """The wide-record (striped) layout crosses the link compressed and
+    re-stripes entirely on device — the wide300/fat70k class."""
+    monkeypatch.setenv("FLUVIO_STRIPE_THRESHOLD", "16384")
+    body = "x" * 30000
+    vals = [
+        f'{{"name":"fluvio-{i & 7}","body":"{body}"}}'.encode()
+        for i in range(48)
+    ]
+    specs = [("regex-filter", {"regex": "fluvio"})]
+    chain = _build("tpu", specs)
+    got = _run_chain(chain, vals)
+    ex = chain.tpu_chain
+    raw_bytes = sum(len(v) for v in vals)
+    assert ex.h2d_bytes_total < raw_bytes / 4, (
+        f"striped upload should be compressed: {ex.h2d_bytes_total} "
+        f"vs {raw_bytes} raw"
+    )
+    ref = _run_chain(_build("python", specs), vals)
+    assert got == ref
+
+
+def test_sharded_staging_ships_compressed(glz_pallas_env):
+    """Sharded dispatch: per-shard glz streams decode inside the shard
+    body (pallas per shard under shard_map)."""
+    from fluvio_tpu.telemetry import TELEMETRY
+
+    vals = _json_vals(8000)
+    specs = [("regex-filter", {"regex": "fluvio"}), ("json-map", {"field": "name"})]
+    lv0 = TELEMETRY.link_variant_counts()
+    chain = _build("tpu", specs, mesh=4)
+    got = _run_chain(chain, vals)
+    ex = chain.tpu_chain
+    raw_bytes = sum(len(v) for v in vals)
+    assert ex.h2d_bytes_total < raw_bytes, "sharded upload should undercut raw"
+    lv = TELEMETRY.link_variant_counts()
+    assert lv.get("glz-pallas", 0) > lv0.get("glz-pallas", 0)
+    ref = _run_chain(_build("python", specs), vals)
+    assert got == ref
+
+
+def test_sharded_aggregate_carries_exact_across_stream(glz_pallas_env):
+    vals_a = [f"{(i * 3) & 63:06d}".encode() for i in range(6000)]
+    vals_b = [f"{(i * 5) & 63:06d}".encode() for i in range(6000)]
+    specs = [("aggregate-sum", None)]
+    chain = _build("tpu", specs, mesh=4)
+    got_a = _run_chain(chain, vals_a)
+    got_b = _run_chain(chain, vals_b)
+    py = _build("python", specs)
+    ref_a = _run_chain(py, vals_a)
+    ref_b = _run_chain(py, vals_b)
+    assert got_a == ref_a and got_b == ref_b
+
+
+def test_sharded_striped_declines_wide(glz_pallas_env, monkeypatch):
+    """The one wide-path exclusion left: sharded STRIPED batches ship
+    raw, with the per-batch `glz-wide-unsupported` decline counted."""
+    from fluvio_tpu.telemetry import TELEMETRY
+
+    monkeypatch.setenv("FLUVIO_STRIPE_THRESHOLD", "16384")
+    body = "y" * 30000
+    vals = [
+        f'{{"name":"fluvio-{i & 7}","body":"{body}"}}'.encode()
+        for i in range(32)
+    ]
+    specs = [("regex-filter", {"regex": "fluvio"})]
+    d0 = dict(TELEMETRY.declines)
+    lv0 = TELEMETRY.link_variant_counts()
+    chain = _build("tpu", specs, mesh=4)
+    got = _run_chain(chain, vals)
+    assert (
+        TELEMETRY.declines.get(glz.DECLINE_WIDE, 0)
+        > d0.get(glz.DECLINE_WIDE, 0)
+    )
+    lv = TELEMETRY.link_variant_counts()
+    assert lv.get("raw", 0) > lv0.get("raw", 0)
+    ref = _run_chain(_build("python", specs), vals)
+    assert got == ref
+
+
+def test_decline_reason_counted_per_batch(glz_pallas_env):
+    """An incompressible corpus ships raw with `glz-ratio` on the
+    decline counter — once per dispatched batch, from the cached
+    compression verdict."""
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+    from fluvio_tpu.telemetry import TELEMETRY
+
+    rng = np.random.default_rng(13)
+    vals = [
+        bytes(rng.integers(33, 127, 40).astype(np.uint8)) + b"fluvio"
+        for _ in range(4000)
+    ]
+    records = [Record(value=v) for v in vals]
+    for i, r in enumerate(records):
+        r.offset_delta = i
+    buf = RecordBuffer.from_smartmodule_input(
+        SmartModuleInput.from_records(records)
+    )
+    chain = _build("tpu", [("regex-filter", {"regex": "fluvio"})])
+    ex = chain.tpu_chain
+    d0 = dict(TELEMETRY.declines)
+    lv0 = TELEMETRY.link_variant_counts()
+    outs = list(ex.process_stream(iter([buf, buf, buf])))
+    assert len(outs) == 3
+    assert (
+        TELEMETRY.declines.get(glz.DECLINE_RATIO, 0)
+        - d0.get(glz.DECLINE_RATIO, 0)
+    ) == 3, "one glz-ratio decline per dispatched batch"
+    lv = TELEMETRY.link_variant_counts()
+    assert lv.get("raw", 0) - lv0.get("raw", 0) == 3
+
+
+# ---------------------------------------------------------------------------
+# Heal ladder: pallas -> gather -> raw
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_heal_demotes_pallas_to_gather(glz_pallas_env, monkeypatch):
+    def boom(*a, **k):
+        raise RuntimeError("mosaic rejected the chunk gather")
+
+    monkeypatch.setattr(pk, "glz_decode_pallas", boom)
+    vals = _json_vals()
+    specs = [("regex-filter", {"regex": "fluvio"})]
+    chain = _build("tpu", specs)
+    got = _run_chain(chain, vals)
+    ex = chain.tpu_chain
+    assert ex._glz_variant == "gather", "ladder should demote one rung"
+    assert ex._link_compress, "compression must STAY ON after demotion"
+    ref = _run_chain(_build("python", specs), vals)
+    assert got == ref
+
+
+def test_dispatch_heal_full_ladder_to_raw(glz_pallas_env, monkeypatch):
+    def boom(*a, **k):
+        raise RuntimeError("no decode at all")
+
+    monkeypatch.setattr(pk, "glz_decode_pallas", boom)
+    monkeypatch.setattr(glz, "decompress_device", boom)
+    vals = _json_vals()
+    specs = [("regex-filter", {"regex": "fluvio"})]
+    chain = _build("tpu", specs)
+    got = _run_chain(chain, vals)
+    ex = chain.tpu_chain
+    assert not ex._link_compress, "bottom of the ladder latches raw"
+    ref = _run_chain(_build("python", specs), vals)
+    assert got == ref
+
+
+def test_sharded_dispatch_heal_demotes(glz_pallas_env, monkeypatch):
+    def boom(*a, **k):
+        raise RuntimeError("mosaic rejected the chunk gather under shard_map")
+
+    monkeypatch.setattr(pk, "glz_decode_pallas", boom)
+    vals = _json_vals(8000)
+    specs = [("regex-filter", {"regex": "fluvio"})]
+    chain = _build("tpu", specs, mesh=4)
+    got = _run_chain(chain, vals)
+    ex = chain.tpu_chain
+    assert ex._glz_variant == "gather"
+    assert ex._link_compress
+    ref = _run_chain(_build("python", specs), vals)
+    assert got == ref
+
+
+def test_sharded_transient_fetch_fault_keeps_compression(glz_pallas_env):
+    """A TRANSIENT finish-side fault on a compressed sharded batch must
+    ride the bounded retry with the ladder untouched: the retry re-ships
+    the same compressed form (from the per-buffer cache), and a
+    recoverable hiccup never costs the executor its link compression."""
+    from fluvio_tpu.resilience import faults
+    from fluvio_tpu.telemetry import TELEMETRY
+
+    faults.FAULTS.inject("device", first=1)  # transient-class
+    try:
+        vals = _json_vals(8000)
+        specs = [("regex-filter", {"regex": "fluvio"})]
+        chain = _build("tpu", specs, mesh=4)
+        lv0 = dict(TELEMETRY.link_variant_counts())
+        got = _run_chain(chain, vals)
+    finally:
+        faults.FAULTS.clear()
+    ex = chain.tpu_chain
+    assert ex._glz_variant == "pallas", "transient fault must not demote"
+    assert ex._link_compress, "transient fault must not latch glz off"
+    lv = {
+        k: v - lv0.get(k, 0)
+        for k, v in TELEMETRY.link_variant_counts().items()
+        if v - lv0.get(k, 0)
+    }
+    assert set(lv) == {"glz-pallas"}, lv  # retry re-shipped compressed
+    assert got == _run_chain(_build("python", specs), vals)
+
+
+def test_sharded_deterministic_finish_failure_demotes(glz_pallas_env):
+    """A DETERMINISTIC finish-side failure of a compressed sharded batch
+    walks the decode ladder: demote pallas -> gather and re-dispatch the
+    same batch down-ladder (compression stays on)."""
+    from fluvio_tpu.resilience import faults
+
+    faults.FAULTS.inject("device", first=1, exc="deterministic")
+    try:
+        vals = _json_vals(8000)
+        specs = [("regex-filter", {"regex": "fluvio"})]
+        chain = _build("tpu", specs, mesh=4)
+        got = _run_chain(chain, vals)
+    finally:
+        faults.FAULTS.clear()
+    ex = chain.tpu_chain
+    assert ex._glz_variant == "gather"
+    assert ex._link_compress
+    assert got == _run_chain(_build("python", specs), vals)
+
+
+def _int_bufs(n_bufs, n=6000):
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    bufs, val_lists = [], []
+    for b in range(n_bufs):
+        vals = [f"{(i * (b + 1)) & 63:06d}".encode() for i in range(n)]
+        records = [Record(value=v) for v in vals]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+        bufs.append(
+            RecordBuffer.from_smartmodule_input(
+                SmartModuleInput.from_records(records)
+            )
+        )
+        val_lists.append(vals)
+    return bufs, val_lists
+
+
+def test_fetch_heal_demotes_and_preserves_carry_lineage(
+    glz_pallas_env, monkeypatch
+):
+    """The async heal under the PALLAS variant: batch k's decode failure
+    surfaces at fetch while k+1 (already dispatched compressed, carries
+    chained) is in flight. The heal must demote to gather — compression
+    stays on — and the carry-lineage epoch machinery must still
+    re-dispatch k+1 from the healed tip, bit-exact vs the interpreter."""
+    from fluvio_tpu.smartengine.tpu.executor import TpuChainExecutor
+
+    real_fetch = TpuChainExecutor._fetch
+    state = {"bombed": False}
+
+    def fetch_bomb(self, buf, header, packed, spec=None):
+        if spec and spec.get("glz_used") and not state["bombed"]:
+            state["bombed"] = True
+            assert spec.get("glz_variant") == "pallas"
+            raise RuntimeError("simulated pallas decode runtime failure")
+        return real_fetch(self, buf, header, packed, spec)
+
+    monkeypatch.setattr(TpuChainExecutor, "_fetch", fetch_bomb)
+    chain = _build("tpu", [("aggregate-sum", None)])
+    ex = chain.tpu_chain
+    bufs, val_lists = _int_bufs(2)
+    outs = list(ex.process_stream(iter(bufs)))
+    assert state["bombed"]
+    assert ex._glz_variant == "gather", "fetch heal demotes the variant"
+    assert ex._link_compress, "compression stays on after demotion"
+    assert len(outs) == 2
+
+    py = _build("python", [("aggregate-sum", None)])
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule import SmartModuleInput
+
+    for out, vals in zip(outs, val_lists):
+        records = [Record(value=v) for v in vals]
+        for i, r in enumerate(records):
+            r.offset_delta = i
+        ref = py.process(SmartModuleInput.from_records(records))
+        assert [r.value for r in out.to_records()] == [
+            r.value for r in ref.successes
+        ]
+    ex._ensure_host_state()
+    assert ex.carries[0][0] == int(py.instances[0].accumulator)
+
+
+# ---------------------------------------------------------------------------
+# CI gates: compile-size smoke + zero-cost chooser
+# ---------------------------------------------------------------------------
+
+
+def test_pallas_decode_compile_size_gate():
+    """Interpret-mode jit of the pallas decode at a bench-shaped bucket
+    must stay well-bounded (the PR-4 DFA gate's methodology): a
+    pathological lowering would blow up trace/compile time long before
+    it blew up the chip."""
+    import jax
+    import jax.numpy as jnp
+
+    out_len = 1 << 20  # 1 MiB bucket, 4 chunks at the 256 KiB default
+    seq = np.zeros(4096, np.uint8)
+    srcs = np.zeros(4096, np.int32)
+    lits = np.zeros(1 << 19, np.uint8)
+
+    fn = jax.jit(
+        lambda a, b, c, d: glz.decode_link_flat(
+            (a, b, c), d, jnp.int32(glz.MAX_DEPTH), out_len,
+            "pallas", glz.GLZ_CHUNK, interpret=True,
+        )
+    )
+    t0 = time.perf_counter()
+    fn(
+        jnp.asarray(seq), jnp.asarray(seq), jnp.asarray(srcs),
+        jnp.asarray(lits),
+    ).block_until_ready()
+    wall = time.perf_counter() - t0
+    assert wall < 60.0, f"pallas glz decode compile took {wall:.1f}s"
+
+
+def test_variant_chooser_zero_cost_when_disabled(monkeypatch):
+    """With link compression off, the staging-variant chooser must cost
+    NOTHING per dispatch: no compressor calls, no pallas-gate reads, no
+    glz module work at all (the overhead-gate companion to the perf
+    arms in test_telemetry_overhead.py)."""
+    monkeypatch.delenv("FLUVIO_LINK_COMPRESS", raising=False)  # auto->off on CPU
+
+    def tripwire(*a, **k):
+        raise AssertionError("glz touched with link compression off")
+
+    monkeypatch.setattr(glz, "compress_link", tripwire)
+    monkeypatch.setattr(glz, "compress", tripwire)
+    monkeypatch.setattr(glz, "decode_link_flat", tripwire)
+    monkeypatch.setattr(pk, "glz_pallas_active", tripwire)
+    monkeypatch.setattr(pk, "glz_decode_pallas", tripwire)
+    vals = _json_vals(2000)
+    specs = [("regex-filter", {"regex": "fluvio"})]
+    chain = _build("tpu", specs)
+    ex = chain.tpu_chain
+    assert not ex._link_compress
+    got = _run_chain(chain, vals)
+    ref = _run_chain(_build("python", specs), vals)
+    assert got == ref
+
+
+# ---------------------------------------------------------------------------
+# Preflight differential: predicted link variant == telemetry truth
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "mode,expected",
+    [("interpret", "glz-pallas"), ("0", "glz-gather")],
+)
+def test_preflight_link_variant_matches_telemetry(monkeypatch, mode, expected):
+    from fluvio_tpu.analysis import preflight_for_specs
+    from fluvio_tpu.telemetry import TELEMETRY
+
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    monkeypatch.setenv("FLUVIO_GLZ_PALLAS", mode)
+    vals = _json_vals(4000)
+    specs = [("regex-filter", {"regex": "fluvio"})]
+    pred = preflight_for_specs(specs, max(len(v) for v in vals))
+    assert pred["link_variant"] == expected
+    lv0 = TELEMETRY.link_variant_counts()
+    chain = _build("tpu", specs)
+    _run_chain(chain, vals)
+    lv = TELEMETRY.link_variant_counts()
+    moved = [k for k, v in lv.items() if v > lv0.get(k, 0)]
+    assert moved == [pred["link_variant"]], (
+        f"predicted {pred['link_variant']}, telemetry observed {moved}"
+    )
+
+
+def test_preflight_predicts_raw_when_disabled(monkeypatch):
+    from fluvio_tpu.analysis import preflight_for_specs
+
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "off")
+    pred = preflight_for_specs([("regex-filter", {"regex": "fluvio"})], 64)
+    assert pred["link_variant"] == "raw"
