@@ -506,6 +506,21 @@ def _run_config(
         )
     buf = _pack(values, ts)
 
+    # SLO satellite: run-scoped verdict block — a private time-series
+    # force-ticked around the measurement, so the windowed rules read
+    # exactly this config's observations (not the whole suite's)
+    slo_eng = None
+    try:
+        from fluvio_tpu.telemetry import slo as slo_mod
+        from fluvio_tpu.telemetry.timeseries import TimeSeries
+
+        slo_eng = slo_mod.SloEngine(timeseries=TimeSeries(
+            window_s=3600.0, capacity=2
+        ))
+        slo_eng.timeseries.force_tick()
+    except Exception as e:  # noqa: BLE001 — SLO must never cost a run
+        log(f"  slo engine unavailable: {type(e).__name__}: {e}")
+
     verify_outputs(cfg["specs"], values, ts, min(n, 512))
     chain = build_chain("tpu", cfg["specs"])
     assert chain.backend_in_use == "tpu", name
@@ -635,6 +650,16 @@ def _run_config(
         "path": path_info["path"],
         "path_records": path_info["records"],
     }
+    if slo_eng is not None:
+        # per-config SLO verdict (targets, observed windows, verdict):
+        # full block in BENCH_DETAIL.json; the compact line carries one
+        # worst-of-suite slo key
+        try:
+            slo_eng.timeseries.force_tick()
+            result["slo"] = slo_mod.summarize(slo_eng.evaluate(tick=False))
+            log(f"  slo: {result['slo'].get('verdict')}")
+        except Exception as e:  # noqa: BLE001 — SLO must never cost a run
+            log(f"  slo evaluation failed: {type(e).__name__}: {e}")
     if preflight is not None:
         # predicted-vs-actual agreement: "unknown" actual (telemetry
         # off) is unjudgeable, not a disagreement
@@ -1027,6 +1052,22 @@ def _preflight_counts(configs: dict):
     return {"agree": sum(1 for a in judged if a), "of": len(judged)}
 
 
+def _slo_verdict(configs: dict):
+    """Worst per-config SLO verdict across the suite — the compact
+    line's tiny ``slo`` key; full per-config blocks (targets, observed
+    windows) stay in BENCH_DETAIL.json."""
+    order = {"ok": 0, "warn": 1, "breach": 2}
+    verds = [
+        c["slo"]["verdict"]
+        for c in configs.values()
+        if isinstance(c, dict) and isinstance(c.get("slo"), dict)
+        and c["slo"].get("verdict") in order
+    ]
+    if not verds:
+        return None
+    return max(verds, key=lambda v: order[v])
+
+
 def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     """Compress the full output object into the driver-facing summary
     line: headline numbers, per-config rps/ratio pairs, link weather,
@@ -1100,6 +1141,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         pf = _preflight_counts(out["configs"])
         if pf:
             compact["preflight"] = pf
+        sv = _slo_verdict(out["configs"])
+        if sv:
+            compact["slo"] = sv
     if "cpu_fallback" in out:
         inner = out["cpu_fallback"]
         compact["cpu_fallback"] = {
@@ -1112,7 +1156,7 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "preflight", "compile", "phases",
+        "configs", "cpu_fallback", "slo", "preflight", "compile", "phases",
         "error", "xla_cache", "link",
     ):
         if len(json.dumps(compact)) <= limit:

@@ -281,6 +281,10 @@ class ClassModel:
     methods: Dict[str, FuncModel] = field(default_factory=dict)
     attr_locks: Dict[str, str] = field(default_factory=dict)  # attr -> lock name
     attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> class qual
+    # default-singleton idiom: `self.X = x if x is not None else SINGLETON`
+    # records the candidate global names here; resolved to attr_types
+    # after singleton binding (build() post-pass)
+    attr_singleton_defaults: Dict[str, List[str]] = field(default_factory=dict)
 
 
 @dataclass
@@ -329,6 +333,15 @@ class PackageAnalyzer:
         self._resolve_export_origins()
         for mod in self.modules.values():
             self._bind_singletons(mod)
+        # default-singleton attr types resolve only after singletons
+        # are bound (the IfExp's Name branch is a cross-module global)
+        for cm in self.classes.values():
+            for attr, names in cm.attr_singleton_defaults.items():
+                for name in names:
+                    cq = self.singleton_classes.get(name)
+                    if cq is not None:
+                        cm.attr_types.setdefault(attr, cq)
+                        break
         for mod in self.modules.values():
             self._scan_function_bodies(mod)
 
@@ -409,6 +422,23 @@ class PackageAnalyzer:
                     item.value.func, ast.Name
                 ):
                     cm.attr_types.setdefault(chain[1], item.value.func.id)
+                elif isinstance(item.value, ast.IfExp):
+                    # `self.X = x if x is not None else DEFAULT`: type
+                    # the attr from whichever branch resolves — a bare
+                    # Name binds through the module-singleton table
+                    # (post-pass, after singletons exist), a
+                    # ClassName(...) call binds like the plain-call case
+                    for branch in (item.value.body, item.value.orelse):
+                        if isinstance(branch, ast.Name):
+                            cm.attr_singleton_defaults.setdefault(
+                                chain[1], []
+                            ).append(branch.id)
+                        elif isinstance(branch, ast.Call) and isinstance(
+                            branch.func, ast.Name
+                        ):
+                            cm.attr_types.setdefault(
+                                chain[1], branch.func.id
+                            )
 
     def _collect_import(self, mod: ModuleModel, node: ast.AST,
                         into: Dict[str, str]) -> None:
@@ -1232,6 +1262,8 @@ class _FuncScanner:
         tname = cm.attr_types.get(attr)
         if tname is None:
             return None
+        if tname in self.pkg.classes:  # pre-resolved qual (singleton default)
+            return tname
         return self.pkg._resolve_class(self.mod, tname)
 
     def _resolve_lock(self, expr: ast.AST) -> Optional[str]:

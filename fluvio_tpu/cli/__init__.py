@@ -2,7 +2,7 @@
 
 Run as ``python -m fluvio_tpu.cli <command>``. Commands: produce, consume,
 topic, partition, smartmodule, tableformat, spu, profile, cluster, run,
-metrics, trace, analyze, version.
+metrics, trace, analyze, health, version.
 """
 
 from __future__ import annotations
@@ -19,6 +19,7 @@ def build_parser() -> argparse.ArgumentParser:
     from fluvio_tpu.cli import cluster as cluster_cmd
     from fluvio_tpu.cli import consume as consume_cmd
     from fluvio_tpu.cli import crud
+    from fluvio_tpu.cli import health as health_cmd
     from fluvio_tpu.cli import hub as hub_cmd
     from fluvio_tpu.cli import metrics as metrics_cmd
     from fluvio_tpu.cli import produce as produce_cmd
@@ -46,6 +47,7 @@ def build_parser() -> argparse.ArgumentParser:
         metrics_cmd.add_metrics_parser,
         trace_cmd.add_trace_parser,
         analyze_cmd.add_analyze_parser,
+        health_cmd.add_health_parser,
     ):
         add(sub)
 

@@ -1,0 +1,123 @@
+"""`health` subcommand — per-chain SLO verdicts from a running SPU.
+
+Reads the monitoring socket's ``health`` mode (the SLO engine's verdict
+document, telemetry/slo.py) and renders it as a table or JSON. Exit
+code is the deploy-gate contract, symmetric with ``fluvio-tpu
+analyze``: 0 when every chain is ``ok``/``warn``, 1 when any chain is
+in ``breach`` — so ``fluvio-tpu health && promote`` refuses to advance
+a rollout whose chains are burning their error budgets.
+
+``--local`` evaluates the in-process engine instead of connecting to a
+socket (bench-style single-process runs and tests).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def add_health_parser(sub) -> None:
+    p = sub.add_parser(
+        "health",
+        help="per-chain SLO verdicts (ok|warn|breach) with window evidence",
+    )
+    p.add_argument(
+        "--path",
+        help="monitoring unix-socket path (default: FLUVIO_METRIC_SPU)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("table", "json"),
+        default="table",
+        help="output format (default: table)",
+    )
+    p.add_argument(
+        "--local",
+        action="store_true",
+        help="evaluate the in-process SLO engine instead of a socket",
+    )
+    p.set_defaults(fn=health)
+
+
+def _fmt_observed(ev: dict) -> str:
+    obs = ev.get("observed")
+    if obs is None:
+        return "-"
+    unit = ev.get("unit", "")
+    if unit == "s":
+        return f"{obs * 1000:.1f}ms"
+    if unit == "bytes":
+        return f"{obs / 1e6:.1f}MB"
+    return f"{obs:.4g}"
+
+
+def _fmt_target(ev: dict) -> str:
+    tgt = ev.get("target")
+    unit = ev.get("unit", "")
+    if unit == "s":
+        return f"{tgt * 1000:.0f}ms"
+    if unit == "bytes":
+        return f"{tgt / 1e6:.0f}MB"
+    return f"{tgt:.4g}{'' if unit in ('ratio',) else ' ' + unit}".rstrip()
+
+
+def render_health_table(doc: dict) -> str:
+    """Verdict document -> operator-facing table. Pure function so the
+    surface tests render without a socket."""
+    from fluvio_tpu.cli.metrics import _rows_to_table
+
+    if not doc.get("enabled", False):
+        return "telemetry capture is off (FLUVIO_TELEMETRY=0): no verdicts"
+    sections = [
+        f"overall: {doc.get('verdict', 'ok')}  "
+        f"(window {doc.get('window_s')}s x {doc.get('retained_windows', 0)}"
+        f"/{doc.get('windows')} retained)"
+    ]
+    rows = []
+    for chain, entry in sorted((doc.get("chains") or {}).items()):
+        for rule, ev in sorted((entry.get("rules") or {}).items()):
+            rows.append(
+                (
+                    chain,
+                    rule,
+                    ev.get("verdict", "ok"),
+                    _fmt_observed(ev),
+                    _fmt_target(ev),
+                    (
+                        f"{ev['window_s']}s"
+                        if ev.get("window_s") is not None
+                        else "-"
+                    ),
+                )
+            )
+    if rows:
+        sections.append(
+            _rows_to_table(
+                rows,
+                header=("chain", "rule", "verdict", "observed", "target",
+                        "window"),
+            )
+        )
+    captures = doc.get("profile_captures")
+    if captures:
+        sections.append(
+            "breach device profiles\n"
+            + "\n".join(f"  {p}" for p in captures)
+        )
+    return "\n\n".join(sections)
+
+
+async def health(args) -> int:
+    if args.local:
+        from fluvio_tpu.telemetry.slo import health_snapshot
+
+        doc = health_snapshot()
+    else:
+        from fluvio_tpu.spu.monitoring import read_health
+
+        doc = await read_health(args.path)
+    if args.format == "json":
+        print(json.dumps(doc, indent=1))
+    else:
+        print(render_health_table(doc))
+    return 1 if doc.get("verdict") == "breach" else 0

@@ -13,7 +13,12 @@ the SPU metrics unix socket), extended with the telemetry surface:
 
 from __future__ import annotations
 
+import argparse
+import asyncio
 import json
+import sys
+
+from fluvio_tpu.cli.common import CliError
 
 
 def add_metrics_parser(sub) -> None:
@@ -32,6 +37,19 @@ def add_metrics_parser(sub) -> None:
         "--spans",
         action="store_true",
         help="dump the recent per-batch phase spans as JSON and exit",
+    )
+    p.add_argument(
+        "--watch",
+        type=float,
+        metavar="N",
+        help="refresh the table every N seconds (ctrl-c to stop) — live "
+        "observation of a run without a scraper stack",
+    )
+    p.add_argument(
+        "--watch-count",
+        type=int,
+        default=0,
+        help=argparse.SUPPRESS,  # test hook: stop after K refreshes
     )
     p.set_defaults(fn=metrics)
 
@@ -106,6 +124,13 @@ def render_metrics_table(data: dict) -> str:
         rows.append((f"decline[{reason}]", _fmt_count(n)))
     for point, n in sorted((counters.get("retries") or {}).items()):
         rows.append((f"retry[{point}]", _fmt_count(n)))
+    if counters.get("sharded_inline_compress_shards"):
+        rows.append(
+            ("sharded_inline_compress_shards",
+             _fmt_count(counters["sharded_inline_compress_shards"]))
+        )
+    for key, n in sorted((counters.get("slo_breaches") or {}).items()):
+        rows.append((f"slo_breach[{key}]", _fmt_count(n)))
     breaker = counters.get("breaker") or {}
     rows.append(
         ("breaker_short_circuits",
@@ -181,6 +206,20 @@ def render_metrics_table(data: dict) -> str:
             )
         )
 
+    chains = tel.get("chains") or {}
+    rows = [
+        (name, _fmt_count(h.get("count", 0)), h.get("p50_ms", 0),
+         h.get("p99_ms", 0))
+        for name, h in sorted(chains.items())
+    ]
+    if rows:
+        sections.append(
+            "chain latency\n"
+            + _rows_to_table(
+                rows, header=("chain", "batches", "p50_ms", "p99_ms")
+            )
+        )
+
     phases = tel.get("phases") or {}
     rows = [
         (name, _fmt_count(h.get("count", 0)), h.get("p50_ms", 0),
@@ -214,6 +253,10 @@ async def metrics(args) -> int:
     if args.spans:
         print(json.dumps(await read_spans(args.path), indent=1))
         return 0
+    if getattr(args, "watch", None) is not None:
+        if args.watch <= 0:
+            raise CliError("--watch interval must be positive seconds")
+        return await _watch(args)
     if args.format == "prom":
         print(await read_prometheus(args.path), end="")
         return 0
@@ -223,3 +266,36 @@ async def metrics(args) -> int:
     else:
         print(render_metrics_table(data))
     return 0
+
+
+async def _watch(args) -> int:
+    """Refresh loop: re-read the socket every ``--watch`` seconds and
+    redraw in place (ANSI clear-home — no curses dependency), honoring
+    ``--format`` (table/json/prom). Each refresh is its own connection,
+    same as a scraper. Stops on ctrl-c (clean exit 0) or after
+    ``--watch-count`` refreshes (tests)."""
+    from fluvio_tpu.spu.monitoring import read_metrics, read_prometheus
+
+    interval = max(float(args.watch), 0.01)
+    drawn = 0
+    try:
+        while True:
+            if args.format == "prom":
+                body = (await read_prometheus(args.path)).rstrip("\n")
+            else:
+                data = await read_metrics(args.path)
+                body = (
+                    json.dumps(data, indent=2)
+                    if args.format == "json"
+                    else render_metrics_table(data)
+                )
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear screen, cursor home
+            print(f"fluvio-tpu metrics  (refresh {interval:g}s)\n")
+            print(body)
+            sys.stdout.flush()
+            drawn += 1
+            if args.watch_count and drawn >= args.watch_count:
+                return 0
+            await asyncio.sleep(interval)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        return 0

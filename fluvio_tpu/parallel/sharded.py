@@ -430,7 +430,9 @@ class ShardedChainExecutor:
         need = max(step, ((rows + step - 1) // step) * step)
         return need, need // self.n
 
-    def _stage_ragged(self, buf: RecordBuffer, compress_ok: bool = False) -> tuple:
+    def _stage_ragged(
+        self, buf: RecordBuffer, compress_ok: bool = False, span=None
+    ) -> tuple:
         """Ragged H2D staging (the single-device link diet, per shard).
 
         The aligned flat is cut at shard row boundaries; every shard's
@@ -482,8 +484,21 @@ class ShardedChainExecutor:
             if cached is not None and cached[0] == key:
                 glz_up, reason = cached[1], cached[2]
             else:
+                # the inline n-shard compress is the cost the ROADMAP
+                # flagged (the compress-ahead worker only covers
+                # single-device buffers): book it as its own
+                # glz_compress phase + per-shard counter so the span
+                # profile can justify extending the worker
+                t_gc = time.perf_counter() if TELEMETRY.enabled else 0.0
                 glz_up, reason = self._compress_segments(segs, seg_len)
                 buf._glz_shard_cache = (key, glz_up, reason)
+                if TELEMETRY.enabled:
+                    dt = time.perf_counter() - t_gc
+                    if span is not None:
+                        span.add("glz_compress", dt)
+                    else:
+                        TELEMETRY.add_phase("glz_compress", dt)
+                    TELEMETRY.add_sharded_compress(self.n)
             if reason is not None:
                 TELEMETRY.add_decline(reason)
             if glz_up is not None:
@@ -608,7 +623,11 @@ class ShardedChainExecutor:
         # a fan-out retry passes the batch's ORIGINAL span back in so the
         # retry's stage/h2d/dispatch/device time accumulates onto it
         # instead of a second span that would be discarded
-        span = reuse_span if reuse_span is not None else TELEMETRY.begin_batch()
+        span = (
+            reuse_span
+            if reuse_span is not None
+            else TELEMETRY.begin_batch(chain=ex._chain_sig)
+        )
         t_ph = time.perf_counter() if span is not None else 0.0
         faults.maybe_fire("stage")
         striped = ex._needs_stripes(buf)
@@ -617,13 +636,21 @@ class ShardedChainExecutor:
         # already compile against the worst shard, and stacking the
         # token-bucket axis on top would square that compile matrix
         # (the one wide-path exclusion left; counted per batch below)
+        gc0 = span.phase("glz_compress") if span is not None else 0.0
         uploads, cfg, nbytes = self._stage_ragged(
-            buf, compress_ok=ex._link_compress and not striped
+            buf, compress_ok=ex._link_compress and not striped, span=span
         )
         glz_bytes, glz_variant = cfg[5], cfg[6]
         if span is not None:
             now = time.perf_counter()
-            span.add("stage", now - t_ph)
+            # the inline n-shard compressor booked its own phase inside
+            # _stage_ragged; stage keeps the remainder so the two are
+            # separable in the span profile (the ROADMAP's evidence for
+            # extending the compress-ahead worker to sharded buffers)
+            span.add(
+                "stage",
+                max(now - t_ph - (span.phase("glz_compress") - gc0), 0.0),
+            )
             t_ph = now
         if ex._fanout and cap_shard is None:
             cap_shard = self._shard_fanout_cap(buf)

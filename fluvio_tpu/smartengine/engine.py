@@ -211,6 +211,15 @@ class SmartModuleChainInstance:
         self.tpu_chain = tpu_chain
         self.native_chain = native_chain
         self.chain_spec = chain_spec or []
+        # chain identity for telemetry samples: the executor's compact
+        # signature when a fused path exists (so interpreter reruns of
+        # the SAME chain land in the SAME per-chain latency family the
+        # SLO engine windows), else the module-kind composition
+        self.chain_label = (
+            tpu_chain._chain_sig
+            if tpu_chain is not None
+            else "+".join(i.kind.value for i in instances) or "empty"
+        )
         # set when a fuel trap abandoned a hook thread (metering.py):
         # the chain fails fast with this error instead of re-entering
         # user code whose previous invocation is still running
@@ -417,7 +426,7 @@ class SmartModuleChainInstance:
             # the spill-rerun seam: a batch whose interpreter re-run
             # also fails is poison — process() quarantines it
             faults.maybe_fire("spill_rerun")
-        span = TELEMETRY.begin_batch(path="interpreter")
+        span = TELEMETRY.begin_batch(path="interpreter", chain=self.chain_label)
         from fluvio_tpu.smartengine.metering import (
             SmartModuleFuelError,
             run_metered,

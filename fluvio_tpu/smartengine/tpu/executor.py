@@ -2258,14 +2258,16 @@ class TpuChainExecutor:
             # retry convention: phase time accumulates onto the batch's
             # single span — the batch really paid staging twice — and a
             # failed attempt's span is never orphaned)
-            sh_span = TELEMETRY.begin_batch()
+            sh_span = TELEMETRY.begin_batch(chain=self._chain_sig)
             h0 = self.h2d_bytes_total
             handle = self._dispatch_with_retry(
                 lambda: self._sharded_dispatch(buf, reuse_span=sh_span)
             )
             self._gauge_track(handle, self.h2d_bytes_total - h0)
             return handle
-        span = TELEMETRY.begin_batch()
+        # chain identity on the span: the per-chain windowed latency
+        # family the SLO engine's e2e_p99 verdicts key on
+        span = TELEMETRY.begin_batch(chain=self._chain_sig)
         prev_carries = self._device_carries
         h0 = self.h2d_bytes_total
         header, packed = self._dispatch_with_retry(

@@ -12,7 +12,10 @@ Protocol: the client MAY send one mode line before reading:
 - ``prom``  → Prometheus text-format exposition of the same snapshot,
 - ``spans`` → the recent per-batch span ring as a JSON array,
 - ``trace`` → the flight recorder's span/event rings as one complete
-  Chrome-trace/Perfetto JSON document (load it in ui.perfetto.dev).
+  Chrome-trace/Perfetto JSON document (load it in ui.perfetto.dev),
+- ``health``→ the SLO engine's machine-readable verdict document
+  (per-chain ok|warn|breach with window evidence — the future
+  admission controller's input; see telemetry/slo.py).
 
 A client that sends nothing still gets JSON after a short grace wait,
 so pre-existing scrapers keep working unchanged. One document per
@@ -70,6 +73,10 @@ class MonitoringServer:
             return (json.dumps(TELEMETRY.spans_json(), indent=1) + "\n").encode()
         if mode == "trace":
             return (trace_json() + "\n").encode()
+        if mode == "health":
+            from fluvio_tpu.telemetry.slo import health_snapshot
+
+            return (json.dumps(health_snapshot(), indent=1) + "\n").encode()
         return json.dumps(self.ctx.metrics.to_dict(), indent=2).encode()
 
     async def _handle(
@@ -87,7 +94,7 @@ class MonitoringServer:
                     reader.readline(), _MODE_LINE_TIMEOUT_S
                 )
                 requested = line.decode("ascii", "replace").strip().lower()
-                if requested in ("prom", "spans", "trace", "json"):
+                if requested in ("prom", "spans", "trace", "health", "json"):
                     mode = requested
             except (asyncio.TimeoutError, ValueError):
                 # legacy client (no mode line) or a line exceeding the
@@ -159,3 +166,9 @@ async def read_spans(path: Optional[str] = None) -> list:
 async def read_trace(path: Optional[str] = None) -> dict:
     """Fetch the flight recorder as one Chrome-trace JSON document."""
     return json.loads(await _read_mode(path, "trace"))
+
+
+async def read_health(path: Optional[str] = None) -> dict:
+    """Fetch the SLO engine's verdict document (per-chain ok|warn|breach
+    with window evidence)."""
+    return json.loads(await _read_mode(path, "health"))

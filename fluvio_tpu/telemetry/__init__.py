@@ -19,7 +19,15 @@ per-record work:
                 outcome),
 - `trace`     — Chrome-trace/Perfetto export: continuous bounded file
                 sink via ``FLUVIO_TRACE=<path>`` plus the on-demand
-                renderer behind the monitoring socket and CLI.
+                renderer behind the monitoring socket and CLI,
+- `timeseries`— rolling-window layer: bounded ring of cumulative
+                snapshots; windowed rate/p50/p99/error-ratio per chain
+                and per path family by mergeable-histogram delta,
+- `slo`       — declarative SLO targets (``FLUVIO_SLO``) evaluated with
+                multi-window burn-rate logic into per-chain
+                ok|warn|breach verdicts; breaches emit flight-recorder
+                instant events and (``FLUVIO_SLO_PROFILE``) bounded
+                jax.profiler captures.
 
 Always-on contract: one monotonic clock pair per phase per batch, no
 per-record work; ``FLUVIO_TELEMETRY=0`` disables span/histogram capture
@@ -44,6 +52,8 @@ from fluvio_tpu.telemetry.trace import (
     render_trace,
     trace_json,
 )
+from fluvio_tpu.telemetry.timeseries import TimeSeries, WindowDelta
+from fluvio_tpu.telemetry.slo import SloEngine, health_snapshot
 
 # continuous flight recorder: arm the file sink when FLUVIO_TRACE names
 # a path (no-op otherwise; bounded + rotated, see telemetry/trace.py)
@@ -64,4 +74,8 @@ __all__ = [
     "install_env_sink",
     "render_trace",
     "trace_json",
+    "TimeSeries",
+    "WindowDelta",
+    "SloEngine",
+    "health_snapshot",
 ]

@@ -76,11 +76,16 @@ def render_prometheus(
         phase_series = [
             ({"phase": p}, h.copy()) for p, h in t.phase_hist.items()
         ]
+        chain_series = [
+            ({"chain": c}, h.copy()) for c, h in t.chain_latency.items()
+        ]
         records = dict(t.batch_records)
         heals, stripe = t.heals, t.stripe_fallbacks
         spills, declines = dict(t.spills), dict(t.declines)
         link_variants = dict(t.link_variants)
         retries, quarantined = dict(t.retries), t.quarantined
+        sharded_compress = t.sharded_compress_shards
+        slo_breaches = dict(t.slo_breaches)
         breaker_states = dict(t.breaker_states)
         breaker_transitions = dict(t.breaker_transitions)
         breaker_shorts = t.breaker_short_circuits
@@ -105,6 +110,13 @@ def render_prometheus(
         "Per-batch time spent in each pipeline phase.",
         phase_series,
     )
+    if chain_series:
+        _histogram(
+            w,
+            f"{_PREFIX}_chain_e2e_latency_seconds",
+            "End-to-end per-batch latency by chain signature.",
+            chain_series,
+        )
 
     w.header(
         f"{_PREFIX}_batch_records_total",
@@ -167,6 +179,26 @@ def render_prometheus(
         "counter",
     )
     w.sample(f"{_PREFIX}_quarantined_total", {}, quarantined)
+
+    w.header(
+        f"{_PREFIX}_sharded_inline_compress_shards_total",
+        "Shard segments glz-compressed inline on the sharded staging "
+        "path (not covered by the compress-ahead worker).",
+        "counter",
+    )
+    w.sample(
+        f"{_PREFIX}_sharded_inline_compress_shards_total",
+        {},
+        sharded_compress,
+    )
+
+    w.header(
+        f"{_PREFIX}_slo_breaches_total",
+        "SLO verdict transitions into breach, by chain/rule.",
+        "counter",
+    )
+    for key, n in sorted(slo_breaches.items()):
+        w.sample(f"{_PREFIX}_slo_breaches_total", {"key": key}, n)
 
     w.header(
         f"{_PREFIX}_breaker_transitions_total",
@@ -263,9 +295,83 @@ def render_prometheus(
         w.header(f"{_PREFIX}_{name}", "Engine gauge.", "gauge")
         w.sample(f"{_PREFIX}_{name}", {}, gauges[name])
 
+    if t is TELEMETRY:
+        _render_slo(w)
     if spu_metrics is not None:
         _render_spu(w, spu_metrics)
     return w.text()
+
+
+_VERDICT_VALUE = {"ok": 0, "warn": 1, "breach": 2}
+
+
+def _render_slo(w: _Writer) -> None:
+    """Windowed gauges + per-chain/rule verdict states from the
+    process-global SLO engine (scrape-driven sampling: the scrape IS
+    the tick). Only rendered for the global registry — a custom
+    `PipelineTelemetry` has no engine bound to it. Guarded: a broken
+    evaluation must never take the scrape surface with it."""
+    try:
+        from fluvio_tpu.telemetry import slo as slo_mod
+
+        doc = slo_mod.health_snapshot()
+    except Exception:  # pragma: no cover — defensive scrape guard
+        return
+    if not doc.get("enabled"):
+        return
+    w.header(
+        f"{_PREFIX}_slo_verdict",
+        "Current SLO verdict per chain and rule (0=ok 1=warn 2=breach).",
+        "gauge",
+    )
+    for chain, entry in sorted((doc.get("chains") or {}).items()):
+        for rule, ev in sorted((entry.get("rules") or {}).items()):
+            w.sample(
+                f"{_PREFIX}_slo_verdict",
+                {"chain": chain, "rule": rule},
+                _VERDICT_VALUE.get(ev.get("verdict"), 0),
+            )
+    w.header(
+        f"{_PREFIX}_slo_observed",
+        "Short-window observed value per chain and rule (rule units).",
+        "gauge",
+    )
+    for chain, entry in sorted((doc.get("chains") or {}).items()):
+        for rule, ev in sorted((entry.get("rules") or {}).items()):
+            if ev.get("observed") is not None:
+                w.sample(
+                    f"{_PREFIX}_slo_observed",
+                    {"chain": chain, "rule": rule},
+                    ev["observed"],
+                )
+    w.header(
+        f"{_PREFIX}_slo_target",
+        "Configured SLO target per rule (rule units).",
+        "gauge",
+    )
+    for rule, tgt in sorted((doc.get("targets") or {}).items()):
+        w.sample(f"{_PREFIX}_slo_target", {"rule": rule}, tgt["target"])
+    window = doc.get("window") or {}
+    w.header(
+        f"{_PREFIX}_window_chain_rate",
+        "Short-window per-chain batch rate (batches/s).",
+        "gauge",
+    )
+    for chain, s in sorted((window.get("chains") or {}).items()):
+        w.sample(
+            f"{_PREFIX}_window_chain_rate", {"chain": chain}, s["rate_per_s"]
+        )
+    w.header(
+        f"{_PREFIX}_window_chain_p99_seconds",
+        "Short-window per-chain end-to-end p99 latency.",
+        "gauge",
+    )
+    for chain, s in sorted((window.get("chains") or {}).items()):
+        w.sample(
+            f"{_PREFIX}_window_chain_p99_seconds",
+            {"chain": chain},
+            s["p99_ms"] / 1000.0,
+        )
 
 
 def _render_spu(w: _Writer, m: dict) -> None:

@@ -63,6 +63,10 @@ class PipelineTelemetry:
     def __init__(self, ring_capacity: int = SPAN_RING_CAPACITY) -> None:
         self.enabled = os.environ.get("FLUVIO_TELEMETRY", "1") != "0"
         self._lock = make_lock("telemetry.registry")
+        # bumped by reset(): cumulative counters going BACKWARDS would
+        # corrupt the time-series layer's window deltas, so its ring
+        # self-invalidates when the generation changes
+        self._generation = 0
         self.batch_latency: Dict[str, LatencyHistogram] = {
             "fused": LatencyHistogram(),
             "striped": LatencyHistogram(),
@@ -71,6 +75,13 @@ class PipelineTelemetry:
         self.phase_hist: Dict[str, LatencyHistogram] = {
             p: LatencyHistogram() for p in PHASES
         }
+        # per-chain e2e latency (keyed by the executor's chain
+        # signature): the SAME mergeable-histogram primitive as the
+        # path split above, so windowed per-chain rate/p50/p99 for the
+        # SLO engine come from diffing snapshots — no second
+        # instrumentation seam. Bounded like breaker_states: a broker
+        # that builds a chain per stream keeps the 64 most recent.
+        self.chain_latency: Dict[str, LatencyHistogram] = {}
         self.spans = SpanRing(ring_capacity)
         # event counters (always-on)
         self.heals = 0
@@ -91,6 +102,15 @@ class PipelineTelemetry:
         # breaker + transition counts + open-state short-circuits)
         self.retries: Dict[str, int] = {}
         self.quarantined = 0
+        # sharded inline-compress accounting (ROADMAP's noted gap: the
+        # compress-ahead worker covers only single-device buffers, so a
+        # sharded stream pays the n-shard compressor inline in stage):
+        # shard segments glz-compressed inline, so the "extend the
+        # worker to pre-fill _glz_shard_cache" call can be made from
+        # evidence instead of guesswork
+        self.sharded_compress_shards = 0
+        # SLO breach transitions, keyed "chain/rule" (telemetry/slo.py)
+        self.slo_breaches: Dict[str, int] = {}
         self.breaker_states: Dict[str, str] = {}
         self.breaker_transitions: Dict[str, int] = {}
         self.breaker_short_circuits = 0
@@ -127,10 +147,12 @@ class PipelineTelemetry:
 
     # -- span lifecycle ------------------------------------------------------
 
-    def begin_batch(self, path: str = "fused") -> Optional[BatchSpan]:
+    def begin_batch(
+        self, path: str = "fused", chain: str = ""
+    ) -> Optional[BatchSpan]:
         if not self.enabled:
             return None
-        return BatchSpan(path)
+        return BatchSpan(path, chain)
 
     def end_batch(self, span: Optional[BatchSpan], records: int = 0) -> None:
         if span is None:
@@ -148,6 +170,17 @@ class PipelineTelemetry:
             self.batch_records[span.path] = (
                 self.batch_records.get(span.path, 0) + records
             )
+            if span.chain:
+                ch = self.chain_latency.get(span.chain)
+                if ch is None:
+                    ch = self.chain_latency.setdefault(
+                        span.chain, LatencyHistogram()
+                    )
+                    while len(self.chain_latency) > 64:
+                        self.chain_latency.pop(
+                            next(iter(self.chain_latency))
+                        )
+                ch.record(e2e)
             for name, s in zip(PHASES, span.phase_s):
                 if s > 0.0:
                     self.phase_hist[name].record(s)
@@ -223,6 +256,23 @@ class PipelineTelemetry:
         with self._lock:
             self.quarantined += 1
         self._event("quarantine")
+
+    def add_sharded_compress(self, shards: int) -> None:
+        """Shard segments glz-compressed INLINE on the sharded staging
+        path (the compress-ahead worker does not cover sharded buffers
+        yet; this counter + the ``glz_compress`` phase span are the
+        evidence for extending it)."""
+        with self._lock:
+            self.sharded_compress_shards += shards
+
+    def add_slo_breach(self, key: str, detail: str = "") -> None:
+        """One SLO verdict transition into ``breach`` for ``key``
+        ("chain/rule"). Emits the flight-recorder instant event so the
+        breach lands on the Perfetto timeline next to the batch spans
+        it indicts."""
+        with self._lock:
+            self.slo_breaches[key] = self.slo_breaches.get(key, 0) + 1
+        self._event("slo-breach", detail or key)
 
     def record_breaker(self, name: str, state: str, transition: bool = True) -> None:
         if transition:
@@ -352,6 +402,41 @@ class PipelineTelemetry:
         with self._lock:
             return self.batch_latency[path].copy()
 
+    def chain_hist_copies(self) -> Dict[str, LatencyHistogram]:
+        """{chain signature: e2e histogram copy} under one lock hold."""
+        with self._lock:
+            return {c: h.copy() for c, h in self.chain_latency.items()}
+
+    def timeseries_sample(self) -> dict:
+        """ONE-lock cumulative capture for the rolling-window layer
+        (telemetry/timeseries.py): histogram copies + the monotone
+        counters the SLO rules window, + point-in-time gauges. All
+        fields come from the same instant, so window deltas cannot tear
+        across families."""
+        with self._lock:
+            return {
+                "generation": self._generation,
+                "chains": {
+                    c: h.copy() for c, h in self.chain_latency.items()
+                },
+                "paths": {
+                    p: h.copy() for p, h in self.batch_latency.items()
+                },
+                "compile_hist": self.compile_hist.copy(),
+                "counters": {
+                    "spills": sum(self.spills.values()),
+                    "retries": sum(self.retries.values()),
+                    "quarantined": self.quarantined,
+                    "compiles": sum(self.compiles.values()),
+                    "compile_seconds": sum(self.compile_seconds.values()),
+                    "recompile_storms": self.declines.get(
+                        "recompile-storm", 0
+                    ),
+                    "breaker_short_circuits": self.breaker_short_circuits,
+                },
+                "gauges": dict(self.gauges),
+            }
+
     def path_records(self) -> Dict[str, int]:
         """{path: records} — the bench diffs two of these around a timed
         run to report the path each config ACTUALLY executed on."""
@@ -374,6 +459,11 @@ class PipelineTelemetry:
                     for p, h in self.phase_hist.items()
                     if h.count
                 },
+                "chains": {
+                    c: h.to_dict()
+                    for c, h in self.chain_latency.items()
+                    if h.count
+                },
                 "counters": {
                     "heals": self.heals,
                     "stripe_fallbacks": self.stripe_fallbacks,
@@ -382,6 +472,10 @@ class PipelineTelemetry:
                     "link_variants": dict(self.link_variants),
                     "retries": dict(self.retries),
                     "quarantined": self.quarantined,
+                    "sharded_inline_compress_shards": (
+                        self.sharded_compress_shards
+                    ),
+                    "slo_breaches": dict(self.slo_breaches),
                     "breaker": {
                         "states": dict(self.breaker_states),
                         "transitions": dict(self.breaker_transitions),
@@ -427,10 +521,12 @@ class PipelineTelemetry:
     def reset(self) -> None:
         """Test/bench isolation helper — never called on the hot path."""
         with self._lock:
+            self._generation += 1
             for h in self.batch_latency.values():
                 h.__init__()
             for h in self.phase_hist.values():
                 h.__init__()
+            self.chain_latency = {}
             self.heals = 0
             self.stripe_fallbacks = 0
             self.spills = {}
@@ -438,6 +534,8 @@ class PipelineTelemetry:
             self.link_variants = {}
             self.retries = {}
             self.quarantined = 0
+            self.sharded_compress_shards = 0
+            self.slo_breaches = {}
             self.breaker_states = {}
             self.breaker_transitions = {}
             self.breaker_short_circuits = 0

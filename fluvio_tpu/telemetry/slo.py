@@ -1,0 +1,496 @@
+"""Chain-level SLO engine: declarative targets, multi-window burn-rate
+verdicts, and breach-triggered deep evidence capture.
+
+Diba (arXiv:2304.01659) argues a stream processor must budget its
+reconfiguration cliffs explicitly; for this engine those cliffs are
+first-call jit compiles (0.5–119 s, metered by PR-5 compile telemetry),
+interpreter spills, and unbounded queue growth. This module turns the
+raw cumulative telemetry into evaluated SLOs over rolling windows
+(telemetry/timeseries.py) — the machine-readable health signal the
+ROADMAP's admission-control/backpressure work keys on.
+
+Rules (defaults overridable via the ``FLUVIO_SLO`` grammar):
+
+==================  =====================================================
+``e2e_p99``         per-chain end-to-end p99 over the short window
+``spill_ratio``     (spills + interpreter batches) / batches
+``error_rate``      (retries + quarantined) / batches
+``compile_budget``  compile wall seconds per wall second of window
+``recompile_rate``  compiles per minute (the storm signal, windowed)
+``queue_depth``     ``inflight_queue_depth`` gauge ceiling
+``hbm_staged``      ``hbm_staged_bytes`` gauge ceiling
+==================  =====================================================
+
+Grammar — ``;``-separated entries, ``rule:field=value[,field=value]``::
+
+    FLUVIO_SLO="e2e_p99:target_ms=250;queue_depth:target=16;spill_ratio:off=1"
+
+Fields: ``target`` (rule units), ``target_ms`` (latency rules),
+``warn`` (warn fraction of target, default 0.75), ``off=1`` (disable).
+
+Burn-rate verdicts: each rule evaluates over the SHORT window (the most
+recent one) and the LONG window (everything retained). ``breach`` means
+the budget is being burned NOW (short over target, and long over target
+when long history exists); ``warn`` means the budget is consumed but
+burning has stopped (long over target, short clean) or observed is
+within the warn fraction of the target. Windows age out
+deterministically (injectable clock), so a verdict recovers to ``ok``
+without process restarts.
+
+Breach hook: every verdict TRANSITION into ``breach`` (per chain+rule)
+emits a flight-recorder instant event (Perfetto-visible next to the
+batch spans it indicts) and, when ``FLUVIO_SLO_PROFILE=<dir>`` is set,
+captures one bounded ``jax.profiler.trace`` window into that dir —
+device-level truth for the offending interval, at most one capture per
+``FLUVIO_SLO_PROFILE_COOLDOWN_S`` (default 60).
+
+Zero-cost contract: nothing here runs per batch. Evaluation is pulled
+by readers (health CLI, monitoring socket, Prometheus scrape); with
+``FLUVIO_TELEMETRY=0`` the evaluator returns a disabled verdict without
+touching the time-series layer.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Tuple
+
+from fluvio_tpu.telemetry.registry import TELEMETRY, PipelineTelemetry
+from fluvio_tpu.telemetry.timeseries import TimeSeries, WindowDelta
+
+from fluvio_tpu.analysis.lockwatch import make_lock
+
+logger = logging.getLogger(__name__)
+
+SLO_ENV = "FLUVIO_SLO"
+PROFILE_ENV = "FLUVIO_SLO_PROFILE"
+PROFILE_COOLDOWN_ENV = "FLUVIO_SLO_PROFILE_COOLDOWN_S"
+PROFILE_DWELL_MS_ENV = "FLUVIO_SLO_PROFILE_MS"
+
+# the engine-wide pseudo-chain the non-per-chain rules report under
+ENGINE_CHAIN = "_engine"
+
+VERDICTS = ("ok", "warn", "breach")
+_RANK = {v: i for i, v in enumerate(VERDICTS)}
+
+
+@dataclass(frozen=True)
+class SloRule:
+    """One declarative target. ``latency`` rules accept ``target_ms``
+    in the grammar; every rule accepts ``target``/``warn``/``off``."""
+
+    name: str
+    target: float
+    unit: str
+    per_chain: bool = False
+    latency: bool = False
+    warn_ratio: float = 0.75
+    enabled: bool = True
+
+
+DEFAULT_RULES: Tuple[SloRule, ...] = (
+    SloRule("e2e_p99", 2.0, "s", per_chain=True, latency=True),
+    SloRule("spill_ratio", 0.05, "ratio"),
+    SloRule("error_rate", 0.02, "ratio"),
+    SloRule("compile_budget", 0.25, "s/s"),
+    SloRule("recompile_rate", 8.0, "compiles/min"),
+    SloRule("queue_depth", 128.0, "chunks"),
+    SloRule("hbm_staged", 2e9, "bytes"),
+)
+
+
+def parse_slo_spec(
+    spec: str, base: Tuple[SloRule, ...] = DEFAULT_RULES
+) -> Tuple[SloRule, ...]:
+    """Apply a ``FLUVIO_SLO`` spec string to the default rule set.
+    Raises ValueError on malformed input (the env loader catches and
+    falls back to defaults; programmatic callers get the error)."""
+    rules = {r.name: r for r in base}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, fields = entry.partition(":")
+        name = name.strip()
+        if not sep or name not in rules:
+            raise ValueError(
+                f"unknown SLO rule {name!r} (known: {sorted(rules)})"
+            )
+        rule = rules[name]
+        for field in fields.split(","):
+            key, sep, value = field.partition("=")
+            key = key.strip()
+            if not sep:
+                raise ValueError(f"SLO field needs key=value, got {field!r}")
+            if key == "target":
+                rule = replace(rule, target=float(value))
+            elif key == "target_ms" and rule.latency:
+                rule = replace(rule, target=float(value) / 1000.0)
+            elif key == "warn":
+                rule = replace(rule, warn_ratio=float(value))
+            elif key == "off":
+                rule = replace(
+                    rule, enabled=value.strip().lower() in ("0", "false", "")
+                )
+            else:
+                raise ValueError(
+                    f"unknown SLO field {key!r} for rule {name!r}"
+                )
+        rules[name] = rule
+    return tuple(rules.values())
+
+
+def rules_from_env(env: Optional[dict] = None) -> Tuple[SloRule, ...]:
+    spec = (env or os.environ).get(SLO_ENV, "")
+    if not spec:
+        return DEFAULT_RULES
+    try:
+        return parse_slo_spec(spec)
+    except ValueError as e:
+        logger.error("ignoring malformed %s=%r: %s", SLO_ENV, spec, e)
+        return DEFAULT_RULES
+
+
+def _observe(rule: SloRule, delta: WindowDelta) -> Dict[str, float]:
+    """{chain: observed} for one rule over one window delta. A chain
+    (or the engine) with nothing to observe is simply absent."""
+    if rule.name == "e2e_p99":
+        return {
+            chain: h.percentile(99)
+            for chain, h in delta.chain_hists().items()
+        }
+    if rule.name in ("queue_depth", "hbm_staged"):
+        gauge = {
+            "queue_depth": "inflight_queue_depth",
+            "hbm_staged": "hbm_staged_bytes",
+        }[rule.name]
+        return {ENGINE_CHAIN: float(delta.gauges.get(gauge, 0.0))}
+    counters = delta.counters()
+    batches = delta.batches()
+    if rule.name == "spill_ratio":
+        if not batches:
+            return {}
+        paths = delta.path_hists()
+        interp = paths.get("interpreter")
+        spilled = counters.get("spills", 0) + (interp.count if interp else 0)
+        return {ENGINE_CHAIN: spilled / batches}
+    if rule.name == "error_rate":
+        if not batches:
+            return {}
+        errs = counters.get("retries", 0) + counters.get("quarantined", 0)
+        return {ENGINE_CHAIN: errs / batches}
+    if rule.name == "compile_budget":
+        return {
+            ENGINE_CHAIN: counters.get("compile_seconds", 0.0)
+            / delta.duration_s
+        }
+    if rule.name == "recompile_rate":
+        return {
+            ENGINE_CHAIN: counters.get("compiles", 0)
+            * 60.0
+            / delta.duration_s
+        }
+    return {}  # pragma: no cover — fixed rule vocabulary
+
+
+def _decide(
+    rule: SloRule, short: Optional[float], long: Optional[float]
+) -> str:
+    """Multi-window burn-rate verdict. ``breach`` = burning NOW (short
+    over target, long confirming when it exists); ``warn`` = budget
+    consumed but no longer burning, or observed inside the warn band."""
+    if short is None and long is None:
+        return "ok"
+    s_bad = short is not None and short > rule.target
+    l_bad = long is not None and long > rule.target
+    if s_bad and (long is None or l_bad):
+        return "breach"
+    warn_at = rule.warn_ratio * rule.target
+    if s_bad or l_bad:
+        return "warn"
+    if (short is not None and short > warn_at) or (
+        long is not None and long > warn_at
+    ):
+        return "warn"
+    return "ok"
+
+
+def worst(verdicts) -> str:
+    v = "ok"
+    for x in verdicts:
+        if _RANK.get(x, 0) > _RANK[v]:
+            v = x
+    return v
+
+
+class SloEngine:
+    """Evaluates the rule set against the time-series layer and owns
+    the breach hooks (instant event + bounded profiler capture)."""
+
+    def __init__(
+        self,
+        telemetry: Optional[PipelineTelemetry] = None,
+        timeseries: Optional[TimeSeries] = None,
+        rules: Optional[Tuple[SloRule, ...]] = None,
+        clock=time.monotonic,
+        profile_dir: Optional[str] = None,
+        profile_cooldown_s: Optional[float] = None,
+    ) -> None:
+        self.telemetry = telemetry if telemetry is not None else TELEMETRY
+        self.clock = clock
+        self.timeseries = (
+            timeseries
+            if timeseries is not None
+            else TimeSeries(self.telemetry, clock=clock)
+        )
+        self.rules = rules if rules is not None else rules_from_env()
+        self.profile_dir = (
+            profile_dir
+            if profile_dir is not None
+            else os.environ.get(PROFILE_ENV, "")
+        )
+        self.profile_cooldown_s = (
+            profile_cooldown_s
+            if profile_cooldown_s is not None
+            else float(os.environ.get(PROFILE_COOLDOWN_ENV, "60"))
+        )
+        self._lock = make_lock("telemetry.slo")
+        self._verdicts: Dict[Tuple[str, str], str] = {}
+        self._last_profile_t: Optional[float] = None
+        self._profile_seq = 0
+        self._profile_thread: Optional[threading.Thread] = None
+        self.profile_captures: List[str] = []
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, tick: bool = True) -> dict:
+        """The verdict document every health surface renders from (CLI
+        table, socket ``health`` mode, Prometheus verdict states, bench
+        ``slo`` block). Pull-based: ticks the window ring, computes
+        short/long observations, applies burn-rate logic, fires breach
+        hooks on transitions."""
+        if not self.telemetry.enabled:
+            return {"enabled": False, "verdict": "disabled", "chains": {}}
+        ts = self.timeseries
+        if tick:
+            ts.maybe_tick()
+        short = ts.delta(1)
+        long = ts.delta(ts.capacity)
+        chains: Dict[str, dict] = {}
+        transitions: List[Tuple[str, str, str]] = []
+        for rule in self.rules:
+            if not rule.enabled:
+                continue
+            s_obs = _observe(rule, short) if short is not None else {}
+            l_obs = _observe(rule, long) if long is not None else {}
+            names = set(s_obs) | set(l_obs)
+            if not rule.per_chain:
+                names.add(ENGINE_CHAIN)
+            with self._lock:
+                # a chain absent from BOTH windows has aged out of the
+                # retained history: drop its verdict memory so a future
+                # breach counts as a fresh transition (event + capture)
+                for key in [
+                    k
+                    for k in self._verdicts
+                    if k[1] == rule.name and k[0] not in names
+                ]:
+                    self._verdicts.pop(key)
+            for chain in names:
+                s = s_obs.get(chain)
+                l = l_obs.get(chain)
+                verdict = _decide(rule, s, l)
+                evidence = {
+                    "verdict": verdict,
+                    "target": rule.target,
+                    "unit": rule.unit,
+                    "observed": None if s is None else round(s, 6),
+                    "window_s": (
+                        round(short.duration_s, 3) if short else None
+                    ),
+                    "long_observed": None if l is None else round(l, 6),
+                    "long_window_s": (
+                        round(long.duration_s, 3) if long else None
+                    ),
+                }
+                entry = chains.setdefault(chain, {"rules": {}})
+                entry["rules"][rule.name] = evidence
+                key = (chain, rule.name)
+                with self._lock:
+                    prev = self._verdicts.get(key, "ok")
+                    self._verdicts[key] = verdict
+                    # bounded like the registry's breaker map: chains
+                    # age out of verdict memory with their histograms
+                    while len(self._verdicts) > 512:
+                        self._verdicts.pop(next(iter(self._verdicts)))
+                if verdict == "breach" and prev != "breach":
+                    transitions.append((chain, rule.name, _fmt_breach(
+                        chain, rule, s, l
+                    )))
+        for entry in chains.values():
+            entry["verdict"] = worst(
+                e["verdict"] for e in entry["rules"].values()
+            )
+        doc = {
+            "enabled": True,
+            "verdict": worst(e["verdict"] for e in chains.values()),
+            "window_s": ts.window_s,
+            "windows": ts.capacity,
+            "retained_windows": ts.retained_windows(),
+            "chains": chains,
+            "targets": {
+                r.name: {"target": r.target, "unit": r.unit}
+                for r in self.rules
+                if r.enabled
+            },
+        }
+        if short is not None:
+            doc["window"] = short.summary()
+        # hooks AFTER the document is assembled and all locks released;
+        # the profiler capture itself runs on a worker thread so a
+        # scrape-driven evaluation never stalls its caller
+        for chain, rule_name, detail in transitions:
+            self.telemetry.add_slo_breach(f"{chain}/{rule_name}", detail)
+            path = self._maybe_profile(detail)
+            if path:
+                doc.setdefault("profile_captures", []).append(path)
+        return doc
+
+    # -- breach-triggered profiler capture -----------------------------------
+
+    def _maybe_profile(self, detail: str) -> Optional[str]:
+        """Start a bounded ``jax.profiler.trace`` capture into the
+        configured dir, at most one per cooldown. The capture itself
+        (first-call jit compile + optional dwell — up to seconds) runs
+        on a WORKER thread: evaluate() is called from the monitoring
+        socket's asyncio handler and the Prometheus scrape path, and a
+        breach is exactly the moment those surfaces must stay live.
+        Returns the capture dir (filling asynchronously) or None."""
+        if not self.profile_dir:
+            return None
+        now = self.clock()
+        with self._lock:
+            if (
+                self._last_profile_t is not None
+                and now - self._last_profile_t < self.profile_cooldown_s
+            ):
+                return None
+            self._last_profile_t = now
+            self._profile_seq += 1
+            seq = self._profile_seq
+        path = os.path.join(self.profile_dir, f"slo_breach_{seq:03d}")
+        t = threading.Thread(
+            target=self._capture_profile, args=(path, detail), daemon=True,
+            name="slo-profile-capture",
+        )
+        self._profile_thread = t
+        t.start()
+        return path
+
+    def _capture_profile(self, path: str, detail: str) -> None:
+        """Worker-thread body. Never raises: a failed capture must not
+        take anything with it."""
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            dwell_ms = float(os.environ.get(PROFILE_DWELL_MS_ENV, "0"))
+            jax.profiler.start_trace(path)
+            try:
+                # one tiny dispatch guarantees device activity inside
+                # the capture window even on an idle engine; the dwell
+                # (bounded at 1 s) widens the window so in-flight
+                # batches land in it
+                jax.jit(lambda x: x + 1)(jnp.float32(1.0)).block_until_ready()
+                if dwell_ms > 0:
+                    time.sleep(min(dwell_ms, 1000.0) / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — capture is best-effort
+            logger.warning("SLO breach profiler capture failed: %s", e)
+            return
+        logger.warning("SLO breach (%s): device profile -> %s", detail, path)
+        with self._lock:
+            self.profile_captures.append(path)
+
+    def join_profile_capture(self, timeout: Optional[float] = None) -> None:
+        """Wait for an in-flight breach capture to finish (tests +
+        orderly shutdown)."""
+        t = self._profile_thread
+        if t is not None:
+            t.join(timeout)
+
+
+def _fmt_breach(
+    chain: str, rule: SloRule, short: Optional[float], long: Optional[float]
+) -> str:
+    s = "n/a" if short is None else f"{short:.6g}"
+    l = "n/a" if long is None else f"{long:.6g}"
+    return (
+        f"{chain}/{rule.name} observed={s} long={l} "
+        f"target={rule.target:.6g}{rule.unit}"
+    )
+
+
+def summarize(doc: dict) -> dict:
+    """Compact per-run record for BENCH_DETAIL.json: the overall
+    verdict, per-rule worst observation vs target, and which chains
+    breached — small enough to ride every config entry."""
+    if not doc.get("enabled"):
+        return {"verdict": "disabled"}
+    rules: Dict[str, dict] = {}
+    for chain, entry in (doc.get("chains") or {}).items():
+        for name, ev in (entry.get("rules") or {}).items():
+            cur = rules.get(name)
+            obs = ev.get("observed")
+            if cur is None or (
+                obs is not None
+                and (cur.get("observed") is None or obs > cur["observed"])
+            ):
+                rules[name] = {
+                    "observed": obs,
+                    "target": ev.get("target"),
+                    "verdict": ev.get("verdict"),
+                    "chain": chain,
+                }
+    out = {"verdict": doc.get("verdict", "ok"), "rules": rules}
+    breached = sorted(
+        chain
+        for chain, entry in (doc.get("chains") or {}).items()
+        if entry.get("verdict") == "breach"
+    )
+    if breached:
+        out["breached_chains"] = breached
+    return out
+
+
+# -- process-global engine (the socket/CLI/Prometheus surfaces share it
+# so verdict-transition memory and profile cooldowns are coherent) -----------
+
+_ENGINE: Optional[SloEngine] = None
+_ENGINE_LOCK = make_lock("telemetry.slo_singleton")
+
+
+def engine() -> SloEngine:
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None:
+            _ENGINE = SloEngine()
+        return _ENGINE
+
+
+def reset_engine() -> None:
+    """Drop the process-global engine (tests re-read env on next use)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        _ENGINE = None
+
+
+def health_snapshot() -> dict:
+    """Evaluate the process-global engine — the monitoring socket's
+    ``health`` mode and the ``fluvio-tpu health --local`` path."""
+    return engine().evaluate()

@@ -52,11 +52,11 @@ class BatchSpan:
     driving the batch (ring insertion at `end` is what synchronizes)."""
 
     __slots__ = (
-        "t0", "t_end", "phase_s", "phase_t0", "records", "path",
+        "t0", "t_end", "phase_s", "phase_t0", "records", "path", "chain",
         "dispatch_end", "ready_t",
     )
 
-    def __init__(self, path: str = "fused") -> None:
+    def __init__(self, path: str = "fused", chain: str = "") -> None:
         self.t0 = time.perf_counter()
         self.t_end: Optional[float] = None
         self.phase_s: List[float] = [0.0] * len(PHASES)
@@ -66,6 +66,10 @@ class BatchSpan:
         self.phase_t0: List[float] = [0.0] * len(PHASES)
         self.records = 0
         self.path = path
+        # chain identity (the executor's compact chain signature, e.g.
+        # "filter+map"): keys the per-chain latency family the SLO
+        # engine's windowed verdicts evaluate; "" = unattributed
+        self.chain = chain
         # set by mark_dispatched; the device phase measures from here
         self.dispatch_end: Optional[float] = None
         # when the first blocking result sync returned (finish-side
@@ -100,6 +104,10 @@ class BatchSpan:
         d = {
             "path": self.path,
             "records": self.records,
+        }
+        if self.chain:
+            d["chain"] = self.chain
+        d |= {
             "e2e_ms": round(
                 ((self.t_end if self.t_end is not None else time.perf_counter())
                  - self.t0) * 1000, 3,

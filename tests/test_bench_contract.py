@@ -179,6 +179,22 @@ def _full_config(rps: int, x: float, path: str = "fused") -> dict:
         # ISSUE-6: per-config preflight record (predicted-vs-actual
         # executed path from the static analyzer, full detail file-only)
         "preflight": {"path": path, "actual": path, "agree": True},
+        # SLO-PR satellite: per-config verdict block (targets, observed
+        # windows, verdict) — full detail file-only; the compact line
+        # carries one worst-of-suite slo key
+        "slo": {
+            "verdict": "ok",
+            "rules": {
+                "e2e_p99": {
+                    "observed": 1.698, "target": 2.0, "verdict": "ok",
+                    "chain": "filter+map",
+                },
+                "spill_ratio": {
+                    "observed": 0.0, "target": 0.05, "verdict": "ok",
+                    "chain": "_engine",
+                },
+            },
+        },
     }
 
 
@@ -271,6 +287,11 @@ def test_compact_line_fits_driver_window():
     # in BENCH_DETAIL.json
     assert parsed["preflight"] == {"agree": 7, "of": 7}
     assert "preflight" not in parsed["configs"]["2_filter_map"]
+    # SLO satellite: ONE tiny worst-of-suite verdict key on the line;
+    # the per-config blocks (targets, observed windows) stay in
+    # BENCH_DETAIL.json
+    assert parsed["slo"] == "ok"
+    assert "slo" not in parsed["configs"]["2_filter_map"]
 
 
 def test_compact_line_trims_pathological_blowup_keeps_link():
@@ -407,6 +428,36 @@ def test_staging_ab_and_glz_fields_survive_the_emit():
     assert got["staging_ab"]["chosen"] == "glz"
     assert got["glz_ratio"] == 0.476
 
+
+
+def test_slo_line_key_is_worst_of_suite():
+    """A single breached config colors the whole line's slo key, and
+    the per-config block still rides BENCH_DETAIL.json untouched."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    cfg_ok = dict(GOOD)
+    cfg_ok["slo"] = {"verdict": "ok", "rules": {}}
+    cfg_bad = dict(GOOD)
+    cfg_bad["slo"] = {
+        "verdict": "breach",
+        "rules": {
+            "e2e_p99": {"observed": 9.1, "target": 2.0,
+                        "verdict": "breach", "chain": "filter+map"},
+        },
+        "breached_chains": ["filter+map"],
+    }
+    out, rc = b._build_output(
+        {"2_filter_map": cfg_ok, "5_windowed": cfg_bad}
+    )
+    assert rc == 0
+    assert out["configs"]["5_windowed"]["slo"]["verdict"] == "breach"
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["slo"] == "breach"
+    # configs without any slo block leave the key off entirely
+    out2, _ = b._build_output({"2_filter_map": dict(GOOD)})
+    assert "slo" not in json.loads(json.dumps(b._compact_line(out2)))
 
 
 def test_preflight_counts_disagreement_and_unjudged():

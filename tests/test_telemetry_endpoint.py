@@ -40,7 +40,7 @@ class _Ctx:
 
 def _populate():
     """Drive representative traffic into every counter family."""
-    span = TELEMETRY.begin_batch()
+    span = TELEMETRY.begin_batch(chain="filter+map")
     span.add("stage", 0.002)
     span.add("dispatch", 0.001)
     span.add("device", 0.010)
@@ -73,6 +73,9 @@ _SAMPLE_RE = re.compile(
 DECLARED_SERIES = [
     "fluvio_tpu_batch_latency_seconds",
     "fluvio_tpu_phase_seconds",
+    "fluvio_tpu_chain_e2e_latency_seconds",
+    "fluvio_tpu_sharded_inline_compress_shards_total",
+    "fluvio_tpu_slo_verdict",
     "fluvio_tpu_batch_records_total",
     "fluvio_tpu_glz_heals_total",
     "fluvio_tpu_stripe_fallbacks_total",
@@ -127,6 +130,77 @@ class TestExpositionFormat:
                 for l in text.splitlines()
                 if not l.startswith("#")
             ), f"series {series} has no samples"
+
+    def test_every_histogram_family_emits_sum_count_with_parity(self):
+        """SLO-PR satellite: every latency family must expose ``_sum``
+        and ``_count`` (scrapers cannot compute true means from buckets
+        alone), and both must agree exactly with the JSON snapshot's
+        totals for the same instant."""
+        _populate()
+        TELEMETRY.add_compile("ragged", "sig", 0.25)
+        text = render_prometheus()
+        snap = TELEMETRY.snapshot()
+        # discover every declared histogram family from the exposition
+        families = [
+            line.split(" ")[2]
+            for line in text.splitlines()
+            if line.startswith("# TYPE ") and line.endswith(" histogram")
+        ]
+        assert set(families) >= {
+            "fluvio_tpu_batch_latency_seconds",
+            "fluvio_tpu_phase_seconds",
+            "fluvio_tpu_chain_e2e_latency_seconds",
+            "fluvio_tpu_compile_latency_seconds",
+        }
+        for family in families:
+            sums = [
+                l for l in text.splitlines()
+                if l.startswith(f"{family}_sum")
+            ]
+            counts = [
+                l for l in text.splitlines()
+                if l.startswith(f"{family}_count")
+            ]
+            assert sums and counts, f"{family} missing _sum/_count"
+            assert len(sums) == len(counts)
+        # exact parity against the snapshot totals (count is integral,
+        # sum within the snapshot's own rounding)
+        for path, b in snap["batches"].items():
+            assert b["count"] == _sample_value(
+                text,
+                "fluvio_tpu_batch_latency_seconds_count",
+                f'{{path="{path}"}}',
+            )
+            assert _sample_value(
+                text,
+                "fluvio_tpu_batch_latency_seconds_sum",
+                f'{{path="{path}"}}',
+            ) == pytest.approx(b["sum_s"], abs=1e-5)
+        for phase, h in snap["phases"].items():
+            assert h["count"] == _sample_value(
+                text, "fluvio_tpu_phase_seconds_count",
+                f'{{phase="{phase}"}}',
+            )
+            assert _sample_value(
+                text, "fluvio_tpu_phase_seconds_sum",
+                f'{{phase="{phase}"}}',
+            ) == pytest.approx(h["sum_s"], abs=1e-5)
+        for chain, h in snap["chains"].items():
+            assert h["count"] == _sample_value(
+                text, "fluvio_tpu_chain_e2e_latency_seconds_count",
+                f'{{chain="{chain}"}}',
+            )
+            assert _sample_value(
+                text, "fluvio_tpu_chain_e2e_latency_seconds_sum",
+                f'{{chain="{chain}"}}',
+            ) == pytest.approx(h["sum_s"], abs=1e-5)
+        comp = snap["compile"]["latency"]
+        assert comp["count"] == _sample_value(
+            text, "fluvio_tpu_compile_latency_seconds_count"
+        )
+        assert _sample_value(
+            text, "fluvio_tpu_compile_latency_seconds_sum"
+        ) == pytest.approx(comp["sum_s"], abs=1e-5)
 
     def test_histogram_invariants(self):
         ctx = _populate()

@@ -256,6 +256,94 @@ def test_glz_chooser_zero_cost_when_disabled(monkeypatch):
     _one_pass(executor, buf)  # any glz touch raises
 
 
+def test_slo_sampler_overhead_under_gate():
+    """SLO-PR CI satellite: the time-series sampler + SLO evaluator,
+    armed and evaluating once per pass (a far hotter cadence than any
+    real scraper), must stay inside the same <2% rps gate. The layer is
+    pull-based — per batch it adds exactly one chain-histogram record —
+    so the honest cost is the evaluation itself, amortized over the
+    pass."""
+    from fluvio_tpu.telemetry import SloEngine, TimeSeries
+
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    buf = _corpus_buf()
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+
+    # tiny window so every evaluation really ticks + diffs the ring
+    eng = SloEngine(timeseries=TimeSeries(window_s=1e-3, capacity=8))
+    eng.evaluate()
+
+    def _measure_slo():
+        times = {"bare": [], "armed": []}
+        for _ in range(PASSES_PER_ARM):
+            for arm in ("bare", "armed"):
+                t0 = time.perf_counter()
+                for out in executor.process_stream(
+                    iter([buf] * BATCHES_PER_PASS)
+                ):
+                    pass
+                if arm == "armed":
+                    doc = eng.evaluate()
+                    assert doc["enabled"] is True
+                times[arm].append(
+                    (time.perf_counter() - t0) / BATCHES_PER_PASS
+                )
+        return min(times["bare"]), min(times["armed"])
+
+    for attempt in range(5):
+        bare_s, armed_s = _measure_slo()
+        overhead = max(armed_s - bare_s, 0.0)
+        if overhead <= bare_s * GATE or overhead < 500e-6:
+            break
+    else:
+        raise AssertionError(
+            f"slo sampler+evaluator cost {overhead*1e6:.0f}us/batch on a "
+            f"{bare_s*1e3:.2f}ms batch — exceeds the {GATE:.0%} gate "
+            f"after 5 measurement rounds"
+        )
+    rps_bare = N_RECORDS / bare_s
+    rps_armed = N_RECORDS / armed_s
+    assert rps_armed >= rps_bare * (1 - GATE) or overhead < 500e-6
+
+
+def test_slo_seams_zero_cost_when_telemetry_off(monkeypatch):
+    """SLO-PR CI satellite, the strict half: with FLUVIO_TELEMETRY=0
+    the whole windowed/SLO layer must be ZERO work — tripwires on the
+    registry sampler and the window ring prove neither is touched, and
+    the evaluator returns a disabled verdict without evaluating."""
+    from fluvio_tpu.telemetry import SloEngine, TimeSeries
+    from fluvio_tpu.telemetry import timeseries as ts_mod
+
+    TELEMETRY.reset()
+    prior = TELEMETRY.enabled
+    TELEMETRY.enabled = False
+    try:
+
+        def tripwire(*a, **k):
+            raise AssertionError("slo seam touched with telemetry off")
+
+        monkeypatch.setattr(TELEMETRY, "timeseries_sample", tripwire)
+        monkeypatch.setattr(ts_mod, "_Cum", tripwire)
+        ts = TimeSeries(window_s=1e-3, capacity=4)
+        eng = SloEngine(timeseries=ts)
+        assert ts.maybe_tick() == 0
+        ts.force_tick()
+        doc = eng.evaluate()
+        assert doc == {"enabled": False, "verdict": "disabled", "chains": {}}
+        # the hot-path seam: a disabled begin_batch hands back None, so
+        # the per-chain histogram family records nothing
+        chain = _headline_chain()
+        buf = _corpus_buf()
+        for out in chain.tpu_chain.process_stream(iter([buf] * 2)):
+            pass
+        assert TELEMETRY.chain_hist_copies() == {}
+    finally:
+        TELEMETRY.enabled = prior
+        TELEMETRY.reset()
+
+
 def test_telemetry_disabled_skips_span_capture_entirely():
     """The off switch must mean OFF: no spans, no histogram writes."""
     chain = _headline_chain()
@@ -270,6 +358,7 @@ def test_telemetry_disabled_skips_span_capture_entirely():
         assert snap["spans_total"] == 0
         assert snap["batches"]["fused"]["count"] == 0
         assert not snap["phases"]
+        assert not snap["chains"]  # per-chain family is span-gated too
         # ISSUE-5: the compile/gauge/event seams are zero-cost too —
         # nothing may record while capture is off
         assert snap["compile"]["by_kind"] == {}
