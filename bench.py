@@ -40,11 +40,11 @@ def log(msg: str) -> None:
     print(f"[{time.time()-_T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-def build_chain(backend: str, specs):
+def build_chain(backend: str, specs, mesh: int = 0):
     from fluvio_tpu.models import lookup
     from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
 
-    b = SmartEngine(backend=backend).builder()
+    b = SmartEngine(backend=backend, mesh_devices=mesh or 0).builder()
     for name, params in specs:
         b.add_smart_module(SmartModuleConfig(params=params or {}), lookup(name))
     return b.initialize()
@@ -174,6 +174,18 @@ CONFIGS = {
         "specs": [("regex-filter", {"regex": "fluvio"})],
         "corpus": gen_fat_70k,
         "divisor": 1024,
+    },
+    # sharded striped: the one compressed-staging exclusion left (PR-8)
+    # — sharded wide batches ship raw with the per-batch
+    # `glz-wide-unsupported` decline. This config exists so the
+    # per-config `link` block carries that decline attribution (the
+    # compress-ahead-worker decision's missing evidence); it skips
+    # cleanly when the backend has fewer devices than the mesh.
+    "8_sharded_fat": {
+        "specs": [("regex-filter", {"regex": "fluvio"})],
+        "corpus": gen_fat_70k,
+        "divisor": 1024,
+        "mesh": 8,
     },
 }
 
@@ -476,6 +488,15 @@ def _run_config(
         n = max(n // divisor, 1024)
     base_n = min(n, 2000 if smoke else 20000)
 
+    mesh = int(cfg.get("mesh", 0))
+    if mesh:
+        import jax
+
+        n_dev = len(jax.devices())
+        if n_dev < mesh:
+            log(f"[{name}] skipped: mesh={mesh} but {n_dev} device(s)")
+            return {"skipped": f"needs {mesh} devices (have {n_dev})"}
+
     log(f"[{name}] generating {n} records ...")
     values = cfg["corpus"](n)
     ts = cfg["ts"](n) if "ts" in cfg else None
@@ -489,7 +510,7 @@ def _run_config(
         from fluvio_tpu.analysis import preflight_for_specs
 
         preflight = preflight_for_specs(
-            cfg["specs"], max(len(v) for v in values)
+            cfg["specs"], max(len(v) for v in values), sharded=bool(mesh)
         )
         log(f"  preflight: predicted path {preflight['path']}")
     except Exception as e:  # noqa: BLE001 — analysis must never cost a run
@@ -522,8 +543,37 @@ def _run_config(
         log(f"  slo engine unavailable: {type(e).__name__}: {e}")
 
     verify_outputs(cfg["specs"], values, ts, min(n, 512))
-    chain = build_chain("tpu", cfg["specs"])
+    chain = build_chain("tpu", cfg["specs"], mesh=mesh)
     assert chain.backend_in_use == "tpu", name
+
+    # admission satellite: with the AOT warmup gate armed, precompile
+    # this corpus's shape bucket BEFORE the measurement — the bench's
+    # per-config `compile` delta then reads ZERO serve-time compiles
+    # (the acceptance signal) and the `admission` block records what
+    # the warmup paid
+    adm_warm = None
+    adm0 = None
+    try:
+        from fluvio_tpu.admission import warmup as adm_warmup
+        from fluvio_tpu.telemetry import TELEMETRY as _TEL
+
+        adm0 = dict(_TEL.admission)
+        if adm_warmup.warmup_enabled() and not chain.tpu_chain._fanout:
+            # exact-coverage warmup: dispatch the corpus buffer's shape
+            # TWIN (same rows/width/flat buckets, synthetic bytes), so
+            # the measured passes below compile NOTHING — the per-config
+            # `compile` delta is the zero-serve-compiles acceptance pin.
+            # Fan-out chains skip it: the twin's element density would
+            # perturb the learned capacity ratio the real corpus needs
+            rep = adm_warmup.warm_buffer(chain.tpu_chain, buf)
+            adm_warm = {
+                "buckets": len(rep.buckets),
+                "compiles": rep.compiles,
+                "compile_s": round(rep.compile_s, 2),
+            }
+            log(f"  admission warmup: {adm_warm}")
+    except Exception as e:  # noqa: BLE001 — admission must never cost a run
+        log(f"  admission warmup failed: {type(e).__name__}: {e}")
     try:
         (out, times, first_call, link_mb, phases, path_info, compile_info,
          link_info) = bench_tpu(chain, buf, runs, passes, deadline)
@@ -650,6 +700,28 @@ def _run_config(
         "path": path_info["path"],
         "path_records": path_info["records"],
     }
+    if adm0 is not None:
+        # admission evidence: shed decisions during the measurement +
+        # the warmed-bucket count (compact line carries a tiny
+        # adm:{shed,warm} key; this block is the detail-file record)
+        try:
+            from fluvio_tpu.admission.types import SHED_REASONS
+            from fluvio_tpu.telemetry import TELEMETRY as _TEL2
+
+            shed = sum(
+                v - adm0.get(k, 0)
+                for k, v in dict(_TEL2.admission).items()
+                if k in SHED_REASONS
+            )
+            if adm_warm is not None or shed:
+                result["admission"] = {
+                    "shed": shed,
+                    "warm": (adm_warm or {}).get("buckets", 0),
+                }
+                if adm_warm is not None:
+                    result["admission"]["warmup"] = adm_warm
+        except Exception:  # noqa: BLE001 — admission must never cost a run
+            pass
     if slo_eng is not None:
         # per-config SLO verdict (targets, observed windows, verdict):
         # full block in BENCH_DETAIL.json; the compact line carries one
@@ -1052,6 +1124,23 @@ def _preflight_counts(configs: dict):
     return {"agree": sum(1 for a in judged if a), "of": len(judged)}
 
 
+def _admission_counts(configs: dict):
+    """Suite-wide admission evidence for the compact line's tiny
+    ``adm`` key: total shed decisions + total warmed buckets. None when
+    no config carried an admission block (controller unarmed)."""
+    blocks = [
+        c["admission"]
+        for c in configs.values()
+        if isinstance(c, dict) and isinstance(c.get("admission"), dict)
+    ]
+    if not blocks:
+        return None
+    return {
+        "shed": sum(int(b.get("shed", 0)) for b in blocks),
+        "warm": sum(int(b.get("warm", 0)) for b in blocks),
+    }
+
+
 def _slo_verdict(configs: dict):
     """Worst per-config SLO verdict across the suite — the compact
     line's tiny ``slo`` key; full per-config blocks (targets, observed
@@ -1144,6 +1233,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         sv = _slo_verdict(out["configs"])
         if sv:
             compact["slo"] = sv
+        adm = _admission_counts(out["configs"])
+        if adm:
+            compact["adm"] = adm
     if "cpu_fallback" in out:
         inner = out["cpu_fallback"]
         compact["cpu_fallback"] = {
@@ -1156,8 +1248,8 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "slo", "preflight", "compile", "phases",
-        "error", "xla_cache", "link",
+        "configs", "cpu_fallback", "adm", "slo", "preflight", "compile",
+        "phases", "error", "xla_cache", "link",
     ):
         if len(json.dumps(compact)) <= limit:
             break

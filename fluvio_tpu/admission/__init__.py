@@ -1,0 +1,85 @@
+"""Production admission control: the front door between the SPU slice
+path and the executor.
+
+Four cooperating pieces (ROADMAP "Production admission controller"):
+
+- `warmup`     — AOT shape-bucket warmup: walk the PR-6 jaxpr-lint
+                 work list and precompile every bucket against the
+                 persistent ``.xla_cache`` before serving
+                 (``fluvio-tpu warmup`` + the serve-time gate);
+- `controller` — backpressure/load-shedding keyed on the PR-9 health
+                 verdicts: token/credit admission per chain, warn
+                 sheds probabilistically, breach sheds hard with a
+                 typed `Rejected` decline; breaker-open shares the
+                 decline surface;
+- `fairness`   — weighted round-robin over bounded per-chain queues,
+                 with the PR-5 recompile-storm detector as the weight-
+                 penalty trip signal;
+- `batcher`    — adaptive shape-bucket batching: coalesce admitted
+                 slices across tenants into the warmed buckets,
+                 dispatch at bucket-full or deadline, never a cold
+                 bucket, never a premature half-full dispatch.
+
+Armed by ``FLUVIO_ADMISSION=1``; disabled, the broker seam resolves to
+None once and costs nothing.
+"""
+
+from fluvio_tpu.admission.batcher import (
+    Flush,
+    ShapeBucketBatcher,
+    coalesce_buffers,
+    split_output,
+)
+from fluvio_tpu.admission.controller import (
+    AdmissionController,
+    AdmissionPipeline,
+    TokenBucket,
+    admission_enabled,
+    gate,
+    reset_gate,
+    set_gate,
+)
+from fluvio_tpu.admission.fairness import FairQueue
+from fluvio_tpu.admission.types import SHED_REASONS, Decision, Rejected
+from fluvio_tpu.admission.warmup import (
+    WarmupReport,
+    default_rows,
+    default_widths,
+    probe_like,
+    reset_warm_registry,
+    warm_buffer,
+    warm_entries,
+    warm_executor,
+    warm_specs,
+    warmup_enabled,
+    work_list,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionPipeline",
+    "Decision",
+    "FairQueue",
+    "Flush",
+    "Rejected",
+    "SHED_REASONS",
+    "ShapeBucketBatcher",
+    "TokenBucket",
+    "WarmupReport",
+    "admission_enabled",
+    "coalesce_buffers",
+    "default_rows",
+    "default_widths",
+    "gate",
+    "probe_like",
+    "reset_gate",
+    "set_gate",
+    "reset_warm_registry",
+    "split_output",
+    "warm_buffer",
+    "warm_entries",
+    "warm_executor",
+    "warm_specs",
+    "warmup_enabled",
+    "work_list",
+]

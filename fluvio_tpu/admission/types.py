@@ -1,0 +1,67 @@
+"""Typed admission decisions.
+
+The admission controller never raises into the serving path: every
+outcome is a value. ``Decision`` (truthy) admits; ``Rejected`` — a
+`Decision` subclass so callers can isinstance-dispatch OR truth-test —
+is the typed decline the broker turns into backpressure (hold the
+slice, retry after ``retry_after_s``), and the chaos suite's
+exactly-once accounting turns into a resubmit. Reasons use one stable
+vocabulary, shared with the ``TELEMETRY.admission`` counter family and
+the Prometheus ``admission_decisions_total`` export:
+
+==================  ======================================================
+``admit``           admitted (token charged)
+``breach-shed``     chain (or engine queue/HBM rule) verdict is breach
+``warn-shed``       probabilistic shed under a warn verdict
+``no-tokens``       per-chain token bucket empty (credit exhausted)
+``queue-full``      the chain's bounded admission queue is at capacity
+``breaker-open``    the chain's circuit breaker is open (shared decline
+                    surface: breaker-open and shed are one vocabulary)
+``cold-chain``      warmup required (serve gate) and the chain's shape
+                    buckets have not been precompiled yet
+==================  ======================================================
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+def env_float(name: str, default: float) -> float:
+    """One home for the FLUVIO_ADMISSION_* numeric knob parse (a bad
+    value falls back to the default; admission must never crash a
+    server over an env typo)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One admission decision for one chain's slice."""
+
+    admitted: bool
+    chain: str = ""
+    reason: str = "admit"
+    verdict: str = "ok"  # the health verdict that drove the decision
+    retry_after_s: float = 0.0  # backpressure hint (sheds only)
+
+    def __bool__(self) -> bool:
+        return self.admitted
+
+
+@dataclass(frozen=True)
+class Rejected(Decision):
+    """Typed decline — never an exception into the client. The broker
+    holds the slice (offsets do not advance, so nothing is lost or
+    duplicated) and retries after ``retry_after_s``."""
+
+    admitted: bool = False
+
+
+SHED_REASONS = (
+    "breach-shed", "warn-shed", "no-tokens", "queue-full",
+    "breaker-open", "cold-chain",
+)

@@ -109,14 +109,19 @@ def analyze_chain(
 
 
 def preflight_for_specs(
-    specs: Sequence[Tuple[str, Optional[dict]]], width: int
+    specs: Sequence[Tuple[str, Optional[dict]]],
+    width: int,
+    sharded: bool = False,
 ) -> dict:
     """Compact per-config preflight record for the bench: the predicted
     path + reason strings for one chain spec at one record width.
-    ``specs`` is the bench-matrix format: ``[(model name, params)]``."""
+    ``specs`` is the bench-matrix format: ``[(model name, params)]``;
+    ``sharded`` predicts for the multi-device (shard_map) engine mode —
+    its striped configs additionally predict the raw link ship with the
+    ``glz-wide-unsupported`` decline."""
     from fluvio_tpu.analysis.spec import analyze_named
 
-    report = analyze_named(specs, widths=(width,))
+    report = analyze_named(specs, widths=(width,), sharded=sharded)
     pred = report.predictions[0]
     out = {"path": pred.path, "link_variant": pred.link_variant}
     if pred.spill_reasons:

@@ -67,7 +67,7 @@ ALLOWED_TELEMETRY_SEAMS = {
     "add_stripe_fallback",
     "add_retry", "add_quarantine", "add_compile", "add_jit_hit",
     "add_interp_instance", "add_breaker_short_circuit", "record_breaker",
-    "add_sharded_compress", "add_slo_breach",
+    "add_sharded_compress", "add_slo_breach", "add_admission",
     "gauge_add", "gauge_set",
 }
 

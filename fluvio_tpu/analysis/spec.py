@@ -399,6 +399,31 @@ def _striped_literal_check(kind: str, lit: bytes, s: int, v: int) -> None:
         )
 
 
+def _jsonget_source_mirror(arg) -> Optional[str]:
+    """Mirror of `stripes._jsonget_source`: the JsonGet key when ``arg``
+    is a (postop-folded) single-level JsonGet over the record value,
+    None otherwise; raises for nested/structural JsonGet args."""
+    expr = arg
+    while isinstance(expr, (dsl.Upper, dsl.Lower)):
+        expr = expr.arg
+    if not isinstance(expr, dsl.JsonGet):
+        return None
+    pre = _value_postops_mirror(expr.arg)
+    if pre is None:
+        raise _NotStriped("striped JsonGet must read the record value")
+    return expr.key
+
+
+def _striped_json_literal_check(lit: bytes, v: int) -> None:
+    """Mirror of `stripes._lower_striped_json_literal`'s overlap gate
+    (every kind needs containment — the field can start anywhere)."""
+    if len(lit) > v:
+        raise _NotStriped(
+            f"JsonGet-sourced literal of {len(lit)} bytes exceeds the "
+            f"stripe overlap ({v})"
+        )
+
+
 def _striped_predicate_check(expr, gates, s: int, v: int, declines) -> None:
     """Mirror of `stripes.lower_striped_predicate` (argument order
     included, so predicted declines count like runtime ones)."""
@@ -413,6 +438,9 @@ def _striped_predicate_check(expr, gates, s: int, v: int, declines) -> None:
         _seg_exact_check(expr)
         return
     if isinstance(expr, (dsl.Contains, dsl.StartsWith, dsl.EndsWith)):
+        if _jsonget_source_mirror(expr.arg) is not None:
+            _striped_json_literal_check(expr.literal, v)
+            return
         postops = _value_postops_mirror(expr.arg)
         if postops is None:
             _seg_exact_check(expr)
@@ -425,6 +453,14 @@ def _striped_predicate_check(expr, gates, s: int, v: int, declines) -> None:
         _striped_literal_check(kind, expr.literal, s, v)
         return
     if isinstance(expr, dsl.RegexMatch):
+        if _jsonget_source_mirror(expr.arg) is not None:
+            info = literal_of(expr.pattern)
+            if info is None:
+                raise _NotStriped(
+                    "JsonGet-sourced regex predicate is not stripeable"
+                )
+            _striped_json_literal_check(info[0], v)
+            return
         postops = _value_postops_mirror(expr.arg)
         if postops is None:
             raise _NotStriped("striped regex must read the record value")

@@ -1025,7 +1025,7 @@ class TpuChainExecutor:
         0 for span-free striped chains, so they keep their
         width-independent compile key."""
         sc = self._striped_chain()
-        if sc is None or not sc.has_span:
+        if sc is None or not sc.needs_kmax:
             return 0
         return int(
             stripes.stripe_counts(

@@ -40,9 +40,14 @@ data-dependent):
   stripe joints, gated on ``FLUVIO_DFA_ASSOC_MAX_STATES``); a
   single-level ``JsonGet`` map carries the structural machine state
   across stripes (`striped_json_span`) and ships view descriptors;
-  ``JsonGet``-sourced predicates, ``word_count``, and ``json_array``
-  explodes remain outside the subset — chains containing them keep the
-  interpreter spill for wide batches;
+  ``JsonGet``-sourced LITERAL predicates run fused too — the same
+  cross-stripe span machine resolves the field's absolute span and a
+  windowed compare matches inside it (`striped_literal_in_span`;
+  literals bounded by the overlap, exactly like record-level
+  containment) — while JsonGet-sourced non-literal regexes,
+  ``word_count``, and ``json_array`` explodes remain outside the
+  subset — chains containing them keep the interpreter spill for wide
+  batches;
 - ``ParseInt`` contributions parse the record's leading int from the
   first stripe: a record whose int prefix (whitespace + sign + digits)
   extends past ``STRIPE_WIDTH`` bytes parses only the in-stripe prefix.
@@ -264,6 +269,54 @@ def striped_json_span(sv, plan, lengths, key: str, kmax: int, n: int):
     return kernels.json_span_finalize(final, lengths, lengths)
 
 
+def striped_literal_in_span(sv, plan, lit: bytes, vst, vln, kind: str, n: int):
+    """Literal predicate evaluated INSIDE a per-segment field span.
+
+    ``(vst, vln)`` are slab-absolute (start, length) descriptors (from
+    `striped_json_span`); the literal matches only where its window lies
+    wholly within ``[vst, vst+vln)``. Per stripe row the windowed
+    compare runs at OWNED byte positions: a window of ≤ overlap bytes
+    starting at an owned byte is wholly contained in its row (non-last
+    rows hold ``step + overlap = s`` bytes; last rows run to record
+    end), so the per-row verdict OR per segment is exact — the same
+    containment argument as record-level literals, shifted into the
+    extracted field's absolute span. ``kind``: contains | startswith |
+    endswith | equals (position-pinned against the span bounds).
+    """
+    r, s = sv.shape
+    k = len(lit)
+    if k == 0:
+        # parity with the narrow kernels: an empty literal matches every
+        # field for contains/startswith/endswith — but "equals" (an
+        # anchored-empty regex like ^$) still requires the FIELD to be
+        # empty, exactly like literal_startswith(b"") & (len == 0)
+        if kind == "equals":
+            return vln.astype(jnp.int32) == 0
+        return jnp.ones((n,), dtype=bool)
+    if k > s:
+        return jnp.zeros((n,), dtype=bool)
+    lo = jnp.take(vst.astype(jnp.int32), plan["seg"])  # [r]
+    hi = lo + jnp.take(vln.astype(jnp.int32), plan["seg"])
+    span = s - k + 1
+    acc = jnp.ones((r, span), dtype=bool)
+    for i, b in enumerate(lit):
+        acc = acc & (sv[:, i : i + span] == b)
+    jidx = jnp.arange(span, dtype=jnp.int32)[None, :]
+    abs_pos = plan["abs_start"][:, None] + jidx
+    owned = jidx < owned_lengths(plan)[:, None]
+    fits = jidx + k <= plan["stripe_len"][:, None]
+    m = acc & owned & fits
+    in_span = (abs_pos >= lo[:, None]) & (abs_pos + k <= hi[:, None])
+    if kind in ("startswith", "equals"):
+        in_span = in_span & (abs_pos == lo[:, None])
+    elif kind == "endswith":
+        in_span = in_span & (abs_pos + k == hi[:, None])
+    hit = seg_any(jnp.any(m & in_span, axis=1), plan, n)
+    if kind == "equals":
+        hit = hit & (vln.astype(jnp.int32) == k)
+    return hit
+
+
 def seg_any(verdict, plan, n: int):
     """Per-segment OR of per-stripe verdicts (the segment reduce the
     striped filter engine is built on)."""
@@ -346,6 +399,98 @@ def _value_postops(arg) -> Optional[Tuple[str, ...]]:
     raise Unlowerable(f"{type(arg).__name__} not stripeable as a byte source")
 
 
+def _jsonget_source(arg) -> Optional[Tuple[str, Tuple[str, ...], Tuple[str, ...]]]:
+    """``arg`` as a (postop-folded) single-level JsonGet over the record
+    value: ``(key, pre, outer)`` — ``pre`` the folds inside the JsonGet
+    arg (what the structural machine must see: case folds change key
+    bytes), ``outer`` the folds applied to the extracted field bytes.
+    None when the source is not a JsonGet; raises Unlowerable for a
+    nested/structural JsonGet arg (one structural level, like the span
+    map)."""
+    outer: List[str] = []
+    expr = arg
+    while isinstance(expr, (dsl.Upper, dsl.Lower)):
+        outer.append("upper" if isinstance(expr, dsl.Upper) else "lower")
+        expr = expr.arg
+    if not isinstance(expr, dsl.JsonGet):
+        return None
+    pre = _value_postops(expr.arg)
+    if pre is None:
+        raise Unlowerable("striped JsonGet must read the record value")
+    outer.reverse()
+    return expr.key, pre, tuple(outer)
+
+
+def _cached_json_span(ctx, key: str, pre):
+    """The cross-stripe span machine is the dominant cost of a JsonGet
+    stage (an O(kmax·s·n) scan); a chain with several predicates (or a
+    predicate plus the span map) over the same (key, postops) source
+    must run it ONCE per batch. Memoized in the run ctx, keyed on the
+    CURRENT stripe bytes' identity so a postop stage between two
+    readers (which rebinds ctx["sv"]) correctly invalidates."""
+    cache = ctx.setdefault("_span_cache", {})
+    ck = (key, tuple(pre))
+    hit = cache.get(ck)
+    # the entry pins the SOURCE array it was computed from and is only
+    # valid while ctx["sv"] *is* that object — an id()-keyed cache
+    # could validate a stale entry after the old array is freed and a
+    # new one reuses its id
+    if hit is None or hit[0] is not ctx["sv"]:
+        sv_pre = apply_postops(ctx["sv"], pre)
+        span = striped_json_span(
+            sv_pre, ctx["plan"], ctx["seg_state"]["lengths"], key,
+            ctx["kmax"], ctx["n"],
+        )
+        hit = cache[ck] = (ctx["sv"], sv_pre, span)
+    return hit[1], hit[2]
+
+
+def _lower_striped_json_literal(
+    kind: str, lit: bytes, key: str, pre, outer, s: int, v: int
+):
+    """One literal predicate over a JsonGet-extracted field — the spill
+    family the ROADMAP names "JsonGet-sourced predicates", fused.
+
+    The cross-stripe span machine (`striped_json_span`) resolves the
+    field's slab-absolute (start, length); the literal then windows
+    inside that span per stripe. Every kind needs containment within
+    the overlap (the field can start anywhere in the record, so no
+    stripe anchoring helps the anchored forms)."""
+    if len(lit) > v:
+        raise Unlowerable(
+            f"JsonGet-sourced literal of {len(lit)} bytes exceeds the "
+            f"stripe overlap ({v})"
+        )
+
+    def fn(ctx):
+        sv_pre, (vst, vln) = _cached_json_span(ctx, key, pre)
+        # outer folds transform the extracted bytes; they are
+        # length-preserving, so the span positions stay valid and the
+        # match runs on the fully folded stripe bytes
+        sv_m = apply_postops(sv_pre, outer)
+        return striped_literal_in_span(
+            sv_m, ctx["plan"], lit, vst, vln, kind, ctx["n"]
+        )
+
+    return fn
+
+
+def predicate_reads_json(expr) -> bool:
+    """Does this (already-lowerable) predicate run the JsonGet span
+    machine? Drives the chain's ``has_json_pred`` flag (kmax sizing)."""
+    if isinstance(expr, (dsl.And, dsl.Or)):
+        return any(predicate_reads_json(a) for a in expr.args)
+    if isinstance(expr, dsl.Not):
+        return predicate_reads_json(expr.arg)
+    if isinstance(expr, (dsl.Contains, dsl.StartsWith, dsl.EndsWith,
+                         dsl.RegexMatch)):
+        try:
+            return _jsonget_source(expr.arg) is not None
+        except Unlowerable:
+            return False
+    return False
+
+
 def _lower_striped_literal(kind: str, lit: bytes, postops, s: int, v: int):
     """One literal predicate over striped record bytes.
 
@@ -402,18 +547,46 @@ def lower_striped_predicate(expr, s: int, v: int) -> Callable:
         fn = lower_expr(expr)
         return lambda c: fn(c["seg_state"])
     if isinstance(expr, (dsl.Contains, dsl.StartsWith, dsl.EndsWith)):
-        postops = _value_postops(expr.arg)
-        if postops is None:  # key/const source: exact on the segment state
-            _check_seg_exact(expr)
-            fn = lower_expr(expr)
-            return lambda c: fn(c["seg_state"])
         kind = {
             dsl.Contains: "contains",
             dsl.StartsWith: "startswith",
             dsl.EndsWith: "endswith",
         }[type(expr)]
+        json_src = _jsonget_source(expr.arg)
+        if json_src is not None:
+            key, pre, outer = json_src
+            return _lower_striped_json_literal(
+                kind, expr.literal, key, pre, outer, s, v
+            )
+        postops = _value_postops(expr.arg)
+        if postops is None:  # key/const source: exact on the segment state
+            _check_seg_exact(expr)
+            fn = lower_expr(expr)
+            return lambda c: fn(c["seg_state"])
         return _lower_striped_literal(kind, expr.literal, postops, s, v)
     if isinstance(expr, dsl.RegexMatch):
+        json_src = _jsonget_source(expr.arg)
+        if json_src is not None:
+            # JsonGet-sourced regex: only the literal family fuses (the
+            # span machine pins the field; the windowed compare pins the
+            # match) — a real DFA over an extracted sub-span stays in
+            # the interpreter spill set
+            info = literal_of(expr.pattern)
+            if info is None:
+                raise Unlowerable(
+                    "JsonGet-sourced regex predicate is not stripeable"
+                )
+            lit, a_start, a_end = info
+            if a_start and a_end:
+                kind = "equals"
+            elif a_start:
+                kind = "startswith"
+            elif a_end:
+                kind = "endswith"
+            else:
+                kind = "contains"
+            key, pre, outer = json_src
+            return _lower_striped_json_literal(kind, lit, key, pre, outer, s, v)
         postops = _value_postops(expr.arg)
         if postops is None:
             raise Unlowerable("striped regex must read the record value")
@@ -504,14 +677,13 @@ def _striped_view(value):
 
 def _make_span_fn(key: str, pre: Tuple[str, ...]):
     """JsonGet span op over the striped ctx: the machine consumes the
-    (postop-folded) stripe bytes and emits slab-absolute descriptors."""
+    (postop-folded) stripe bytes and emits slab-absolute descriptors
+    (shared with any JsonGet predicate on the same source via the ctx
+    span cache)."""
 
     def fn(ctx):
-        sv = apply_postops(ctx["sv"], pre)
-        return striped_json_span(
-            sv, ctx["plan"], ctx["seg_state"]["lengths"], key,
-            ctx["kmax"], ctx["n"],
-        )
+        _, span = _cached_json_span(ctx, key, pre)
+        return span
 
     return fn
 
@@ -620,6 +792,14 @@ class StripedChain:
     fanout: bool = False
     has_agg: bool = False
     has_span: bool = False
+    # a filter predicate runs the cross-stripe JsonGet span machine:
+    # the executor must size kmax (the per-record stripe-count bound)
+    # even though the chain ships no span-view outputs
+    has_json_pred: bool = False
+
+    @property
+    def needs_kmax(self) -> bool:
+        return self.has_span or self.has_json_pred
 
     def run(self, ctx, valid, carries, base_ts, agg_ctx):
         """Execute the striped chain; returns (valid[n], seg_state,
@@ -675,12 +855,16 @@ def try_build_striped(programs, stages, s: int, v: int) -> Optional[StripedChain
                 chain.ops.append(
                     ("filter", lower_striped_predicate(prog.predicate, s, v))
                 )
+                chain.has_json_pred |= predicate_reads_json(prog.predicate)
             elif isinstance(prog, (dsl.MapProgram, dsl.FilterMapProgram)):
                 if isinstance(prog, dsl.FilterMapProgram):
                     if chain.has_span:
                         raise Unlowerable("filter after a striped span map")
                     chain.ops.append(
                         ("filter", lower_striped_predicate(prog.predicate, s, v))
+                    )
+                    chain.has_json_pred |= predicate_reads_json(
+                        prog.predicate
                     )
                 if prog.key is not None:
                     raise Unlowerable("striped map cannot rewrite keys")

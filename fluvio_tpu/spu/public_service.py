@@ -48,6 +48,9 @@ from fluvio_tpu.spu.smart_chain import (
     BatchProcessResult,
     PendingSlice,
     SmartModuleResolutionError,
+    admission_check,
+    admission_note_warm,
+    admission_require_warm,
     apply_chain,
     acquire_stream_chain,
     build_chain,
@@ -365,19 +368,59 @@ def _schedule_chain_warmup(chain) -> None:
     """Compile the chain's jit machinery off the hot path.
 
     First-touch XLA compilation stalls the first consume by tens of
-    seconds; warming a tiny buffer at chain attach populates the jit
-    dispatch path and the persistent compile cache concurrently with the
-    stream's initial offset wait (the first real shape bucket may still
-    compile, but the fixed per-chain costs are paid early). Stateful
-    chains are skipped: a warmup record would race the device carries.
+    seconds. Two regimes:
+
+    - **Admission AOT warmup** (``FLUVIO_ADMISSION_WARMUP=1``): the full
+      shape-bucket work-list walk (`admission.warmup.warm_executor`) —
+      every bucket the chain would compile is paid at attach, the
+      warmed buckets register with the admission controller (the
+      serve-time gate sheds ``cold-chain`` until then), and stateful
+      chains warm safely behind the carry snapshot/restore.
+    - **Legacy tiny warm** (default): one 2-record buffer populates the
+      fixed per-chain jit costs; stateless chains only (a warmup record
+      would race the device carries).
     """
+    from fluvio_tpu.admission import warmup as adm_warmup
+
     tpu = getattr(chain, "tpu_chain", None)
-    if tpu is None or tpu.agg_configs or chain in _warmed_chains:
+    aot = adm_warmup.warmup_enabled()
+    if tpu is None or (tpu.agg_configs and not aot) or chain in _warmed_chains:
         return
     _warmed_chains.add(chain)
+    if aot:
+        # the serve gate arms BEFORE the warm thread starts: traffic
+        # arriving mid-warmup sheds cold-chain instead of paying the
+        # compile inline
+        admission_require_warm(chain)
+
+    def _lift_gate() -> None:
+        # a failed warmup must not shed the chain forever: lift the
+        # gate and serve (cold compiles and all — degraded beats
+        # unavailable)
+        from fluvio_tpu.spu.smart_chain import (
+            _admission_gate,
+            admission_chain_sig,
+        )
+
+        ctl = _admission_gate()
+        if ctl is not None:
+            ctl.require_warm(admission_chain_sig(chain), False)
 
     def _warm() -> None:
         try:
+            if aot:
+                report = None
+                try:
+                    report = adm_warmup.warm_executor(tpu)
+                finally:
+                    # the gate lifts on EVERY outcome: warmed buckets
+                    # registered, or (empty report / escaped exception)
+                    # explicitly un-gated — never armed-forever
+                    if report is not None and report.buckets:
+                        admission_note_warm(chain, report.buckets)
+                    else:
+                        _lift_gate()
+                return
             from fluvio_tpu.protocol.record import Record
             from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
 
@@ -497,6 +540,17 @@ class StreamFetchHandler:
             while not self.conn.end.is_set() and not self._ended:
                 bound = leader.read_bound(req.isolation)
                 if current < bound:
+                    if chain is not None:
+                        # admission front door: a health/credit shed
+                        # HOLDS the slice (offsets untouched — nothing
+                        # lost, nothing duplicated); breaker-open
+                        # proceeds, the per-record path serves it
+                        rej = admission_check(chain)
+                        if rej is not None and rej.reason != "breaker-open":
+                            await asyncio.sleep(
+                                min(max(rej.retry_after_s, 0.005), 0.25)
+                            )
+                            continue
                     sent_next = await self._send_back_records(leader, chain, current)
                     if self._ended:
                         return
@@ -536,7 +590,17 @@ class StreamFetchHandler:
             nxt: Optional[PendingSlice] = None
             nxt_batches = None
             read_from = planned
+            shed = None
             if planned < leader.read_bound(req.isolation):
+                # admission front door for the speculative read: a shed
+                # skips THIS slice's intake (the in-flight one still
+                # finishes below) and, when nothing is in flight,
+                # sleeps out the backpressure hint — offsets never
+                # advance past a shed slice, so the retry re-reads it
+                shed = admission_check(chain)
+                if shed is not None and shed.reason == "breaker-open":
+                    shed = None  # per-record path serves breaker-open
+            if shed is None and planned < leader.read_bound(req.isolation):
                 try:
                     rslice = leader.read_records(
                         planned, req.max_bytes, req.isolation
@@ -578,6 +642,13 @@ class StreamFetchHandler:
                 if truncated:
                     continue
 
+            if shed is not None:
+                # nothing in flight and this slice was shed: sleep out
+                # the backpressure hint before retrying the same offset
+                await asyncio.sleep(
+                    min(max(shed.retry_after_s, 0.005), 0.25)
+                )
+                continue
             if nxt is not None:
                 pending = nxt
                 continue

@@ -396,6 +396,73 @@ def _decline(metrics, reason: str):
     return None
 
 
+# --- admission seam (fluvio_tpu/admission) ----------------------------------
+# One source of truth: admission.gate() owns the resolve-once state, so
+# admission.reset_gate()/set_gate() affect the broker seam immediately.
+# Only the import is cached here; with FLUVIO_ADMISSION off (the
+# default) the per-slice cost is one resolved-flag check returning None
+# — no controller, queue, lock, or gauge (the overhead gate tripwires
+# this).
+_GATE_FN = None
+
+
+def _admission_gate():
+    global _GATE_FN
+    if _GATE_FN is None:
+        from fluvio_tpu.admission import gate
+
+        _GATE_FN = gate
+    return _GATE_FN()
+
+
+def admission_chain_sig(chain) -> str:
+    tpu = getattr(chain, "tpu_chain", None)
+    if tpu is not None:
+        return tpu._chain_sig
+    return getattr(chain, "chain_label", "") or "chain"
+
+
+def admission_check(chain):
+    """The broker front door: one admission decision for one read slice.
+
+    Returns None when admitted (or admission is disabled), else the
+    typed ``Rejected`` decline. A health/credit shed means HOLD the
+    slice — the stream handler sleeps ``retry_after_s`` and retries, so
+    offsets never advance past unserved records (no loss, no
+    duplicates) and no exception ever reaches the client. A
+    ``breaker-open`` rejection is counted on the same decline surface
+    but the caller proceeds: the existing breaker path serves the slice
+    per-record, which is strictly better than stalling it.
+
+    A shed happens BEFORE `tpu_stage_dispatch`, so a shed slice never
+    constructs a dispatched `PendingSlice` — the
+    ``inflight_queue_depth`` gauge must not move for it (regression-
+    pinned in tests/test_admission.py).
+    """
+    ctl = _admission_gate()
+    if ctl is None:
+        return None
+    decision = ctl.admit(
+        admission_chain_sig(chain), breaker=getattr(chain, "breaker", None)
+    )
+    return None if decision else decision
+
+
+def admission_note_warm(chain, buckets) -> None:
+    """Register AOT-warmed width buckets with the live controller (the
+    serve gate's cold-chain shed lifts once the chain's buckets are
+    warm)."""
+    ctl = _admission_gate()
+    if ctl is not None:
+        ctl.note_warm(admission_chain_sig(chain), buckets)
+
+
+def admission_require_warm(chain) -> None:
+    ctl = _admission_gate()
+    if ctl is not None:
+        ctl.require_warm(admission_chain_sig(chain))
+
+
 def tpu_pipelinable(chain) -> bool:
     """Safe for speculative dispatch-ahead: stateless, row-preserving
     chains only (no carries to roll back when a speculative slice is

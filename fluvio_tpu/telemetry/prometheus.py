@@ -86,6 +86,7 @@ def render_prometheus(
         retries, quarantined = dict(t.retries), t.quarantined
         sharded_compress = t.sharded_compress_shards
         slo_breaches = dict(t.slo_breaches)
+        admission = dict(t.admission)
         breaker_states = dict(t.breaker_states)
         breaker_transitions = dict(t.breaker_transitions)
         breaker_shorts = t.breaker_short_circuits
@@ -201,6 +202,16 @@ def render_prometheus(
         w.sample(f"{_PREFIX}_slo_breaches_total", {"key": key}, n)
 
     w.header(
+        f"{_PREFIX}_admission_decisions_total",
+        "Admission-controller decisions (admit plus shed/flush reasons).",
+        "counter",
+    )
+    for reason, n in sorted(admission.items()):
+        w.sample(
+            f"{_PREFIX}_admission_decisions_total", {"outcome": reason}, n
+        )
+
+    w.header(
         f"{_PREFIX}_breaker_transitions_total",
         "Circuit-breaker state transitions, by entered state.",
         "counter",
@@ -285,12 +296,17 @@ def render_prometheus(
          "Pipelined broker slice chunks dispatched and not yet finished."),
         ("deadletter_entries",
          "Quarantined poison batches resident in the dead-letter dir."),
+        ("admission_queue_depth",
+         "Slices held in the admission fair queues, not yet dispatched."),
+        ("warmed_buckets",
+         "Shape buckets precompiled by the AOT warmup pass."),
     ):
         w.header(f"{_PREFIX}_{name}", help_text, "gauge")
         w.sample(f"{_PREFIX}_{name}", {}, gauges.get(name, 0))
     for name in sorted(set(gauges) - {
         "hbm_staged_bytes", "live_batch_handles",
         "inflight_queue_depth", "deadletter_entries",
+        "admission_queue_depth", "warmed_buckets",
     }):
         w.header(f"{_PREFIX}_{name}", "Engine gauge.", "gauge")
         w.sample(f"{_PREFIX}_{name}", {}, gauges[name])

@@ -111,6 +111,13 @@ class PipelineTelemetry:
         self.sharded_compress_shards = 0
         # SLO breach transitions, keyed "chain/rule" (telemetry/slo.py)
         self.slo_breaches: Dict[str, int] = {}
+        # admission-controller decisions keyed by outcome (admission/):
+        # "admit" plus the shed reasons (breach-shed, warn-shed,
+        # no-tokens, queue-full, breaker-open, cold-chain) and the
+        # batcher flush causes (batch-full, batch-deadline, cold-bucket).
+        # Only moves when FLUVIO_ADMISSION arms the controller — the
+        # disabled seam never reaches this counter
+        self.admission: Dict[str, int] = {}
         self.breaker_states: Dict[str, str] = {}
         self.breaker_transitions: Dict[str, int] = {}
         self.breaker_short_circuits = 0
@@ -273,6 +280,14 @@ class PipelineTelemetry:
         with self._lock:
             self.slo_breaches[key] = self.slo_breaches.get(key, 0) + 1
         self._event("slo-breach", detail or key)
+
+    def add_admission(self, reason: str) -> None:
+        """One admission-controller decision: ``admit`` or a shed/flush
+        reason. Breaker-open sheds and health sheds count on this ONE
+        family so every decline surface (prom, CLI table, snapshot)
+        reads admission behavior from a single vocabulary."""
+        with self._lock:
+            self.admission[reason] = self.admission.get(reason, 0) + 1
 
     def record_breaker(self, name: str, state: str, transition: bool = True) -> None:
         if transition:
@@ -476,6 +491,7 @@ class PipelineTelemetry:
                         self.sharded_compress_shards
                     ),
                     "slo_breaches": dict(self.slo_breaches),
+                    "admission": dict(self.admission),
                     "breaker": {
                         "states": dict(self.breaker_states),
                         "transitions": dict(self.breaker_transitions),
@@ -536,6 +552,7 @@ class PipelineTelemetry:
             self.quarantined = 0
             self.sharded_compress_shards = 0
             self.slo_breaches = {}
+            self.admission = {}
             self.breaker_states = {}
             self.breaker_transitions = {}
             self.breaker_short_circuits = 0

@@ -114,7 +114,7 @@ def _decline_delta(d0) -> dict:
 # ---------------------------------------------------------------------------
 
 
-_BENCH_SMALL_N = {"7_fat70k": 4, "6_wide300": 32}
+_BENCH_SMALL_N = {"7_fat70k": 4, "6_wide300": 32, "8_sharded_fat": 4}
 
 
 @pytest.mark.parametrize("name", list(_bench().CONFIGS))
@@ -290,7 +290,14 @@ def _spill_family_case(monkeypatch, mods, values, expect_causes_substr):
     assert _spill_delta(s0).get("record-too-wide-unstripeable", 0) > 0
 
 
-def test_jsonget_sourced_predicate_spills_wide(monkeypatch):
+def test_jsonget_sourced_literal_predicate_runs_striped(monkeypatch):
+    """ISSUE-11 satellite: the "JsonGet-sourced predicates" spill
+    family shrank — literal predicates over a single-level JsonGet now
+    lower striped (the cross-stripe span machine pins the field, a
+    windowed compare matches inside it). Predicted AND observed path
+    must both be striped, with no spill."""
+    for k, v in _SMALL_STRIPES.items():
+        monkeypatch.setenv(k, v)
     pad = "p" * 160
     values = [
         f'{{"name":"fluvio-{i}","pad":"{pad}"}}'.encode() for i in range(16)
@@ -300,6 +307,67 @@ def test_jsonget_sourced_predicate_spills_wide(monkeypatch):
             dsl.Contains(
                 arg=dsl.JsonGet(arg=dsl.Value(), key="name"),
                 literal=b"fluvio",
+            )
+        ),
+        None,
+    )]
+    entries, chain = _entries(mods)
+    width = max(len(v) for v in values)
+    report = analyze_entries(entries, widths=(width,))
+    pred = report.predictions[0]
+    assert pred.path == "striped"
+    assert not pred.spill_reasons
+
+    s0 = dict(TELEMETRY.spills)
+    pr0 = TELEMETRY.path_records()
+    out = _run(chain, values)
+    assert _observed_path(pr0) == "striped"
+    assert not _spill_delta(s0)
+    # survivor exactness vs the reference engine
+    py = SmartEngine(backend="python").builder()
+    for module, params in mods:
+        py.add_smart_module(
+            SmartModuleConfig(params=dict(params or {})), module
+        )
+    ref_out = _run(py.initialize(), values)
+    assert [r.value for r in out.successes] == [
+        r.value for r in ref_out.successes
+    ]
+
+
+def test_jsonget_predicate_overlap_exceeding_literal_still_spills(monkeypatch):
+    """The family's remaining boundary: a literal longer than the
+    stripe overlap has no containment argument inside the extracted
+    span — predicted and observed spill, with the JsonGet-sourced
+    cause string."""
+    pad = "p" * 160
+    lit = b"x" * 20  # > the 16-byte test overlap
+    values = [
+        f'{{"name":"{"x" * 24}","pad":"{pad}"}}'.encode() for i in range(8)
+    ]
+    mods = [(
+        _predicate_module(
+            dsl.Contains(
+                arg=dsl.JsonGet(arg=dsl.Value(), key="name"), literal=lit
+            )
+        ),
+        None,
+    )]
+    _spill_family_case(monkeypatch, mods, values, "JsonGet-sourced")
+
+
+def test_jsonget_sourced_regex_predicate_still_spills(monkeypatch):
+    """Non-literal regexes over a JsonGet source stay in the spill set
+    (a DFA over an extracted sub-span has no striped lowering)."""
+    pad = "p" * 160
+    values = [
+        f'{{"name":"fluvio-{i}","pad":"{pad}"}}'.encode() for i in range(8)
+    ]
+    mods = [(
+        _predicate_module(
+            dsl.RegexMatch(
+                arg=dsl.JsonGet(arg=dsl.Value(), key="name"),
+                pattern="cat|dog",
             )
         ),
         None,
@@ -340,6 +408,38 @@ def test_hard_ceiling_record_too_wide(monkeypatch):
     _run(chain, [b"fluvio" + b"x" * width])
     assert _observed_path(pr0) == "interpreter"
     assert _spill_delta(s0).get("record-too-wide", 0) > 0
+
+
+def test_sharded_striped_predicts_glz_wide_unsupported(monkeypatch):
+    """ISSUE-11 satellite (PR-8 leftover): with link compression armed,
+    a sharded STRIPED config must predict the raw link ship with the
+    per-batch ``glz-wide-unsupported`` decline — the compact `link`
+    block's evidence for the compress-ahead-worker decision."""
+    from fluvio_tpu.smartengine.tpu import glz
+
+    monkeypatch.setenv("FLUVIO_LINK_COMPRESS", "on")
+    if not glz.available():
+        pytest.skip("native glz library unavailable")
+    report = analyze_named(
+        [("regex-filter", {"regex": "fluvio"})],
+        widths=(70 * 1024,),
+        sharded=True,
+    )
+    pred = report.predictions[0]
+    assert pred.path == "striped"
+    assert "glz-wide-unsupported" in pred.declines
+    assert pred.link_variant == "raw"
+    # the same prediction through the bench's entry point
+    pf = preflight_for_specs(
+        [("regex-filter", {"regex": "fluvio"})], 70 * 1024, sharded=True
+    )
+    assert pf["path"] == "striped"
+    assert "glz-wide-unsupported" in pf.get("declines", [])
+    # unsharded at the same width: striped ships COMPRESSED (no decline)
+    pf2 = preflight_for_specs(
+        [("regex-filter", {"regex": "fluvio"})], 70 * 1024
+    )
+    assert "glz-wide-unsupported" not in pf2.get("declines", [])
 
 
 def test_sharded_fanout_stays_narrow_in_prediction():

@@ -460,6 +460,90 @@ def test_slo_line_key_is_worst_of_suite():
     assert "slo" not in json.loads(json.dumps(b._compact_line(out2)))
 
 
+def test_adm_line_key_aggregates_shed_and_warm():
+    """ISSUE-11: a tiny ``adm:{shed,warm}`` key rides the compact line
+    when any config carried an admission block; full warmup/shed detail
+    stays in BENCH_DETAIL.json, and the ≤1500-char contract holds with
+    the key present."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    cfg1 = dict(GOOD)
+    cfg1["admission"] = {
+        "shed": 3, "warm": 2,
+        "warmup": {"buckets": 2, "compiles": 4, "compile_s": 11.2},
+    }
+    cfg2 = dict(GOOD)
+    cfg2["admission"] = {"shed": 1, "warm": 1}
+    out, rc = b._build_output({"2_filter_map": cfg1, "1_filter": cfg2})
+    assert rc == 0
+    # detail block rides BENCH_DETAIL.json untouched
+    assert out["configs"]["2_filter_map"]["admission"]["warmup"][
+        "compiles"
+    ] == 4
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["adm"] == {"shed": 4, "warm": 3}
+    assert "admission" not in line["configs"]["2_filter_map"]
+    # configs without admission blocks leave the key off entirely
+    out2, _ = b._build_output({"2_filter_map": dict(GOOD)})
+    assert "adm" not in json.loads(json.dumps(b._compact_line(out2)))
+
+
+def test_adm_key_fits_contract_and_trims_before_link():
+    """The full seven-config line with the adm key stays ≤1500 chars,
+    and the blowup trim drops ``adm`` before ``link`` (link.glz is the
+    contract field)."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    b._LINK.update(
+        rtt_ms=65.0, h2d_mb_s=49.0, d2h_mb_s=37.0, glz="on", glz_pinned=False
+    )
+    results = _full_results()
+    for cfg in results.values():
+        if isinstance(cfg, dict) and "records_per_sec" in cfg:
+            cfg["admission"] = {"shed": 2, "warm": 1}
+    try:
+        out, _ = b._build_output(results)
+        line = json.dumps(b._compact_line(out))
+    finally:
+        b._LINK.clear()
+    assert len(line) <= 1500, f"compact line is {len(line)} chars"
+    parsed = json.loads(line)
+    n_blocks = sum(
+        1
+        for cfg in results.values()
+        if isinstance(cfg, dict) and "admission" in cfg
+    )
+    assert parsed["adm"] == {"shed": 2 * n_blocks, "warm": n_blocks}
+    # trim ladder order: adm drops before link (the contract field)
+    import re
+
+    src = open(_BENCH_PATH).read()
+    ladder = re.search(r"for drop in \(([^)]*)\)", src).group(1)
+    assert ladder.index('"adm"') < ladder.index('"link"')
+
+
+def test_sharded_config_skip_entry_rides_configs():
+    """The 8_sharded_fat config skips cleanly on device-poor backends;
+    the skip marker must survive the compact line."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    out, rc = b._build_output(
+        {
+            "2_filter_map": dict(GOOD),
+            "8_sharded_fat": {"skipped": "needs 8 devices (have 1)"},
+        }
+    )
+    assert rc == 0
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["configs"]["8_sharded_fat"]["skipped"].startswith("needs 8")
+
+
 def test_preflight_counts_disagreement_and_unjudged():
     """The compact preflight key counts only judgeable configs: an
     ``agree: None`` (telemetry off -> actual unknown) is excluded, a

@@ -508,6 +508,33 @@ def test_runtime_lock_graph_matches_static_prediction(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+def test_admission_locks_in_static_vocabulary():
+    """ISSUE-11: the admission layer's locks are created via make_lock
+    under canonical names, so the FLV2xx analyzer's graph covers them
+    (and the runtime lockwatch differential keys on the same
+    vocabulary). Importing the package must register all four."""
+    import fluvio_tpu.admission  # noqa: F401 — lock creation side effect
+
+    names = set(analyze_package().locks)
+    assert {
+        "admission.controller",
+        "admission.fairness",
+        "admission.batcher",
+        "admission.gate",
+    } <= names, sorted(n for n in names if "admission" in n)
+
+
+def test_admission_layer_is_flv2xx_clean():
+    """The lock-discipline pass over the whole package (admission
+    included) must stay free of ERROR findings — no dispatch or user
+    hook under an admission lock, no unguarded shared writes."""
+    report = analyze_package()
+    errs = [
+        f for f in report.errors() if "admission" in (f.path or "")
+    ]
+    assert not errs, [str(e) for e in errs]
+
+
 def test_bounded_ring_counters_consistent_under_concurrent_push():
     """Regression: `_BoundedRing.total`/`dropped`/`__len__` used to read
     `_next` unlocked — a scrape racing a push could observe torn

@@ -253,6 +253,72 @@ class TestSmartModuleStreams:
 
         loop.run_until_complete(run())
 
+    def test_admission_shed_holds_stream_without_loss(self, spu):
+        """ISSUE-11 integration: with the admission gate armed and the
+        chain's health BREACHING, the stream handler HOLDS slices (no
+        error to the client, no records skipped) and delivers everything
+        once the verdict recovers — the typed decline is backpressure,
+        never an exception, and a shed slice moves no dispatch gauge."""
+        from fluvio_tpu.admission import AdmissionController
+        from fluvio_tpu.telemetry import TELEMETRY
+
+        server, loop = spu
+
+        class RecoveringSlo:
+            """Breach for the first few evaluations, then healthy."""
+
+            def __init__(self, breaches: int) -> None:
+                self.left = breaches
+
+            def evaluate(self, tick=True):
+                if self.left > 0:
+                    self.left -= 1
+                    return {
+                        "enabled": True,
+                        "chains": {"_engine": {"verdict": "breach",
+                                               "rules": {}}},
+                    }
+                return {"enabled": True, "chains": {}}
+
+        ctl = AdmissionController(
+            slo_engine=RecoveringSlo(3), refresh_s=0.0,
+            tokens=1e9, refill=1e9,
+        )
+        from fluvio_tpu import admission as admission_pkg
+
+        admission_pkg.set_gate(ctl)
+        shed0 = dict(TELEMETRY.admission)
+        g0 = TELEMETRY.gauge_value("inflight_queue_depth")
+
+        async def run():
+            await produce_values(
+                server.public_addr,
+                [b"keep-1", b"drop-1", b"keep-2", b"drop-2", b"keep-3"],
+            )
+            config = ConsumerConfig(
+                disable_continuous=True,
+                smartmodules=[adhoc(FILTER_SM, kind=SmartModuleInvocationKind.FILTER)],
+            )
+            records = await consume_values(server.public_addr, config=config)
+            # every record delivered exactly once despite the sheds
+            assert [r.value for r in records] == [
+                b"keep-1", b"keep-2", b"keep-3",
+            ]
+
+        try:
+            loop.run_until_complete(run())
+        finally:
+            admission_pkg.reset_gate()  # later tests run un-gated
+        sheds = sum(
+            v - shed0.get(k, 0)
+            for k, v in TELEMETRY.admission.items()
+            if k == "breach-shed"
+        )
+        assert sheds >= 1, TELEMETRY.admission
+        # a shed slice never reached dispatch: the gauge is untouched
+        # at quiesce (finished slices released theirs)
+        assert TELEMETRY.gauge_value("inflight_queue_depth") == g0
+
     def test_consume_with_filter_map_chain(self, spu):
         server, loop = spu
 

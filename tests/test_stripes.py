@@ -332,14 +332,121 @@ class TestStripedEquivalence:
         )
         assert ex._striped_chain().has_span
 
-    def test_json_sourced_predicate_spills(self, small_stripes):
-        # JsonGet-SOURCED predicates stay outside the stripeable subset
-        # (the striped filters scan stripe bytes, not the extracted view)
+    def test_json_sourced_literal_predicate_runs_striped(self, small_stripes):
+        # ISSUE-11: JsonGet-sourced LITERAL predicates joined the
+        # stripeable subset — the cross-stripe span machine resolves the
+        # field's absolute span and a windowed compare matches inside
+        # it. Fields before/after stripe joints, missing fields, and
+        # decoys in OTHER fields must all verdict exactly.
         pred = dsl.Contains(
             arg=dsl.JsonGet(arg=dsl.Value(), key="name"), literal=b"fluvio"
         )
+        pad = "x" * 120
+        vals = []
+        for i in range(60):
+            if i % 4 == 0:
+                # decoy: the literal appears OUTSIDE the extracted field
+                vals.append(
+                    f'{{"other":"fluvio","pad":"{pad}","name":"kafka"}}'.encode()
+                )
+            elif i % 4 == 1:
+                # field starts past stripe 0 (the pad pushes it right)
+                vals.append(f'{{"pad":"{pad}","name":"fluvio-{i}"}}'.encode())
+            elif i % 4 == 2:
+                # field value itself straddles stripe joints
+                vals.append(
+                    f'{{"name":"{"z" * 70}fluvio{"z" * 70}"}}'.encode()
+                )
+            else:
+                vals.append(f'{{"name":"fluvio-{i}","pad":"{pad}"}}'.encode())
+        ex = _assert_equivalent(
+            lambda: [(predicate_module(pred), None)], vals
+        )
+        sc = ex._striped_chain()
+        assert sc.has_json_pred and not sc.has_span and sc.needs_kmax
+        # the kmax compile-shape axis sizes for json predicates too
+        buf = RecordBuffer.from_records(
+            [Record(value=vals[0], offset_delta=0)]
+        )
+        assert ex._stripe_kmax(buf) > 0
+
+    def test_json_sourced_anchored_predicates_run_striped(self, small_stripes):
+        pad = "x" * 110
         vals = [
-            (f'{{"name":"fluvio-{i}","pad":"{"x" * 120}"}}').encode()
+            f'{{"name":"fluvio-{i}","pad":"{pad}"}}'.encode()
+            for i in range(24)
+        ] + [
+            f'{{"pad":"{pad}","name":"tail-fluvio"}}'.encode()
+            for i in range(24)
+        ]
+        for pred in (
+            dsl.StartsWith(
+                arg=dsl.JsonGet(arg=dsl.Value(), key="name"),
+                literal=b"fluvio",
+            ),
+            dsl.EndsWith(
+                arg=dsl.JsonGet(arg=dsl.Value(), key="name"),
+                literal=b"fluvio",
+            ),
+            dsl.RegexMatch(
+                arg=dsl.JsonGet(arg=dsl.Value(), key="name"),
+                pattern="^fluvio",
+            ),
+        ):
+            _assert_equivalent(
+                lambda p=pred: [(predicate_module(p), None)], vals
+            )
+
+    def test_json_sourced_empty_anchored_regex_exact(self, small_stripes):
+        # review regression: ^$ over a JsonGet source reduces to the
+        # empty "equals" literal — it must match ONLY empty/missing
+        # fields, not every record (the k==0 fast path must still
+        # apply the length pin)
+        pad = "x" * 110
+        vals = [
+            f'{{"name":"fluvio-{i}","pad":"{pad}"}}'.encode()
+            for i in range(12)
+        ] + [
+            f'{{"name":"","pad":"{pad}"}}'.encode() for _ in range(6)
+        ] + [
+            f'{{"other":"y","pad":"{pad}"}}'.encode() for _ in range(6)
+        ]
+        pred = dsl.RegexMatch(
+            arg=dsl.JsonGet(arg=dsl.Value(), key="name"), pattern="^$"
+        )
+        _assert_equivalent(lambda: [(predicate_module(pred), None)], vals)
+
+    def test_json_pred_after_postop_map_rebinds_span_cache(
+        self, small_stripes
+    ):
+        # review regression: the ctx span cache pins the source array
+        # by identity — a postop stage between build and the predicate
+        # rebinds ctx["sv"], and the predicate must read the FOLDED
+        # bytes (parity with the reference engine), never a stale span
+        pad = "x" * 110
+        vals = [
+            f'{{"name":"fluvio-{i}","pad":"{pad}"}}'.encode()
+            for i in range(24)
+        ]
+        pred = dsl.Contains(
+            arg=dsl.JsonGet(arg=dsl.Value(), key="NAME"), literal=b"FLUVIO"
+        )
+        _assert_equivalent(
+            lambda: [
+                (upper_map_module(), None),
+                (predicate_module(pred), None),
+            ],
+            vals,
+        )
+
+    def test_json_sourced_regex_predicate_still_spills(self, small_stripes):
+        # the remaining boundary: a real DFA over an extracted sub-span
+        # has no striped lowering
+        pred = dsl.RegexMatch(
+            arg=dsl.JsonGet(arg=dsl.Value(), key="name"), pattern="cat|dog"
+        )
+        vals = [
+            (f'{{"name":"cat-{i}","pad":"{"x" * 120}"}}').encode()
             for i in range(40)
         ]
         _assert_equivalent(

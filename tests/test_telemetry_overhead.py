@@ -344,6 +344,98 @@ def test_slo_seams_zero_cost_when_telemetry_off(monkeypatch):
         TELEMETRY.reset()
 
 
+def test_admission_armed_overhead_under_gate():
+    """ISSUE-11 CI satellite: the admission front door — one
+    controller decision per slice against a live health engine — must
+    stay inside the same <2% rps gate. The decision is a cached-verdict
+    read plus a token-bucket charge; the SLO evaluation refreshes at
+    most once per FLUVIO_ADMISSION_REFRESH_S, never per slice."""
+    from fluvio_tpu.admission import AdmissionController
+    from fluvio_tpu.telemetry import SloEngine, TimeSeries
+
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    buf = _corpus_buf()
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+
+    ctl = AdmissionController(
+        slo_engine=SloEngine(timeseries=TimeSeries(window_s=1.0, capacity=8)),
+        refresh_s=1.0,
+        tokens=1e9,
+        refill=1e9,
+    )
+    ctl.admit(executor._chain_sig)  # resolve the first evaluation
+
+    def _measure_admission():
+        times = {"bare": [], "armed": []}
+        for _ in range(PASSES_PER_ARM):
+            for arm in ("bare", "armed"):
+                t0 = time.perf_counter()
+                for i in range(BATCHES_PER_PASS):
+                    if arm == "armed":
+                        d = ctl.admit(executor._chain_sig)
+                        assert d.admitted
+                    executor.process_buffer(buf)
+                times[arm].append(
+                    (time.perf_counter() - t0) / BATCHES_PER_PASS
+                )
+        return min(times["bare"]), min(times["armed"])
+
+    for attempt in range(5):
+        bare_s, armed_s = _measure_admission()
+        overhead = max(armed_s - bare_s, 0.0)
+        if overhead <= bare_s * GATE or overhead < 500e-6:
+            break
+    else:
+        raise AssertionError(
+            f"admission decision cost {overhead*1e6:.0f}us/batch on a "
+            f"{bare_s*1e3:.2f}ms batch — exceeds the {GATE:.0%} gate "
+            f"after 5 measurement rounds"
+        )
+    rps_bare = N_RECORDS / bare_s
+    rps_armed = N_RECORDS / armed_s
+    assert rps_armed >= rps_bare * (1 - GATE) or overhead < 500e-6
+
+
+def test_admission_seams_zero_cost_when_disabled(monkeypatch):
+    """ISSUE-11 CI satellite, the strict half: with FLUVIO_ADMISSION
+    unset the broker seam resolves to None ONCE and the whole admission
+    layer is untouchable — tripwires on the controller, queue, and
+    batcher entry points prove no decision, no enqueue, no gauge, and
+    no counter moves through a full slice-path check."""
+    from fluvio_tpu import admission
+    from fluvio_tpu.admission import controller as ctl_mod
+    from fluvio_tpu.admission import fairness as fair_mod
+    from fluvio_tpu.admission import batcher as batch_mod
+    from fluvio_tpu.spu import smart_chain
+
+    monkeypatch.delenv("FLUVIO_ADMISSION", raising=False)
+    admission.reset_gate()
+
+    def tripwire(*a, **k):
+        raise AssertionError("admission seam touched while disabled")
+
+    monkeypatch.setattr(
+        ctl_mod.AdmissionController, "admit", tripwire
+    )
+    monkeypatch.setattr(fair_mod.FairQueue, "push", tripwire)
+    monkeypatch.setattr(batch_mod.ShapeBucketBatcher, "add", tripwire)
+
+    TELEMETRY.reset()
+    chain = _headline_chain()
+    buf = _corpus_buf()
+    # the broker front-door seam: must resolve None and touch nothing
+    assert smart_chain.admission_check(chain) is None
+    for out in chain.tpu_chain.process_stream(iter([buf] * 2)):
+        pass
+    snap = TELEMETRY.snapshot()
+    assert snap["counters"]["admission"] == {}
+    assert "admission_queue_depth" not in snap["gauges"]
+    assert "warmed_buckets" not in snap["gauges"]
+    TELEMETRY.reset()
+
+
 def test_telemetry_disabled_skips_span_capture_entirely():
     """The off switch must mean OFF: no spans, no histogram writes."""
     chain = _headline_chain()
