@@ -210,22 +210,25 @@ def _compile_delta(a: dict, b: dict) -> dict:
 
 
 def _link_deltas(lv0: dict, dc0: dict) -> tuple:
-    """(link-variant deltas, glz-decline deltas) since the captured
-    baselines — the bench's per-config link attribution (which form the
-    flat actually crossed in, and WHY batches shipped raw)."""
+    """(H2D variant deltas, D2H ``down-*`` variant deltas, glz-decline
+    deltas) since the captured baselines — the bench's per-config link
+    attribution (which form the flat crossed UP in, which form the
+    results crossed DOWN in, and WHY batches shipped raw)."""
     from fluvio_tpu.telemetry import TELEMETRY
 
-    lv = {
+    moved = {
         k: v - lv0.get(k, 0)
         for k, v in TELEMETRY.link_variant_counts().items()
         if v - lv0.get(k, 0) > 0
     }
+    lv = {k: v for k, v in moved.items() if not k.startswith("down-")}
+    dn = {k: v for k, v in moved.items() if k.startswith("down-")}
     dc = {
         k: v - dc0.get(k, 0)
         for k, v in dict(TELEMETRY.declines).items()
         if k.startswith("glz-") and v - dc0.get(k, 0) > 0
     }
-    return lv, dc
+    return lv, dn, dc
 
 
 def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
@@ -307,7 +310,10 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
     for p in e2e_paths:
         d = TELEMETRY.batch_hist_copy(p).diff(hist0[p])
         e2e_hist = d if e2e_hist is None else e2e_hist.merge(d)
-    phases = _phase_breakdown(single, phase_ms, e2e_hist)
+    phases = _phase_breakdown(
+        single, phase_ms, e2e_hist,
+        pipelined_s=statistics.median(times) if times else 0.0,
+    )
     deltas = {
         k: v - pr0.get(k, 0)
         for k, v in TELEMETRY.path_records().items()
@@ -336,13 +342,21 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
         f"pc {compile_info['persistent_hits']}h/"
         f"{compile_info['persistent_misses']}m)"
     )
-    variants, glz_declines = _link_deltas(lv0, dc0)
+    variants, down_variants, glz_declines = _link_deltas(lv0, dc0)
     link_info = {
         "up_mb": round(link_mb[0], 2),
         "down_mb": round(link_mb[1], 2),
         # majority engaged variant (mixed runs keep the full histogram)
         "variant": max(variants, key=variants.get) if variants else "off",
         "variants": variants,
+        # D2H (result) side: which form the outputs crossed down in —
+        # the ISSUE-12 compaction/encode ladder's per-config evidence
+        "down_variant": (
+            max(down_variants, key=down_variants.get)
+            if down_variants
+            else "off"
+        ),
+        "down_variants": down_variants,
     }
     if glz_declines:
         link_info["declines"] = glz_declines
@@ -351,11 +365,16 @@ def bench_tpu(chain, buf, runs: int, passes: int, deadline=None) -> tuple:
             compile_info, link_info)
 
 
-def _phase_breakdown(single_s: float, phase_ms: dict, e2e_hist) -> dict:
+def _phase_breakdown(
+    single_s: float, phase_ms: dict, e2e_hist, pipelined_s: float = 0.0
+) -> dict:
     """Compact per-phase record for BENCH_DETAIL.json: serial-pass wall
     + per-phase ms (their sum must track the wall within ~10%), p50/p99
-    end-to-end batch latency across the pipelined passes, and the top-3
-    phase shares of attributed time."""
+    end-to-end batch latency across the pipelined passes, the top-3
+    phase shares of attributed time, and the fetch-overlap ratio —
+    what fraction of the serial pass's d2h+fetch time the pipelined
+    loop hid behind other batches' phases (1.0 = the result side is
+    fully off the critical path; 0 = it serializes)."""
     total = sum(phase_ms.values())
     top = sorted(phase_ms.items(), key=lambda kv: -kv[1])[:3]
     out = {
@@ -366,6 +385,12 @@ def _phase_breakdown(single_s: float, phase_ms: dict, e2e_hist) -> dict:
             [name, round(ms / total, 2) if total else 0.0] for name, ms in top
         ],
     }
+    fetch_side = phase_ms.get("fetch", 0.0) + phase_ms.get("d2h", 0.0)
+    if pipelined_s and fetch_side > 0:
+        hidden = single_s * 1000 - pipelined_s * 1000
+        out["fetch_overlap"] = round(
+            max(0.0, min(1.0, hidden / fetch_side)), 2
+        )
     if e2e_hist.count:
         out["e2e_p50_ms"] = round(e2e_hist.percentile(50) * 1000, 2)
         out["e2e_p99_ms"] = round(e2e_hist.percentile(99) * 1000, 2)
@@ -1201,6 +1226,18 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
             "glz",
             "on" if str(hl.get("variant", "off")).startswith("glz") else "off",
         )
+    # the tiny down:{mb,variant} key (ISSUE-12): the headline's result-
+    # side bytes + engaged down-link variant — the compaction/encode
+    # acceptance evidence rides the line like up_mb does
+    if isinstance(headline_cfg, dict) and isinstance(
+        headline_cfg.get("link"), dict
+    ):
+        hl = headline_cfg["link"]
+        if "down_mb" in hl:
+            compact["down"] = {
+                "mb": hl["down_mb"],
+                "variant": hl.get("down_variant", "off"),
+            }
     if isinstance(headline_cfg, dict) and isinstance(
         headline_cfg.get("phases"), dict
     ):
@@ -1248,8 +1285,8 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "adm", "slo", "preflight", "compile",
-        "phases", "error", "xla_cache", "link",
+        "configs", "cpu_fallback", "adm", "slo", "preflight", "down",
+        "compile", "phases", "error", "xla_cache", "link",
     ):
         if len(json.dumps(compact)) <= limit:
             break
@@ -1640,6 +1677,24 @@ def _run_after_lock() -> None:
     default_n = "20000" if smoke else "1000000"
     n = int(os.environ.get("BENCH_RECORDS", default_n))
     only = os.environ.get("BENCH_CONFIGS")
+    # result-side compaction/encode evidence: the down-link byte
+    # counters are hardware-independent (the same arrays cross on CPU
+    # and on the real chip), so CPU runs arm the device encoder too —
+    # auto would resolve it off there and the per-config down_mb /
+    # down_variant attribution would lose its measurement. An operator
+    # pin always wins; the resolved modes ride the link block.
+    if _BACKEND_MODE != "tpu":
+        os.environ.setdefault("FLUVIO_RESULT_COMPRESS", "on")
+    from fluvio_tpu.smartengine.tpu.executor import (
+        effective_result_compact, effective_result_compress,
+    )
+
+    _LINK["down_compact"] = "on" if effective_result_compact() else "off"
+    _LINK["down_glz"] = "on" if effective_result_compress() else "off"
+    log(
+        f"result compaction: {_LINK['down_compact']}, "
+        f"down-link glz: {_LINK['down_glz']}"
+    )
 
     # a degraded tunnel can stretch every transfer ~10-100x; bound the
     # whole run so the driver always gets a JSON line. The headline

@@ -123,7 +123,11 @@ def preflight_for_specs(
 
     report = analyze_named(specs, widths=(width,), sharded=sharded)
     pred = report.predictions[0]
-    out = {"path": pred.path, "link_variant": pred.link_variant}
+    out = {
+        "path": pred.path,
+        "link_variant": pred.link_variant,
+        "down_variant": pred.down_variant,
+    }
     if pred.spill_reasons:
         out["spill_reasons"] = list(pred.spill_reasons)
     if pred.declines:

@@ -233,6 +233,10 @@ def trace_chain_entry_points(
             np.int64(buf.base_timestamp),
             carries,
         )
+        # down-link static axes (ISSUE-12): resolved through the SAME
+        # executor helper the dispatch seam uses, so the AOT warmup
+        # work list can never warm a program serving won't request
+        enc, pack = executor._down_axes(striped)
         kwargs = dict(
             kwidth=buf.keys.shape[1],
             has_keys=has_keys,
@@ -240,6 +244,8 @@ def trace_chain_entry_points(
             ts_mode=ts_mode,
             fanout_cap=executor._fanout_cap(buf),
             glz_bytes=0,
+            enc=enc,
+            pack=pack,
         )
         if striped and executor._striped_chain() is not None:
             kwargs.update(

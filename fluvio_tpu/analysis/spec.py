@@ -90,6 +90,12 @@ class PathPrediction:
     # (glz-ratio, glz-below-min) resolve per batch at runtime and the
     # executor then ships raw with the reason on the decline counter.
     link_variant: str = "raw"
+    # predicted D2H (result) form: "down-raw" | "down-packed" |
+    # "down-glz-xla" | "down-glz-pallas" — the result side's own
+    # variant family. Same contract as link_variant: the CONFIGURED
+    # variant; per-batch ratio losses ship packed with `glz-enc-ratio`
+    # on the decline counter.
+    down_variant: str = "down-raw"
 
     def to_dict(self) -> dict:
         return {
@@ -100,6 +106,7 @@ class PathPrediction:
             "declines": list(self.declines),
             "causes": list(self.causes),
             "link_variant": self.link_variant,
+            "down_variant": self.down_variant,
         }
 
 
@@ -163,7 +170,19 @@ def resolve_gates() -> dict:
         "link_compress": effective_link_compress(),
         "glz_available": glz.available(),
         "glz_pallas": pallas_kernels.glz_pallas_active(),
+        # down-link gates: the result-side compaction + ENCODE ladder
+        # (FLUVIO_RESULT_COMPACT / FLUVIO_RESULT_COMPRESS /
+        # FLUVIO_GLZ_ENC_PALLAS), mirrored for the down_variant arm
+        "result_compact": _executor().effective_result_compact(),
+        "result_compress": _executor().effective_result_compress(),
+        "glz_enc_pallas": pallas_kernels.glz_enc_pallas_active(),
     }
+
+
+def _executor():
+    from fluvio_tpu.smartengine.tpu import executor
+
+    return executor
 
 
 # ---------------------------------------------------------------------------
@@ -668,6 +687,104 @@ def predict_path(
     )
 
 
+def down_profile(programs) -> str:
+    """Which D2H representation family a chain's results ship in — the
+    static mirror of the executor's `_viewable`/`_identity_view`/
+    `_int_output` build-time flags. Returns one of:
+
+    - "identity": filter-only — the 1-bit mask is the whole download
+    - "ints": chain ends in an aggregate — delta-narrowed int columns
+    - "desc": view/fan-out survivors — (start, len) descriptor blocks
+      (the encode ladder's first target)
+    - "bytes": byte-mode value columns (packs to ONE flat payload; the
+      encode ladder's second target)
+    """
+    has_agg = any(isinstance(p, dsl.AggregateProgram) for p in programs)
+    if not has_agg and all(
+        isinstance(p, dsl.FilterProgram) for p in programs
+    ):
+        return "identity"
+    if programs and isinstance(programs[-1], dsl.AggregateProgram):
+        # int-output excludes chains where a map rewrote keys on device
+        if not any(
+            isinstance(p, dsl.ArrayMapProgram) for p in programs
+        ) and not any(
+            isinstance(p, (dsl.MapProgram, dsl.FilterMapProgram))
+            and p.key is not None
+            for p in programs
+        ):
+            return "ints"
+    if not has_agg and all(
+        isinstance(p, (dsl.FilterProgram, dsl.ArrayMapProgram))
+        or (
+            isinstance(p, (dsl.MapProgram, dsl.FilterMapProgram))
+            and p.key is None
+            and _span_lowerable(p)
+        )
+        for p in programs
+    ):
+        return "desc"
+    return "bytes"
+
+
+def _mentions_jsonget(e) -> bool:
+    """Generic expr walk: does this DSL expression contain a JsonGet?
+    (The striped builder only ships span DESCRIPTORS for JsonGet views;
+    whole-record views ship the mask alone — `stripes.has_span`.)"""
+    if isinstance(e, dsl.JsonGet):
+        return True
+    if hasattr(e, "__dataclass_fields__"):
+        for f in e.__dataclass_fields__:
+            v = getattr(e, f, None)
+            if isinstance(v, dsl.Expr) and _mentions_jsonget(v):
+                return True
+    return False
+
+
+def _span_lowerable(prog) -> bool:
+    """Does this map's value lower as a VIEW of the record's own bytes
+    (the executor's `lower_span`)? Mirrored without lowering."""
+    from fluvio_tpu.smartengine.tpu.lower import lower_span
+
+    try:
+        return lower_span(prog.value) is not None
+    except Exception:  # noqa: BLE001 — mirror of try_build's tolerance
+        return False
+
+
+def predict_down_variant(
+    gates: dict, path: str, profile: str, sharded: bool,
+    striped_span: bool = False,
+) -> str:
+    """Which form a batch's results cross the D2H link in on this path
+    — the mirror of the executor's fetch-side variant selection
+    (`_count_down_variant`). Interpreter batches never fetch ("down-
+    raw"); identity/int chains always ship their packed representation;
+    descriptor and payload streams encode when the ladder is armed
+    (sharded: narrow descriptor chains only — sharded striped and
+    sharded byte-mode keep their raw/packed ship)."""
+    if path == "interpreter":
+        return "down-raw"
+    if profile in ("identity", "ints"):
+        return "down-packed"
+    if profile == "bytes":
+        if sharded or not gates.get("result_compact"):
+            return "down-raw"
+        if not gates.get("result_compress"):
+            return "down-packed"
+    else:  # desc
+        if path == "striped" and (sharded or not striped_span):
+            # striped whole-record views ship the mask alone; sharded
+            # striped keeps the raw descriptor ship (the H2D glz-wide
+            # exclusion, mirrored)
+            return "down-packed"
+        if not gates.get("result_compress"):
+            return "down-packed"
+    return (
+        "down-glz-pallas" if gates.get("glz_enc_pallas") else "down-glz-xla"
+    )
+
+
 def predict_link_variant(gates: dict, path: str, sharded: bool) -> str:
     """Which form a batch's flat crosses the H2D link in on this path —
     the mirror of the executor's build-time variant resolution plus the
@@ -741,6 +858,14 @@ def analyze_entries(
             has_fanout, sharded=sharded,
         )
         pred.link_variant = predict_link_variant(gates, pred.path, sharded)
+        pred.down_variant = predict_down_variant(
+            gates, pred.path, down_profile(programs), sharded,
+            striped_span=any(
+                isinstance(p, (dsl.MapProgram, dsl.FilterMapProgram))
+                and _mentions_jsonget(p.value)
+                for p in programs
+            ),
+        )
         if sharded and pred.path == "striped" and gates.get("link_compress"):
             pred.declines = pred.declines + ("glz-wide-unsupported",)
         report.predictions.append(pred)

@@ -105,7 +105,7 @@ class ShardedChainExecutor:
         per-shard compacted view descriptors; ``kmax`` bounds their
         cross-stripe carry's outer scan."""
         (_width, kwidth, has_keys, has_offsets, ts_mode,
-         _glz_bytes, _glz_variant, _glz_chunk, _cap, srows, kmax) = cfg
+         _glz_bytes, _glz_variant, _glz_chunk, _enc, _cap, srows, kmax) = cfg
         ex = self.executor
         s, v = ex._stripe_s, ex._stripe_v
         lengths = uploads["lengths"].astype(jnp.int32)
@@ -208,7 +208,7 @@ class ShardedChainExecutor:
         paths use — pallas kernels run per shard under shard_map, which
         GSPMD tracing cannot."""
         (width, kwidth, has_keys, has_offsets, ts_mode,
-         glz_bytes, glz_variant, glz_chunk, fanout_cap) = cfg
+         glz_bytes, glz_variant, glz_chunk, enc, fanout_cap) = cfg
         flat_words = self._shard_flat_words(
             uploads, glz_bytes, glz_variant, glz_chunk
         )
@@ -234,9 +234,12 @@ class ShardedChainExecutor:
             "offset_deltas": offset_deltas,
             "timestamp_deltas": timestamp_deltas,
         }
-        return self._local_step(arrays, count, base_ts, carries, fanout_cap)
+        return self._local_step(
+            arrays, count, base_ts, carries, fanout_cap, enc=enc
+        )
 
-    def _local_step(self, arrays: Dict, count, base_ts, carries, fanout_cap=None):
+    def _local_step(self, arrays: Dict, count, base_ts, carries, fanout_cap=None,
+                    enc: str = "off"):
         ex = self.executor
         ax = RECORD_AXIS
         n_local = arrays["values"].shape[0]
@@ -279,6 +282,26 @@ class ShardedChainExecutor:
             packed["span_len"] = compacted[1]
             if ex._fanout:
                 packed["src_row"] = compacted[2]
+            if enc != "off":
+                # per-shard down-link encode under shard_map (the same
+                # interleaved descriptor stream the single-device chain
+                # emits, one independent token set per shard — pallas
+                # kernels run per shard, which GSPMD tracing cannot)
+                ll, ml, srcs, lits, n_seq, n_lit, depth = glz.encode_result(
+                    ex._desc_stream(
+                        compacted[0], compacted[1],
+                        arrays["values"].shape[1],
+                    ),
+                    ex._enc_chunk or glz.GLZ_CHUNK,
+                    enc,
+                )
+                packed["down_ll"] = ll
+                packed["down_ml"] = ml
+                packed["down_src"] = srcs
+                packed["down_lits"] = lits
+                packed["down_meta"] = jnp.stack(
+                    [n_seq, n_lit, depth]
+                ).astype(jnp.int32)[None, :]
             return header(jnp.max(compacted[1]), jnp.int32(0)), packed, carries
         if ex._int_output:
             windowed = bool(ex.stages[-1].window_ms)
@@ -316,7 +339,7 @@ class ShardedChainExecutor:
         )
 
     def _jitted(self, uploads: Dict, cfg: tuple):
-        striped = len(cfg) == 11  # (..., fanout_cap, srows, kmax)
+        striped = len(cfg) == 12  # (..., enc, fanout_cap, srows, kmax)
         key = (
             tuple(sorted((k, v.shape, str(v.dtype)) for k, v in uploads.items())),
             cfg,
@@ -334,7 +357,7 @@ class ShardedChainExecutor:
             )
             out_specs = (
                 row,  # per-shard (1, 5) headers stack to (n, 5)
-                self._packed_specs(striped),
+                self._packed_specs(striped, cfg[8]),
                 jax.tree_util.tree_map(lambda _: rep, self._carries()),
             )
 
@@ -369,7 +392,7 @@ class ShardedChainExecutor:
             self._jit_cache[key] = fn
         return fn
 
-    def _packed_specs(self, striped: bool = False):
+    def _packed_specs(self, striped: bool = False, enc: str = "off"):
         row = P(RECORD_AXIS)
         mat = P(RECORD_AXIS, None)
         ex = self.executor
@@ -392,6 +415,11 @@ class ShardedChainExecutor:
                 out["src_row"] = row
             else:
                 out["mask"] = row
+            if enc != "off":
+                out.update(
+                    down_ll=row, down_ml=row, down_src=row,
+                    down_lits=row, down_meta=mat,
+                )
             return out
         if ex._int_output:
             out = {"agg_int": row}
@@ -430,6 +458,35 @@ class ShardedChainExecutor:
         need = max(step, ((rows + step - 1) // step) * step)
         return need, need // self.n
 
+    def _shard_segments(self, buf: RecordBuffer) -> tuple:
+        """Per-shard flat segments for the ragged staging: the aligned
+        flat cut at shard row boundaries, each segment padded to one
+        bucketed length (equal shapes keep one compiled program).
+        Shards over the LIVE rows (bucketed), not the buffer's pow2 row
+        padding — trailing all-padding shards would otherwise still
+        ship seg_len bytes each. Shared by `_stage_ragged` and the
+        executor's sharded compress-ahead worker (the cache key is
+        (n, seg_len); the two must never disagree). Returns
+        (segs uint8[n, seg_len], seg_len, cache key)."""
+        ex = self.executor
+        _need, shard_rows = self._row_blocks(min(buf.count, buf.rows))
+        flat, starts = buf.ragged_values()
+        lengths4 = (buf.lengths.astype(np.int64) + 3) & ~3
+        total = int(lengths4.sum())
+        # segment bounds at shard row boundaries (rows past buf.rows are
+        # zero-length padding and contribute no bytes)
+        cuts = [0]
+        for s in range(1, self.n):
+            r = s * shard_rows
+            cuts.append(int(starts[r]) if r < len(starts) else total)
+        cuts.append(total)
+        seg_sizes = np.diff(cuts)
+        seg_len = ex._bucket_bytes(max(int(seg_sizes.max()), 4))
+        segs = np.zeros((self.n, seg_len), dtype=np.uint8)
+        for s in range(self.n):
+            segs[s, : seg_sizes[s]] = flat[cuts[s] : cuts[s + 1]]
+        return segs, seg_len, (self.n, seg_len)
+
     def _stage_ragged(
         self, buf: RecordBuffer, compress_ok: bool = False, span=None
     ) -> tuple:
@@ -452,26 +509,8 @@ class ShardedChainExecutor:
         Returns (uploads dict, static cfg, H2D byte count).
         """
         ex = self.executor
-        # shard over the LIVE rows (bucketed), not the buffer's pow2 row
-        # padding: trailing all-padding shards would otherwise still ship
-        # seg_len bytes each (equal per-shard shapes are required), which
-        # is exactly the H2D blowup this staging exists to avoid
         need, shard_rows = self._row_blocks(min(buf.count, buf.rows))
-        flat, starts = buf.ragged_values()
-        lengths4 = (buf.lengths.astype(np.int64) + 3) & ~3
-        total = int(lengths4.sum())
-        # segment bounds at shard row boundaries (rows past buf.rows are
-        # zero-length padding and contribute no bytes)
-        cuts = [0]
-        for s in range(1, self.n):
-            r = s * shard_rows
-            cuts.append(int(starts[r]) if r < len(starts) else total)
-        cuts.append(total)
-        seg_sizes = np.diff(cuts)
-        seg_len = ex._bucket_bytes(max(int(seg_sizes.max()), 4))
-        segs = np.zeros((self.n, seg_len), dtype=np.uint8)
-        for s in range(self.n):
-            segs[s, : seg_sizes[s]] = flat[cuts[s] : cuts[s + 1]]
+        segs, seg_len, _key = self._shard_segments(buf)
         glz_up, glz_bytes, glz_chunk = None, 0, 0
         if compress_ok:
             # per-buffer cache (the single-device `_glz_cache` precedent):
@@ -479,7 +518,7 @@ class ShardedChainExecutor:
             # buffer re-use the compressed form instead of paying the
             # n-shard compressor again; the cached decline reason counts
             # on EVERY dispatch that ships raw because of it
-            key = (self.n, seg_len)
+            key = _key
             cached = getattr(buf, "_glz_shard_cache", None)
             if cached is not None and cached[0] == key:
                 glz_up, reason = cached[1], cached[2]
@@ -654,7 +693,14 @@ class ShardedChainExecutor:
             t_ph = now
         if ex._fanout and cap_shard is None:
             cap_shard = self._shard_fanout_cap(buf)
-        cfg = cfg + (cap_shard,)
+        # sharded down-link encode: the shared arming rule, further
+        # restricted to narrow viewable/fan-out chains (sharded striped
+        # keeps its raw descriptor ship, mirroring the H2D glz-wide
+        # exclusion — the per-shard token-bucket axis would square the
+        # worst-shard compile matrix; sharded byte-mode keeps the
+        # padded ship, so packing stays off here too)
+        enc_sh = ex._down_axes(striped)[0] if ex._viewable else "off"
+        cfg = cfg + (enc_sh, cap_shard)
         if striped:
             if ex._striped_chain() is None or ex._fanout:
                 # wide batch outside the sharded stripeable subset
@@ -697,6 +743,12 @@ class ShardedChainExecutor:
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
+            if enc_sh != "off" and classify(e) != TRANSIENT:
+                # sync half of the sharded ENCODE ladder: demote one
+                # rung and re-dispatch the same batch (the encoder is
+                # output-side; the staged uploads re-ship from cache)
+                ex._enc_demote(e, enc_sh, where="sharded dispatch")
+                return self._dispatch_buffer_inner(buf, cap_shard, span)
             if not glz_bytes:
                 raise
             if classify(e) == TRANSIENT:
@@ -731,6 +783,7 @@ class ShardedChainExecutor:
         return (
             prev_carries, new_carries, header, packed, cap_shard, span,
             glz_variant if glz_bytes else None,
+            enc_sh if enc_sh != "off" else None,
         )
 
     def discard_dispatch(self, handle) -> None:
@@ -766,16 +819,93 @@ class ShardedChainExecutor:
             [np.asarray(p)[: int(c)] for p, c in zip(parts, counts)]
         )
 
+    def _try_down_fetch(
+        self, buf, packed, down_meta, counts, enc_form, _fetch_all,
+        width: int,
+    ):
+        """Sharded fetch half of the result-encode ladder: download each
+        shard's token slices (one concurrent `_fetch_all`, survivor
+        recovery riding along), inflate per shard, split the descriptor
+        columns. Returns (src, st, ln) or None when the tokens lose the
+        whole-batch ratio race (counted as `glz-enc-ratio`) or a decode
+        fails (one rung down via `_enc_demote`; caller re-fetches the
+        raw columns, which are in ``packed`` regardless)."""
+        ex = self.executor
+        n = self.n
+        G = packed["down_ll"].shape[0] // n
+        L = packed["down_lits"].shape[0] // n
+        n_desc = packed["span_start"].shape[0] // n  # descriptor cap/shard
+        desc_width = width
+        f_st, f_ln = ex._desc_fields(desc_width)
+        buckets = []
+        token_total = 0
+        raw_total = 0
+        for s in range(n):
+            ns, nl = int(down_meta[s, 0]), int(down_meta[s, 1])
+            bs = min(ex._bucket_bytes(max(ns, 8), floor=256), G)
+            bl = min(ex._bucket_bytes(max(nl, 8), floor=256), L)
+            buckets.append((bs, bl))
+            token_total += bs * 6 + bl
+            rows_s = min(ex._bucket_bytes(max(int(counts[s]), 1), 8), n_desc)
+            raw_total += rows_s * (f_st + f_ln)
+        if token_total >= raw_total:
+            TELEMETRY.add_decline(glz.DECLINE_ENC_RATIO)
+            return None
+        from jax import lax as jlax
+
+        slices = []
+        for s in range(n):
+            bs, bl = buckets[s]
+            for name, base_len, b in (
+                ("down_ll", G, bs), ("down_ml", G, bs),
+                ("down_src", G, bs), ("down_lits", L, bl),
+            ):
+                slices.append(
+                    jlax.slice(
+                        packed[name], (s * base_len,), (s * base_len + b,)
+                    )
+                )
+        src, (tok,) = _fetch_all(slices)
+        st_parts, ln_parts = [], []
+        pos = 0
+        for s in range(n):
+            ll_h, ml_h, sc_h, li_h = tok[pos : pos + 4]
+            pos += 4
+            ns, nl, dep = (int(x) for x in down_meta[s])
+            try:
+                stream = glz.decode_result_host(
+                    np.asarray(ll_h), np.asarray(ml_h), np.asarray(sc_h),
+                    np.asarray(li_h), ns, nl, L, dep,
+                )
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                ex._enc_demote(e, enc_form or "xla", where="sharded fetch")
+                return None
+            st_s, ln_s = ex._desc_split(stream, int(counts[s]), desc_width)
+            st_parts.append(st_s)
+            ln_parts.append(ln_s)
+        st = np.concatenate(st_parts).astype(np.int64)
+        ln = np.concatenate(ln_parts).astype(np.int32)
+        return src, st, ln
+
     def finish_buffer(self, buf: RecordBuffer, handle) -> RecordBuffer:
         from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
-        _prev, new_carries, header, packed, cap_shard, span, _glz = handle
+        (_prev, new_carries, header, packed, cap_shard, span, _glz,
+         _enc) = handle
         t_f0 = time.perf_counter() if span is not None else 0.0
         d2h0 = span.phase("d2h") if span is not None else 0.0
         ex = self.executor
         # device-side failures surface at the first blocking sync
         faults.maybe_fire("device")
-        hdrs = np.asarray(jax.device_get(header))  # (n_shards, 5)
+        down_meta = None
+        if "down_meta" in packed:
+            hdr_got = jax.device_get([header, packed["down_meta"]])
+            hdrs = np.asarray(hdr_got[0])  # (n_shards, 5)
+            down_meta = np.asarray(hdr_got[1])  # (n_shards, 3)
+        else:
+            hdrs = np.asarray(jax.device_get(header))  # (n_shards, 5)
         if span is not None:
             span.mark_device_ready()
         counts = hdrs[:, 0].astype(np.int64)
@@ -807,7 +937,12 @@ class ShardedChainExecutor:
                     buf, cap_shard=retry_cap, reuse_span=span
                 )
                 (_prev, new_carries, header, packed, cap_shard, _,
-                 _glz) = handle
+                 _glz, _enc) = handle
+                down_meta = (
+                    np.asarray(jax.device_get(packed["down_meta"]))
+                    if "down_meta" in packed
+                    else None
+                )
                 hdrs = np.asarray(jax.device_get(header))
                 if span is not None:
                     span.mark_device_ready()
@@ -861,6 +996,14 @@ class ShardedChainExecutor:
             return src_h, groups
 
         if ex._viewable:
+            used_tokens = None
+            desc_cols = None
+            if down_meta is not None:
+                desc_cols = self._try_down_fetch(
+                    buf, packed, down_meta, counts, _enc, _fetch_all, width
+                )
+                if desc_cols is not None:
+                    used_tokens = _enc or "xla"
             if ex._needs_stripes(buf) and "span_start" not in packed:
                 # striped survivors are whole records: the segment mask
                 # is the entire download; spans derive host-side (span
@@ -868,6 +1011,8 @@ class ShardedChainExecutor:
                 src, _ = _fetch_all()
                 st = np.zeros(total, dtype=np.int64)
                 ln = buf.lengths[src[:total]].astype(np.int32)
+            elif desc_cols is not None:
+                src, st, ln = desc_cols
             else:
                 # span descriptors are width-bounded: ship them at the
                 # same narrow dtype the single-device fetch uses
@@ -882,6 +1027,7 @@ class ShardedChainExecutor:
                 )
                 st = self._concat_counts(st_parts, counts).astype(np.int64)
                 ln = self._concat_counts(ln_parts, counts).astype(np.int32)
+            ex._count_down_variant(used_tokens)
             vw = int(max(int(hdrs[:, 1].max()), 1))
             vw = min(ex._pad_slice(vw), width)
             out_values = np.zeros((rows_out, vw), dtype=np.uint8)
@@ -925,6 +1071,7 @@ class ShardedChainExecutor:
             if windowed:
                 groups.append(self._shard_slices(packed["agg_win"], counts))
             src, got = _fetch_all(*groups)
+            ex._count_down_variant(None)
             ints = self._concat_counts(got[0], counts).astype(np.int64)
             wins = (
                 self._concat_counts(got[1], counts).astype(np.int64)
@@ -954,6 +1101,10 @@ class ShardedChainExecutor:
                 self._shard_slices(packed["keys"], counts, kw),
                 self._shard_slices(packed["key_lengths"], counts),
             )
+            # sharded byte-mode still ships the padded matrix (result
+            # compaction covers the single-device byte path); count it
+            # honestly so the preflight differential stays exact
+            TELEMETRY.add_link_variant("down-raw")
             out_values = np.zeros((rows_out, vw), np.uint8)
             out_values[:total] = self._concat_counts(got[0], counts)
             out_lengths = np.zeros((rows_out,), np.int32)

@@ -15,6 +15,7 @@ point           seam
 ``device``      first blocking sync on device results (header fetch)
 ``fetch``       the D2H download of result columns
 ``glz_decode``  the on-device link-decompression path (glz armed only)
+``glz_encode``  the on-device result-encode path (down-link ladder armed)
 ``spill_rerun`` the interpreter re-run of a spilled batch
 ``socket_accept``  the SPU monitoring socket's per-client handler
 ==============  ==========================================================
@@ -60,6 +61,7 @@ FAULT_POINTS = (
     "device",
     "fetch",
     "glz_decode",
+    "glz_encode",
     "spill_rerun",
     "socket_accept",
 )

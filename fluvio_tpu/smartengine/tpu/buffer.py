@@ -41,6 +41,23 @@ def apply_postops_host(values: np.ndarray, postops) -> np.ndarray:
     return values
 
 
+def ragged_range_select(
+    flat: np.ndarray, starts: np.ndarray, lengths: np.ndarray
+) -> np.ndarray:
+    """Extract ascending, DISJOINT byte ranges [starts[i], +lengths[i])
+    from ``flat`` with one diff-mark + cumsum boolean select — a few
+    sequential passes, no large fancy-index temporaries (the fat-record
+    split-back hot path). Callers own the precondition: ranges must be
+    ascending and non-overlapping (the running sum then stays in
+    {0, 1}, which is what makes the int8 cumsum safe) and end within
+    ``flat``."""
+    marks = np.zeros(len(flat) + 1, dtype=np.int8)
+    np.add.at(marks, starts, 1)
+    np.add.at(marks, starts + lengths, -1)
+    keep = np.cumsum(marks[:-1], dtype=np.int8).view(np.bool_)
+    return flat[keep]
+
+
 def _next_pow2(n: int, floor: int) -> int:
     v = floor
     while v < n:
@@ -374,15 +391,26 @@ class RecordBuffer:
 
     def to_columns(self) -> dict:
         """Exact (unaligned) columnar form of the live rows — the input
-        shape of `native_backend.encode_record_columns`."""
+        shape of `native_backend.encode_record_columns`.
+
+        Flat-backed buffers (device-side result compaction: the fetch
+        adopted the packed payload, or the view split-back built the
+        4-aligned flat directly) convert with ONE ragged gather over the
+        flat — the padded matrix (and the masked re-extraction it would
+        cost on top) never exists. This is the broker split-back's input
+        form, so a fused slice goes packed-payload -> wire bytes without
+        ever densifying."""
         n = self.count
         lengths = self.lengths[:n].astype(np.int64)
         val_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(lengths, out=val_off[1:])
-        values = self.dense_values()
-        width = values.shape[1]
-        mask = np.arange(width, dtype=np.int32)[None, :] < lengths[:, None]
-        val_flat = values[:n][mask]
+        if self.values is None:
+            val_flat = self._flat_unaligned(lengths, val_off)
+        else:
+            values = self.values
+            width = values.shape[1]
+            mask = np.arange(width, dtype=np.int32)[None, :] < lengths[:, None]
+            val_flat = values[:n][mask]
         key_present = (self.key_lengths[:n] >= 0).astype(np.uint8)
         klens = np.maximum(self.key_lengths[:n], 0).astype(np.int64)
         key_off = np.zeros(n + 1, dtype=np.int64)
@@ -401,18 +429,48 @@ class RecordBuffer:
             "ts_delta": self.timestamp_deltas[:n].astype(np.int64),
         }
 
+    def _flat_unaligned(self, lengths: np.ndarray, val_off: np.ndarray):
+        """Exact-packed live bytes from the 4-aligned flat.
+
+        The live byte ranges [start, start+len) are ascending and
+        disjoint by construction (starts are a cumsum of the aligned
+        lengths), so ONE boolean range-select extracts them — a few
+        sequential passes over the flat, no big fancy-index
+        temporaries (this is the broker split-back's hot path for fat
+        records)."""
+        n = len(lengths)
+        total = int(val_off[-1])
+        if not n or not total:
+            return np.zeros(0, dtype=np.uint8)
+        flat = self._flat
+        if not len(flat):  # all-empty values
+            return np.zeros(total, dtype=np.uint8)
+        # live ranges are ascending and disjoint by construction
+        # (starts are a cumsum of the aligned lengths)
+        return ragged_range_select(
+            flat, self._starts[:n].astype(np.int64), lengths
+        )
+
     # -- materialization ----------------------------------------------------
 
     def to_records(self) -> List[Record]:
         out: List[Record] = []
-        values = self.dense_values()
         keys = self.keys
+        if self.values is None:
+            # flat-backed: slice each record straight out of the flat
+            flat, starts = self._flat, self._starts
+            values_row = lambda i, vlen: flat[  # noqa: E731
+                int(starts[i]) : int(starts[i]) + vlen
+            ]
+        else:
+            values = self.values
+            values_row = lambda i, vlen: values[i, :vlen]  # noqa: E731
         for i in range(self.count):
             vlen = int(self.lengths[i])
             klen = int(self.key_lengths[i])
             out.append(
                 Record(
-                    value=values[i, :vlen].tobytes(),
+                    value=values_row(i, vlen).tobytes(),
                     key=None if klen < 0 else keys[i, :klen].tobytes(),
                     offset_delta=int(self.offset_deltas[i]),
                     timestamp_delta=int(self.timestamp_deltas[i]),

@@ -19,6 +19,7 @@ with a single XLA program per shape bucket.
 from __future__ import annotations
 
 import contextlib
+import functools
 import logging
 import os
 import time
@@ -49,6 +50,7 @@ from fluvio_tpu.smartengine.tpu.buffer import (
     MAX_WIDTH,
     RecordBuffer,
     apply_postops_host,
+    ragged_range_select,
 )
 from fluvio_tpu.smartengine.tpu.lower import (
     Unlowerable,
@@ -448,6 +450,58 @@ def effective_link_compress() -> bool:
     return mode == "on" or (mode == "auto" and jax.default_backend() != "cpu")
 
 
+def effective_result_compact() -> bool:
+    """``FLUVIO_RESULT_COMPACT`` (on/off/auto): device-side result
+    compaction — byte-mode outputs ship as ONE packed payload +
+    lengths instead of a padded matrix, and view/byte materialization
+    builds FLAT-BACKED output buffers (the padded output matrix never
+    exists; the broker split-back consumes the flat directly). "auto"
+    is ON everywhere: it reduces D2H bytes and host materialization
+    cost on every backend."""
+    mode = os.environ.get("FLUVIO_RESULT_COMPACT", "auto")
+    return mode != "off"
+
+
+def effective_result_compress() -> bool:
+    """``FLUVIO_RESULT_COMPRESS`` (on/off/auto): the device-side glz
+    ENCODE ladder for result streams (descriptor blocks, packed
+    payloads) — the down-link mirror of ``FLUVIO_LINK_COMPRESS``.
+    "auto" enables off-CPU only (on CPU there is no link to save), and
+    only composes with compaction (the encoder runs over the packed
+    streams compaction builds)."""
+    mode = os.environ.get("FLUVIO_RESULT_COMPRESS", "auto")
+    if mode == "off":
+        return False
+    if not effective_result_compact():
+        return False
+    return mode == "on" or jax.default_backend() != "cpu"
+
+
+def effective_donation() -> bool:
+    """``FLUVIO_DONATE`` (on/off/auto): donate the staged flat (and glz
+    token) buffers into the chain jits — the staged input is dead after
+    the device re-pad, so XLA may alias it for outputs instead of the
+    fetch paying a copy. "auto" is off on CPU (donation is
+    unimplemented there and warns). Every dispatch stages FRESH device
+    arrays (`jnp.asarray` per call), so heal/retry re-dispatches can
+    never read a donated buffer — pinned in tests/test_glz_encode.py."""
+    mode = os.environ.get("FLUVIO_DONATE", "auto")
+    if mode == "off":
+        return False
+    return mode == "on" or jax.default_backend() != "cpu"
+
+
+def effective_fetch_overlap() -> bool:
+    """``FLUVIO_FETCH_OVERLAP`` (on/off/auto): overlap batch N's host
+    materialization with batch N+1's device phase in the pipelined
+    stream loops. Auto is ON: the deferred half is pure numpy over
+    already-downloaded arrays (all executor-state mutation — failure
+    ladders, carry bookkeeping — resolves before the thunk exists), so
+    the only cost is one shared worker thread."""
+    mode = os.environ.get("FLUVIO_FETCH_OVERLAP", "auto")
+    return mode != "off"
+
+
 # -- transfer-guard strictness (FLUVIO_TRANSFER_GUARD) ------------------------
 #
 # The static arm (analysis FLV003/FLV214) bans implicit D2H syncs in
@@ -524,6 +578,30 @@ def _compress_pool():
     return _GLZ_POOL  # noqa: FLV202 — published once, never rebound
 
 
+_FETCH_POOL = None
+_FETCH_POOL_LOCK = make_lock("executor.fetch_pool")
+
+
+def _fetch_mat_pool():
+    """Process-wide single-worker pool for the stream loops' deferred
+    host materialization (`effective_fetch_overlap`): batch N's pure
+    numpy split-back runs here while the main thread dispatches N+1 and
+    blocks on N+1's downloads. One worker keeps completion in dispatch
+    order; shared across executors like the glz pool."""
+    global _FETCH_POOL
+    # double-checked lazy init (same pattern as _compress_pool): the
+    # unlocked read is a GIL-atomic reference load
+    if _FETCH_POOL is None:  # noqa: FLV202 — double-checked lazy init
+        from concurrent.futures import ThreadPoolExecutor
+
+        with _FETCH_POOL_LOCK:
+            if _FETCH_POOL is None:
+                _FETCH_POOL = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="fetch-mat"
+                )
+    return _FETCH_POOL  # noqa: FLV202 — published once, never rebound
+
+
 class TpuChainExecutor:
     """Compiled chain + device-resident aggregate state."""
 
@@ -550,6 +628,12 @@ class TpuChainExecutor:
             )
             or "empty"
         )
+        # buffer donation (effective_donation): the staged flat / glz
+        # token arrays are dead after the device re-pad, so the jits may
+        # alias them for outputs — fetch stops paying that copy. Args
+        # 0/9/10 are flat, glz_seqs, glz_lits; every dispatch stages
+        # fresh device arrays, so retries never touch a donated buffer.
+        donate = (0, 9, 10) if effective_donation() else ()
         # jit entry points wrapped for compile observability: every
         # trace-cache miss records {kind, chain signature + shape
         # bucket, wall seconds, persistent-cache outcome} (free when
@@ -560,7 +644,9 @@ class TpuChainExecutor:
                 static_argnames=(
                     "width", "kwidth", "has_keys", "has_offsets", "ts_mode",
                     "fanout_cap", "glz_bytes", "glz_variant", "glz_chunk",
+                    "enc", "pack",
                 ),
+                donate_argnums=donate,
             ),
             "ragged",
             describe=self._describe_ragged,
@@ -582,8 +668,9 @@ class TpuChainExecutor:
                 static_argnames=(
                     "srows", "kmax", "kwidth", "has_keys", "has_offsets",
                     "ts_mode", "fanout_cap", "glz_bytes", "glz_variant",
-                    "glz_chunk",
+                    "glz_chunk", "enc", "pack",
                 ),
+                donate_argnums=donate,
             ),
             "striped",
             describe=self._describe_striped,
@@ -700,6 +787,31 @@ class TpuChainExecutor:
         self._rebuild_offsets_from_src = all(
             s.preserves_rows and not s.rewrites_offsets for s in stages
         )
+        # device-side result compaction + the down-link ENCODE ladder
+        # (the PR-8 decode ladder, mirrored): byte-mode outputs pack to
+        # one flat payload, view/fan-out descriptor blocks interleave
+        # into one stream, and either stream optionally glz-ENCODES on
+        # device before D2H ("pallas" window kernel -> "xla" hash
+        # formulation -> raw ship; `_enc_demote` walks the rungs from
+        # both the dispatch and the fetch seams). Resolved ONCE here —
+        # zero per-dispatch cost when off (overhead-gate pinned).
+        self._result_compact = effective_result_compact()
+        self._enc_variant = "off"
+        self._enc_chunk = 0
+        if effective_result_compress():
+            from fluvio_tpu.smartengine.tpu import pallas_kernels
+
+            self._enc_variant = (
+                "pallas" if pallas_kernels.glz_enc_pallas_active() else "xla"
+            )
+            self._enc_chunk = glz.chunk_bytes()
+        # which down-stream the encoder can apply to: descriptor blocks
+        # (view/fan-out survivors) or the byte-mode packed payload;
+        # identity/mask-only and int-output chains have nothing worth
+        # encoding (1 bit/row and delta-narrowed ints)
+        self._enc_eligible = (
+            self._viewable and not self._identity_view
+        ) or (not self._viewable and not self._int_output)
 
     # -- build --------------------------------------------------------------
 
@@ -798,9 +910,96 @@ class TpuChainExecutor:
         """Python-side instances mirror aggregate state for backend parity."""
         self._instances = instances
 
+    # -- device-side result compaction / down-link encode (traced) ----------
+
+    @staticmethod
+    def _desc_fields(width: int):
+        """Static LE byte widths of one interleaved descriptor record:
+        (start, len) at the SAME narrow tiers `_narrow_static` ships the
+        raw columns at (u8 below 256, u16 below 64 Ki, i32 above) — the
+        encoded stream must never start fatter than the raw fallback it
+        competes with. Interleaving (rather than concatenating the
+        columns) keeps each survivor's record contiguous, so corpus
+        periodicity shows up as group periodicity for the encoder's
+        matcher. Fan-out source rows are NOT in the stream: an (almost)
+        incrementing counter defeats group matching, so the src column
+        rides the existing delta-probe download next to the tokens."""
+        return TpuChainExecutor._itm(width), TpuChainExecutor._itm(width + 1)
+
+    @staticmethod
+    def _desc_stream(st, ln, width: int):
+        """Interleave compacted (start, len) descriptor columns into one
+        LE byte stream (traced; the host `_desc_split` is the inverse —
+        the two must not fork). Padded to an 8-byte boundary for the
+        encoder's group alignment."""
+        f_st, f_ln = TpuChainExecutor._desc_fields(width)
+        cols = []
+        for col, f in ((st.astype(jnp.int32), f_st), (ln.astype(jnp.int32), f_ln)):
+            for b in range(f):
+                cols.append((col >> (8 * b)) & 0xFF)
+        desc = jnp.stack(cols, axis=1).astype(jnp.uint8).reshape(-1)
+        pad = (-desc.shape[0]) % 8
+        if pad:
+            desc = jnp.concatenate([desc, jnp.zeros((pad,), jnp.uint8)])
+        return desc
+
+    @staticmethod
+    def _desc_split(desc: np.ndarray, count: int, width: int):
+        """Host inverse of `_desc_stream` over the decoded down bytes:
+        (start, len) columns for ``count`` survivors."""
+        f_st, f_ln = TpuChainExecutor._desc_fields(width)
+        stride = f_st + f_ln
+        rec = (
+            np.ascontiguousarray(desc[: count * stride])
+            .reshape(count, stride)
+            .astype(np.int64)
+        )
+        st = rec[:, 0:f_st] @ (1 << (8 * np.arange(f_st, dtype=np.int64)))
+        ln = rec[:, f_st:stride] @ (1 << (8 * np.arange(f_ln, dtype=np.int64)))
+        return st, ln.astype(np.int32)
+
+    def _down_encode(self, packed: Dict, stream, enc: str) -> None:
+        """Run the device encoder over a down-link byte stream and stash
+        the token arrays + decision scalars in ``packed``. ``stream``'s
+        static length must be a multiple of 8 (descriptor caps and
+        payload caps are). The fetch decides per batch whether the
+        tokens beat the raw slice — losing costs nothing extra on the
+        wire (the raw columns are in ``packed`` either way)."""
+        ll, ml, srcs, lits, n_seq, n_lit, depth = glz.encode_result(
+            stream, self._enc_chunk or glz.GLZ_CHUNK, enc
+        )
+        packed["down_ll"] = ll
+        packed["down_ml"] = ml
+        packed["down_src"] = srcs
+        packed["down_lits"] = lits
+        packed["down_meta"] = jnp.stack(
+            [n_seq, n_lit, depth]
+        ).astype(jnp.int32)
+
+    @staticmethod
+    def _packed_payload(values_c, lengths_c):
+        """Byte-mode result compaction: compacted value rows -> ONE flat
+        4-aligned payload + per-row aligned starts (the exact
+        `RecordBuffer.ragged_values` wire form, so the fetch adopts the
+        download as a flat-backed output buffer with zero reshaping).
+        Returns (payload u8[rows*width], payload_len scalar)."""
+        rows, width = values_c.shape
+        l4 = (lengths_c.astype(jnp.int32) + 3) & ~3
+        starts = jnp.cumsum(l4) - l4
+        cap = rows * width
+        col = jnp.arange(width, dtype=jnp.int32)[None, :]
+        dst = jnp.where(col < l4[:, None], starts[:, None] + col, cap)
+        payload = (
+            jnp.zeros((cap,), jnp.uint8)
+            .at[dst.reshape(-1)]
+            .set(values_c.reshape(-1), mode="drop")
+        )
+        return payload, jnp.sum(l4)
+
     # -- execution ----------------------------------------------------------
 
-    def _chain_fn(self, arrays: Dict, count, base_ts, carries, fanout_cap=None):
+    def _chain_fn(self, arrays: Dict, count, base_ts, carries, fanout_cap=None,
+                  enc: str = "off", pack: bool = False):
         """Fused chain body. Returns (header, packed dict, carries).
 
         D2H is the scarce resource on the host link (BASELINE.md's
@@ -865,6 +1064,18 @@ class TpuChainExecutor:
                 packed["src_row"] = compacted[2]
             else:
                 packed["mask"] = kernels.pack_mask(valid)
+            if enc != "off":
+                # down-link encode of the interleaved descriptor block;
+                # the raw columns stay in packed for the fetch's
+                # per-batch raw-vs-tokens choice
+                self._down_encode(
+                    packed,
+                    self._desc_stream(
+                        compacted[0], compacted[1],
+                        arrays["values"].shape[1],
+                    ),
+                    enc,
+                )
             return _header(jnp.max(compacted[1]), jnp.int32(0)), packed, carries
         if self._int_output:
             windowed = bool(self.stages[-1].window_ms)
@@ -888,10 +1099,23 @@ class TpuChainExecutor:
         elif not self._rebuild_offsets_from_src:
             compact_cols += [state["offset_deltas"], state["timestamp_deltas"]]
         _, compacted = kernels.compact_rows(valid, *compact_cols)
-        packed["values"] = compacted[0]
         packed["lengths"] = compacted[1]
         packed["keys"] = compacted[2]
         packed["key_lengths"] = compacted[3]
+        if pack:
+            # byte-mode result compaction: the padded output matrix
+            # never crosses the link (or, flat-backed, even exists on
+            # the host) — one packed 4-aligned payload does, sliced to
+            # the batch's real byte count at fetch time
+            payload, payload_len = self._packed_payload(
+                compacted[0], compacted[1]
+            )
+            packed["payload"] = payload
+            packed["payload_meta"] = payload_len.astype(jnp.int32)[None]
+            if enc != "off":
+                self._down_encode(packed, payload, enc)
+        else:
+            packed["values"] = compacted[0]
         if self._fanout:
             packed["src_row"] = compacted[4]
         elif not self._rebuild_offsets_from_src:
@@ -926,6 +1150,8 @@ class TpuChainExecutor:
         glz_bytes: int = 0,
         glz_variant: str = "gather",
         glz_chunk: int = 0,
+        enc: str = "off",
+        pack: bool = False,
     ):
         """Reconstruct the padded matrix on device from the flat upload.
 
@@ -969,7 +1195,9 @@ class TpuChainExecutor:
             "offset_deltas": offset_deltas,
             "timestamp_deltas": timestamp_deltas,
         }
-        return self._chain_fn(arrays, count, base_ts, carries, fanout_cap)
+        return self._chain_fn(
+            arrays, count, base_ts, carries, fanout_cap, enc=enc, pack=pack
+        )
 
     # -- striped wide-record path -------------------------------------------
 
@@ -1064,6 +1292,8 @@ class TpuChainExecutor:
         glz_bytes: int = 0,
         glz_variant: str = "gather",
         glz_chunk: int = 0,
+        enc: str = "off",
+        pack: bool = False,
     ):
         """Striped chain body: same ragged flat upload as the narrow
         path (glz decode included), re-padded into ``srows`` stripe rows
@@ -1170,6 +1400,17 @@ class TpuChainExecutor:
             packed["span_start"] = compacted[0]
             packed["span_len"] = compacted[1]
             packed["mask"] = kernels.pack_mask(valid)
+            if enc != "off":
+                # striped spans index into records wider than the u16
+                # tier by definition of the path: always the u32 fields
+                # (MAX_RECORD_WIDTH forces the stride host-side too)
+                self._down_encode(
+                    packed,
+                    self._desc_stream(
+                        compacted[0], compacted[1], MAX_RECORD_WIDTH
+                    ),
+                    enc,
+                )
             return _header(jnp.max(compacted[1])), packed, carries
         # viewable (filters + postop maps): survivors are whole records,
         # so the 1-bit segment mask is the entire D2H payload
@@ -1184,7 +1425,19 @@ class TpuChainExecutor:
             f"{self._chain_sig} w={k.get('width')} "
             f"glz={k.get('glz_bytes', 0)}"
             f"{self._glz_sig(k)} cap={k.get('fanout_cap')}"
+            f"{self._down_sig(k)}"
         )
+
+    @staticmethod
+    def _down_sig(k) -> str:
+        """Down-link static-axis tag: the encode rung and byte-mode
+        packing flag are distinct XLA programs per shape bucket."""
+        tag = ""
+        if k.get("enc", "off") != "off":
+            tag += f" enc={k['enc']}"
+        if k.get("pack"):
+            tag += " pack"
+        return tag
 
     @staticmethod
     def _glz_sig(k) -> str:
@@ -1198,7 +1451,7 @@ class TpuChainExecutor:
         return (
             f"{self._chain_sig} srows={k.get('srows')} "
             f"kmax={k.get('kmax', 0)} glz={k.get('glz_bytes', 0)}"
-            f"{self._glz_sig(k)}"
+            f"{self._glz_sig(k)}{self._down_sig(k)}"
         )
 
     # -- device-memory / in-flight gauges ------------------------------------
@@ -1269,6 +1522,7 @@ class TpuChainExecutor:
             # telemetry records the path the batch ACTUALLY executed:
             # striped batches land in their own latency/record family
             span.path = "striped"
+        enc_now, pack_now = self._down_axes(striped)
         t_ph = time.perf_counter() if span is not None else 0.0
         faults.maybe_fire("stage")
         flat, bucket = self._flat_and_bucket(buf)
@@ -1290,11 +1544,16 @@ class TpuChainExecutor:
         )
         ts_up = jnp.asarray(ts_np) if ts_np is not None else None
 
-        def _call():
+        def _call(glz_variant, enc, pack):
             if glz_bytes:
                 # the device-decode seam: an InjectedFault here takes the
                 # same self-heal path a real decode failure would
                 faults.maybe_fire("glz_decode")
+            if enc != "off":
+                # the device-ENCODE seam: the sync half of the encode
+                # ladder (trace/compile failures); async runtime
+                # failures surface at fetch and heal there
+                faults.maybe_fire("glz_encode")
             faults.maybe_fire("dispatch")
             args = (
                 flat_up,
@@ -1319,6 +1578,8 @@ class TpuChainExecutor:
                 glz_bytes=glz_bytes,
                 glz_variant=glz_variant if glz_bytes else "gather",
                 glz_chunk=glz_chunk if glz_bytes else 0,
+                enc=enc,
+                pack=pack,
             )
             if striped:
                 return self._jit_striped(
@@ -1332,7 +1593,7 @@ class TpuChainExecutor:
         t_ph = time.perf_counter() if span is not None else 0.0
         while True:
             try:
-                header, packed, new_carries = _call()
+                header, packed, new_carries = _call(glz_variant, enc_now, pack_now)
                 break
             except (KeyboardInterrupt, SystemExit):
                 # operator interrupts must unwind, never convert into a
@@ -1340,6 +1601,14 @@ class TpuChainExecutor:
                 # broadened rewrite of this handler may ever swallow them)
                 raise
             except Exception as e:
+                if enc_now != "off":
+                    # sync half of the ENCODE ladder: the encoder is
+                    # output-side, so demotion re-dispatches the SAME
+                    # staged arrays — nothing new crosses the link
+                    # (pallas -> xla -> off; `_enc_demote` counts the
+                    # heal and latches the executor's rung)
+                    enc_now = self._enc_demote(e, enc_now, where="dispatch")
+                    continue
                 if not glz_bytes:
                     raise
                 # self-healing decode ladder (trace/compile errors
@@ -1362,6 +1631,7 @@ class TpuChainExecutor:
             span.add("dispatch", time.perf_counter() - t_ph)
         self._glz_last = bool(glz_bytes)
         self._glz_last_variant = glz_variant if glz_bytes else None
+        self._enc_last = enc_now if enc_now != "off" else None
         # link-variant attribution (always-on counter, like declines):
         # which form THIS batch's flat actually crossed the link in
         TELEMETRY.add_link_variant(
@@ -1398,6 +1668,36 @@ class TpuChainExecutor:
         if len(flat) < bucket:
             return np.pad(flat, (0, bucket - len(flat)))
         return flat
+
+    def _precompress_fn(self, buf: RecordBuffer):
+        """Which compress-ahead job covers ``buf`` on this executor's
+        engine mode: the single-device flat compressor, the sharded
+        per-shard segment compressor (PR-8/9 leftover — the inline
+        n-shard compress was the hot spot the
+        `sharded_inline_compress_shards_total` counter measured), or
+        None (compression off / sharded striped, which keeps its
+        explicit `glz-wide-unsupported` raw ship)."""
+        if not self._link_compress:
+            return None
+        if self._sharded is None:
+            return self._precompress
+        if self._needs_stripes(buf):
+            return None
+        return self._precompress_sharded
+
+    def _precompress_sharded(self, buf: RecordBuffer) -> None:
+        """Worker-thread sharded compress-ahead: fill the buffer's
+        per-shard glz cache so the NEXT sharded dispatch stages warm —
+        the inline n-shard compressor (and its glz_compress phase cost)
+        drops out of the dispatch path exactly like the single-device
+        worker did for flat buffers."""
+        sh = self._sharded
+        segs, seg_len, key = sh._shard_segments(buf)
+        cached = getattr(buf, "_glz_shard_cache", None)
+        if cached is not None and cached[0] == key:
+            return
+        up, reason = sh._compress_segments(segs, seg_len)
+        buf._glz_shard_cache = (key, up, reason)
 
     def _precompress(self, buf: RecordBuffer) -> None:
         """Worker-thread half of the stream loop's compress-ahead: fill
@@ -1439,6 +1739,53 @@ class TpuChainExecutor:
             buf._glz_cache = None
             buf._glz_shard_cache = None
         return "raw"
+
+    def _down_axes(self, striped: bool) -> Tuple[str, bool]:
+        """The down-link STATIC jit axes for a batch on the given
+        layout: (encode rung, byte-mode packing flag). The ONE home for
+        this arming rule — the dispatch seam, the jaxpr-lint/AOT-warmup
+        work list, and the sharded dispatch (which additionally
+        restricts to narrow viewable chains) all resolve through it, so
+        warmup can never compile a program serving won't request. The
+        encode ladder applies to descriptor/payload streams only
+        (striped: span chains ship descriptors, mask-only chains have
+        nothing to encode); byte-mode packing never applies striped
+        (there is no striped byte mode)."""
+        enc = self._enc_variant if self._enc_eligible else "off"
+        if striped and not self._striped_has_span():
+            enc = "off"
+        pack = (
+            self._result_compact
+            and not striped
+            and not self._viewable
+            and not self._int_output
+        )
+        return enc, pack
+
+    def _enc_demote(self, e, variant: str, where: str = "dispatch") -> str:
+        """One rung down the result-ENCODE ladder after a failure of an
+        encode-armed batch — the mirror of `_glz_demote`, shared by the
+        sync dispatch seam, the async fetch seam, and both sharded
+        seams so the ladder cannot diverge: pallas -> xla (the same
+        staged arrays re-dispatch; the encoder is output-side), xla ->
+        raw ship (encode latched off for this executor; the raw packed
+        columns are still in every dispatch's ``packed``, so nothing is
+        lost mid-flight). Counts the heal; returns the new variant."""
+        TELEMETRY.add_heal()
+        log = logging.getLogger(__name__)
+        if variant == "pallas":
+            log.warning(
+                "glz pallas result-encode failed at %s; demoting this "
+                "executor to the XLA hash encoder: %s", where, e,
+            )
+            self._enc_variant = "xla"
+            return "xla"
+        log.warning(
+            "glz result-encode failed at %s; result compression disabled: %s",
+            where, e,
+        )
+        self._enc_variant = "off"
+        return "off"
 
     @staticmethod
     def pad_glz_tokens(comp, seq_pad=None, lit_pad=None):
@@ -1639,17 +1986,82 @@ class TpuChainExecutor:
         self.d2h_bytes_total += 64 + sum(np.asarray(a).nbytes for a in host)
         return host
 
+    @staticmethod
+    def _itm(bound: int) -> int:
+        """Byte width `_narrow_static` ships a column of this bound at."""
+        if bound <= (1 << 8):
+            return 1
+        if bound <= (1 << 16):
+            return 2
+        return 4
+
+    def _down_try_fetch(
+        self, packed, down_meta, variant, raw_cost: int, span,
+        extra_slices=(),
+    ):
+        """Fetch half of the result-encode ladder: download the token
+        slices and inflate host-side — or decline. Returns
+        (stream bytes, extra host arrays) on success, (None, None) when
+        the tokens lose the per-batch ratio race (counted on the
+        decline surface) or the host decode fails (one ladder rung
+        down via `_enc_demote`; the raw columns are still in ``packed``
+        so the caller falls back without a re-dispatch)."""
+        n_seq, n_lit, depth = down_meta
+        cap_s = packed["down_ll"].shape[0]
+        cap_l = packed["down_lits"].shape[0]
+        bs = min(self._bucket_bytes(max(n_seq, 8), floor=256), cap_s)
+        bl = min(self._bucket_bytes(max(n_lit, 8), floor=256), cap_l)
+        if bs * 6 + bl >= raw_cost:
+            TELEMETRY.add_decline(glz.DECLINE_ENC_RATIO)
+            return None, None
+        slices = [
+            lax.slice(packed["down_ll"], (0,), (bs,)),
+            lax.slice(packed["down_ml"], (0,), (bs,)),
+            lax.slice(packed["down_src"], (0,), (bs,)),
+            lax.slice(packed["down_lits"], (0,), (bl,)),
+            *extra_slices,
+        ]
+        host = self._download(slices, span)
+        try:
+            stream = glz.decode_result_host(
+                host[0], host[1], host[2], host[3], n_seq, n_lit, cap_l,
+                depth,
+            )
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            # corrupt tokens: the download already counted its bytes;
+            # demote one rung and let the caller ship the raw columns
+            self._enc_demote(e, variant, where="fetch")
+            return None, None
+        return stream, host[4:]
+
+    def _count_down_variant(self, variant: Optional[str]) -> None:
+        """Per-batch down-link attribution (the D2H mirror of the H2D
+        `link_variants` family, and the preflight's differential truth):
+        ``down-glz-{pallas,xla}`` when encoded tokens shipped,
+        ``down-packed`` for mask/descriptor/delta-int/packed-payload
+        downloads, ``down-raw`` only for the unpacked byte-mode matrix."""
+        if variant:
+            TELEMETRY.add_link_variant(f"down-glz-{variant}")
+        elif self._result_compact or self._viewable or self._int_output:
+            TELEMETRY.add_link_variant("down-packed")
+        else:
+            TELEMETRY.add_link_variant("down-raw")
+
     def _fetch(
-        self, buf: RecordBuffer, header, packed, spec: Optional[Dict] = None
-    ) -> RecordBuffer:
+        self, buf: RecordBuffer, header, packed, spec: Optional[Dict] = None,
+        defer: bool = False,
+    ):
         """The intentional D2H seam: `_fetch_inner` under the explicit
         transfer-guard allow scope (see `transfer_guard_fetch`)."""
         with transfer_guard_fetch():
-            return self._fetch_inner(buf, header, packed, spec)
+            return self._fetch_inner(buf, header, packed, spec, defer)
 
     def _fetch_inner(
-        self, buf: RecordBuffer, header, packed, spec: Optional[Dict] = None
-    ) -> RecordBuffer:
+        self, buf: RecordBuffer, header, packed, spec: Optional[Dict] = None,
+        defer: bool = False,
+    ):
         """Minimal-D2H materialization.
 
         Always downloads the survivor bitmask (1 bit per input row) and
@@ -1674,13 +2086,22 @@ class TpuChainExecutor:
         # 4x fewer bytes on the slow D2H direction for explode chains
         src_delta = None
         int_probe = None
+        # down-link decision scalars ride the same blocking sync as the
+        # header: encode token counts + the packed payload's byte count
+        tail = []
+        if "down_meta" in packed:
+            tail.append(spec.get("down_meta", packed["down_meta"]))
+        if "payload_meta" in packed:
+            tail.append(spec.get("payload_meta", packed["payload_meta"]))
         if self._fanout:
             d, mx, mn, b = (
                 spec["fan_probe"]
                 if "fan_probe" in spec
                 else self._fan_probe(header, packed)
             )
-            hdr, mx, mn, b = jax.device_get([header, mx, mn, b])
+            got = jax.device_get([header, mx, mn, b] + tail)
+            hdr, mx, mn, b = got[:4]
+            tail = got[4:]
             if int(mx) < (1 << 8) and int(mn) >= 0:
                 src_delta = (d.astype(jnp.uint8), int(b))
         elif self._int_output:
@@ -1695,7 +2116,16 @@ class TpuChainExecutor:
             hdr = got[0]
             int_probe = (a_d, w_d, [int(x) for x in got[1:]])
         else:
-            hdr = jax.device_get(header)
+            got = jax.device_get([header] + tail)
+            hdr = got[0]
+            tail = got[1:]
+        down_meta = None
+        payload_len = None
+        if "down_meta" in packed:
+            down_meta = [int(x) for x in tail[0]]
+            tail = tail[1:]
+        if "payload_meta" in packed:
+            payload_len = int(tail[0][0])
         if span is not None:
             # the header sync is the first blocking wait on this batch's
             # results: everything up to here since dispatch-end is device
@@ -1709,6 +2139,17 @@ class TpuChainExecutor:
             if total > cap:
                 raise _FanoutOverflow(total)
         width = buf.width
+
+        def _mat(rows, st, ln, src):
+            """View materialization, optionally deferred: the pure-numpy
+            split-back the overlapped stream loop runs on the fetch
+            worker — every download, probe, and failure ladder has
+            already resolved by the time the thunk exists."""
+            thunk = functools.partial(
+                self._materialize_view, buf, count, rows, width, st, ln,
+                src, max_v,
+            )
+            return thunk if defer else thunk()
 
         def _src_col():
             if src_delta is not None:
@@ -1739,14 +2180,45 @@ class TpuChainExecutor:
             src = self._mask_to_src(host[0], buf)[:count]
             st = np.zeros(count, dtype=np.int64)
             ln = buf.lengths[src].astype(np.int32)
-            return self._materialize_view(
-                buf, count, rows, width, st, ln, src, max_v
-            )
+            self._count_down_variant(None)
+            return _mat(rows, st, ln, src)
         if self._viewable:
             n_desc = packed["span_start"].shape[0]
             rows = min(self._bucket_bytes(max(count, 1), 8), n_desc)
             if not self._fanout:
                 self._spec_prev, self._spec_rows = self._spec_rows, rows
+            if down_meta is not None:
+                # encoded descriptor block: the token download replaces
+                # the (start, len) column slices whenever it wins the
+                # per-batch ratio race; survivor recovery (the mask, or
+                # the fan-out src column through its usual delta-probe
+                # tiers) rides the same download
+                desc_width = (
+                    MAX_RECORD_WIDTH if self._needs_stripes(buf) else width
+                )
+                raw_cost = rows * sum(self._desc_fields(desc_width))
+                stream, extra = self._down_try_fetch(
+                    packed, down_meta, spec.get("enc_variant"), raw_cost,
+                    span,
+                    (lax.slice(_src_col(), (0,), (rows,)),)
+                    if self._fanout
+                    else (packed["mask"],),
+                )
+                if stream is not None:
+                    view_spec = spec.get("view")
+                    if view_spec is not None:
+                        # dispatch-time speculative descriptor copies
+                        # crossed for nothing: charge them
+                        self.d2h_bytes_total += (
+                            view_spec[1].nbytes + view_spec[2].nbytes
+                        )
+                    st, ln = self._desc_split(stream, count, desc_width)
+                    if self._fanout:
+                        src = _src_decode(extra[0])
+                    else:
+                        src = self._mask_to_src(extra[0], buf)[:count]
+                    self._count_down_variant(spec.get("enc_variant") or "xla")
+                    return _mat(rows, st, ln, src)
             view_spec = spec.get("view")
             if view_spec is not None and view_spec[0] == rows:
                 # the dispatch-time speculative copies guessed this
@@ -1773,15 +2245,17 @@ class TpuChainExecutor:
                 src = self._mask_to_src(host[2], buf)[:count]
             st = st_h[:count].astype(np.int64)
             ln = ln_h[:count].astype(np.int32)
-            return self._materialize_view(
-                buf, count, rows, width, st, ln, src, max_v
-            )
+            self._count_down_variant(None)
+            return _mat(rows, st, ln, src)
 
         if self._int_output:
+            self._count_down_variant(None)
             return self._fetch_ints(buf, count, packed, int_probe, span)
 
         return self._fetch_bytes(
-            buf, count, packed, max_v, max_k, _src_col, _src_decode, span
+            buf, count, packed, max_v, max_k, _src_col, _src_decode, span,
+            down_meta=down_meta, payload_len=payload_len,
+            enc_variant=spec.get("enc_variant"),
         )
 
     @staticmethod
@@ -1799,8 +2273,17 @@ class TpuChainExecutor:
     ) -> RecordBuffer:
         """Rebuild view-mode output bytes from the input slab the host
         already holds (shared by the descriptor-download path and the
-        filter-only identity path, which derives st/ln host-side)."""
+        filter-only identity path, which derives st/ln host-side).
+
+        With result compaction armed the output is FLAT-BACKED: one
+        O(total bytes) ragged gather instead of a rows x width padded
+        matrix — the fat-record fetch wall was this very matrix (and
+        the masked re-extraction `to_columns` paid on top of it)."""
         vw = min(self._pad_slice(max(max_v, 1)), width)
+        if self._result_compact:
+            return self._materialize_view_flat(
+                buf, count, rows, vw, st, ln, src
+            )
         out_values = np.zeros((rows, vw), dtype=np.uint8)
         if count:
             keep = np.arange(vw, dtype=np.int32)[None, :] < ln[:, None]
@@ -1828,6 +2311,13 @@ class TpuChainExecutor:
             )
         out_lengths = np.zeros((rows,), dtype=np.int32)
         out_lengths[:count] = ln
+        out_keys, out_klens = self._view_keys(buf, count, rows, src)
+        return self._assemble(buf, count, rows, out_values, out_lengths,
+                              out_keys, out_klens, src)
+
+    def _view_keys(self, buf: RecordBuffer, count: int, rows: int, src):
+        """Survivor key columns for view-mode outputs (shared by the
+        dense and flat materializers)."""
         if buf.has_keys():
             out_keys = np.zeros((rows, buf.keys.shape[1]), dtype=np.uint8)
             out_klens = np.full((rows,), -1, dtype=np.int32)
@@ -1836,19 +2326,98 @@ class TpuChainExecutor:
         else:
             out_keys = np.zeros((rows, 1), dtype=np.uint8)
             out_klens = np.full((rows,), -1, dtype=np.int32)
-        return self._assemble(buf, count, rows, out_values, out_lengths,
-                              out_keys, out_klens, src)
+        return out_keys, out_klens
+
+    def _materialize_view_flat(
+        self, buf: RecordBuffer, count: int, rows: int, vw: int,
+        st: np.ndarray, ln: np.ndarray, src: np.ndarray,
+    ) -> RecordBuffer:
+        """Flat-backed view materialization: gather every survivor's
+        bytes straight into the 4-aligned ragged form `RecordBuffer`
+        ships and the broker split-back consumes — O(sum of lengths)
+        work and memory, no padded matrix.
+
+        Fast path: survivor source ranges in the input flat are
+        ascending and disjoint for every real view family (whole-record
+        survivors, explode elements, JsonGet spans), so ONE boolean
+        range-select (diff-mark + cumsum over the input flat) extracts
+        the payload — ~3 sequential passes instead of the fancy-index
+        gather's many int64 temporaries, which is what the fat-record
+        fetch wall is made of. Alignment-overrun or overlapping spans
+        (possible when a span ends within 3 bytes of the next one's
+        start) fall back to the exact gather."""
+        ln64 = ln.astype(np.int64)
+        l4 = (ln64 + 3) & ~3
+        starts64 = np.cumsum(l4) - l4
+        total = int(l4.sum()) if count else 0
+        flat_out = np.zeros((total,), dtype=np.uint8)
+        if count and total:
+            in_flat, in_starts = buf.ragged_values()
+            if len(in_flat):
+                base = in_starts.astype(np.int64)[src] + st
+                fast = (
+                    base[0] >= 0
+                    and base[-1] + l4[-1] <= len(in_flat)
+                    and bool((base[1:] >= base[:-1] + l4[:-1]).all())
+                )
+                if fast:
+                    flat_out = ragged_range_select(in_flat, base, l4)
+                    # zero the alignment-pad tail bytes (<= 3/record)
+                    pad = l4 - ln64
+                    if pad.any():
+                        npad = int(pad.sum())
+                        padbase = np.repeat(starts64 + ln64, pad)
+                        within = np.arange(npad, dtype=np.int64) - np.repeat(
+                            np.cumsum(pad) - pad, pad
+                        )
+                        flat_out[padbase + within] = 0
+                else:
+                    pos = np.arange(total, dtype=np.int64) - np.repeat(
+                        starts64, l4
+                    )
+                    idx = np.clip(
+                        np.repeat(base, l4) + pos, 0, len(in_flat) - 1
+                    )
+                    keep = pos < np.repeat(ln64, l4)
+                    flat_out = np.where(keep, in_flat[idx], 0).astype(
+                        np.uint8
+                    )
+            flat_out = apply_postops_host(flat_out, self._view_postops)
+        out_lengths = np.zeros((rows,), dtype=np.int32)
+        out_lengths[:count] = ln64
+        starts = np.zeros((rows,), dtype=np.int32)
+        starts[:count] = starts64
+        starts[count:] = total
+        out_keys, out_klens = self._view_keys(buf, count, rows, src)
+        return self._assemble(buf, count, rows, None, out_lengths,
+                              out_keys, out_klens, src,
+                              flat=flat_out, starts=starts, vw=vw)
 
     def _fetch_bytes(
         self, buf: RecordBuffer, count: int, packed, max_v, max_k,
-        _src_col, _src_decode, span=None,
+        _src_col, _src_decode, span=None, down_meta=None,
+        payload_len=None, enc_variant=None,
     ) -> RecordBuffer:
         """Byte-mode materialization: compacted value/key columns cross
         the link sliced to count x used-width (tail of `_fetch`; the
-        src-column helpers close over its probe state)."""
-        n_rows = packed["values"].shape[0]
+        src-column helpers close over its probe state).
+
+        With result compaction armed (``packed["payload"]``) the value
+        matrix never crosses at all: ONE packed 4-aligned payload does —
+        sliced to the batch's real byte count, or inflated from the
+        device-encoded tokens when they win the ratio race — and the
+        output buffer adopts it FLAT-BACKED (the padded output matrix
+        never exists on the host either; `to_columns`/`to_records`
+        consume the flat directly)."""
+        use_payload = "payload" in packed
+        n_rows = packed["lengths"].shape[0]
         rows = min(self._bucket_bytes(max(count, 1), 8), n_rows)
-        vw = min(self._pad_slice(max(max_v, 1)), packed["values"].shape[1])
+        val_w = (
+            packed["payload"].shape[0] // n_rows
+            if use_payload
+            else packed["values"].shape[1]
+        )
+        vw = min(self._pad_slice(max(max_v, 1)), val_w)
         kw = (
             min(self._pad_slice(max(max_k, 1)), packed["keys"].shape[1])
             if max_k > 0
@@ -1856,9 +2425,7 @@ class TpuChainExecutor:
         )
         # byte mode: output widths can exceed the input width (e.g.
         # Concat), so the narrow-length cast keys off the OUTPUT matrix
-        out_len_col = self._narrow_static(
-            packed["lengths"], packed["values"].shape[1] + 1
-        )
+        out_len_col = self._narrow_static(packed["lengths"], val_w + 1)
         want_keys = buf.has_keys() or self._writes_keys
         # survivor recovery: fan-out chains ship an explicit src column;
         # row-preserving chains ship the 1-bit mask when the host rebuilds
@@ -1868,10 +2435,26 @@ class TpuChainExecutor:
         want_dev_offsets = (
             not self._rebuild_offsets_from_src and not self._fanout
         )
-        slices = [
-            lax.slice(packed["values"], (0, 0), (rows, vw)),
-            lax.slice(out_len_col, (0,), (rows,)),
-        ]
+        slices = []
+        payload_np = None
+        used_tokens = None
+        if use_payload:
+            pb = min(
+                self._bucket_bytes(max(payload_len, 1), floor=256),
+                packed["payload"].shape[0],
+            )
+            if down_meta is not None:
+                stream, _ = self._down_try_fetch(
+                    packed, down_meta, enc_variant, pb, span
+                )
+                if stream is not None:
+                    payload_np = stream
+                    used_tokens = enc_variant or "xla"
+            if payload_np is None:
+                slices.append(lax.slice(packed["payload"], (0,), (pb,)))
+        else:
+            slices.append(lax.slice(packed["values"], (0, 0), (rows, vw)))
+        slices.append(lax.slice(out_len_col, (0,), (rows,)))
         if self._fanout:
             slices.append(lax.slice(_src_col(), (0,), (rows,)))
         elif want_mask:
@@ -1884,9 +2467,17 @@ class TpuChainExecutor:
             slices.append(lax.slice(packed["offset_deltas"], (0,), (rows,)))
             slices.append(lax.slice(packed["timestamp_deltas"], (0,), (rows,)))
         host = self._download(slices, span)
-        out_values, out_lengths = host[:2]
-        out_lengths = out_lengths.astype(np.int32)
-        pos = 2
+        pos = 0
+        out_values = None
+        if use_payload:
+            if payload_np is None:
+                payload_np = np.asarray(host[pos])
+                pos += 1
+        else:
+            out_values = host[pos]
+            pos += 1
+        out_lengths = np.asarray(host[pos]).astype(np.int32)
+        pos += 1
         src = None
         if self._fanout:
             src = _src_decode(host[pos])
@@ -1901,6 +2492,18 @@ class TpuChainExecutor:
         else:
             out_klens = np.full((rows,), -1, dtype=np.int32)
             out_keys = np.zeros((rows, 1), dtype=np.uint8)
+        flat = starts = None
+        if use_payload:
+            # adopt the payload flat-backed: per-row aligned starts are
+            # one cumsum over the downloaded lengths (bit-identical to
+            # the device's packing by construction)
+            out_lengths = out_lengths.copy()
+            out_lengths[count:] = 0
+            l4 = (out_lengths.astype(np.int64) + 3) & ~3
+            starts_all = np.cumsum(l4) - l4
+            starts = starts_all.astype(np.int32)
+            flat = np.ascontiguousarray(payload_np[: int(l4.sum())])
+        self._count_down_variant(used_tokens)
         if want_dev_offsets:
             out_off = np.asarray(host[pos]).astype(np.int32)
             out_ts = np.asarray(host[pos + 1]).astype(np.int64)
@@ -1911,9 +2514,13 @@ class TpuChainExecutor:
                 key_lengths=out_klens, offset_deltas=out_off,
                 timestamp_deltas=out_ts, count=count,
                 base_offset=buf.base_offset, base_timestamp=buf.base_timestamp,
+                _flat=flat, _starts=starts,
+                _width=vw if use_payload else 0,
+                _rows=rows if use_payload else 0,
             )
         return self._assemble(buf, count, rows, out_values, out_lengths,
-                              out_keys, out_klens, src)
+                              out_keys, out_klens, src,
+                              flat=flat, starts=starts, vw=vw)
 
     @staticmethod
     def _ints_to_ascii_host(ints: np.ndarray):
@@ -2011,14 +2618,17 @@ class TpuChainExecutor:
                               out_keys, out_klens, src)
 
     def _assemble(self, buf, count, rows, out_values, out_lengths, out_keys,
-                  out_klens, src) -> RecordBuffer:
+                  out_klens, src, flat=None, starts=None,
+                  vw: int = 0) -> RecordBuffer:
         """Rebuild offset/timestamp columns from survivor source rows.
 
         Row-preserving chains pass the source deltas through; fan-out
         outputs are "fresh" — zero relative to their source record's
         batch, i.e. the batch-rebase columns the broker attaches (zeros
         at the engine surface, matching the interpreter's fresh
-        Records)."""
+        Records). With ``flat``/``starts`` set (result compaction) the
+        output buffer is FLAT-BACKED: ``out_values`` is None and the
+        padded matrix is never built."""
         src_c = np.clip(
             src[:count] if len(src) >= count else np.zeros(count, np.int64),
             0,
@@ -2044,6 +2654,10 @@ class TpuChainExecutor:
             count=count,
             base_offset=buf.base_offset,
             base_timestamp=buf.base_timestamp,
+            _flat=flat,
+            _starts=starts,
+            _width=vw if flat is not None else 0,
+            _rows=rows if flat is not None else 0,
         )
 
     def _fanout_cap(self, buf: RecordBuffer) -> Optional[int]:
@@ -2211,6 +2825,19 @@ class TpuChainExecutor:
                 if self.agg_configs and lineage_ok:
                     self._sharded._pending_carries = handle[0]
                 if not (lineage_ok and self._retry_policy.should_retry(e, attempt)):
+                    enc_form = handle[7] if len(handle) > 7 else None
+                    if enc_form is not None and lineage_ok:
+                        # async half of the sharded ENCODE ladder: a
+                        # deterministic failure of an encode-armed batch
+                        # at the stacked-header sync demotes one rung
+                        # and re-dispatches down-ladder (the raw
+                        # re-dispatch has enc_form None, bounding the
+                        # loop exactly like the decode ladder below)
+                        self._enc_demote(e, enc_form, where="sharded fetch")
+                        handle = self._sharded_dispatch(
+                            buf, reuse_span=handle[5]
+                        )
+                        continue
                     glz_form = handle[6] if len(handle) > 6 else None
                     if glz_form is not None and lineage_ok:
                         # async half of the sharded glz ladder: a
@@ -2288,6 +2915,8 @@ class TpuChainExecutor:
         # and the heal epoch its carry lineage belongs to
         spec["glz_used"] = getattr(self, "_glz_last", False)
         spec["glz_variant"] = getattr(self, "_glz_last_variant", None)
+        spec["enc_used"] = getattr(self, "_enc_last", None) is not None
+        spec["enc_variant"] = getattr(self, "_enc_last", None)
         spec["epoch"] = self._heal_epoch
         handle = (prev_carries, header, packed, spec)
         self._gauge_track(handle, self.h2d_bytes_total - h0)
@@ -2310,14 +2939,10 @@ class TpuChainExecutor:
                 if fut is not None:
                     fut.result()
                     fut = None
-                if (
-                    i + 1 < len(bufs)
-                    and self._link_compress
-                    and self._sharded is None
-                ):
-                    fut = _compress_pool().submit(
-                        self._precompress, bufs[i + 1]
-                    )
+                if i + 1 < len(bufs):
+                    job = self._precompress_fn(bufs[i + 1])
+                    if job is not None:
+                        fut = _compress_pool().submit(job, bufs[i + 1])
                 out.append((buf, self.dispatch_buffer(buf)))
         except BaseException:
             # a mid-list dispatch failure (post-retries) must not leak
@@ -2350,6 +2975,14 @@ class TpuChainExecutor:
         """
         spec: Dict = {}
         header.copy_to_host_async()
+        # down-link decision scalars (encode token counts, packed
+        # payload bytes) ride the header's sync
+        if "down_meta" in packed:
+            packed["down_meta"].copy_to_host_async()
+            spec["down_meta"] = packed["down_meta"]
+        if "payload_meta" in packed:
+            packed["payload_meta"].copy_to_host_async()
+            spec["payload_meta"] = packed["payload_meta"]
         if self._fanout:
             d, mx, mn, b = self._fan_probe(header, packed)
             for s in (mx, mn, b):
@@ -2420,7 +3053,22 @@ class TpuChainExecutor:
             # exhaustion) retires the handle's HBM/live-handle gauges
             self._gauge_release(handle)
 
-    def _finish_buffer_inner(self, buf: RecordBuffer, handle) -> RecordBuffer:
+    def finish_buffer_deferred(self, buf: RecordBuffer, handle):
+        """`finish_buffer` with the pure host-materialization half split
+        off: blocks on downloads and resolves every failure ladder on
+        the calling thread, then returns either the finished buffer or
+        a zero-argument thunk (pure numpy over host arrays) the caller
+        may run on the fetch worker — the overlapped stream loops'
+        "fetch runs concurrently with the next batch's device phase"
+        half. Exactly-once by construction: carries, heals, and retries
+        are settled before the thunk exists."""
+        try:
+            return self._finish_buffer_inner(buf, handle, defer=True)
+        finally:
+            self._gauge_release(handle)
+
+    def _finish_buffer_inner(self, buf: RecordBuffer, handle,
+                             defer: bool = False):
         if self._sharded is not None:
             return self._finish_sharded(buf, handle)
         prev_carries, header, packed, spec = handle
@@ -2434,7 +3082,7 @@ class TpuChainExecutor:
         t_f0 = time.perf_counter() if span is not None else 0.0
         d2h0 = span.phase("d2h") if span is not None else 0.0
         try:
-            out = self._fetch(buf, header, packed, spec)
+            out = self._fetch(buf, header, packed, spec, defer=defer)
         except _FanoutOverflow as o:
             self._learn_cap(buf, o.total)
             self._device_carries = prev_carries
@@ -2459,7 +3107,23 @@ class TpuChainExecutor:
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
-            if spec and spec.get("glz_used"):
+            if spec and spec.get("enc_used"):
+                # async half of the ENCODE ladder: a device runtime
+                # failure of an encode-armed batch surfaces when results
+                # are consumed — demote one rung and re-run the batch
+                # through the shared recovery re-dispatch (which owns
+                # the carry snapshot + heal-epoch bookkeeping, exactly
+                # like the decode heal below)
+                self._enc_demote(
+                    e, spec.get("enc_variant") or "xla", where="fetch"
+                )
+                try:
+                    out = self._redispatch_refetch(buf, handle, span)
+                except (TpuSpill, KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e2:
+                    out = self._finish_retry(buf, handle, span, e2)
+            elif spec and spec.get("glz_used"):
                 # async half of the glz self-heal (_dispatch catches
                 # trace/compile errors; device RUNTIME failures surface
                 # here when results are consumed): disable compression,
@@ -2492,22 +3156,34 @@ class TpuChainExecutor:
                 # transient device/fetch failure outside glz: bounded
                 # retry against the handle's carry snapshot
                 out = self._finish_retry(buf, handle, span, e)
-        if span is not None:
-            # fetch = host materialization time inside this finish call:
-            # total minus the device wait (up to ready_t) minus the
-            # blocking d2h copies recorded since this call began
-            t_end = time.perf_counter()
-            wait = 0.0
-            if span.ready_t is not None and span.ready_t > t_f0:
-                wait = span.ready_t - t_f0
-            span.add(
-                "fetch", (t_end - t_f0) - wait - (span.phase("d2h") - d2h0)
-            )
-            # records = INPUT records staged through this batch (same
-            # semantic as the interpreter path, so per-path record
-            # counters compare identical workloads)
-            TELEMETRY.end_batch(span, records=buf.count)
-        return out
+
+        def _complete(result):
+            if span is not None:
+                # fetch = host materialization time for this batch:
+                # total minus the device wait (up to ready_t) minus the
+                # blocking d2h copies recorded since this call began —
+                # in deferred mode the clock stops when the worker-side
+                # materialization finishes, so flight-recorder lanes
+                # show the real overlap with the next batch's phases
+                t_end = time.perf_counter()
+                wait = 0.0
+                if span.ready_t is not None and span.ready_t > t_f0:
+                    wait = span.ready_t - t_f0
+                span.add(
+                    "fetch", (t_end - t_f0) - wait - (span.phase("d2h") - d2h0)
+                )
+                # records = INPUT records staged through this batch (same
+                # semantic as the interpreter path, so per-path record
+                # counters compare identical workloads)
+                TELEMETRY.end_batch(span, records=buf.count)
+            return result
+
+        if callable(out):
+            # deferred materialization: the recovery ladders above all
+            # return finished buffers, so a thunk here is the pure
+            # happy-path split-back
+            return lambda: _complete(out())
+        return _complete(out)
 
     def _finish_stale_epoch(self, buf: RecordBuffer, handle) -> RecordBuffer:
         """Finish an aggregate dispatch whose carry lineage a glz heal
@@ -2571,26 +3247,69 @@ class TpuChainExecutor:
         # yield only after k+1 arrives — immaterial for eager sources
         # (the bench, sharded pipelining, queue drains), one batch of
         # result latency on a sparse tailing source.
+        # Fetch/compute overlap (effective_fetch_overlap): finish_buffer
+        # splits into its blocking half (downloads + failure ladders, on
+        # this thread) and a PURE materialization thunk that runs on the
+        # shared fetch worker — batch k's host split-back proceeds while
+        # batch k+1 dispatches and its device phase runs. One worker
+        # keeps yields in dispatch order.
+        overlap = effective_fetch_overlap() and self._sharded is None
         it = iter(bufs)
         cur = next(it, None)
         pending = None
         fut = None
-        while cur is not None:
-            if fut is not None:
-                # settle before cur dispatches: the staging must never
-                # race the worker on the same buffer's cache
-                fut.result()
-                fut = None
-            handle = self.dispatch_buffer(cur)
-            nxt = next(it, None)
-            if nxt is not None and self._link_compress and self._sharded is None:
-                fut = _compress_pool().submit(self._precompress, nxt)
+        mat = None  # in-flight deferred materialization (Future)
+        try:
+            while cur is not None:
+                if fut is not None:
+                    # settle before cur dispatches: the staging must never
+                    # race the worker on the same buffer's cache
+                    fut.result()
+                    fut = None
+                handle = self.dispatch_buffer(cur)
+                nxt = next(it, None)
+                if nxt is not None:
+                    job = self._precompress_fn(nxt)
+                    if job is not None:
+                        fut = _compress_pool().submit(job, nxt)
+                if pending is not None:
+                    if overlap:
+                        out = self.finish_buffer_deferred(
+                            pending[0], pending[1]
+                        )
+                        if mat is not None:
+                            yield mat.result()
+                            mat = None
+                        if callable(out):
+                            mat = _fetch_mat_pool().submit(out)
+                        else:
+                            yield out
+                    else:
+                        yield self.finish_buffer(pending[0], pending[1])
+                pending = (cur, handle)
+                cur = nxt
             if pending is not None:
-                yield self.finish_buffer(pending[0], pending[1])
-            pending = (cur, handle)
-            cur = nxt
-        if pending is not None:
-            yield self.finish_buffer(pending[0], pending[1])
+                out = (
+                    self.finish_buffer_deferred(pending[0], pending[1])
+                    if overlap
+                    else self.finish_buffer(pending[0], pending[1])
+                )
+                if mat is not None:
+                    yield mat.result()
+                    mat = None
+                yield out() if callable(out) else out
+        except GeneratorExit:
+            # consumer closed us mid-stream: no further yields allowed
+            raise
+        except BaseException:
+            # a later batch's dispatch/finish failure must not swallow a
+            # batch that ALREADY finished and whose pure materialization
+            # is in flight on the worker — the serialized path had
+            # yielded it one iteration earlier (delivered work is never
+            # lost to a neighbor's error)
+            if mat is not None:
+                yield mat.result()
+            raise
 
     def process(
         self, inp: SmartModuleInput, metrics: Optional[SmartModuleChainMetrics] = None

@@ -393,6 +393,284 @@ def decompress_device(lit_lens, match_lens, srcs, lits, depth, out_len: int):
     return lax.fori_loop(0, depth, round_, base)
 
 
+# ---------------------------------------------------------------------------
+# Device-side result ENCODER (the down-link mirror of the decode ladder)
+# ---------------------------------------------------------------------------
+#
+# The fetch wall is the D2H direction (BASELINE.md: 1.4-37 MB/s down vs
+# 20-700 MB/s up), so result streams compress ON DEVICE before they ever
+# cross the link and inflate host-side with the existing decoders
+# (`decompress_host` native, `decompress_numpy` fallback) — the same
+# one-wire-format contract as `compress_link`: chunk-local matches,
+# absolute sources, lit/match lens <= 255, depth <= MAX_DEPTH.
+#
+# A TPU cannot run the host compressor's serial greedy parse, so the
+# device encoder is a data-parallel formulation over aligned 8-byte
+# GROUPS:
+#
+#   1. match detection — a group matches an EARLIER group of its own
+#      chunk with identical bytes. Two interchangeable rungs find the
+#      source: the XLA rung scatter-builds a per-chunk first-occurrence
+#      hash table; the Pallas rung (pallas_kernels.glz_encode_match)
+#      compares a static distance window in VMEM and pointer-squares to
+#      the chain root. Both only ever emit depth-1 sources (targets are
+#      literal groups by construction), so streams stay wire-legal.
+#   2. constant runs (v[g] == v[g-1], e.g. zero tails of bucketed
+#      payloads) get a closed-form source ladder: doubling pieces up to
+#      32 groups, then 31-group pieces reading the run head — depth <=
+#      6 == MAX_DEPTH, and every piece's sources are CONSECUTIVE so the
+#      coalescer below folds each into one 6-byte sequence.
+#   3. sequence formation — runs of literal groups and source-
+#      consecutive match runs coalesce into (lit_len, match_len, src)
+#      sequences, capped at ENC_MAX_RUN groups per half (248 <= u8),
+#      split at chunk boundaries; one scatter packs the literal stream.
+#
+# Both rungs produce VALID streams that decode to the same raw bytes;
+# they may pick different matches (the differential tests pin
+# round-trip equality, not byte-identical tokens).
+
+ENC_GROUP = 8        # bytes per match group (== MIN_MATCH)
+ENC_MAX_RUN = 31     # groups per sequence half: 248 bytes <= the u8 field
+ENC_TABLE = 1 << 15  # first-occurrence hash slots per chunk (XLA rung)
+
+# down-link decline-reason vocabulary (telemetry counter keys)
+DECLINE_ENC_RATIO = "glz-enc-ratio"
+DECLINE_ENC_WIDE = "glz-enc-wide"
+
+
+def _enc_roll1(x, fill=0):
+    import jax.numpy as jnp
+
+    return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
+
+
+def enc_group_words(raw):
+    """(w0, w1) int32 words per aligned 8-byte group of ``raw`` (uint8,
+    length % 8 == 0). Group equality == both words equal."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    words = lax.bitcast_convert_type(raw.reshape(-1, 4), jnp.int32)
+    w = words.reshape(-1, 2)
+    return w[:, 0], w[:, 1]
+
+
+def enc_const_runs(w0, w1, chunk_groups: int):
+    """Constant-run detection + closed-form legal sources.
+
+    Returns (const_m bool[G], csrc int32[G]): group g in a run of
+    identical groups (broken at chunk starts) matches ``csrc[g]`` with
+    chain depth <= 5 relative to the run head; heads themselves may be
+    hash/window-matched (depth 1), so the stream depth bound is 6."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    G = w0.shape[0]
+    gidx = jnp.arange(G, dtype=jnp.int32)
+    eq_prev = (w0 == _enc_roll1(w0)) & (w1 == _enc_roll1(w1))
+    eq_prev = eq_prev & (gidx % chunk_groups != 0)
+    run_start = lax.cummax(jnp.where(~eq_prev, gidx, -1))
+    k = gidx - run_start
+    # doubling pieces for k < 32 (src offset k - 2^floor(log2 k)), then
+    # 31-group pieces replaying the run head; each piece's sources are
+    # consecutive, so coalescing falls out of the generic ext rule
+    hp = jnp.ones_like(k)
+    for b in (2, 4, 8, 16):
+        hp = jnp.where(k >= b, jnp.int32(b), hp)
+    csrc = jnp.where(
+        k < 32, run_start + (k - hp), run_start + ((k - 32) % 31)
+    )
+    return eq_prev, csrc
+
+
+def enc_match_xla(raw, chunk: int):
+    """XLA match-detection rung: (is_match, src_g, depth) per group.
+
+    First-occurrence hash table per chunk (scatter-min), verified by
+    exact group-word compare — a candidate is always the first
+    non-const occurrence of its key in the chunk, hence a literal, so
+    hash matches are depth 1. One extension pass lets a match run
+    continue past its root recycling when the continuation target is a
+    literal (still depth 1). Constant runs override (depth <= 6).
+    """
+    import jax.numpy as jnp
+
+    w0, w1 = enc_group_words(raw)
+    G = w0.shape[0]
+    chunk_groups = chunk // ENC_GROUP
+    n_chunks = max(1, (G + chunk_groups - 1) // chunk_groups)
+    gidx = jnp.arange(G, dtype=jnp.int32)
+    chunk_id = gidx // jnp.int32(chunk_groups)
+
+    const_m, csrc = enc_const_runs(w0, w1, chunk_groups)
+
+    h = (w0 * jnp.int32(-1640531527)) ^ (w1 * jnp.int32(40503))
+    h = (h ^ (h >> 15)) & jnp.int32(ENC_TABLE - 1)
+    # const-matched groups stay out of the table so candidates (and the
+    # extension targets below) can never chain through a const source
+    entry = jnp.where(const_m, jnp.int32(G), gidx)
+    table = jnp.full((n_chunks, ENC_TABLE), G, jnp.int32)
+    table = table.at[chunk_id, h].min(entry, mode="drop")
+    cand = table[chunk_id, h]
+    hm = (
+        (cand < gidx)
+        & (jnp.take(w0, cand, mode="clip") == w0)
+        & (jnp.take(w1, cand, mode="clip") == w1)
+        & ~const_m
+    )
+    src0 = jnp.where(hm, cand, gidx)
+    # extension pass: group g continues the previous group's match when
+    # its bytes equal the next source group AND that target is a
+    # literal under the pre-extension flags (depth stays 1)
+    m0 = const_m | hm
+    prev_m = _enc_roll1(m0, fill=False)
+    prev_src = _enc_roll1(jnp.where(const_m, csrc, src0))
+    tgt = prev_src + 1
+    ext = (
+        ~m0
+        & prev_m
+        & (chunk_id == _enc_roll1(chunk_id))
+        & (tgt < gidx)
+        & (jnp.take(chunk_id, tgt, mode="clip") == chunk_id)
+        & (jnp.take(w0, tgt, mode="clip") == w0)
+        & (jnp.take(w1, tgt, mode="clip") == w1)
+        & ~jnp.take(m0, tgt, mode="clip")
+    )
+    is_match = m0 | ext
+    src_g = jnp.where(
+        const_m, csrc, jnp.where(hm, cand, jnp.where(ext, tgt, gidx))
+    )
+    depth = jnp.where(jnp.any(const_m), jnp.int32(MAX_DEPTH), jnp.int32(1))
+    return is_match, src_g, depth
+
+
+def enc_sequences(raw, is_match, src_g, chunk: int):
+    """Shared sequence formation: group match plan -> token arrays.
+
+    Returns (lit_lens u8[G], match_lens u8[G], srcs i32[G],
+    lits u8[G*8], n_seq i32, n_lit i32) — seg arrays are G-capacity;
+    callers slice to ``n_seq`` / ``n_lit`` (the fetch downloads bucketed
+    slices; the scalars ride the header sync).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    G = is_match.shape[0]
+    chunk_groups = chunk // ENC_GROUP
+    gidx = jnp.arange(G, dtype=jnp.int32)
+    at_cb = (gidx % chunk_groups) == 0
+    prev_m = _enc_roll1(is_match, fill=False)
+    prev_src = _enc_roll1(src_g)
+    ext_run = is_match & prev_m & (src_g == prev_src + 1) & ~at_cb
+    run_change = at_cb | (is_match != prev_m) | (is_match & ~ext_run)
+    runpos = gidx - lax.cummax(jnp.where(run_change, gidx, -1))
+    cap_break = (runpos > 0) & (runpos % ENC_MAX_RUN == 0)
+    piece_change = run_change | cap_break
+    # a match piece directly after a literal group joins that literal
+    # piece's sequence (lits-then-match); every other piece starts one
+    seg_start = piece_change & ~(is_match & ~prev_m & ~at_cb)
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    n_seq = seg_id[-1] + 1
+    litg = ~is_match
+    lit_cnt = jnp.zeros((G,), jnp.int32).at[seg_id].add(
+        litg.astype(jnp.int32), mode="drop"
+    )
+    mat_cnt = jnp.zeros((G,), jnp.int32).at[seg_id].add(
+        is_match.astype(jnp.int32), mode="drop"
+    )
+    lit_lens = (lit_cnt * 8).astype(jnp.uint8)
+    match_lens = (mat_cnt * 8).astype(jnp.uint8)
+    first_m = is_match & (~prev_m | seg_start)
+    srcs = jnp.zeros((G,), jnp.int32).at[
+        jnp.where(first_m, seg_id, jnp.int32(G))
+    ].set(src_g * 8, mode="drop")
+    lit_pos = jnp.cumsum(litg.astype(jnp.int32)) - litg.astype(jnp.int32)
+    n_lit = (jnp.sum(litg.astype(jnp.int32))) * 8
+    dst = (
+        jnp.where(litg, lit_pos, jnp.int32(G))[:, None] * 8
+        + jnp.arange(8, dtype=jnp.int32)[None, :]
+    ).reshape(-1)
+    lits = jnp.zeros((G * 8,), jnp.uint8).at[dst].set(raw, mode="drop")
+    return lit_lens, match_lens, srcs, lits, n_seq, n_lit
+
+
+def encode_result(raw, chunk: int, variant: str = "xla", interpret=None):
+    """The device half of the ENCODE ladder, by variant.
+
+    ``raw`` is a traced uint8 buffer whose static length is a multiple
+    of 8 (callers pad; bucketed result payloads already are).
+    ``variant`` is "pallas" (VMEM window-match, per chunk) or "xla"
+    (hash first-occurrence). Raw ship is the ladder's final rung and
+    lives on the fetch side: the raw columns are still in ``packed``,
+    so falling back costs a bigger download, never a re-dispatch.
+    Returns (lit_lens, match_lens, srcs, lits, n_seq, n_lit, depth).
+    """
+    import jax.numpy as jnp
+
+    # single-window streams (most descriptor blocks are well under one
+    # link chunk) clamp the window to the stream's own lane-rounded
+    # size: the pallas matcher's block — and its distance probes and
+    # pointer-squaring rounds — then track the real stream instead of
+    # padding up to a full 256 KiB chunk of zeros. Multi-window streams
+    # keep the configured chunk so boundaries stay consistent across
+    # rungs. 128 groups = 1024 bytes keeps lane alignment.
+    G = raw.shape[0] // ENC_GROUP
+    if G <= chunk // ENC_GROUP:
+        chunk = max(128, ((G + 127) // 128) * 128) * ENC_GROUP
+
+    if variant == "pallas":
+        from fluvio_tpu.smartengine.tpu import pallas_kernels
+
+        if interpret is None:
+            interpret = pallas_kernels.interpret_mode()
+        w0, w1 = enc_group_words(raw)
+        chunk_groups = chunk // ENC_GROUP
+        const_m, csrc = enc_const_runs(w0, w1, chunk_groups)
+        root = pallas_kernels.glz_encode_match(
+            w0, w1, const_m, chunk_groups, interpret=interpret
+        )
+        gidx = jnp.arange(w0.shape[0], dtype=jnp.int32)
+        wm = (root != gidx) & ~const_m
+        is_match = const_m | wm
+        src_g = jnp.where(const_m, csrc, jnp.where(wm, root, gidx))
+        depth = jnp.where(
+            jnp.any(const_m), jnp.int32(MAX_DEPTH), jnp.int32(1)
+        )
+    else:
+        is_match, src_g, depth = enc_match_xla(raw, chunk)
+    ll, ml, srcs, lits, n_seq, n_lit = enc_sequences(
+        raw, is_match, src_g, chunk
+    )
+    return ll, ml, srcs, lits, n_seq, n_lit, depth
+
+
+def decode_result_host(
+    ll: np.ndarray,
+    ml: np.ndarray,
+    srcs: np.ndarray,
+    lits: np.ndarray,
+    n_seq: int,
+    n_lit: int,
+    out_len: int,
+    depth: int = MAX_DEPTH,
+) -> np.ndarray:
+    """Host half of the result-encode fetch: token slices (bucketed —
+    may carry zero padding past the real counts) -> raw bytes. Uses the
+    native reference decoder when available, else the numpy mirror of
+    the device algorithm."""
+    comp = Compressed(
+        lit_lens=np.ascontiguousarray(ll[:n_seq], dtype=np.uint8),
+        match_lens=np.ascontiguousarray(ml[:n_seq], dtype=np.uint8),
+        srcs=np.ascontiguousarray(srcs[:n_seq], dtype=np.int32),
+        lits=np.ascontiguousarray(lits[:n_lit], dtype=np.uint8),
+        depth=max(int(depth), 1),
+        out_len=out_len,
+    )
+    if available():
+        return decompress_host(comp)
+    return decompress_numpy(comp)
+
+
 def decode_link_flat(
     glz_seqs, glz_lits, depth, out_len: int, variant: str,
     chunk: int = 0, interpret: Optional[bool] = None,

@@ -508,6 +508,125 @@ def glz_decode_pallas(base, midx, chunk: int, interpret: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# glz result ENCODE (per-chunk VMEM window match)
+# ---------------------------------------------------------------------------
+
+# static candidate distances (in 8-byte groups) the window matcher
+# probes: the contiguous short range covers every group period <= 32
+# (an odd byte period P repeats at group distance P), the sparse tail
+# larger power-of-two-ish repeats. The XLA hash rung has no such
+# window limit — a corpus whose period the window misses still
+# compresses after one ladder demotion; the two rungs only promise
+# VALID streams, not identical ones.
+GLZ_ENC_DISTANCES = tuple(range(1, 33)) + (40, 48, 56, 64, 80, 96, 128)
+
+
+def glz_enc_pallas_active() -> bool:
+    """Should result buffers encode with the Pallas window kernel?
+    ``FLUVIO_GLZ_ENC_PALLAS``: ``0`` disables (XLA hash rung),
+    ``1``/``interpret`` forces (interpreted on CPU for equivalence
+    testing), ``auto`` (default) enables off-CPU only — the same ladder
+    shape as the decode's ``FLUVIO_GLZ_PALLAS``. Resolved once per
+    executor build, never per dispatch."""
+    if _disable_depth or not _PALLAS:
+        return False
+    mode = os.environ.get("FLUVIO_GLZ_ENC_PALLAS", "auto")
+    if mode == "0":
+        return False
+    if mode in ("interpret", "1"):
+        return True
+    return not interpret_mode()
+
+
+def _glz_enc_match_kernel(gpc: int, rounds: int,
+                          w0_ref, w1_ref, nc_ref, root_ref):
+    """One chunk: window-match groups against earlier equal groups and
+    resolve each match chain to its literal root, entirely in VMEM.
+
+    Blocks are (gpc/128, 128) int32 views of the chunk's per-group
+    words (``w0``/``w1``) and a not-const eligibility flag (``nc``:
+    const-run groups get their closed-form sources in shared XLA code
+    and must not become window targets, or chains would exceed the
+    depth bound). Every candidate edge requires exact value equality,
+    so pointer-squaring (the decode kernel's trick, reversed) lands on
+    an equal-valued literal root — depth-1 sources by construction.
+    ``root_ref`` is CHUNK-LOCAL group indices; self == literal.
+    """
+    w0 = w0_ref[:, :].reshape(gpc)
+    w1 = w1_ref[:, :].reshape(gpc)
+    nc = nc_ref[:, :].reshape(gpc)
+    idx = jax.lax.iota(jnp.int32, gpc)
+    cand = idx
+    # largest distance first: the LAST write (smallest d) wins, which
+    # keeps chains short for tight periods
+    for d in reversed(GLZ_ENC_DISTANCES):
+        if d >= gpc:
+            continue
+        zeros = jnp.zeros((d,), jnp.int32)
+        s0 = jnp.concatenate([zeros, w0[:-d]])
+        s1 = jnp.concatenate([zeros, w1[:-d]])
+        snc = jnp.concatenate([zeros, nc[:-d]])
+        eq = (w0 == s0) & (w1 == s1) & (idx >= d) & (snc != 0) & (nc != 0)
+        cand = jnp.where(eq, idx - d, cand)
+    for _ in range(rounds):
+        cand = jnp.take(cand, cand)
+    root_ref[:, :] = cand.reshape(-1, GLZ_CHUNK_LANES)
+
+
+def glz_encode_match(w0, w1, const_m, chunk_groups: int,
+                     interpret: bool = False):
+    """Pallas rung of the result-encode ladder: per-group literal-root
+    sources. Inputs are the full buffer's group words plus the shared
+    const-run mask; the grid walks chunks and each step resolves one
+    chunk's match graph in VMEM. Returns GLOBAL root indices (root == g
+    means literal; const-run groups return self and are overridden by
+    the caller's closed-form sources)."""
+    if not _PALLAS:
+        raise RuntimeError("pallas unavailable")
+    G = w0.shape[0]
+    if chunk_groups % GLZ_CHUNK_LANES:
+        raise ValueError(f"glz chunk groups {chunk_groups} not lane-aligned")
+    n_chunks = max(1, (G + chunk_groups - 1) // chunk_groups)
+    padded = n_chunks * chunk_groups
+    w0 = w0.astype(jnp.int32)
+    w1 = w1.astype(jnp.int32)
+    nc = (~const_m).astype(jnp.int32)
+    if padded != G:
+        # pad groups are self-roots: give them a value no real group
+        # can alias within the pad-only tail and mark them ineligible
+        w0 = jnp.pad(w0, (0, padded - G))
+        w1 = jnp.pad(w1, (0, padded - G))
+        nc = jnp.pad(nc, (0, padded - G))
+    rows = chunk_groups // GLZ_CHUNK_LANES
+    shape2 = (n_chunks * rows, GLZ_CHUNK_LANES)
+    rounds = max(1, int(np.ceil(np.log2(max(chunk_groups, 2)))))
+    kernel = functools.partial(_glz_enc_match_kernel, chunk_groups, rounds)
+    with _enable_x64(False):  # see the x64/Mosaic note in json_get_pallas
+        root2 = pl.pallas_call(
+            kernel,
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((rows, GLZ_CHUNK_LANES), lambda b: (b, 0)),
+                pl.BlockSpec((rows, GLZ_CHUNK_LANES), lambda b: (b, 0)),
+                pl.BlockSpec((rows, GLZ_CHUNK_LANES), lambda b: (b, 0)),
+            ],
+            out_specs=pl.BlockSpec((rows, GLZ_CHUNK_LANES), lambda b: (b, 0)),
+            out_shape=jax.ShapeDtypeStruct(shape2, jnp.int32),
+            interpret=interpret,
+        )(
+            w0.reshape(shape2),
+            w1.reshape(shape2),
+            nc.reshape(shape2),
+        )
+    # chunk-local roots -> global
+    local = root2.reshape(padded)[:G]
+    base = (
+        jnp.arange(G, dtype=jnp.int32) // jnp.int32(chunk_groups)
+    ) * jnp.int32(chunk_groups)
+    return base + local
+
+
+# ---------------------------------------------------------------------------
 # DFA regex scan
 # ---------------------------------------------------------------------------
 

@@ -745,6 +745,8 @@ def tpu_finish(
     from fluvio_tpu.smartengine import native_backend
     from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
+    from fluvio_tpu.smartengine.tpu import executor as tpu_executor
+
     tpu = chain.tpu_chain
     base0, ts0 = pending.base0, pending.ts0
     result = BatchProcessResult()
@@ -752,10 +754,35 @@ def tpu_finish(
     # whatever the outcome below (outputs, spill, fused-error decline),
     # this slice's chunks leave the pipelined queue now
     pending.release_depth()
+    # fetch/compute overlap across the slice's chunks: each chunk's
+    # blocking half (downloads + failure ladders) runs here in order,
+    # its PURE split-back thunk on the shared fetch worker — chunk k
+    # materializes while chunk k+1's results download. `finished`
+    # counts chunks whose handles were consumed (the discard slices
+    # below must skip them AND the one that raised).
+    overlap = (
+        tpu_executor.effective_fetch_overlap() and len(pending.chunks) > 1
+    )
     outbufs = []
+    finished = 0
     try:
-        for b, h in pending.chunks:
-            outbufs.append(tpu.finish_buffer(b, h))
+        if overlap:
+            parts = []
+            for b, h in pending.chunks:
+                out = tpu.finish_buffer_deferred(b, h)
+                finished += 1
+                parts.append(
+                    tpu_executor._fetch_mat_pool().submit(out)
+                    if callable(out)
+                    else out
+                )
+            outbufs = [
+                p.result() if hasattr(p, "result") else p for p in parts
+            ]
+        else:
+            for b, h in pending.chunks:
+                outbufs.append(tpu.finish_buffer(b, h))
+                finished += 1
     except TpuSpill:
         # later chunks' dispatch-time D2H copies still crossed the link;
         # discard them so the executor's byte accounting stays honest.
@@ -764,7 +791,7 @@ def tpu_finish(
         # spill per batch — counting the slice here too would inflate
         # spills_total for the single logical event (the slice-level
         # decline counter below already records it once)
-        for _, h in pending.chunks[len(outbufs) + 1 :]:
+        for _, h in pending.chunks[finished + 1 :]:
             tpu.discard_dispatch(h)
         return _decline(metrics, "transform-error-spill")
     except (KeyboardInterrupt, SystemExit):
@@ -773,7 +800,7 @@ def tpu_finish(
         # a device/fetch failure that survived the executor's bounded
         # retries: same containment as a spill — the per-record path
         # decides per batch (carries were rolled back by the executor)
-        for _, h in pending.chunks[len(outbufs) + 1 :]:
+        for _, h in pending.chunks[finished + 1 :]:
             tpu.discard_dispatch(h)
         logging.getLogger(__name__).warning(
             "fused slice finish failed (%s: %s); per-record fallback",

@@ -144,15 +144,63 @@ def test_bench_matrix_predicted_path_matches_observed(name):
     assert sorted(spilled) == sorted(pred.get("spill_reasons", [])), name
 
 
+@pytest.mark.parametrize("name", list(_bench().CONFIGS))
+def test_bench_matrix_predicted_down_variant_matches_observed(
+    name, monkeypatch
+):
+    """ISSUE-12 acceptance pin: with the result-encode ladder armed,
+    the predicted D2H variant must be differential-exact against the
+    telemetry ``down-*`` counters for every bench-matrix config — the
+    one tolerated divergence is a per-batch ratio/size decline, which
+    must then show on the `glz-enc-ratio`/decline surface (the same
+    contract the H2D prediction has with `glz-ratio`)."""
+    monkeypatch.setenv("FLUVIO_RESULT_COMPRESS", "on")
+    b = _bench()
+    cfg = b.CONFIGS[name]
+    if cfg.get("mesh"):
+        pytest.skip("sharded config: single-device differential here")
+    n = _BENCH_SMALL_N.get(name, 48)
+    values = cfg["corpus"](n)
+    ts = cfg["ts"](n) if "ts" in cfg else None
+    pred = preflight_for_specs(cfg["specs"], max(len(v) for v in values))
+    chain = _build_chain(cfg["specs"])
+    lv0 = TELEMETRY.link_variant_counts()
+    d0 = dict(TELEMETRY.declines)
+    _run(chain, values, ts)
+    moved = sorted(
+        k
+        for k, v in TELEMETRY.link_variant_counts().items()
+        if v > lv0.get(k, 0) and k.startswith("down-")
+    )
+    assert moved, f"{name}: no down-variant counter moved"
+    if moved != [pred["down_variant"]]:
+        declines = _decline_delta(d0)
+        assert pred["down_variant"].startswith("down-glz") and set(
+            moved
+        ) <= {"down-packed", pred["down_variant"]}, (
+            f"{name}: predicted {pred['down_variant']}, observed {moved}"
+        )
+        assert any(k.startswith("glz-enc") for k in declines), (
+            f"{name}: down divergence without a decline: {declines}"
+        )
+
+
 def test_bench_preflight_record_shape():
-    """The record bench.py embeds per config: path + link variant +
-    optional reasons. On the CPU test backend link compression resolves
-    off (auto), so the predicted variant is raw."""
+    """The record bench.py embeds per config: path + link variant (both
+    directions) + optional reasons. On the CPU test backend link
+    compression AND the result-encode ladder resolve off (auto), so the
+    predicted H2D variant is raw and the D2H one is down-packed (the
+    headline chain is a descriptor-shipping span chain; compaction is
+    on everywhere)."""
     b = _bench()
     pred = preflight_for_specs(
         b.CONFIGS["2_filter_map"]["specs"], 64
     )
-    assert pred == {"path": "fused", "link_variant": "raw"}
+    assert pred == {
+        "path": "fused",
+        "link_variant": "raw",
+        "down_variant": "down-packed",
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -148,6 +148,10 @@ def _full_config(rps: int, x: float, path: str = "fused") -> dict:
             "down_mb": 4.33,
             "variant": "glz-pallas",
             "variants": {"glz-pallas": 7},
+            # ISSUE-12: the result-side (D2H) variant family — which
+            # form the outputs crossed down in
+            "down_variant": "down-glz-pallas",
+            "down_variants": {"down-glz-pallas": 7},
             "declines": {},
         },
         "path": path,
@@ -173,12 +177,19 @@ def _full_config(rps: int, x: float, path: str = "fused") -> dict:
                 "device": 901.2, "fetch": 240.8, "d2h": 107.4,
             },
             "top": [["device", 0.55], ["fetch", 0.15], ["stage", 0.12]],
+            # ISSUE-12: fraction of the serial pass's d2h+fetch the
+            # pipelined loop hid behind other batches' phases
+            "fetch_overlap": 0.64,
             "e2e_p50_ms": 1554.0,
             "e2e_p99_ms": 1698.0,
         },
         # ISSUE-6: per-config preflight record (predicted-vs-actual
         # executed path from the static analyzer, full detail file-only)
-        "preflight": {"path": path, "actual": path, "agree": True},
+        "preflight": {
+            "path": path, "actual": path, "agree": True,
+            "link_variant": "glz-pallas",
+            "down_variant": "down-glz-pallas",
+        },
         # SLO-PR satellite: per-config verdict block (targets, observed
         # windows, verdict) — full detail file-only; the compact line
         # carries one worst-of-suite slo key
@@ -524,6 +535,59 @@ def test_adm_key_fits_contract_and_trims_before_link():
     src = open(_BENCH_PATH).read()
     ladder = re.search(r"for drop in \(([^)]*)\)", src).group(1)
     assert ladder.index('"adm"') < ladder.index('"link"')
+
+
+def test_down_key_rides_compact_line_and_trims_before_link():
+    """ISSUE-12: the headline's result-side evidence rides the line as
+    the tiny ``down:{mb,variant}`` key, stays inside the 1500-char
+    contract for a full run, and the blowup trim drops ``down`` BEFORE
+    ``link`` (link.glz is the sentinel's contract field)."""
+    import json
+    import re
+
+    bench = _bench()
+    out, rc = bench._build_output(_full_results())
+    line = json.dumps(bench._compact_line(out))
+    assert len(line) <= 1500, f"compact line is {len(line)} chars"
+    parsed = json.loads(line)
+    assert parsed["down"] == {"mb": 4.33, "variant": "down-glz-pallas"}
+    src = open(bench.__file__).read()
+    ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
+    assert ladder.index('"down"') < ladder.index('"link"')
+    assert ladder.index('"down"') < ladder.index('"compile"')
+
+
+def test_fetch_overlap_ratio_in_detail_not_line():
+    """The per-config fetch_overlap ratio is detail-file evidence; the
+    compact line's phases key carries only p50/p99/top."""
+    import json
+
+    bench = _bench()
+    out, rc = bench._build_output(_full_results())
+    cfg = out["configs"]["2_filter_map"]
+    assert cfg["phases"]["fetch_overlap"] == 0.64
+    compact = bench._compact_line(out)
+    assert "fetch_overlap" not in json.dumps(compact.get("phases", {}))
+
+
+def test_phase_breakdown_computes_overlap_ratio():
+    bench = _bench()
+    phases = bench._phase_breakdown(
+        1.0,  # serial single pass: 1000 ms
+        {"device": 500.0, "fetch": 300.0, "d2h": 100.0, "h2d": 100.0},
+        _EmptyHist(),
+        pipelined_s=0.7,  # pipelined hid 300 ms of the 400 ms fetch side
+    )
+    assert phases["fetch_overlap"] == 0.75
+    # no pipelined number -> no ratio key (degraded runs stay honest)
+    phases2 = bench._phase_breakdown(
+        1.0, {"device": 500.0, "fetch": 300.0}, _EmptyHist()
+    )
+    assert "fetch_overlap" not in phases2
+
+
+class _EmptyHist:
+    count = 0
 
 
 def test_sharded_config_skip_entry_rides_configs():

@@ -272,11 +272,11 @@ def test_fetch_time_decode_failure_self_heals(monkeypatch):
     real_fetch = TpuChainExecutor._fetch
     state = {"bombed": False}
 
-    def fetch_bomb(self, buf, header, packed, spec=None):
+    def fetch_bomb(self, buf, header, packed, spec=None, defer=False):
         if spec and spec.get("glz_used") and not state["bombed"]:
             state["bombed"] = True
             raise RuntimeError("simulated device runtime failure")
-        return real_fetch(self, buf, header, packed, spec)
+        return real_fetch(self, buf, header, packed, spec, defer)
 
     monkeypatch.setattr(TpuChainExecutor, "_fetch", fetch_bomb)
     vals = [f'{{"name":"fluvio-{i & 255}","n":{i}}}'.encode()
@@ -319,11 +319,11 @@ def _arm_first_fetch_bomb(monkeypatch):
     real_fetch = TpuChainExecutor._fetch
     state = {"bombed": False}
 
-    def fetch_bomb(self, buf, header, packed, spec=None):
+    def fetch_bomb(self, buf, header, packed, spec=None, defer=False):
         if spec and spec.get("glz_used") and not state["bombed"]:
             state["bombed"] = True
             raise RuntimeError("simulated device decode failure")
-        return real_fetch(self, buf, header, packed, spec)
+        return real_fetch(self, buf, header, packed, spec, defer)
 
     monkeypatch.setattr(TpuChainExecutor, "_fetch", fetch_bomb)
     return state

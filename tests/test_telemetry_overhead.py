@@ -256,6 +256,60 @@ def test_glz_chooser_zero_cost_when_disabled(monkeypatch):
     _one_pass(executor, buf)  # any glz touch raises
 
 
+def test_result_encode_zero_cost_when_disabled(monkeypatch):
+    """ISSUE-12 CI satellite: with the result-ENCODE ladder off (the
+    CPU auto default), the down-link seams must be ZERO work per
+    dispatch — the variant resolves once at executor build, and the
+    fetch never touches the encoder, the token decoder, the pallas
+    encode gate, or the desc-stream packers. Tripwires over a full
+    pipelined pass prove it."""
+    from fluvio_tpu.smartengine.tpu import glz, pallas_kernels
+    from fluvio_tpu.smartengine.tpu.executor import TpuChainExecutor
+
+    monkeypatch.delenv("FLUVIO_RESULT_COMPRESS", raising=False)
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    assert executor._enc_variant == "off"
+    buf = _corpus_buf()
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+
+    def tripwire(*a, **k):
+        raise AssertionError("result-encode seam touched while off")
+
+    for mod, name in (
+        (glz, "encode_result"), (glz, "decode_result_host"),
+        (glz, "enc_match_xla"), (glz, "enc_sequences"),
+        (pallas_kernels, "glz_enc_pallas_active"),
+        (pallas_kernels, "glz_encode_match"),
+    ):
+        monkeypatch.setattr(mod, name, tripwire)
+    monkeypatch.setattr(TpuChainExecutor, "_down_encode", tripwire)
+    monkeypatch.setattr(TpuChainExecutor, "_down_try_fetch", tripwire)
+    _one_pass(executor, buf)  # any encode-seam touch raises
+
+
+def test_fetch_overlap_off_zero_cost(monkeypatch):
+    """ISSUE-12 CI satellite, overlap arm: with FLUVIO_FETCH_OVERLAP
+    off, the stream loop must never touch the fetch worker pool or the
+    deferred-finish surface."""
+    from fluvio_tpu.smartengine.tpu import executor as ex_mod
+
+    monkeypatch.setenv("FLUVIO_FETCH_OVERLAP", "off")
+
+    def tripwire(*a, **k):
+        raise AssertionError("fetch-overlap seam touched while off")
+
+    monkeypatch.setattr(ex_mod, "_fetch_mat_pool", tripwire)
+    monkeypatch.setattr(
+        ex_mod.TpuChainExecutor, "finish_buffer_deferred", tripwire
+    )
+    chain = _headline_chain()
+    buf = _corpus_buf()
+    for out in chain.tpu_chain.process_stream(iter([buf] * 2)):
+        pass
+
+
 def test_slo_sampler_overhead_under_gate():
     """SLO-PR CI satellite: the time-series sampler + SLO evaluator,
     armed and evaluating once per pass (a far hotter cadence than any
