@@ -187,6 +187,22 @@ CONFIGS = {
         "divisor": 1024,
         "mesh": 8,
     },
+    # partitioned-topic execution (ISSUE-13): ≥2 partitions run
+    # concurrently over the (partitions × records) device-group mesh
+    # through the partition runtime — per-partition HBM-resident
+    # aggregate carries and consumer offsets, one mid-run group
+    # failure + rebalance, and a per-partition-sum exactness pin
+    # against the host. Compact line carries `part:{n,rebal}`.
+    "9_partitioned": {
+        "specs": [
+            ("regex-filter", {"regex": "fluvio"}),
+            ("aggregate-field", {"field": "n", "combine": "add"}),
+        ],
+        "corpus": gen_json,
+        "divisor": 2,
+        "partitions": 4,
+        "groups": 2,
+    },
 }
 
 
@@ -471,7 +487,156 @@ def verify_outputs(specs, values, ts, check_n: int) -> None:
 _AB_VERDICT = None  # set to "raw" by the headline A/B
 
 
+def _run_partitioned_config(
+    name: str, cfg: dict, n: int, smoke: bool, deadline=None
+) -> dict:
+    """Partitioned-topic measurement (ISSUE-13): P partition streams
+    interleave through one PartitionRuntime over the (partitions ×
+    records) device-group mesh — per-partition HBM-resident carries +
+    consumer offsets, one injected group failure + rebalance between
+    measured passes, and an exactness pin: the per-partition aggregate
+    sums must reproduce the host-computed per-partition truth."""
+    from fluvio_tpu.partition.placement import (
+        parse_placement_rules,
+        partition_key,
+        plan_placement,
+    )
+    from fluvio_tpu.partition.runtime import PartitionRuntime
+    from fluvio_tpu.telemetry import TELEMETRY
+
+    parts = int(cfg["partitions"])
+    groups = int(cfg.get("groups", 2))
+    divisor = cfg.get("divisor", 1)
+    if divisor > 1:
+        n = max(n // divisor, 1024)
+    runs = 2 if smoke else 3
+    log(f"[{name}] generating {n} records over {parts} partitions ...")
+    values = cfg["corpus"](n)
+    # preflight: the partitioned path executes the same predicted
+    # ladder per partition; predicted-vs-actual lands below
+    preflight = None
+    try:
+        from fluvio_tpu.analysis import preflight_for_specs
+
+        preflight = preflight_for_specs(
+            cfg["specs"], max(len(v) for v in values)
+        )
+        log(f"  preflight: predicted path {preflight['path']}")
+    except Exception as e:  # noqa: BLE001 — analysis must never cost a run
+        log(f"  preflight analysis failed: {type(e).__name__}: {e}")
+    # round-robin split: partition p owns values[p::parts]
+    per_part = [values[p::parts] for p in range(parts)]
+    bufs = [_pack(v) for v in per_part]
+    chain = build_chain("tpu", cfg["specs"])
+    assert chain.backend_in_use == "tpu", name
+    # spread, not hash: the measurement wants BOTH groups owning
+    # partitions so the injected group failure really moves some
+    plan = plan_placement(
+        parse_placement_rules(".*=spread"),
+        [partition_key("bench", p) for p in range(parts)],
+        groups,
+    )
+    runtime = PartitionRuntime(chain.tpu_chain, plan, chain=chain)
+    pr0 = TELEMETRY.path_records()
+    stream = [("bench", p, bufs[p]) for p in range(parts)]
+    t0 = time.time()
+    for _ in runtime.process_interleaved(list(stream)):
+        pass
+    first_call = time.time() - t0
+    times = []
+    rebal_done = False
+    for r in range(runs):
+        if r == 1 and groups > 1 and not rebal_done:
+            # injected group failure between passes: the survivors take
+            # over (carries migrate at next dispatch) — the timing of
+            # later passes INCLUDES the rebalanced layout
+            runtime.fail_group(0)
+            rebal_done = True
+        t0 = time.time()
+        for topic, p, buf, out in runtime.process_interleaved(list(stream)):
+            runtime.offsets.advance(
+                partition_key(topic, p),
+                runtime.offsets.committed(partition_key(topic, p))
+                + buf.count,
+            )
+        times.append(time.time() - t0)
+        if deadline is not None and time.time() > deadline:
+            break
+    t_med = statistics.median(times)
+    tpu_rps = n / t_med
+    log(
+        f"  partitioned tpu: {[f'{t*1000:.0f}ms' for t in times]} -> "
+        f"{tpu_rps:,.0f} records/s across {parts} partitions"
+    )
+    # exactness pin: each partition's final aggregate carry must equal
+    # the host-computed sum over ITS slice of the corpus, across
+    # 1 + runs passes and the mid-run rebalance
+    exact = True
+    try:
+        import json as _json
+        import re as _re
+
+        field = cfg["specs"][-1][1]["field"]
+        pat = _re.compile(cfg["specs"][0][1]["regex"].encode())
+        for p in range(parts):
+            # host truth mirrors the chain: only records surviving the
+            # regex filter reach the aggregate
+            want = sum(
+                _json.loads(v).get(field, 0)
+                for v in per_part[p]
+                if pat.search(v)
+            ) * (1 + len(times))
+            got = runtime.carry_snapshot("bench", p)[0][0]
+            if got != want:
+                exact = False
+                log(f"  EXACTNESS FAIL p{p}: device {got} != host {want}")
+    except Exception as e:  # noqa: BLE001 — the pin must not kill the run
+        log(f"  exactness pin unavailable: {type(e).__name__}: {e}")
+        exact = None
+    deltas = {
+        k: v - pr0.get(k, 0)
+        for k, v in TELEMETRY.path_records().items()
+        if v - pr0.get(k, 0) > 0
+    }
+    path = max(deltas, key=deltas.get) if deltas else "unknown"
+    base_rps = bench_host_baseline(
+        cfg["specs"], values, None, min(n, 2000 if smoke else 20000), "native"
+    ) or bench_host_baseline(
+        cfg["specs"], values, None, min(n, 2000), "python"
+    )
+    result = {
+        "records_per_sec": round(tpu_rps),
+        "payload_mb_per_sec": round(
+            sum(len(v) for v in values) / t_med / 1e6, 1
+        ),
+        "baseline_records_per_sec": round(base_rps),
+        "vs_baseline": round(tpu_rps / base_rps, 2) if base_rps else None,
+        "pass_ms": [round(t * 1000) for t in times],
+        "first_call_s": round(first_call, 2),
+        "path": path,
+        "path_records": deltas,
+        # the partition evidence block (compact line: part:{n,rebal})
+        "part": {
+            "n": parts,
+            "groups": groups,
+            "rebal": runtime.rebalances,
+            "exact": exact,
+            "offsets": runtime.offsets.snapshot(),
+            "plan": runtime.plan.to_dict()["assignments"],
+        },
+    }
+    if preflight is not None:
+        preflight["actual"] = path
+        preflight["agree"] = (
+            preflight["path"] == path if path != "unknown" else None
+        )
+        result["preflight"] = preflight
+    return result
+
+
 def run_config(name: str, cfg: dict, n: int, smoke: bool, deadline=None) -> dict:
+    if cfg.get("partitions"):
+        return _run_partitioned_config(name, cfg, n, smoke, deadline)
     headline = name == "2_filter_map"
     # wide300 re-checks a raw verdict at its own far-better ratio — but
     # only with enough budget left for its re-check to actually run;
@@ -1149,6 +1314,24 @@ def _preflight_counts(configs: dict):
     return {"agree": sum(1 for a in judged if a), "of": len(judged)}
 
 
+def _partition_counts(configs: dict):
+    """Partitioned-config evidence for the compact line's tiny ``part``
+    key: partition count + rebalances survived. None when no config ran
+    partitioned. Full plan/offsets/exactness detail stays in
+    BENCH_DETAIL.json only (the ≤1500-char contract)."""
+    blocks = [
+        c["part"]
+        for c in configs.values()
+        if isinstance(c, dict) and isinstance(c.get("part"), dict)
+    ]
+    if not blocks:
+        return None
+    return {
+        "n": sum(b.get("n", 0) for b in blocks),
+        "rebal": sum(b.get("rebal", 0) for b in blocks),
+    }
+
+
 def _admission_counts(configs: dict):
     """Suite-wide admission evidence for the compact line's tiny
     ``adm`` key: total shed decisions + total warmed buckets. None when
@@ -1273,6 +1456,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         adm = _admission_counts(out["configs"])
         if adm:
             compact["adm"] = adm
+        pt = _partition_counts(out["configs"])
+        if pt:
+            compact["part"] = pt
     if "cpu_fallback" in out:
         inner = out["cpu_fallback"]
         compact["cpu_fallback"] = {
@@ -1285,8 +1471,8 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "adm", "slo", "preflight", "down",
-        "compile", "phases", "error", "xla_cache", "link",
+        "configs", "cpu_fallback", "part", "adm", "slo", "preflight",
+        "down", "compile", "phases", "error", "xla_cache", "link",
     ):
         if len(json.dumps(compact)) <= limit:
             break

@@ -237,9 +237,15 @@ class AdmissionController:
         now = self.clock()
         if breaker is not None and not breaker.allow_fused():
             return self._shed(chain, "breaker-open", "ok")
+        # partition-keyed identity: "sig@topic/partition" keys get their
+        # own token buckets and SLO-verdict families (a hot partition
+        # sheds alone), but warm bookkeeping is per-CHAIN — the AOT
+        # buckets one partition warmed serve every sibling partition of
+        # the same chain, so the cold gate reads through the base sig
+        base = chain.split("@", 1)[0]
         with self._lock:
-            cold = self._require_warm.get(chain) and not self._warmed.get(
-                chain
+            cold = self._require_warm.get(base) and not self._warmed.get(
+                base
             )
         if cold:
             return self._shed(chain, "cold-chain", "ok")

@@ -35,7 +35,7 @@ __all__ = [
     "ERROR", "INFO", "WARN",
     "ChainReport", "Hazard", "PathPrediction", "LintViolation",
     "analyze_entries", "analyze_named", "analyze_chain", "resolve_gates",
-    "predict_link_variant",
+    "analyze_partitioned", "predict_link_variant",
     "lint_source", "lint_file", "lint_paths", "lint_repo",
     "preflight_for_specs",
     "ConcurrencyReport", "analyze_concurrency", "static_lock_graph",
@@ -46,8 +46,8 @@ __all__ = [
 # spec import here would close a cycle back through ops/regex_dfa
 _SPEC_EXPORTS = {
     "ERROR", "INFO", "WARN", "ChainReport", "Hazard", "PathPrediction",
-    "analyze_entries", "analyze_named", "resolve_gates",
-    "predict_link_variant",
+    "analyze_entries", "analyze_named", "analyze_partitioned",
+    "resolve_gates", "predict_link_variant",
 }
 _CONCURRENCY_EXPORTS = {
     "ConcurrencyReport": "ConcurrencyReport",

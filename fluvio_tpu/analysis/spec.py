@@ -901,3 +901,47 @@ def analyze_named(
         for name, params in specs
     ]
     return analyze_entries(entries, widths=widths, sharded=sharded)
+
+
+def analyze_partitioned(
+    entries_by_topic: Dict[str, Sequence],
+    plan,
+    widths: Optional[Sequence[int]] = None,
+    sharded: bool = False,
+) -> dict:
+    """Partitioned-path preflight: per-partition chain families.
+
+    One :func:`analyze_entries` report per topic's chain family, fanned
+    out over the placement plan's partitions. Placement changes nothing
+    about a chain's lowering — every partition of a topic executes the
+    SAME predicted path ladder — so the fan-out is pure identity: each
+    row names the partition's ``chain@topic/partition`` telemetry
+    family (what the differential tests and SLO verdicts key on) and
+    its device group. ``errors`` aggregates ERROR hazards across the
+    families (the ``analyze --partitions`` rc-1 gate).
+    """
+    reports = {
+        topic: analyze_entries(entries, widths=widths, sharded=sharded)
+        for topic, entries in entries_by_topic.items()
+    }
+    rows: List[dict] = []
+    for key, group in plan.rows():
+        topic = key.rsplit("/", 1)[0]
+        report = reports.get(topic)
+        if report is None:
+            continue
+        for pred in report.predictions:
+            rows.append(
+                {
+                    "partition": key,
+                    "group": group,
+                    "chain": f"{report.chain_sig}@{key}",
+                    **pred.to_dict(),
+                }
+            )
+    return {
+        "plan": plan.to_dict(),
+        "chains": {t: r.to_dict() for t, r in reports.items()},
+        "rows": rows,
+        "errors": sum(len(r.errors()) for r in reports.values()),
+    }

@@ -81,6 +81,27 @@ def add_analyze_parser(sub) -> None:
         "(guard map, lock-order graph, FLV2xx hazards)",
     )
     p.add_argument(
+        "--partitions",
+        type=int,
+        metavar="N",
+        help="partitioned-path preflight: place N partitions of --topic "
+        "over the device-group mesh (FLUVIO_PARTITION_RULES grammar, "
+        "group count from --groups or FLUVIO_PARTITIONS) and predict "
+        "each partition's executed path",
+    )
+    p.add_argument(
+        "--groups",
+        type=int,
+        metavar="G",
+        help="device-group count for --partitions "
+        "(default: FLUVIO_PARTITIONS, else 2)",
+    )
+    p.add_argument(
+        "--topic",
+        default="t",
+        help="topic name for --partitions placement keys (default: t)",
+    )
+    p.add_argument(
         "--format",
         choices=("table", "json"),
         default="table",
@@ -150,12 +171,14 @@ async def analyze(args) -> int:
         name for name, wanted in (
             ("concurrency", args.concurrency),
             ("lint", args.lint is not None),
-            ("chain", bool(args.module)),
+            ("partitions", args.partitions is not None),
+            ("chain", bool(args.module) and args.partitions is None),
         ) if wanted
     ]
     if not jobs:
         raise CliError(
-            "nothing to analyze: pass --module (or --lint / --concurrency)"
+            "nothing to analyze: pass --module "
+            "(or --lint / --concurrency / --partitions)"
         )
     # several passes in json mode merge into ONE top-level document —
     # two concatenated dumps would be unparseable machine output
@@ -170,6 +193,10 @@ async def analyze(args) -> int:
         lrc, payload = _run_lint(args, emit=not merge)
         rc = max(rc, lrc)
         merged["lint"] = payload
+    if "partitions" in jobs:
+        prc, payload = _run_partitions(args, emit=not merge)
+        rc = max(rc, prc)
+        merged["partitions"] = payload
     if "chain" in jobs:
         arc, payload = _run_chain(args, emit=not merge)
         rc = max(rc, arc)
@@ -204,6 +231,75 @@ def _run_chain(args, emit: bool = True):
             if errors:
                 print(f"\n{len(errors)} ERROR-severity hazard(s)")
     return (1 if errors else 0), report.to_dict()
+
+
+def _run_partitions(args, emit: bool = True):
+    """``analyze --partitions N``: placement plan table + per-partition
+    path predictions (rc 1 on ERROR hazards in any chain family)."""
+    from fluvio_tpu.analysis import analyze_partitioned
+    from fluvio_tpu.cli.metrics import _rows_to_table
+    from fluvio_tpu.models import lookup
+    from fluvio_tpu.partition.placement import (
+        partition_key,
+        plan_placement,
+        rules_from_env,
+    )
+    from fluvio_tpu.smartengine.config import SmartModuleConfig
+
+    from fluvio_tpu.partition import partitions_env
+
+    if args.partitions < 1:
+        raise CliError("--partitions wants a positive partition count")
+    if not args.module:
+        raise CliError("--partitions needs the chain: pass --module ...")
+    n_groups = args.groups or partitions_env() or 2
+    specs = [_parse_module(m) for m in args.module]
+    try:
+        entries = [
+            (lookup(n), SmartModuleConfig(params=dict(p))) for n, p in specs
+        ]
+        rules = rules_from_env()
+        plan = plan_placement(
+            rules,
+            [partition_key(args.topic, i) for i in range(args.partitions)],
+            n_groups,
+        )
+    except (KeyError, ValueError) as e:
+        raise CliError(str(e)) from e
+    doc = analyze_partitioned(
+        {args.topic: entries}, plan, widths=args.width or None,
+        sharded=args.sharded,
+    )
+    rc = 1 if doc["errors"] else 0
+    if args.format == "json":
+        if emit:
+            print(json.dumps(doc, indent=1))
+        return rc, doc
+    sections = []
+    rows = [
+        (key, group, doc["plan"]["rebalances"])
+        for key, group in sorted(doc["plan"]["assignments"].items())
+    ]
+    sections.append(
+        f"placement plan ({n_groups} device groups)\n"
+        + _rows_to_table(rows, header=("partition", "group", "rebalances"))
+    )
+    rows = [
+        (r["partition"], r["group"], r["width"], r["path"],
+         r["chain"])
+        for r in doc["rows"]
+    ]
+    sections.append(
+        "per-partition path predictions\n"
+        + _rows_to_table(
+            rows, header=("partition", "group", "width", "path", "identity")
+        )
+    )
+    if emit:
+        print("\n\n".join(sections))
+        if rc:
+            print(f"\n{doc['errors']} ERROR-severity hazard(s)")
+    return rc, doc
 
 
 def _run_concurrency(args, emit: bool = True):

@@ -19,6 +19,39 @@ def make_record_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.array(devs), (RECORD_AXIS,))
 
 
+def make_grouped_mesh(
+    n_groups: int,
+    group_size: Optional[int] = None,
+    devices=None,
+    axis_names=("partitions", RECORD_AXIS),
+) -> Mesh:
+    """2-axis mesh: rows are device groups, columns the record axis.
+
+    Generalizes ``make_record_mesh``'s single ``records`` axis to the
+    partition-parallel layout (one row per partition device group). The
+    grid shape is chosen multi-host-style — ``jax.devices()`` order,
+    contiguous rows — so the same call under ``jax.distributed`` yields
+    the per-host-major layout a pod slice would want. A device-poor
+    backend (fewer devices than groups) folds: the mesh carries as many
+    rows as devices allow (≥1) and logical groups map onto rows
+    round-robin at the placement layer — placement DECISIONS are made
+    for ``n_groups`` regardless, so the plan is portable to the bigger
+    pool unchanged.
+    """
+    if n_groups < 1:
+        raise ValueError("n_groups must be >= 1")
+    devs = list(devices if devices is not None else jax.devices())
+    rows = min(n_groups, len(devs))
+    if group_size is None:
+        group_size = max(1, len(devs) // rows)
+    if rows * group_size > len(devs):
+        raise ValueError(
+            f"mesh wants {rows}x{group_size} devices, have {len(devs)}"
+        )
+    grid = np.array(devs[: rows * group_size]).reshape(rows, group_size)
+    return Mesh(grid, tuple(axis_names))
+
+
 def shard_buffer_arrays(arrays: Dict[str, jnp.ndarray], mesh: Mesh) -> Dict[str, jnp.ndarray]:
     """Place buffer columns row-sharded across the record axis."""
     out = {}

@@ -540,6 +540,7 @@ class ShardedChainExecutor:
                     TELEMETRY.add_sharded_compress(self.n)
             if reason is not None:
                 TELEMETRY.add_decline(reason)
+                ex.tag_decline(reason)
             if glz_up is not None:
                 glz_bytes, glz_chunk = seg_len, ex._glz_chunk
         flat_words = segs.reshape(-1).view(np.int32)
@@ -713,6 +714,7 @@ class ShardedChainExecutor:
                 )
             if ex._link_compress:
                 TELEMETRY.add_decline(glz.DECLINE_WIDE)
+                ex.tag_decline(glz.DECLINE_WIDE)
             cfg = cfg + (self._stripe_rows_shard(buf), ex._stripe_kmax(buf))
             if span is not None:
                 span.path = "striped"
@@ -850,6 +852,7 @@ class ShardedChainExecutor:
             raw_total += rows_s * (f_st + f_ln)
         if token_total >= raw_total:
             TELEMETRY.add_decline(glz.DECLINE_ENC_RATIO)
+            ex.tag_decline(glz.DECLINE_ENC_RATIO)
             return None
         from jax import lax as jlax
 

@@ -1,0 +1,105 @@
+"""Partitioned-topic execution layer: partition→device-group placement
+over a 2-axis JAX mesh, per-partition carries/offsets, leader-failover
+replay.
+
+Zero-cost seam contract (the admission-gate pattern): ``gate()`` is the
+broker's one touch point. With ``FLUVIO_PARTITIONS`` unset it resolves
+once to None and every later call is a single cached-flag read — no
+plan, mesh, lock, or placement object exists (the overhead gate
+tripwires this). ``set_gate``/``reset_gate`` let tests and embedders
+swap the seam atomically.
+
+Submodules import lazily (PEP 562) so ``import fluvio_tpu.partition``
+never drags jax in before the gate decides it is needed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_GATE = None
+_RESOLVED = False
+
+_LAZY = {
+    "PlacementRule": "fluvio_tpu.partition.placement",
+    "PlacementPlan": "fluvio_tpu.partition.placement",
+    "plan_placement": "fluvio_tpu.partition.placement",
+    "parse_placement_rules": "fluvio_tpu.partition.placement",
+    "rules_from_env": "fluvio_tpu.partition.placement",
+    "partition_key": "fluvio_tpu.partition.placement",
+    "make_partition_mesh": "fluvio_tpu.partition.placement",
+    "PARTITION_AXIS": "fluvio_tpu.partition.placement",
+    "PartitionRuntime": "fluvio_tpu.partition.runtime",
+    "PartitionOffsets": "fluvio_tpu.partition.runtime",
+    "BrokerPartitionGate": "fluvio_tpu.partition.runtime",
+    "CarryReplica": "fluvio_tpu.partition.failover",
+    "FailoverCoordinator": "fluvio_tpu.partition.failover",
+    "chain_from_spec": "fluvio_tpu.partition.failover",
+}
+
+__all__ = sorted(_LAZY) + ["gate", "set_gate", "reset_gate"]
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+def partitions_env(env: Optional[dict] = None) -> int:
+    """Parsed ``FLUVIO_PARTITIONS`` group count (0 = disabled)."""
+    e = env if env is not None else os.environ
+    spec = (e.get("FLUVIO_PARTITIONS") or "").strip()
+    if not spec:
+        return 0
+    try:
+        n = int(spec)
+    except ValueError:
+        logger.error("ignoring malformed FLUVIO_PARTITIONS=%r", spec)
+        return 0
+    return max(n, 0)
+
+
+def gate():
+    """The broker seam: a resolved ``BrokerPartitionGate`` or None.
+
+    Resolution happens exactly once per process (or per ``reset_gate``)
+    — the disabled path is one flag check, nothing else.
+    """
+    global _GATE, _RESOLVED
+    if not _RESOLVED:
+        n = partitions_env()
+        if n:
+            try:
+                from fluvio_tpu.partition.runtime import BrokerPartitionGate
+
+                _GATE = BrokerPartitionGate(n)
+                logger.warning(
+                    "FLUVIO_PARTITIONS armed: %d device groups", n
+                )
+            except Exception as e:  # noqa: BLE001 — serve beats crash
+                logger.error("partition gate unavailable: %s", e)
+                _GATE = None
+        _RESOLVED = True
+    return _GATE
+
+
+def set_gate(g) -> None:
+    """Install a gate object directly (tests, embedders)."""
+    global _GATE, _RESOLVED
+    _GATE = g
+    _RESOLVED = True
+
+
+def reset_gate() -> None:
+    """Drop the resolved gate so the next ``gate()`` re-reads the env."""
+    global _GATE, _RESOLVED
+    _GATE = None
+    _RESOLVED = False

@@ -1,0 +1,256 @@
+"""Leader failover: carry replication + promotion replay.
+
+The reference system promotes a follower SPU when a partition leader
+dies; the follower's log already holds every record, so it resumes the
+stream where the leader stopped. Our fused chains add one more piece of
+state: the chain's aggregate carry. It is tiny and constant-size (the
+SSM inter-chunk-state argument — a few scalars per aggregate stage), so
+the leader replicates ``(committed_offset, carries)`` to followers on
+every commit, piggybacking on the same cadence as HW advancement.
+
+Promotion then needs no carry transfer from the dead leader: a fresh
+chain is rebuilt from the replayable chain spec (the dead-letter
+machinery's identity format — resilience/deadletter.py), seeded with
+the last committed carry snapshot, and the un-acked records (committed
+offset → LEO, all present in the follower's log) replay through the
+FULL recovery ladder — fused attempt, spill rerun, bounded retry,
+dead-letter quarantine — so every input record lands exactly once in
+served ∪ dead-letter across the handoff and the carries come out
+bit-equal to a run that never failed over.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from fluvio_tpu.analysis.lockwatch import make_lock
+from fluvio_tpu.partition.placement import (
+    PlacementPlan,
+    partition_key,
+    plan_placement,
+    rules_from_env,
+)
+from fluvio_tpu.partition.runtime import PartitionRuntime
+
+logger = logging.getLogger(__name__)
+
+
+def chain_from_spec(chain_spec: List[dict], backend: str = "auto"):
+    """Rebuild an executable chain from a replayable chain spec.
+
+    The spec rows are the dead-letter identity format ({name, kind,
+    params, initial}) — names resolve against the built-in models
+    registry, so a follower (or an operator replaying a dead-letter
+    entry) reconstructs the exact chain the leader ran.
+    """
+    from fluvio_tpu.models import lookup
+    from fluvio_tpu.smartengine import SmartEngine, SmartModuleConfig
+
+    b = SmartEngine(backend=backend).builder()
+    for row in chain_spec:
+        initial = row.get("initial")
+        b.add_smart_module(
+            SmartModuleConfig(
+                params=dict(row.get("params") or {}),
+                initial_data=(
+                    base64.b64decode(initial) if initial else b""
+                ),
+            ),
+            lookup(row["name"]),
+        )
+    return b.initialize()
+
+
+class CarryReplica:
+    """The follower-side replication bus for per-partition chain state.
+
+    Leaders ``publish`` after every served batch; promotion reads
+    ``latest``. State is a few host ints per partition — publishing at
+    commit cadence is noise next to the record traffic it rides with.
+    """
+
+    def __init__(self):
+        self._lock = make_lock("partition.carry_replica")
+        self._state: Dict[str, tuple] = {}
+        self._leaders: Dict[str, object] = {}
+
+    def bind_leader(self, key: str, leader) -> None:
+        """Mirror publishes onto the partition's LeaderReplicaState
+        carry bus (spu/replica.py publish_carry) so in-broker consumers
+        of the replica layer see the same snapshots."""
+        with self._lock:
+            self._leaders[key] = leader
+
+    def publish(
+        self,
+        key: str,
+        committed_offset: int,
+        carries: List[tuple],
+        inst_state: Optional[List[tuple]] = None,
+    ) -> None:
+        with self._lock:
+            self._state[key] = (
+                committed_offset,
+                [tuple(c) for c in carries],
+                [tuple(s) for s in inst_state] if inst_state else None,
+            )
+            leader = self._leaders.get(key)
+        if leader is not None:
+            leader.publish_carry(committed_offset, carries)
+
+    def latest(self, key: str) -> Tuple[int, Optional[list], Optional[list]]:
+        """(committed_offset, carries, inst_state); (-1, None, None)
+        when nothing was ever committed (replay from the beginning,
+        seed carries)."""
+        with self._lock:
+            got = self._state.get(key)
+        if got is None:
+            return -1, None, None
+        return got
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: v[0] for k, v in self._state.items()}
+
+
+@dataclass
+class _PartitionLog:
+    """The follower's view of one partition's log: every appended
+    record slab with its offsets (the real follower replicates these
+    via the PR-0 sync sessions; the harness appends directly)."""
+
+    entries: List[tuple] = field(default_factory=list)  # (base, next, slab)
+
+    def append(self, base_offset: int, next_offset: int, slab) -> None:
+        self.entries.append((base_offset, next_offset, slab))
+
+    def unacked(self, committed: int) -> List[tuple]:
+        return [e for e in self.entries if e[1] > committed]
+
+
+class FailoverCoordinator:
+    """Drives a partitioned stream with leader-loss promotion.
+
+    The leader runs the FAST path only (executor dispatch/finish via
+    the partition runtime): an injected deterministic fault at any
+    pipeline seam (stage/h2d/dispatch/device/fetch — the PR-3 fault
+    points) escapes as an exception, which IS the leader loss. The
+    promoted follower replays through the full recovery ladder, so
+    faults that would have demoted batches on a healthy leader instead
+    resolve (or dead-letter) during replay — exactly-once either way.
+    """
+
+    def __init__(
+        self,
+        chain_spec: List[dict],
+        topic: str = "t",
+        n_groups: int = 2,
+        backend: str = "tpu",
+        plan: Optional[PlacementPlan] = None,
+    ):
+        self.chain_spec = [dict(r) for r in chain_spec]
+        self.topic = topic
+        self.n_groups = n_groups
+        self.backend = backend
+        self._plan = plan
+        self.replica = CarryReplica()
+        self.logs: Dict[str, _PartitionLog] = {}
+        self.served: Dict[str, list] = {}
+        self.promotions = 0
+        self.leader = self._build_runtime()
+
+    def _build_runtime(self) -> PartitionRuntime:
+        chain = chain_from_spec(self.chain_spec, backend=self.backend)
+        if chain.tpu_chain is None:
+            raise ValueError("failover coordinator needs a fused chain")
+        plan = self._plan or plan_placement(
+            rules_from_env(), [], self.n_groups
+        )
+        return PartitionRuntime(chain.tpu_chain, plan, chain=chain)
+
+    # -- leader path ---------------------------------------------------------
+
+    def _commit(self, key: str, partition: int, next_offset: int, out) -> None:
+        self.served.setdefault(key, []).extend(out)
+        self.leader.offsets.advance(key, next_offset)
+        topic = self.topic
+        self.replica.publish(
+            key,
+            next_offset,
+            self.leader.carry_snapshot(topic, partition),
+        )
+
+    def run(self, slabs_by_partition: List[Tuple[int, object]]) -> None:
+        """Process an interleaved stream of (partition, slab) pairs.
+
+        Every slab appends to the follower log BEFORE the leader
+        touches it (the follower's sync is ahead of serving, as in the
+        reference replication protocol), so a leader death at any seam
+        leaves the records replayable. On leader death the promotion
+        runs inline and the stream continues on the new leader.
+        """
+        from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer
+
+        pending = list(slabs_by_partition)
+        while pending:
+            partition, slab = pending.pop(0)
+            key = partition_key(self.topic, partition)
+            committed = self.leader.offsets.committed(key)
+            base = max(committed, 0)
+            nxt = base + len(slab.records or [])
+            self.logs.setdefault(key, _PartitionLog()).append(
+                base, nxt, slab
+            )
+            try:
+                buf = RecordBuffer.from_smartmodule_input(slab)
+                out = self.leader.process(self.topic, partition, buf)
+                self._commit(key, partition, nxt, out.to_records())
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                logger.warning(
+                    "leader died serving %s (%s: %s); promoting follower",
+                    key, type(e).__name__, e,
+                )
+                self.promote()
+
+    # -- promotion -----------------------------------------------------------
+
+    def promote(self) -> None:
+        """Replace the dead leader: rebuild the chain from its
+        replayable spec, seed every partition with its last committed
+        carry snapshot, and replay the un-acked suffix of each log
+        through the full recovery ladder."""
+        self.promotions += 1
+        old_offsets = self.leader.offsets
+        runtime = self._build_runtime()
+        # committed consumer offsets survive the handoff (they live on
+        # the replica bus, not in the dead leader)
+        for key, committed in old_offsets.snapshot().items():
+            runtime.offsets.advance(key, committed)
+        self.leader = runtime
+        for key, plog in sorted(self.logs.items()):
+            partition = int(key.rsplit("/", 1)[1])
+            committed, carries, inst = self.replica.latest(key)
+            if carries is not None:
+                runtime.seed_partition(
+                    self.topic, partition, carries, inst_state=inst
+                )
+            for base, nxt, slab in plog.unacked(committed):
+                # full ladder: a record that still fails both paths
+                # dead-letters (stream advances empty) — exactly-once
+                # accounting lands it in served ∪ quarantined
+                out = runtime.process_chain(self.topic, partition, slab)
+                self._commit(key, partition, nxt, out.successes)
+
+    # -- accounting ----------------------------------------------------------
+
+    def served_values(self, partition: int) -> List[bytes]:
+        key = partition_key(self.topic, partition)
+        return [r.value for r in self.served.get(key, [])]
+
+    def final_carries(self, partition: int) -> List[tuple]:
+        return self.leader.carry_snapshot(self.topic, partition)

@@ -1,0 +1,279 @@
+"""Rule-driven partition→device-group placement over a 2-axis JAX mesh.
+
+The reference system scales by topic partitions spread across leader
+SPUs (PAPER.md layers L3/L4). This module rebuilds that placement story
+on a JAX device mesh: a ``(partitions, records)`` 2-axis grid — each
+row is one *device group* that owns a set of ``(topic, partition)``
+replicas — generalizing ``parallel/mesh.py``'s single ``records`` axis.
+Declarative :class:`PlacementRule`\\ s (the ``match_partition_rules``
+pattern: first regex match over the ``topic/partition`` key wins) map
+partitions onto groups, and :meth:`PlacementPlan.rebalance` reassigns a
+failed group's partitions onto the survivors deterministically.
+
+The layout is kept multi-host-shaped from day one: groups are rows of a
+named mesh whose axis names (``partitions`` × ``records``) are exactly
+the layout a ``jax.distributed`` multi-host pool would declare — today
+the rows map onto one host's local devices (data-parallel), and when
+several groups must share a smaller device pool (the CPU backend's
+single device, most commonly) logical groups fold onto mesh rows
+round-robin without changing any placement decision.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from fluvio_tpu.parallel.mesh import RECORD_AXIS, make_grouped_mesh
+
+PARTITION_AXIS = "partitions"
+
+# env grammar (shaped like FLUVIO_FAULTS / FLUVIO_SLO):
+#   FLUVIO_PARTITION_RULES="orders/.*=0;logs/[0-3]=spread;.*=hash"
+_GROUP_WORDS = ("hash", "spread")
+
+
+def partition_key(topic: str, partition: int) -> str:
+    """The canonical rule-matching key: ``topic/partition``."""
+    return f"{topic}/{partition}"
+
+
+@dataclass(frozen=True)
+class PlacementRule:
+    """One declarative placement rule.
+
+    ``pattern`` is a regex searched against the ``topic/partition`` key;
+    ``group`` is either a concrete group index, ``"hash"`` (stable
+    crc32 of the key modulo group count — the default spread), or
+    ``"spread"`` (least-loaded group at assignment time).
+    """
+
+    pattern: str
+    group: object  # int | "hash" | "spread"
+
+    def __post_init__(self):
+        re.compile(self.pattern)  # fail loud at rule build, not at match
+        if not isinstance(self.group, int) and self.group not in _GROUP_WORDS:
+            raise ValueError(
+                f"rule group must be an int or one of {_GROUP_WORDS}, "
+                f"got {self.group!r}"
+            )
+
+
+DEFAULT_RULES: Tuple[PlacementRule, ...] = (PlacementRule(".*", "hash"),)
+
+
+def parse_placement_rules(spec: Optional[str]) -> Tuple[PlacementRule, ...]:
+    """Parse the ``FLUVIO_PARTITION_RULES`` grammar.
+
+    ``"pat=group;pat=group"`` — empty/None yields the default
+    hash-everything rule. Malformed specs raise ``ValueError`` (the
+    caller decides whether that is fatal; the CLI surfaces it, the
+    broker gate logs and falls back to defaults).
+    """
+    if not spec or not spec.strip():
+        return DEFAULT_RULES
+    rules: List[PlacementRule] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"placement rule {part!r} is not pat=group")
+        pat, _, grp = part.rpartition("=")
+        grp = grp.strip()
+        group: object = int(grp) if grp.lstrip("-").isdigit() else grp
+        rules.append(PlacementRule(pat.strip(), group))
+    return tuple(rules) if rules else DEFAULT_RULES
+
+
+def rules_from_env(env: Optional[dict] = None) -> Tuple[PlacementRule, ...]:
+    e = env if env is not None else os.environ
+    return parse_placement_rules(e.get("FLUVIO_PARTITION_RULES"))
+
+
+def validate_rules(rules: Sequence[PlacementRule], n_groups: int) -> None:
+    """Reject rule sets that can only fail at match time: a pinned
+    group index outside the mesh is a deploy error and must surface at
+    gate/plan construction, not on the first slice of some topic."""
+    for rule in rules:
+        if isinstance(rule.group, int) and not 0 <= rule.group < n_groups:
+            raise ValueError(
+                f"placement rule {rule.pattern!r} pins group {rule.group} "
+                f"but the mesh has {n_groups} groups"
+            )
+
+
+def match_placement(
+    rules: Sequence[PlacementRule],
+    key: str,
+    n_groups: int,
+    loads: Optional[Dict[int, int]] = None,
+) -> int:
+    """Resolve one key against the rule list (first match wins).
+
+    ``loads`` carries current per-group assignment counts for
+    ``"spread"`` resolution. No matching rule raises — the exemplar's
+    contract (an unplaced partition is a deploy error, not a silent
+    default).
+    """
+    for rule in rules:
+        if re.search(rule.pattern, key) is None:
+            continue
+        if isinstance(rule.group, int):
+            if not 0 <= rule.group < n_groups:
+                raise ValueError(
+                    f"rule {rule.pattern!r} names group {rule.group} but the "
+                    f"mesh has {n_groups} groups"
+                )
+            return rule.group
+        if rule.group == "hash":
+            # blake2s, not crc32: crc has no avalanche — sequential
+            # partition suffixes ("t/0".."t/3") land mod-2 on ONE group
+            digest = hashlib.blake2s(key.encode(), digest_size=8).digest()
+            return int.from_bytes(digest, "little") % n_groups
+        # "spread": least-loaded group, lowest index breaking ties
+        loads = loads or {}
+        return min(range(n_groups), key=lambda g: (loads.get(g, 0), g))
+    raise ValueError(f"no placement rule matched partition {key!r}")
+
+
+@dataclass
+class PlacementPlan:
+    """An immutable-by-convention partition→group assignment.
+
+    ``rebalance`` returns a NEW plan (the runtime swaps plans under its
+    own lock); ``failed`` accumulates dead groups so a rebalanced plan
+    never routes back onto them.
+    """
+
+    n_groups: int
+    assignments: Dict[str, int] = field(default_factory=dict)
+    rules: Tuple[PlacementRule, ...] = DEFAULT_RULES
+    failed: frozenset = frozenset()
+    rebalances: int = 0
+
+    def group_of(self, topic: str, partition: int) -> int:
+        key = partition_key(topic, partition)
+        got = self.assignments.get(key)
+        if got is None:
+            raise KeyError(f"partition {key!r} is not in the placement plan")
+        return got
+
+    def loads(self) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for g in self.assignments.values():
+            out[g] = out.get(g, 0) + 1
+        return out
+
+    def live_groups(self) -> List[int]:
+        return [g for g in range(self.n_groups) if g not in self.failed]
+
+    def with_partitions(self, keys: Iterable[str]) -> "PlacementPlan":
+        """Extend the plan with newly-seen partitions (idempotent)."""
+        assignments = dict(self.assignments)
+        loads = self.loads()
+        live = set(self.live_groups())
+        for key in keys:
+            if key in assignments:
+                continue
+            g = match_placement(self.rules, key, self.n_groups, loads)
+            if g not in live:
+                # the rule targets a dead group: spread onto survivors
+                g = min(live, key=lambda x: (loads.get(x, 0), x))
+            assignments[key] = g
+            loads[g] = loads.get(g, 0) + 1
+        return PlacementPlan(
+            n_groups=self.n_groups,
+            assignments=assignments,
+            rules=self.rules,
+            failed=self.failed,
+            rebalances=self.rebalances,
+        )
+
+    def rebalance(self, failed_group: int) -> "PlacementPlan":
+        """Reassign a failed group's partitions onto the survivors.
+
+        Deterministic: orphaned keys move in sorted order onto the
+        least-loaded surviving group (ties to the lowest index), so
+        every replica of the control plane computes the same new plan.
+        """
+        failed = frozenset(self.failed | {failed_group})
+        live = [g for g in range(self.n_groups) if g not in failed]
+        if not live:
+            raise ValueError("no surviving device groups to rebalance onto")
+        assignments = dict(self.assignments)
+        loads = {
+            g: n for g, n in self.loads().items() if g not in failed
+        }
+        for key in sorted(
+            k for k, g in self.assignments.items() if g == failed_group
+        ):
+            target = min(live, key=lambda g: (loads.get(g, 0), g))
+            assignments[key] = target
+            loads[target] = loads.get(target, 0) + 1
+        return PlacementPlan(
+            n_groups=self.n_groups,
+            assignments=assignments,
+            rules=self.rules,
+            failed=failed,
+            rebalances=self.rebalances + 1,
+        )
+
+    def rows(self) -> List[Tuple[str, int]]:
+        """(key, group) rows in stable order — the CLI plan table."""
+        return sorted(self.assignments.items())
+
+    def to_dict(self) -> dict:
+        return {
+            "n_groups": self.n_groups,
+            "assignments": dict(sorted(self.assignments.items())),
+            "failed": sorted(self.failed),
+            "rebalances": self.rebalances,
+        }
+
+
+def plan_placement(
+    rules: Sequence[PlacementRule],
+    keys: Iterable[str],
+    n_groups: int,
+) -> PlacementPlan:
+    """Build a plan by resolving every key against the rules."""
+    if n_groups < 1:
+        raise ValueError("n_groups must be >= 1")
+    plan = PlacementPlan(n_groups=n_groups, rules=tuple(rules))
+    return plan.with_partitions(keys)
+
+
+def make_partition_mesh(
+    n_groups: int, group_size: Optional[int] = None, devices=None
+):
+    """The 2-axis ``(partitions, records)`` mesh for ``n_groups`` groups.
+
+    Generalizes ``parallel.mesh.make_record_mesh``: rows are device
+    groups (one per partition-group, folded round-robin when the local
+    pool is smaller), columns are the data-parallel record axis within
+    a group. See ``make_grouped_mesh`` for the folding rules.
+    """
+    return make_grouped_mesh(
+        n_groups, group_size=group_size, devices=devices,
+        axis_names=(PARTITION_AXIS, RECORD_AXIS),
+    )
+
+
+def group_devices(mesh) -> List[tuple]:
+    """Per-mesh-row device tuples; logical group g maps to row
+    ``g % len(rows)`` (the folding a device-poor host applies)."""
+    import numpy as np
+
+    grid = np.asarray(mesh.devices)
+    return [tuple(row) for row in grid]
+
+
+def device_for_group(mesh, group: int):
+    """The group's lead device (dispatch target for its partitions)."""
+    rows = group_devices(mesh)
+    return rows[group % len(rows)][0]

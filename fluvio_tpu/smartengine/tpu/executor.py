@@ -609,16 +609,16 @@ class TpuChainExecutor:
         self.stages = stages
         # agg_configs rows are (combine_op, window_ms, initial_data)
         self.agg_configs = agg_configs
-        self.carries: List[Tuple[int, int, bool]] = []
-        for op, window_ms, initial in agg_configs:
-            neutral = _AGG_NEUTRAL[op]
-            if window_ms:
-                self.carries.append((neutral, 0, False))
-            else:
-                acc = dsl.parse_int_prefix(initial) if initial else neutral
-                self.carries.append((acc, 0, True))
+        self.carries: List[Tuple[int, int, bool]] = self.initial_carries()
         self._instances: List = []
         self._device_carries = None
+        # partition-layer identity (fluvio_tpu/partition): when set, the
+        # span chain label carries the chain@partition suffix (SLO and
+        # admission key on it) and down-link/decline telemetry gains a
+        # per-partition:group label. None (the default) costs one attr
+        # read on the seams that check it.
+        self.span_chain: Optional[str] = None
+        self.partition_tag: Optional[str] = None
         # short chain signature for compile-event attribution: which
         # chain shape a trace-cache miss compiled for
         self._chain_sig = (
@@ -1849,6 +1849,7 @@ class TpuChainExecutor:
             # (glz-ratio / glz-below-min / glz-unavailable)
             if reason is not None:
                 TELEMETRY.add_decline(reason)
+                self.tag_decline(reason)
         # ship the aligned flat as i32 words (see _chain_fn_ragged);
         # derivable columns stay off the link (synthesized on device)
         words = self._padded(flat, bucket).view(np.int32)
@@ -2013,6 +2014,7 @@ class TpuChainExecutor:
         bl = min(self._bucket_bytes(max(n_lit, 8), floor=256), cap_l)
         if bs * 6 + bl >= raw_cost:
             TELEMETRY.add_decline(glz.DECLINE_ENC_RATIO)
+            self.tag_decline(glz.DECLINE_ENC_RATIO)
             return None, None
         slices = [
             lax.slice(packed["down_ll"], (0,), (bs,)),
@@ -2036,6 +2038,47 @@ class TpuChainExecutor:
             return None, None
         return stream, host[4:]
 
+    def initial_carries(self) -> List[Tuple[int, int, bool]]:
+        """The chain SPEC's starting aggregate state — what a brand-new
+        executor (or a brand-new partition of this chain) begins from,
+        independent of anything this instance has processed."""
+        out: List[Tuple[int, int, bool]] = []
+        for op, window_ms, initial in self.agg_configs:
+            neutral = _AGG_NEUTRAL[op]
+            if window_ms:
+                out.append((neutral, 0, False))
+            else:
+                acc = dsl.parse_int_prefix(initial) if initial else neutral
+                out.append((acc, 0, True))
+        return out
+
+    def set_partition_identity(self, key: Optional[str], group=None):
+        """Install (or clear, key=None) the chain@partition identity —
+        the ONE format every partition-keyed telemetry family joins on
+        (span chains / SLO verdicts, down-* link variants, decline
+        tags). Returns the previous (span_chain, partition_tag) pair
+        for restore."""
+        prev = (self.span_chain, self.partition_tag)
+        if key is None:
+            self.span_chain = None
+            self.partition_tag = None
+        else:
+            self.span_chain = f"{self._chain_sig}@{key}"
+            self.partition_tag = f"{key}:g{group}"
+        return prev
+
+    def restore_partition_identity(self, prev) -> None:
+        self.span_chain, self.partition_tag = prev
+
+    def tag_decline(self, reason: str) -> None:
+        """Per-partition decline attribution: when the partition layer
+        tagged this executor, count the decline AGAIN under its
+        ``reason@topic/partition:group`` key (the sharded-striped
+        ``glz-wide-unsupported`` raw ship stays visible per group).
+        Zero work untagged — one attr read."""
+        if self.partition_tag is not None:
+            TELEMETRY.add_decline(f"{reason}@{self.partition_tag}")
+
     def _count_down_variant(self, variant: Optional[str]) -> None:
         """Per-batch down-link attribution (the D2H mirror of the H2D
         `link_variants` family, and the preflight's differential truth):
@@ -2043,11 +2086,16 @@ class TpuChainExecutor:
         ``down-packed`` for mask/descriptor/delta-int/packed-payload
         downloads, ``down-raw`` only for the unpacked byte-mode matrix."""
         if variant:
-            TELEMETRY.add_link_variant(f"down-glz-{variant}")
+            name = f"down-glz-{variant}"
         elif self._result_compact or self._viewable or self._int_output:
-            TELEMETRY.add_link_variant("down-packed")
+            name = "down-packed"
         else:
-            TELEMETRY.add_link_variant("down-raw")
+            name = "down-raw"
+        TELEMETRY.add_link_variant(name)
+        if self.partition_tag is not None:
+            # partitioned streams: per-partition down-link attribution
+            # (each partition's result stream compresses independently)
+            TELEMETRY.add_link_variant(f"{name}@{self.partition_tag}")
 
     def _fetch(
         self, buf: RecordBuffer, header, packed, spec: Optional[Dict] = None,
@@ -2885,7 +2933,9 @@ class TpuChainExecutor:
             # retry convention: phase time accumulates onto the batch's
             # single span — the batch really paid staging twice — and a
             # failed attempt's span is never orphaned)
-            sh_span = TELEMETRY.begin_batch(chain=self._chain_sig)
+            sh_span = TELEMETRY.begin_batch(
+                chain=self.span_chain or self._chain_sig
+            )
             h0 = self.h2d_bytes_total
             handle = self._dispatch_with_retry(
                 lambda: self._sharded_dispatch(buf, reuse_span=sh_span)
@@ -2893,8 +2943,9 @@ class TpuChainExecutor:
             self._gauge_track(handle, self.h2d_bytes_total - h0)
             return handle
         # chain identity on the span: the per-chain windowed latency
-        # family the SLO engine's e2e_p99 verdicts key on
-        span = TELEMETRY.begin_batch(chain=self._chain_sig)
+        # family the SLO engine's e2e_p99 verdicts key on — partitioned
+        # dispatches carry the chain@partition identity instead
+        span = TELEMETRY.begin_batch(chain=self.span_chain or self._chain_sig)
         prev_carries = self._device_carries
         h0 = self.h2d_bytes_total
         header, packed = self._dispatch_with_retry(

@@ -438,9 +438,13 @@ def _schedule_chain_warmup(chain) -> None:
 
 
 
-def _process_batches_from(chain, batches, max_bytes, metrics, start_offset):
+def _process_batches_from(
+    chain, batches, max_bytes, metrics, start_offset,
+    topic=None, partition=None,
+):
     return process_batches(
-        chain, batches, max_bytes, metrics, start_offset=start_offset
+        chain, batches, max_bytes, metrics, start_offset=start_offset,
+        topic=topic, partition=partition,
     )
 
 
@@ -545,7 +549,9 @@ class StreamFetchHandler:
                         # HOLDS the slice (offsets untouched — nothing
                         # lost, nothing duplicated); breaker-open
                         # proceeds, the per-record path serves it
-                        rej = admission_check(chain)
+                        rej = admission_check(
+                            chain, topic=req.topic, partition=req.partition
+                        )
                         if rej is not None and rej.reason != "breaker-open":
                             await asyncio.sleep(
                                 min(max(rej.retry_after_s, 0.005), 0.25)
@@ -597,7 +603,9 @@ class StreamFetchHandler:
                 # finishes below) and, when nothing is in flight,
                 # sleeps out the backpressure hint — offsets never
                 # advance past a shed slice, so the retry re-reads it
-                shed = admission_check(chain)
+                shed = admission_check(
+                    chain, topic=req.topic, partition=req.partition
+                )
                 if shed is not None and shed.reason == "breaker-open":
                     shed = None  # per-record path serves breaker-open
             if shed is None and planned < leader.read_bound(req.isolation):
@@ -614,11 +622,15 @@ class StreamFetchHandler:
                 if rslice.file_slice is not None and rslice.next_offset is not None:
                     nxt_batches = rslice.decode_batches(parse_records=False)
                     nxt = tpu_stage_dispatch(
-                        chain, nxt_batches, self.metrics, start_offset=planned
+                        chain, nxt_batches, self.metrics, start_offset=planned,
+                        topic=req.topic, partition=req.partition,
                     )
 
             if pending is not None:
-                result = tpu_finish(chain, pending, req.max_bytes, self.metrics)
+                result = tpu_finish(
+                    chain, pending, req.max_bytes, self.metrics,
+                    topic=req.topic, partition=req.partition,
+                )
                 if result is None:
                     # rare decline: rerun this slice on the per-record path
                     # (directly — re-entering process_batches would
@@ -657,6 +669,7 @@ class StreamFetchHandler:
                 result = await _chain_off_loop(
                     chain, _process_batches_from, chain, nxt_batches,
                     req.max_bytes, self.metrics, read_from,
+                    req.topic, req.partition,
                 )
                 sent_next = await self._push_processed(leader, result)
                 if self._ended:
@@ -762,7 +775,7 @@ class StreamFetchHandler:
         batches = rslice.decode_batches(parse_records=False)
         result: BatchProcessResult = await _chain_off_loop(
             chain, _process_batches_from, chain, batches, req.max_bytes,
-            self.metrics, offset,
+            self.metrics, offset, req.topic, req.partition,
         )
         sent_next = await self._push_processed(leader, result)
         return max(sent_next, offset)

@@ -11,7 +11,7 @@ stream-fetch select loops wake up. Follower-offset tracking
 from __future__ import annotations
 
 import asyncio
-from typing import Dict
+from typing import Dict, Optional
 
 from fluvio_tpu.protocol.record import RecordSet
 from fluvio_tpu.schema.spu import Isolation
@@ -59,6 +59,13 @@ class LeaderReplicaState:
         # applied to every produced record set before the log append)
         self.sm_chain = None
         self.sm_chain_metrics = None
+        # partition-layer carry replication (partition/failover.py): the
+        # chain's tiny constant-size aggregate carry at the last
+        # committed consumer offset, published on its own bus at commit
+        # cadence — a promoting follower seeds from this snapshot and
+        # replays only the un-acked suffix
+        self.carry_state: Optional[tuple] = None  # (committed, carries)
+        self.carry_publisher = OffsetPublisher(-1)
 
     # -- offsets ------------------------------------------------------------
 
@@ -76,6 +83,15 @@ class LeaderReplicaState:
         if isolation == Isolation.READ_COMMITTED:
             return self.hw_publisher
         return self.leo_publisher
+
+    def publish_carry(self, committed_offset: int, carries) -> None:
+        """Replicate the chain's aggregate carry snapshot (the SSM-style
+        tiny constant state) alongside the committed consumer offset."""
+        self.carry_state = (
+            committed_offset,
+            [tuple(c) for c in carries],
+        )
+        self.carry_publisher.update(committed_offset)
 
     def read_bound(self, isolation: Isolation) -> int:
         return self.hw() if isolation == Isolation.READ_COMMITTED else self.leo()

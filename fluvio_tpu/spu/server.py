@@ -8,6 +8,7 @@ LRS reporting).
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from fluvio_tpu.spu.cleaner_controller import CleanerController
@@ -69,6 +70,12 @@ class SpuServer:
             from fluvio_tpu.smartengine.native_backend import load_library
 
             threading.Thread(target=load_library, daemon=True).start()
+        if os.environ.get("FLUVIO_PARTITIONS"):
+            # resolve the partition placement gate (plan + mesh build)
+            # at server start so the first stream's slice never pays it
+            from fluvio_tpu.partition import gate as partition_gate
+
+            partition_gate()
         await self.public_server.start()
         if self.internal_server is not None:
             await self.internal_server.start()

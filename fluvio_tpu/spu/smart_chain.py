@@ -415,14 +415,69 @@ def _admission_gate():
     return _GATE_FN()
 
 
-def admission_chain_sig(chain) -> str:
+# --- partition seam (fluvio_tpu/partition) ----------------------------------
+# Same shape as the admission seam: with FLUVIO_PARTITIONS unset the
+# per-slice cost is one resolved-flag check returning None — no plan,
+# mesh, or placement object (overhead-gate tripwired).
+_PARTITION_GATE_FN = None
+
+
+def _partition_gate():
+    global _PARTITION_GATE_FN
+    if _PARTITION_GATE_FN is None:
+        from fluvio_tpu.partition import gate
+
+        _PARTITION_GATE_FN = gate
+    return _PARTITION_GATE_FN()
+
+
+def _enter_partition_scope(topic, partition, tpu):
+    """Enter the partition placement scope for one slice, or None.
+
+    None when the gate is unarmed, no partition identity was supplied,
+    or placement itself fails — a rule set that matches nothing for
+    this topic is a CONFIG error surfaced loudly on its own typed
+    decline reason, after which the slice serves unpartitioned instead
+    of crashing the stream. BOTH the dispatch and the finish seam come
+    through here: either can be the first to hit the bad rule."""
+    pgate = _partition_gate()
+    if pgate is None or partition is None or tpu is None:
+        return None
+    try:
+        scope = pgate.scope(topic or "t", partition, tpu)
+        scope.__enter__()
+        return scope
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        logging.getLogger(__name__).error(
+            "partition placement failed for %s/%s (%s: %s); "
+            "serving unpartitioned",
+            topic, partition, type(e).__name__, e,
+        )
+        # decline counter only — NOT _decline(): the slice still
+        # serves fused, so it must not book a per-record fallback
+        TELEMETRY.add_decline("partition-placement-error")
+        return None
+
+
+def admission_chain_sig(chain, topic=None, partition=None) -> str:
     tpu = getattr(chain, "tpu_chain", None)
-    if tpu is not None:
-        return tpu._chain_sig
-    return getattr(chain, "chain_label", "") or "chain"
+    sig = (
+        tpu._chain_sig
+        if tpu is not None
+        else getattr(chain, "chain_label", "") or "chain"
+    )
+    if partition is None:
+        return sig
+    # chain@partition identity: per-partition admission buckets and SLO
+    # verdict families — a hot partition sheds without starving its
+    # siblings (warm bookkeeping stays per-chain; the controller strips
+    # the suffix for warm lookups)
+    return f"{sig}@{topic or 't'}/{partition}"
 
 
-def admission_check(chain):
+def admission_check(chain, topic=None, partition=None):
     """The broker front door: one admission decision for one read slice.
 
     Returns None when admitted (or admission is disabled), else the
@@ -443,7 +498,8 @@ def admission_check(chain):
     if ctl is None:
         return None
     decision = ctl.admit(
-        admission_chain_sig(chain), breaker=getattr(chain, "breaker", None)
+        admission_chain_sig(chain, topic, partition),
+        breaker=getattr(chain, "breaker", None),
     )
     return None if decision else decision
 
@@ -476,6 +532,8 @@ def tpu_stage_dispatch(
     batches: List[Batch],
     metrics=None,
     start_offset: Optional[int] = None,
+    topic: Optional[str] = None,
+    partition: Optional[int] = None,
 ) -> Optional[PendingSlice]:
     """Phase 1 of the TPU fast path: stage a read slice into columnar
     buffers through the native parser (no per-record Python objects),
@@ -646,6 +704,11 @@ def tpu_stage_dispatch(
     # must not crash the stream handler: the slice declines to the
     # per-record path, whose own fused/spill/quarantine ladder decides
     # per batch (dispatch_buffers discarded any partial handles).
+    # partitioned placement: this stream's dispatches run on its
+    # partition's device group with the chain@partition identity on
+    # spans/down-link telemetry (broker chains are per-stream so the
+    # carries are already per-partition)
+    pscope = _enter_partition_scope(topic, partition, tpu)
     try:
         chunks: List[tuple] = tpu.dispatch_buffers(chunk_bufs)
     except TpuSpill:
@@ -658,6 +721,9 @@ def tpu_stage_dispatch(
             type(e).__name__, e,
         )
         return _decline(metrics, "fused-error")
+    finally:
+        if pscope is not None:
+            pscope.__exit__(None, None, None)
     pending = PendingSlice(
         batches=batches,
         chunks=chunks,
@@ -729,9 +795,16 @@ def tpu_finish(
     pending: PendingSlice,
     max_bytes: int,
     metrics=None,
+    topic: Optional[str] = None,
+    partition: Optional[int] = None,
 ) -> Optional[BatchProcessResult]:
     """Phase 2: block on the device results and re-assemble output
     batches at the byte level with the native encoder.
+
+    With the partition gate armed and a partition identity supplied,
+    the whole finish runs in the partition's placement scope so the
+    fetch-side telemetry (down-* variants, enc-ratio declines) books
+    per partition, matching the dispatch side.
 
     Wire/offset semantics match `process_batches`: survivors keep their
     stored offsets rebased to the slice's first batch. Aggregate chains
@@ -742,6 +815,22 @@ def tpu_finish(
     executor) when the device signalled a transform error — the
     interpreter re-runs the slice for exact error semantics.
     """
+    pscope = _enter_partition_scope(
+        topic, partition, getattr(chain, "tpu_chain", None)
+    )
+    try:
+        return _tpu_finish_inner(chain, pending, max_bytes, metrics)
+    finally:
+        if pscope is not None:
+            pscope.__exit__(None, None, None)
+
+
+def _tpu_finish_inner(
+    chain: SmartModuleChainInstance,
+    pending: PendingSlice,
+    max_bytes: int,
+    metrics=None,
+) -> Optional[BatchProcessResult]:
     from fluvio_tpu.smartengine import native_backend
     from fluvio_tpu.smartengine.tpu.executor import TpuSpill
 
@@ -893,6 +982,8 @@ def _tpu_process_batches(
     max_bytes: int,
     metrics=None,
     start_offset: Optional[int] = None,
+    topic: Optional[str] = None,
+    partition: Optional[int] = None,
 ) -> Optional[BatchProcessResult]:
     """Coalesced TPU fast path, serial form: stage+dispatch then finish.
 
@@ -900,10 +991,16 @@ def _tpu_process_batches(
     directly so slice k+1 dispatches while slice k downloads and hits
     the socket.
     """
-    pending = tpu_stage_dispatch(chain, batches, metrics, start_offset)
+    pending = tpu_stage_dispatch(
+        chain, batches, metrics, start_offset,
+        topic=topic, partition=partition,
+    )
     if pending is None:
         return None
-    return tpu_finish(chain, pending, max_bytes, metrics)
+    return tpu_finish(
+        chain, pending, max_bytes, metrics,
+        topic=topic, partition=partition,
+    )
 
 
 def process_batches(
@@ -912,6 +1009,8 @@ def process_batches(
     max_bytes: int,
     metrics=None,
     start_offset: Optional[int] = None,
+    topic: Optional[str] = None,
+    partition: Optional[int] = None,
 ) -> BatchProcessResult:
     """Run stored batches through the chain, re-batch the outputs.
 
@@ -927,7 +1026,10 @@ def process_batches(
     Chains with a TPU executor take `_tpu_process_batches`'s coalesced
     batch-level path when the native codecs are available.
     """
-    fast = _tpu_process_batches(chain, batches, max_bytes, metrics, start_offset)
+    fast = _tpu_process_batches(
+        chain, batches, max_bytes, metrics, start_offset,
+        topic=topic, partition=partition,
+    )
     if fast is not None:
         return fast
     return process_batches_per_record(chain, batches, max_bytes, metrics)

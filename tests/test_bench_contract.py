@@ -641,3 +641,55 @@ def test_preflight_survives_emit_and_line_trim_order():
     assert out["configs"]["2_filter_map"]["preflight"]["agree"] is True
     line = json.loads(json.dumps(b._compact_line(out)))
     assert line["preflight"] == {"agree": 1, "of": 1}
+
+
+def test_part_line_key_rides_compact_line():
+    """ISSUE-13: a tiny ``part:{n,rebal}`` key rides the compact line
+    when any config ran partitioned; the full plan/offsets/exactness
+    block stays in BENCH_DETAIL.json only."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    cfg = dict(GOOD)
+    cfg["part"] = {
+        "n": 4, "groups": 2, "rebal": 1, "exact": True,
+        "offsets": {"bench/0": 4999, "bench/1": 4999},
+        "plan": {"bench/0": 0, "bench/1": 1},
+    }
+    out, rc = b._build_output({"9_partitioned": cfg})
+    assert rc == 0
+    assert out["configs"]["9_partitioned"]["part"]["exact"] is True
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["part"] == {"n": 4, "rebal": 1}
+    # the bulky detail never reaches the line
+    assert "part" not in line["configs"].get("9_partitioned", {})
+    # without a partitioned config the key stays off entirely
+    out2, _ = b._build_output({"2_filter_map": dict(GOOD)})
+    assert "part" not in json.loads(json.dumps(b._compact_line(out2)))
+
+
+def test_part_key_fits_contract_and_trims_before_link():
+    """The full-matrix line with the part key stays ≤1500 chars and the
+    blowup trim ladder drops ``part`` before ``link`` (the sentinel's
+    contract field) and before ``compile``."""
+    import json
+    import re
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = _full_results()
+    results["9_partitioned"] = dict(GOOD)
+    results["9_partitioned"]["part"] = {
+        "n": 4, "groups": 2, "rebal": 1, "exact": True,
+        "offsets": {f"bench/{i}": 4999 for i in range(4)},
+        "plan": {f"bench/{i}": i % 2 for i in range(4)},
+    }
+    out, _ = b._build_output(results)
+    line = json.dumps(b._compact_line(out))
+    assert len(line) <= 1500, f"compact line is {len(line)} chars"
+    assert json.loads(line)["part"] == {"n": 4, "rebal": 1}
+    src = open(_BENCH_PATH).read()
+    ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
+    assert ladder.index('"part"') < ladder.index('"link"')
+    assert ladder.index('"part"') < ladder.index('"compile"')

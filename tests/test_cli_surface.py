@@ -113,6 +113,36 @@ class TestAnalyzeExitCodes:
         assert rc == 1
         assert "--module" in capsys.readouterr().err
 
+    def test_partitions_plan_and_predictions(self, capsys):
+        """ISSUE-13: `analyze --partitions N` prints the placement plan
+        and per-partition path predictions; clean chains exit 0."""
+        rc = self._main(
+            ["analyze", "--partitions", "4", "--groups", "2",
+             "--module", "regex-filter:regex=fluvio",
+             "--topic", "orders", "--format", "json"]
+        )
+        assert rc == 0
+        import json
+
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc["plan"]["assignments"]) == {
+            f"orders/{i}" for i in range(4)
+        }
+        assert len(doc["rows"]) >= 4
+        assert all(r["chain"].endswith(r["partition"]) for r in doc["rows"])
+
+    def test_partitions_spill_prediction_exits_nonzero(self, capsys):
+        rc = self._main(
+            ["analyze", "--partitions", "2",
+             "--module", "word-count", "--width", "200000"]
+        )
+        assert rc == 1
+
+    def test_partitions_without_module_is_cli_error(self, capsys):
+        rc = self._main(["analyze", "--partitions", "2"])
+        assert rc == 1
+        assert "--module" in capsys.readouterr().err
+
     def test_lint_mode_clean_repo_exits_zero(self, capsys):
         import os
 

@@ -153,6 +153,86 @@ class TestMultiPartitionConsumer:
 
         run(body())
 
+    def test_admission_hold_is_per_partition(self, tmp_path):
+        """ISSUE-13 satellite: with the admission gate armed, a shed of
+        ONE partition's `chain@topic/partition` key holds THAT
+        partition's slices — its consumer offsets never advance past
+        the unserved slice — while the sibling partition keeps serving;
+        after the verdict recovers the held partition delivers every
+        record exactly once (the PR-10 hold-the-slice semantics, now
+        partition-keyed at the live-server level)."""
+        from fluvio_tpu import admission as admission_pkg
+        from fluvio_tpu.admission.types import Decision, Rejected
+
+        class PartitionShedController:
+            """Sheds keys suffixed @multi/0 for the first N admits of
+            that key; everything else admits. Records the partitioned
+            identities the broker seam actually presented."""
+
+            def __init__(self, sheds: int):
+                self.left = sheds
+                self.seen = []
+                self.held_progress = []
+
+            def admit(self, chain, cost=1.0, breaker=None):
+                self.seen.append(chain)
+                if chain.endswith("@multi/0") and self.left > 0:
+                    self.left -= 1
+                    return Rejected(
+                        chain=chain, reason="breach-shed",
+                        verdict="breach", retry_after_s=0.01,
+                    )
+                return Decision(admitted=True, chain=chain)
+
+            def note_warm(self, chain, buckets):
+                pass
+
+            def require_warm(self, chain):
+                pass
+
+        ctl = PartitionShedController(sheds=3)
+        admission_pkg.set_gate(ctl)
+
+        async def body():
+            sc, admin, spus, client, metas = await _setup(tmp_path)
+            try:
+                cfg = ConsumerConfig(
+                    disable_continuous=True,
+                    smartmodules=[
+                        SmartModuleInvocation(
+                            wasm=SmartModuleInvocationWasm.adhoc(FILTER_SM),
+                            kind=SmartModuleInvocationKind.FILTER,
+                        )
+                    ],
+                )
+                consumer = await client.consumer(
+                    PartitionSelectionStrategy.all("multi")
+                )
+                got = [
+                    r async for r in consumer.stream(Offset.beginning(), cfg)
+                ]
+                # exactly once across BOTH partitions despite the holds
+                assert sorted(r.value for r in got) == sorted(
+                    f"keep-{i:03d}".encode() for i in range(40)
+                )
+                for p in (0, 1):
+                    offs = [r.offset for r in got if r.partition == p]
+                    assert offs == sorted(offs)
+            finally:
+                await client.close()
+                await shutdown_cluster(sc, admin, spus)
+
+        try:
+            run(body())
+        finally:
+            admission_pkg.reset_gate()
+        # the seam presented partition-keyed identities for both
+        # partitions, the held key was really shed, and the sibling
+        # partition was never held
+        assert ctl.left == 0, "the armed sheds must all fire"
+        assert any(c.endswith("@multi/0") for c in ctl.seen)
+        assert any(c.endswith("@multi/1") for c in ctl.seen)
+
     def test_all_requires_metadata(self, tmp_path):
         """A lone-SPU connection cannot resolve 'all partitions'."""
         from fluvio_tpu.spu import SpuConfig, SpuServer

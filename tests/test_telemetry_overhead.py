@@ -514,3 +514,92 @@ def test_telemetry_disabled_skips_span_capture_entirely():
     finally:
         TELEMETRY.enabled = prior
         TELEMETRY.reset()
+
+
+def test_partition_armed_overhead_under_gate():
+    """ISSUE-13 CI satellite: the partition runtime's per-batch work —
+    a state lookup, the carry-slot swap, the identity labels, and the
+    group-device scope — must stay inside the same <2% rps gate
+    against the bare executor."""
+    from fluvio_tpu.partition.placement import (
+        parse_placement_rules,
+        plan_placement,
+    )
+    from fluvio_tpu.partition.runtime import PartitionRuntime
+
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    buf = _corpus_buf()
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+    runtime = PartitionRuntime(
+        executor,
+        plan_placement(parse_placement_rules(".*=spread"), [], 2),
+        chain=chain,
+    )
+    runtime.process("t", 0, buf)  # resolve the partition state once
+
+    def _measure_partition():
+        times = {"bare": [], "armed": []}
+        for _ in range(PASSES_PER_ARM):
+            for arm in ("bare", "armed"):
+                t0 = time.perf_counter()
+                for _i in range(BATCHES_PER_PASS):
+                    if arm == "armed":
+                        runtime.process("t", 0, buf)
+                    else:
+                        executor.process_buffer(buf)
+                times[arm].append(
+                    (time.perf_counter() - t0) / BATCHES_PER_PASS
+                )
+        return min(times["bare"]), min(times["armed"])
+
+    for attempt in range(5):
+        bare_s, armed_s = _measure_partition()
+        overhead = max(armed_s - bare_s, 0.0)
+        if overhead <= bare_s * GATE or overhead < 500e-6:
+            break
+    else:
+        raise AssertionError(
+            f"partition runtime cost {overhead*1e6:.0f}us/batch on a "
+            f"{bare_s*1e3:.2f}ms batch — exceeds the {GATE:.0%} gate "
+            f"after 5 measurement rounds"
+        )
+
+
+def test_partition_seam_zero_cost_when_disabled(monkeypatch):
+    """ISSUE-13 CI satellite, the strict half: with FLUVIO_PARTITIONS
+    unset the broker seam resolves to None ONCE and the partition layer
+    is untouchable — tripwires on the gate, the scope, and the runtime
+    prove no plan, no placement, no identity label, and no tagged
+    counter moves through a full pipelined pass."""
+    from fluvio_tpu import partition
+    from fluvio_tpu.partition import runtime as rt_mod
+    from fluvio_tpu.spu import smart_chain
+
+    monkeypatch.delenv("FLUVIO_PARTITIONS", raising=False)
+    partition.reset_gate()
+
+    def tripwire(*a, **k):
+        raise AssertionError("partition seam touched while disabled")
+
+    monkeypatch.setattr(rt_mod.BrokerPartitionGate, "__init__", tripwire)
+    monkeypatch.setattr(rt_mod.BrokerPartitionGate, "scope", tripwire)
+    monkeypatch.setattr(rt_mod.PartitionRuntime, "dispatch", tripwire)
+    monkeypatch.setattr(rt_mod.PartitionRuntime, "finish", tripwire)
+
+    TELEMETRY.reset()
+    chain = _headline_chain()
+    buf = _corpus_buf()
+    assert smart_chain._partition_gate() is None
+    for out in chain.tpu_chain.process_stream(iter([buf] * 2)):
+        pass
+    # the executor's identity stayed unpartitioned: no tagged counters
+    assert chain.tpu_chain.span_chain is None
+    assert chain.tpu_chain.partition_tag is None
+    snap = TELEMETRY.snapshot()
+    assert not [
+        k for k in snap["counters"]["link_variants"] if "@" in k
+    ]
+    assert not [k for k in snap["counters"]["declines"] if "@" in k]
+    TELEMETRY.reset()
