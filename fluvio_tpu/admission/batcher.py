@@ -109,12 +109,30 @@ def coalesce_buffers(bufs: Sequence, target_width: Optional[int] = None):
         c = int(b.count)
         base = i * SLICE_STRIDE
         bases.append(base)
+        # for SHARED merges, delta < SLICE_STRIDE keeps base + delta <
+        # MAX_COALESCE * SLICE_STRIDE <= i32 max AND keeps
+        # split_output's bracket-by-base route-back exact — a slice
+        # whose deltas reach the stride must dispatch solo, never
+        # wrap. A single-source merge has base 0 and no banding, so
+        # any i32 delta is fine (the batcher's solo path relies on
+        # this).
+        if len(bufs) > 1 and c and int(b.offset_deltas[:c].max()) >= (
+            SLICE_STRIDE
+        ):
+            raise ValueError(
+                f"source slice offset delta "
+                f"{int(b.offset_deltas[:c].max())} reaches the "
+                f"coalesce stride ({SLICE_STRIDE}) — the disjoint-base "
+                "route-back would alias; dispatch this slice solo"
+            )
         dense = b.dense_values()
         values[pos : pos + c, : dense.shape[1]] = dense[:c]
         lengths[pos : pos + c] = b.lengths[:c]
         keys[pos : pos + c, : b.keys.shape[1]] = b.keys[:c]
         key_lengths[pos : pos + c] = b.key_lengths[:c]
-        offset_deltas[pos : pos + c] = b.offset_deltas[:c] + base
+        # guards above: base <= (MAX_COALESCE-1)*SLICE_STRIDE and every
+        # delta < SLICE_STRIDE, so the sum stays inside i32
+        offset_deltas[pos : pos + c] = b.offset_deltas[:c] + base  # noqa: FLV301
         timestamp_deltas[pos : pos + c] = b.timestamp_deltas[:c]
         pos += c
     merged = RecordBuffer.from_arrays(
@@ -131,8 +149,16 @@ def split_output(outbuf, bases: Sequence[int]) -> List[List[Tuple[bytes, int]]]:
     brackets it (row-preserving chains keep survivor deltas). Returns,
     per source slice, ``[(value bytes, original offset delta), ...]``
     in record order."""
-    out: List[List[Tuple[bytes, int]]] = [[] for _ in bases]
     records = outbuf.to_records()
+    if len(bases) == 1:
+        # single-source (solo) flush: no base banding — every survivor
+        # belongs to the one slice, whatever its deltas (a big-delta
+        # slice must not lose records to the stride bracket)
+        return [
+            [(rec.value, int(rec.offset_delta) - bases[0])
+             for rec in records]
+        ]
+    out: List[List[Tuple[bytes, int]]] = [[] for _ in bases]
     for rec in records:
         slot = int(rec.offset_delta) // SLICE_STRIDE
         if 0 <= slot < len(bases):
@@ -157,12 +183,12 @@ class ShapeBucketBatcher:
         self.row_target = (
             row_target
             if row_target is not None
-            else int(env_float("FLUVIO_ADMISSION_BATCH_ROWS", 4096))
+            else int(env_float("FLUVIO_ADMISSION_BATCH_ROWS"))
         )
         self.deadline_s = (
             deadline_s
             if deadline_s is not None
-            else env_float("FLUVIO_ADMISSION_BATCH_DEADLINE_MS", 25.0) / 1000.0
+            else env_float("FLUVIO_ADMISSION_BATCH_DEADLINE_MS") / 1000.0
         )
         self.clock = clock
         self._lock = make_lock("admission.batcher")
@@ -187,10 +213,21 @@ class ShapeBucketBatcher:
 
     def add(self, chain: str, buf) -> List[Flush]:
         """Accumulate one admitted slice; returns the flushes this add
-        triggered (bucket-full only — deadlines flush via `poll`)."""
+        triggered (bucket-full only — deadlines flush via `poll`). A
+        slice whose offset deltas reach the coalesce stride cannot
+        share a dispatch (the disjoint-base route-back would alias —
+        and overflow i32 at the 2047-slice bound), so it dispatches
+        SOLO here instead of poisoning a shared bucket and losing its
+        co-batched slices to the `coalesce_buffers` backstop raise."""
         from fluvio_tpu.smartengine.tpu.buffer import bucket_width
 
         key = (chain, bucket_width(max(int(buf.width), 1)))
+        c = int(buf.count)
+        if c and int(buf.offset_deltas[:c].max()) >= SLICE_STRIDE:
+            # the same warmed-cover padding / cold-bucket accounting /
+            # cause counting as every other flush — just never shared
+            return [self._flush(key, _Bucket(items=[buf], rows=c),
+                                "solo")]
         now = self.clock()
         ready: List[Tuple[Tuple[str, int], _Bucket]] = []
         with self._lock:

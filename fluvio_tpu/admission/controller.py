@@ -43,7 +43,6 @@ or a gauge (``tests/test_telemetry_overhead.py`` tripwires it).
 
 from __future__ import annotations
 
-import os
 import random
 import time
 from typing import Callable, Dict, List, Optional
@@ -58,6 +57,7 @@ from fluvio_tpu.telemetry.registry import (
 from fluvio_tpu.admission.batcher import ShapeBucketBatcher
 from fluvio_tpu.admission.fairness import FairQueue
 from fluvio_tpu.admission.types import Decision, Rejected, env_float
+from fluvio_tpu.analysis.envreg import env_bool
 
 ADMISSION_ENV = "FLUVIO_ADMISSION"
 
@@ -69,9 +69,7 @@ _REFILL_SCALE = {"ok": 1.0, "warn": 0.5, "breach": 0.0}
 
 
 def admission_enabled(env: Optional[dict] = None) -> bool:
-    return (env or os.environ).get(ADMISSION_ENV, "0") not in (
-        "0", "", "off", "false",
-    )
+    return env_bool(ADMISSION_ENV, env)
 
 
 class TokenBucket:
@@ -118,22 +116,22 @@ class AdmissionController:
         self.refresh_s = (
             refresh_s
             if refresh_s is not None
-            else env_float("FLUVIO_ADMISSION_REFRESH_S", 1.0)
+            else env_float("FLUVIO_ADMISSION_REFRESH_S")
         )
         self.warn_shed = (
             warn_shed
             if warn_shed is not None
-            else env_float("FLUVIO_ADMISSION_WARN_SHED", 0.5)
+            else env_float("FLUVIO_ADMISSION_WARN_SHED")
         )
         self.capacity = (
             tokens
             if tokens is not None
-            else env_float("FLUVIO_ADMISSION_TOKENS", 64.0)
+            else env_float("FLUVIO_ADMISSION_TOKENS")
         )
         self.refill = (
             refill
             if refill is not None
-            else env_float("FLUVIO_ADMISSION_REFILL", 32.0)
+            else env_float("FLUVIO_ADMISSION_REFILL")
         )
         self._lock = make_lock("admission.controller")
         self._buckets: Dict[str, TokenBucket] = {}
@@ -397,6 +395,9 @@ class AdmissionPipeline:
     def _dispatch_solo(self, chain: str, buf):
         from fluvio_tpu.admission.batcher import Flush
 
+        # one counting policy with the batcher's solo path: the 'solo'
+        # admission counter means EVERY un-coalesced dispatch
+        TELEMETRY.add_admission("solo")
         flush = Flush(
             chain=chain, width_bucket=int(getattr(buf, "width", 0)),
             items=[buf], bases=[0], buffer=buf, cause="solo",

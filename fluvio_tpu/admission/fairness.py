@@ -47,7 +47,7 @@ class FairQueue:
         self.max_depth = (
             max_depth
             if max_depth is not None
-            else int(env_float("FLUVIO_ADMISSION_QUEUE", 64))
+            else int(env_float("FLUVIO_ADMISSION_QUEUE"))
         )
         self.default_weight = default_weight
         self.clock = clock

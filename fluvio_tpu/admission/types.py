@@ -24,18 +24,17 @@ the Prometheus ``admission_decisions_total`` export:
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 
+from fluvio_tpu.analysis.envreg import env_float as _registry_env_float
 
-def env_float(name: str, default: float) -> float:
-    """One home for the FLUVIO_ADMISSION_* numeric knob parse (a bad
-    value falls back to the default; admission must never crash a
-    server over an env typo)."""
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+
+def env_float(name: str) -> float:
+    """The FLUVIO_ADMISSION_* numeric knob parse, hoisted onto the
+    central flag registry (analysis/envreg.py): the default lives in
+    ONE place, and a bad value falls back to it — admission must never
+    crash a server over an env typo."""
+    return float(_registry_env_float(name))
 
 
 @dataclass(frozen=True)

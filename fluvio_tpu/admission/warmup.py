@@ -40,6 +40,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from fluvio_tpu.analysis.envreg import env_bool, env_int
 from fluvio_tpu.analysis.lockwatch import make_lock
 from fluvio_tpu.telemetry import TELEMETRY
 
@@ -96,9 +97,8 @@ def default_widths() -> Tuple[int, ...]:
                 return widths
         except ValueError:
             logger.error("ignoring malformed %s=%r", WIDTHS_ENV, spec)
-    from fluvio_tpu.smartengine.tpu.buffer import MAX_WIDTH
 
-    threshold = int(os.environ.get("FLUVIO_STRIPE_THRESHOLD", MAX_WIDTH))
+    threshold = int(env_int("FLUVIO_STRIPE_THRESHOLD"))
     return (1024, threshold + 1)
 
 
@@ -124,7 +124,7 @@ def default_rows() -> Tuple[int, ...]:
 
 
 def warmup_enabled(env: Optional[dict] = None) -> bool:
-    return (env or os.environ).get(WARMUP_ENV, "0") not in ("0", "", "off")
+    return env_bool(WARMUP_ENV, env)
 
 
 def work_list(executor, widths: Sequence[int], rows: int = 8) -> List[dict]:

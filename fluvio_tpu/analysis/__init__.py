@@ -39,6 +39,9 @@ __all__ = [
     "lint_source", "lint_file", "lint_paths", "lint_repo",
     "preflight_for_specs",
     "ConcurrencyReport", "analyze_concurrency", "static_lock_graph",
+    "ValueFlowReport", "analyze_values", "analyze_values_sources",
+    "EnvFinding", "lint_env", "lint_env_sources", "warn_unknown_env",
+    "registry_report",
 ]
 
 # spec re-exports resolve lazily (PEP 562): engine modules import the
@@ -54,6 +57,18 @@ _CONCURRENCY_EXPORTS = {
     "analyze_concurrency": "analyze_package",
     "static_lock_graph": "static_lock_graph",
 }
+_VALUEFLOW_EXPORTS = {
+    "ValueFlowReport": "ValueFlowReport",
+    "analyze_values": "analyze_values_package",
+    "analyze_values_sources": "analyze_values_sources",
+}
+_ENVREG_EXPORTS = {
+    "EnvFinding": "EnvFinding",
+    "lint_env": "lint_env_package",
+    "lint_env_sources": "lint_env_sources",
+    "warn_unknown_env": "warn_unknown_env",
+    "registry_report": "registry_report",
+}
 
 
 def __getattr__(name: str):
@@ -65,6 +80,14 @@ def __getattr__(name: str):
         from fluvio_tpu.analysis import concurrency
 
         return getattr(concurrency, _CONCURRENCY_EXPORTS[name])
+    if name in _VALUEFLOW_EXPORTS:
+        from fluvio_tpu.analysis import valueflow
+
+        return getattr(valueflow, _VALUEFLOW_EXPORTS[name])
+    if name in _ENVREG_EXPORTS:
+        from fluvio_tpu.analysis import envreg
+
+        return getattr(envreg, _ENVREG_EXPORTS[name])
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

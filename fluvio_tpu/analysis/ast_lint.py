@@ -47,6 +47,8 @@ import os
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
+from fluvio_tpu.analysis.noqa import line_suppresses
+
 KERNEL_MODULES = ("kernels.py", "pallas_kernels.py", "stripes.py", "lower.py")
 
 # executor functions on the dispatch side of the pipeline (stage ->
@@ -162,19 +164,9 @@ class _FileLinter(ast.NodeVisitor):
     # -- plumbing -----------------------------------------------------------
 
     def _suppressed(self, line: int, code: str) -> bool:
-        if not 1 <= line <= len(self.lines):
-            return False
-        text = self.lines[line - 1]
-        if "noqa" not in text:
-            return False
-        _, _, tail = text.partition("noqa")
-        tail = tail.lstrip(":").strip()
-        # an existing suppression comment keeps working under either
-        # vocabulary: the ruff/pyflakes code or the native FLV code
-        aliases = {"FLV101": {"B006"}, "FLV102": {"F401"}}
-        accepted = {code} | aliases.get(code, set())
-        codes = set(tail.replace(",", " ").split())
-        return not codes or bool(codes & accepted)
+        # shared grammar (analysis/noqa.py): ruff/pyflakes aliases and
+        # combined multi-analyzer comments both resolve there
+        return line_suppresses(self.lines, line, code)
 
     def _flag(self, node: ast.AST, code: str, message: str) -> None:
         line = getattr(node, "lineno", 1)

@@ -73,6 +73,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from fluvio_tpu.analysis.ast_lint import DISPATCH_HOT_FUNCS
 from fluvio_tpu.analysis.lockwatch import find_cycle
+from fluvio_tpu.analysis.noqa import line_suppresses
 
 ERROR = "error"
 WARN = "warn"
@@ -557,15 +558,9 @@ class PackageAnalyzer:
     # -- suppression ---------------------------------------------------------
 
     def _suppressed(self, mod: ModuleModel, line: int, code: str) -> bool:
-        if not 1 <= line <= len(mod.lines):
-            return False
-        text = mod.lines[line - 1]
-        if "noqa" not in text:
-            return False
-        _, _, tail = text.partition("noqa")
-        tail = tail.lstrip(":").strip()
-        codes = set(tail.replace(",", " ").split())
-        return not codes or code in codes
+        # shared grammar (analysis/noqa.py): one comment listing codes
+        # from several analyzers (``noqa: FLV201,FLV301``) satisfies each
+        return line_suppresses(mod.lines, line, code)
 
     def _flag(self, fm: FuncModel, line: int, code: str, message: str,
               level: Optional[str] = None) -> None:

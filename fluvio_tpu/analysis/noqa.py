@@ -1,0 +1,85 @@
+"""One ``# noqa`` parser for every fluvio analyzer.
+
+Before this module, three linters — the AST invariant linter
+(FLV0xx/FLV1xx), the concurrency pass (FLV2xx), and the value-flow
+pass (FLV3xx/FLV4xx) — each re-implemented suppression-comment
+parsing, and each re-implementation drifted: the AST linter accepted
+ruff aliases, the concurrency pass did not, and a combined comment
+like ``# noqa: FLV201,FLV301`` only worked by accident of both
+parsers splitting on commas. This module is the single grammar:
+
+``# noqa``
+    blanket — suppresses every rule on the line.
+``# noqa: CODE[,CODE...]``
+    targeted — suppresses exactly the listed codes (commas and/or
+    whitespace separate; case preserved). A linter asks about ITS code
+    and the answer covers registered aliases, so one comment satisfies
+    every analyzer whose code it lists.
+
+Aliases map a native FLV code to the foreign vocabulary that means the
+same class (``FLV101`` ⇔ ruff's ``B006``, ``FLV102`` ⇔ pyflakes'
+``F401``): an existing suppression keeps working under either name.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence, Set
+
+#: native code -> foreign spellings accepted as the same suppression
+ALIASES: Dict[str, Set[str]] = {
+    "FLV101": {"B006"},
+    "FLV102": {"F401"},
+}
+
+
+def parse_noqa(line: str) -> Optional[Set[str]]:
+    """The suppression set a source line carries.
+
+    ``None``: no ``noqa`` comment at all. An empty set: a blanket
+    ``# noqa`` (suppress everything). Otherwise the explicit codes.
+    """
+    if "noqa" not in line:
+        return None
+    _, _, tail = line.partition("noqa")
+    tail = tail.lstrip(":").strip()
+    codes = set(tail.replace(",", " ").split())
+    # a trailing prose comment after a blanket noqa ("# noqa — see X")
+    # is not a code list; treat pure punctuation/prose-only tails as
+    # blanket by keeping only code-shaped tokens when any exist
+    code_like = {c for c in codes if c[:1].isalpha() and any(
+        ch.isdigit() for ch in c
+    )}
+    return code_like
+
+
+def suppresses(line: str, code: str,
+               aliases: Optional[Dict[str, Set[str]]] = None) -> bool:
+    """Does this line's ``noqa`` comment (if any) silence ``code``?"""
+    codes = parse_noqa(line)
+    if codes is None:
+        return False
+    if not codes:
+        return True  # blanket
+    table = ALIASES if aliases is None else aliases
+    accepted = {code} | table.get(code, set())
+    return bool(codes & accepted)
+
+
+def line_suppresses(lines: Sequence[str], lineno: int, code: str,
+                    aliases: Optional[Dict[str, Set[str]]] = None) -> bool:
+    """`suppresses` against 1-indexed ``lineno`` of ``lines`` (the
+    shape every AST-walking linter has in hand); out-of-range is not
+    suppressed."""
+    if not 1 <= lineno <= len(lines):
+        return False
+    return suppresses(lines[lineno - 1], code, aliases)
+
+
+def iter_suppressions(lines: Iterable[str]):
+    """Yield ``(lineno, codes)`` for every noqa comment — the audit
+    surface: grep-free enumeration of every deliberate relaxation in a
+    file (``codes`` empty = blanket)."""
+    for i, text in enumerate(lines, start=1):
+        codes = parse_noqa(text)
+        if codes is not None:
+            yield i, codes

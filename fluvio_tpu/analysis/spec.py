@@ -31,10 +31,10 @@ rules rather than a call into the lowering.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from fluvio_tpu.analysis.envreg import env_int
 from fluvio_tpu.ops.regex_dfa import (
     UnsupportedRegex,
     compile_regex_cached,
@@ -150,7 +150,7 @@ def resolve_gates() -> dict:
     import jax
 
     from fluvio_tpu.smartengine.tpu import glz, kernels, pallas_kernels
-    from fluvio_tpu.smartengine.tpu.buffer import MAX_RECORD_WIDTH, MAX_WIDTH
+    from fluvio_tpu.smartengine.tpu.buffer import MAX_RECORD_WIDTH
     from fluvio_tpu.smartengine.tpu.executor import effective_link_compress
     from fluvio_tpu.smartengine.tpu.lower import _depth_over_work
 
@@ -159,9 +159,7 @@ def resolve_gates() -> dict:
         "dfa_assoc": _depth_over_work("FLUVIO_DFA_ASSOC"),
         "fast_json": _depth_over_work("FLUVIO_TPU_FAST_JSON"),
         "dfa_assoc_max_states": kernels.dfa_assoc_max_states(),
-        "stripe_threshold": int(
-            os.environ.get("FLUVIO_STRIPE_THRESHOLD", MAX_WIDTH)
-        ),
+        "stripe_threshold": int(env_int("FLUVIO_STRIPE_THRESHOLD")),
         "max_record_width": MAX_RECORD_WIDTH,
         # link-staging gates: the H2D variant ladder the executor
         # resolves at build time (FLUVIO_LINK_COMPRESS / the native

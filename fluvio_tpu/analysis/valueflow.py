@@ -1,0 +1,965 @@
+"""Level-5 preflight: value-flow (integer range / dtype-width) analysis.
+
+The north star is 1M-record batches of up-to-70KB records — scales
+where byte-offset products (``rows x width``, coalesce bases,
+stripe/segment offsets, hash mixes) silently exceed int32. PR 10 only
+dodged that class because a human reviewer caught one instance live
+(the ``MAX_COALESCE`` cap in ``admission/batcher.py``); this pass
+makes the whole class mechanical, the way PR 6 made executed paths and
+PR 7 made lock discipline statically checkable: per-function abstract
+interpretation over **integer intervals** seeded from the declared
+scale bounds, with a **dtype lattice** (np/jnp fixed-width int32/int64
+vs weak Python int) propagated through arithmetic and the
+array-constructor vocabulary (``zeros``/``full``/``arange``/
+``astype``/``cumsum``). Index-width planning done ahead-of-time is the
+same argument the dataflow-accelerator literature makes for bandwidth
+(Sextans 2109.11081) — prove the arithmetic fits before it multiplies.
+
+Rules (all ERROR — a predicted overflow at declared bounds is a
+deploy blocker exactly like a predicted interpreter spill):
+
+- **FLV301** fixed-width arithmetic (``+ * <<``, or a store into a
+  fixed-dtype array slot) whose interval at declared bounds exceeds
+  the result dtype — the coalesce-base class.
+- **FLV302** narrowing cast (``astype(int32)``, ``np.int32(...)``)
+  whose source interval does not fit the destination.
+- **FLV303** accumulation (``cumsum``/``sum``) over a column whose
+  worst case ``count x element-max`` overflows the accumulator dtype.
+  NB the asymmetry the rule encodes: host ``np.cumsum`` widens int32
+  input to int64, device ``jnp.cumsum`` does NOT — an identical
+  formula is safe on the host and overflows on the chip.
+- **FLV304** weak-Python-int arithmetic whose value relies on
+  arbitrary precision (hash mixes, shifted products) narrowed into a
+  fixed np width — wraparound changes meaning under fixed width.
+
+Declared scale bounds (the ``BOUNDS`` table): ``MAX_RECORD_WIDTH``,
+``MAX_WIDTH``/``FLUVIO_STRIPE_THRESHOLD``, ``SLICE_STRIDE`` /
+``MAX_COALESCE``, stripe geometry, and the 1M-row north-star bucket.
+Loop indices over *unknown-length* iterables deliberately widen to the
+row bound: the analyzer's question is "what happens at declared
+scale", not "what happened in the unit test".
+
+Soundness posture: findings fire only when BOTH interval sides are
+known — unknown values produce silence, not noise. ``# noqa:FLV3xx``
+(shared grammar, ``analysis/noqa.py``) documents each deliberate
+relaxation; suppressed findings stay enumerable
+(``ValueFlowReport.suppressed``) so the scale-probe differential suite
+can pin every one of them to a runtime guard or a documented
+impossibility.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from fluvio_tpu.analysis.noqa import line_suppresses
+
+ERROR = "error"
+WARN = "warn"
+
+RULES = {
+    "FLV301": (ERROR, "fixed-width arithmetic can exceed its dtype at "
+                      "declared scale bounds"),
+    "FLV302": (ERROR, "narrowing cast whose source interval does not fit "
+                      "the destination dtype"),
+    "FLV303": (ERROR, "cumsum/sum accumulation can overflow the "
+                      "accumulator dtype at declared bounds"),
+    "FLV304": (ERROR, "Python-int wraparound-dependent value narrowed "
+                      "into a fixed np width"),
+}
+
+# -- declared scale bounds ---------------------------------------------------
+
+#: the 1M-record north-star batch bucket: any loop/row count the code
+#: does not bound itself is assumed to reach this
+ROWS_BOUND = 1 << 20
+#: fluvio_tpu.smartengine.tpu.buffer hard ceiling per record value
+MAX_RECORD_WIDTH = 1 << 20
+
+BOUNDS: Dict[str, int] = {
+    "ROWS": ROWS_BOUND,
+    "MAX_RECORD_WIDTH": MAX_RECORD_WIDTH,
+    "MAX_WIDTH": 1 << 16,
+    "SLICE_STRIDE": 1 << 20,
+    "MAX_COALESCE": (2 ** 31 - 1) // (1 << 20),
+    "STRIPE_WIDTH": 8192,
+    "STRIPE_OVERLAP": 128,
+    "GLZ_CHUNK": 256 * 1024,
+    "MIN_ROWS": 8,
+    "MIN_WIDTH": 32,  # buffer.MIN_WIDTH (pinned by tests/test_valueflow)
+}
+
+#: modules walked by the repo gate — every kernel/executor/admission/
+#: partition arithmetic site (package-relative paths)
+VALUEFLOW_MODULES = (
+    "smartengine/tpu/buffer.py",
+    "smartengine/tpu/executor.py",
+    "smartengine/tpu/stripes.py",
+    "smartengine/tpu/kernels.py",
+    "smartengine/tpu/pallas_kernels.py",
+    "smartengine/tpu/glz.py",
+    "smartengine/tpu/lower.py",
+    "admission/batcher.py",
+    "admission/warmup.py",
+    "admission/controller.py",
+    "admission/fairness.py",
+    "partition/runtime.py",
+    "partition/placement.py",
+    "spu/smart_chain.py",
+)
+
+# -- dtype lattice -----------------------------------------------------------
+
+_INT_RANGES = {
+    "i8": (-(2 ** 7), 2 ** 7 - 1),
+    "i16": (-(2 ** 15), 2 ** 15 - 1),
+    "i32": (-(2 ** 31), 2 ** 31 - 1),
+    "i64": (-(2 ** 63), 2 ** 63 - 1),
+    "u8": (0, 2 ** 8 - 1),
+    "u16": (0, 2 ** 16 - 1),
+    "u32": (0, 2 ** 32 - 1),
+    "u64": (0, 2 ** 64 - 1),
+}
+_RANK = {"i8": 0, "u8": 0, "i16": 1, "u16": 1, "i32": 2, "u32": 2,
+         "i64": 3, "u64": 3}
+
+_DTYPE_NAMES = {
+    "int8": "i8", "int16": "i16", "int32": "i32", "int64": "i64",
+    "uint8": "u8", "uint16": "u16", "uint32": "u32", "uint64": "u64",
+    "float16": "f", "float32": "f", "float64": "f", "bfloat16": "f",
+    "bool_": "b", "bool": "b",
+}
+
+PYINT = "pyint"
+FLOAT = "f"
+TOP_D = "?"
+
+
+def _dtype_of_node(node) -> Optional[str]:
+    """``np.int32`` / ``jnp.int32`` / ``"int32"`` -> lattice dtype."""
+    if isinstance(node, ast.Attribute):
+        return _DTYPE_NAMES.get(node.attr)
+    if isinstance(node, ast.Name):
+        return _DTYPE_NAMES.get(node.id)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_NAMES.get(node.value)
+    return None
+
+
+def _promote(a: str, b: str) -> str:
+    """Result dtype of mixed arithmetic: fixed width wins over a weak
+    Python int (numpy's array-beats-weak-scalar rule); mixed fixed
+    widths take the wider rank; anything unknown stays unknown."""
+    if FLOAT in (a, b):
+        return FLOAT
+    if TOP_D in (a, b):
+        return TOP_D
+    if a == PYINT:
+        return b
+    if b == PYINT:
+        return a
+    if a == b:
+        return a
+    wide = a if _RANK.get(a, 0) >= _RANK.get(b, 0) else b
+    return wide
+
+
+@dataclass
+class Val:
+    """One abstract value: interval + dtype (+ element count when it
+    is an array, in which case lo/hi bound the ELEMENTS)."""
+
+    lo: Optional[int] = None
+    hi: Optional[int] = None
+    dtype: str = TOP_D
+    array: bool = False
+    n_hi: Optional[int] = None  # element-count upper bound (arrays)
+    #: an overflow was already reported on this value's derivation
+    #: chain — downstream re-derivations of the same overflow stay quiet
+    tainted: bool = False
+
+    @property
+    def known(self) -> bool:
+        return self.lo is not None and self.hi is not None
+
+
+TOP = Val()
+
+
+def _const(v: int) -> Val:
+    return Val(v, v, PYINT)
+
+
+def _seed_scalar(hi: int) -> Val:
+    return Val(0, hi, PYINT)
+
+
+#: name -> seed (matched on the identifier or the attribute's last
+#: segment, lowercased) — the declared-scale-bounds vocabulary
+def _seed_for(name: str) -> Optional[Val]:
+    n = name.lower()
+    if n in ("rows", "n_rows", "nrows", "row_target", "count", "n",
+             "n_out", "live_count", "c", "pos", "total_rows"):
+        return _seed_scalar(ROWS_BOUND)
+    if n in ("width", "kwidth", "max_width", "target_width", "w",
+             "val_width", "width_bucket"):
+        return _seed_scalar(MAX_RECORD_WIDTH)
+    if n in ("lengths", "lengths4", "l4", "lens", "stripe_len",
+             "seg_len", "val_len", "key_len", "lengths_c"):
+        return Val(-1, MAX_RECORD_WIDTH + 3, "i32", array=True,
+                   n_hi=ROWS_BOUND)
+    if n in ("key_lengths",):
+        return Val(-1, MAX_RECORD_WIDTH + 3, "i32", array=True,
+                   n_hi=ROWS_BOUND)
+    if n in ("offset_deltas", "fresh_offset_deltas"):
+        return Val(0, _INT_RANGES["i32"][1], "i32", array=True,
+                   n_hi=ROWS_BOUND)
+    if n in ("timestamp_deltas",):
+        return Val(0, _INT_RANGES["i64"][1], "i64", array=True,
+                   n_hi=ROWS_BOUND)
+    return None
+
+
+# -- findings ----------------------------------------------------------------
+
+
+@dataclass
+class ValueFinding:
+    path: str
+    line: int
+    code: str
+    level: str
+    message: str
+    #: bound evidence: intervals, dtypes, and the smallest in-bounds
+    #: shape that triggers the overflow (the scale-probe witness)
+    detail: Dict[str, object] = field(default_factory=dict)
+    suppressed: bool = False
+
+    def __str__(self) -> str:
+        return (
+            f"{self.path}:{self.line}: {self.code} [{self.level}] "
+            f"{self.message}"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path, "line": self.line, "code": self.code,
+            "level": self.level, "message": self.message,
+            "detail": self.detail, "suppressed": self.suppressed,
+        }
+
+
+@dataclass
+class ValueFlowReport:
+    findings: List[ValueFinding] = field(default_factory=list)
+    suppressed: List[ValueFinding] = field(default_factory=list)
+    files: int = 0
+
+    def errors(self) -> List[ValueFinding]:
+        return [f for f in self.findings if f.level == ERROR]
+
+    def all_sites(self) -> List[ValueFinding]:
+        """Reported + suppressed — the scale-probe audit surface."""
+        return list(self.findings) + list(self.suppressed)
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "files": self.files,
+            "rules": {k: {"level": lv, "doc": doc}
+                      for k, (lv, doc) in RULES.items()},
+        }
+
+
+# -- interval arithmetic -----------------------------------------------------
+
+
+def _iv_add(a: Val, b: Val) -> Tuple[Optional[int], Optional[int]]:
+    if a.known and b.known:
+        return a.lo + b.lo, a.hi + b.hi
+    return None, None
+
+
+def _iv_sub(a: Val, b: Val) -> Tuple[Optional[int], Optional[int]]:
+    if a.known and b.known:
+        return a.lo - b.hi, a.hi - b.lo
+    return None, None
+
+
+def _iv_mul(a: Val, b: Val) -> Tuple[Optional[int], Optional[int]]:
+    if a.known and b.known:
+        combos = [a.lo * b.lo, a.lo * b.hi, a.hi * b.lo, a.hi * b.hi]
+        return min(combos), max(combos)
+    return None, None
+
+
+def _iv_floordiv(a: Val, b: Val) -> Tuple[Optional[int], Optional[int]]:
+    if a.known and b.known and b.lo is not None and b.lo > 0:
+        combos = [a.lo // b.lo, a.lo // b.hi, a.hi // b.lo, a.hi // b.hi]
+        return min(combos), max(combos)
+    return None, None
+
+
+def _iv_mod(a: Val, b: Val) -> Tuple[Optional[int], Optional[int]]:
+    if b.known and b.lo > 0:
+        return 0, b.hi - 1
+    return None, None
+
+
+def _iv_lshift(a: Val, b: Val) -> Tuple[Optional[int], Optional[int]]:
+    if a.known and b.known and 0 <= b.lo and b.hi <= 128:
+        return a.lo << b.lo if a.lo >= 0 else a.lo << b.hi, a.hi << b.hi
+    return None, None
+
+
+def _iv_pow(a: Val, b: Val) -> Tuple[Optional[int], Optional[int]]:
+    if a.known and b.known and a.lo >= 0 and 0 <= b.lo and b.hi <= 128:
+        return a.lo ** b.lo, a.hi ** b.hi
+    return None, None
+
+
+# -- the per-function interpreter -------------------------------------------
+
+
+class _FuncFlow:
+    def __init__(self, linter: "_ModuleFlow", fn: ast.AST):
+        self.L = linter
+        self.fn = fn
+        self.env: Dict[str, Val] = {}
+
+    # -- evaluation ---------------------------------------------------------
+
+    def lookup(self, key: str, seed_name: str) -> Val:
+        if key in self.env:
+            return self.env[key]
+        if seed_name in self.L.consts:
+            v = self.L.consts[seed_name]
+            return Val(v, v, PYINT)
+        seeded = _seed_for(seed_name)
+        return seeded if seeded is not None else TOP
+
+    def eval(self, node) -> Val:
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool) or node.value is None:
+                return TOP
+            if isinstance(node.value, int):
+                return _const(node.value)
+            if isinstance(node.value, float):
+                return Val(dtype=FLOAT)
+            return TOP
+        if isinstance(node, ast.Name):
+            return self.lookup(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            key = self._attr_key(node)
+            return self.lookup(key or node.attr, node.attr)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand)
+            if isinstance(node.op, ast.USub) and v.known:
+                return Val(-v.hi, -v.lo, v.dtype, v.array, v.n_hi)
+            if isinstance(node.op, ast.Invert) and v.known:
+                return Val(-v.hi - 1, -v.lo - 1, v.dtype, v.array, v.n_hi)
+            return Val(dtype=v.dtype, array=v.array, n_hi=v.n_hi)
+        if isinstance(node, ast.BinOp):
+            return self._binop(node)
+        if isinstance(node, ast.IfExp):
+            return self._join(self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.eval(child)
+            return Val(0, 1, "b")
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self.eval(elt)  # casts inside tuple assigns still check
+            return TOP
+        return TOP
+
+    def _attr_key(self, node: ast.Attribute) -> Optional[str]:
+        parts = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def _join(self, a: Val, b: Val) -> Val:
+        lo = min(a.lo, b.lo) if a.known and b.known else None
+        hi = max(a.hi, b.hi) if a.known and b.known else None
+        dt = a.dtype if a.dtype == b.dtype else _promote(a.dtype, b.dtype)
+        n = None
+        if a.n_hi is not None and b.n_hi is not None:
+            n = max(a.n_hi, b.n_hi)
+        return Val(lo, hi, dt, a.array or b.array, n)
+
+    # -- operators ----------------------------------------------------------
+
+    _OPS = {
+        ast.Add: _iv_add, ast.Sub: _iv_sub, ast.Mult: _iv_mul,
+        ast.FloorDiv: _iv_floordiv, ast.Mod: _iv_mod,
+        ast.LShift: _iv_lshift, ast.Pow: _iv_pow,
+    }
+    _OVERFLOWING = (ast.Add, ast.Mult, ast.LShift, ast.Pow, ast.Sub)
+
+    def _binop(self, node: ast.BinOp) -> Val:
+        a = self.eval(node.left)
+        b = self.eval(node.right)
+        if isinstance(node.op, ast.RShift):
+            # x >> k == x // 2**k for our (non-negative) index math
+            if b.known and 0 <= b.lo and b.hi <= 128:
+                b = Val(2 ** b.lo, 2 ** b.hi, PYINT)
+                lo, hi = _iv_floordiv(a, b)
+            else:
+                lo = hi = None
+        elif isinstance(node.op, ast.BitAnd):
+            lo, hi = self._iv_bitand(a, b)
+        elif isinstance(node.op, (ast.BitOr, ast.BitXor)):
+            lo, hi = self._iv_bitor(a, b)
+        elif isinstance(node.op, ast.Div):
+            return Val(dtype=FLOAT, array=a.array or b.array)
+        else:
+            fn = self._OPS.get(type(node.op))
+            lo, hi = fn(a, b) if fn else (None, None)
+        dt = _promote(a.dtype, b.dtype)
+        array = a.array or b.array
+        n_hi = a.n_hi if a.array else (b.n_hi if b.array else None)
+        tainted = a.tainted or b.tainted
+        out = Val(lo, hi, dt, array, n_hi, tainted)
+        if (
+            isinstance(node.op, self._OVERFLOWING)
+            and out.known
+            and not tainted
+            and dt in _INT_RANGES
+        ):
+            dlo, dhi = _INT_RANGES[dt]
+            if out.hi > dhi or out.lo < dlo:
+                self.L.flag(
+                    node, "FLV301",
+                    f"{dt} arithmetic reaches "
+                    f"[{out.lo}, {out.hi}] at declared bounds — "
+                    f"exceeds {dt} range [{dlo}, {dhi}]",
+                    detail=self._witness_mul(node, a, b, dhi, dt),
+                )
+                out = Val(max(out.lo, dlo), min(out.hi, dhi), dt, array,
+                          n_hi, tainted=True)
+        return out
+
+    @staticmethod
+    def _iv_bitand(a: Val, b: Val):
+        # x & mask: a positive mask caps the value; a negative mask
+        # (~3-style alignment) only rounds toward zero
+        for x, y in ((a, b), (b, a)):
+            if y.known and y.lo == y.hi:
+                m = y.lo
+                if m >= 0:
+                    return 0, m
+                if x.known and x.lo >= 0:
+                    return 0, x.hi
+        if a.known and b.known and a.lo >= 0 and b.lo >= 0:
+            return 0, max(a.hi, b.hi)
+        return None, None
+
+    @staticmethod
+    def _iv_bitor(a: Val, b: Val):
+        if a.known and b.known and a.lo >= 0 and b.lo >= 0:
+            top = max(a.hi, b.hi)
+            return 0, (1 << top.bit_length()) - 1 if top else 0
+        return None, None
+
+    def _witness_mul(self, node, a: Val, b: Val, dhi: int, dt: str) -> dict:
+        detail: Dict[str, object] = {
+            "dtype": dt,
+            "left": [a.lo, a.hi], "right": [b.lo, b.hi],
+        }
+        if isinstance(node.op, ast.Mult) and b.known and b.hi and b.hi > 0:
+            detail["witness"] = {
+                "left": dhi // b.hi + 1, "right": b.hi,
+            }
+        elif isinstance(node.op, ast.Add):
+            detail["witness"] = {"left": a.hi, "right": b.hi}
+        return detail
+
+    # -- subscripts ---------------------------------------------------------
+
+    def _subscript(self, node: ast.Subscript) -> Val:
+        base = self.eval(node.value)
+        if base.array:
+            if isinstance(node.slice, ast.Slice):
+                return Val(base.lo, base.hi, base.dtype, True, base.n_hi)
+            return Val(base.lo, base.hi, base.dtype, False, None)
+        return TOP
+
+    # -- calls --------------------------------------------------------------
+
+    _CTOR_FUNCS = {"zeros", "empty", "ones", "full", "full_like", "asarray",
+                   "array"}
+    _ACC_FUNCS = {"cumsum", "sum"}
+    _NP_ROOTS = {"np", "numpy"}
+    _JNP_ROOTS = {"jnp", "lax", "jax"}
+
+    def _call_parts(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            root = fn.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            rootname = root.id if isinstance(root, ast.Name) else None
+            return fn.attr, rootname, fn.value
+        if isinstance(fn, ast.Name):
+            return fn.id, None, None
+        return None, None, None
+
+    def _kw(self, node: ast.Call, name: str):
+        for kw in node.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _call(self, node: ast.Call) -> Val:
+        name, root, recv = self._call_parts(node)
+        # builtins that transport bounds
+        if name in ("int", "abs") and root is None and len(node.args) == 1:
+            v = self.eval(node.args[0])
+            return Val(v.lo, v.hi, PYINT if name == "int" else v.dtype)
+        if name in ("min", "max") and root is None and node.args:
+            vals = [self.eval(a) for a in node.args]
+            if all(v.known for v in vals):
+                if name == "min":
+                    return Val(min(v.lo for v in vals),
+                               min(v.hi for v in vals), PYINT)
+                return Val(max(v.lo for v in vals),
+                           max(v.hi for v in vals), PYINT)
+            return TOP
+        if name == "len" and root is None and len(node.args) == 1:
+            v = self.eval(node.args[0])
+            if v.array and v.n_hi is not None:
+                return Val(0, v.n_hi, PYINT)
+            return TOP
+        if name == "range":
+            args = [self.eval(a) for a in node.args]
+            if len(args) == 1 and args[0].known:
+                return Val(0, max(args[0].hi - 1, 0), PYINT, array=True,
+                           n_hi=args[0].hi)
+            if len(args) >= 2 and args[0].known and args[1].known:
+                return Val(args[0].lo, max(args[1].hi - 1, args[0].lo),
+                           PYINT, array=True, n_hi=None)
+            return Val(dtype=PYINT, array=True)
+        # dtype casts: np.int32(x) / jnp.int32(x)
+        cast_dt = _DTYPE_NAMES.get(name or "")
+        if cast_dt is not None and len(node.args) == 1:
+            return self._cast(node, self.eval(node.args[0]), cast_dt)
+        if name == "astype" and recv is not None and node.args:
+            target = _dtype_of_node(node.args[0])
+            src = self.eval(recv)
+            if target is not None:
+                return self._cast(node, src, target)
+            return Val(dtype=TOP_D, array=src.array, n_hi=src.n_hi)
+        # constructors
+        if name in self._CTOR_FUNCS and root in (
+            self._NP_ROOTS | self._JNP_ROOTS
+        ):
+            return self._ctor(node, name)
+        if name == "arange" and root in (self._NP_ROOTS | self._JNP_ROOTS):
+            dt_node = self._kw(node, "dtype")
+            dt = _dtype_of_node(dt_node) if dt_node is not None else PYINT
+            if len(node.args) == 1:
+                n = self.eval(node.args[0])
+                if n.known:
+                    out = Val(0, max(n.hi - 1, 0), dt or TOP_D, True, n.hi)
+                    return self._cast(node, out, dt) if dt in _INT_RANGES \
+                        else out
+            return Val(dtype=dt or TOP_D, array=True)
+        # accumulations
+        if name in self._ACC_FUNCS and root in (
+            self._NP_ROOTS | self._JNP_ROOTS
+        ) and node.args:
+            return self._accumulate(node, name, root)
+        if name == "clip" and len(node.args) >= 3:
+            v = self.eval(node.args[0])
+            lo = self.eval(node.args[1])
+            hi = self.eval(node.args[2])
+            if lo.known and hi.known:
+                return Val(lo.lo, hi.hi, v.dtype, v.array, v.n_hi)
+            return v
+        if name in ("maximum", "minimum") and len(node.args) == 2:
+            a, b = self.eval(node.args[0]), self.eval(node.args[1])
+            if a.known and b.known:
+                if name == "maximum":
+                    return Val(max(a.lo, b.lo), max(a.hi, b.hi),
+                               _promote(a.dtype, b.dtype),
+                               a.array or b.array, a.n_hi or b.n_hi)
+                return Val(min(a.lo, b.lo), min(a.hi, b.hi),
+                           _promote(a.dtype, b.dtype),
+                           a.array or b.array, a.n_hi or b.n_hi)
+            return TOP
+        if name == "where" and len(node.args) == 3:
+            return self._join(self.eval(node.args[1]),
+                              self.eval(node.args[2]))
+        if name == "take" and len(node.args) >= 2:
+            return self.eval(node.args[0])
+        # evaluate args for nested checks, result unknown
+        for a in node.args:
+            self.eval(a)
+        for kw in node.keywords:
+            if kw.value is not None:
+                self.eval(kw.value)
+        return TOP
+
+    def _cast(self, node, src: Val, target: str) -> Val:
+        if target in _INT_RANGES and src.known:
+            dlo, dhi = _INT_RANGES[target]
+            if src.tainted and (src.hi > dhi or src.lo < dlo):
+                return Val(dlo, dhi, target, src.array, src.n_hi,
+                           tainted=True)
+            if src.hi > dhi or src.lo < dlo:
+                if src.dtype == PYINT:
+                    code, why = "FLV304", (
+                        "Python-int value relies on arbitrary precision "
+                        "— wraparound changes meaning under fixed width"
+                    )
+                else:
+                    code, why = "FLV302", "source interval does not fit"
+                self.L.flag(
+                    node, code,
+                    f"narrowing to {target}: source reaches "
+                    f"[{src.lo}, {src.hi}] at declared bounds but "
+                    f"{target} holds [{dlo}, {dhi}] — {why}",
+                    detail={
+                        "target": target, "source": [src.lo, src.hi],
+                        "source_dtype": src.dtype,
+                    },
+                )
+                return Val(dlo, dhi, target, src.array, src.n_hi,
+                           tainted=True)
+            return Val(max(src.lo, dlo), min(src.hi, dhi), target,
+                       src.array, src.n_hi)
+        if target in _INT_RANGES:
+            return Val(None, None, target, src.array, src.n_hi)
+        return Val(dtype=target or TOP_D, array=src.array, n_hi=src.n_hi)
+
+    def _ctor(self, node: ast.Call, name: str) -> Val:
+        dt_node = self._kw(node, "dtype")
+        dt = _dtype_of_node(dt_node) if dt_node is not None else None
+        n_hi = None
+        if node.args:
+            shape = self.eval(node.args[0])
+            if shape.known and not shape.array:
+                n_hi = shape.hi
+        if name in ("zeros", "empty", "ones"):
+            fill = 1 if name == "ones" else 0
+            return Val(0, fill, dt or TOP_D, True, n_hi)
+        if name in ("full", "full_like") and len(node.args) > 1:
+            fill = self.eval(node.args[1])
+            if dt in _INT_RANGES and fill.known:
+                return self._cast(node, Val(fill.lo, fill.hi, PYINT, True,
+                                            n_hi), dt)
+            return Val(fill.lo, fill.hi, dt or fill.dtype, True, n_hi)
+        if name in ("asarray", "array") and node.args:
+            src = self.eval(node.args[0])
+            if dt is not None:
+                return self._cast(node, Val(src.lo, src.hi, src.dtype,
+                                            True, src.n_hi), dt)
+            return Val(src.lo, src.hi, src.dtype, True, src.n_hi)
+        return Val(dtype=dt or TOP_D, array=True, n_hi=n_hi)
+
+    def _accumulate(self, node: ast.Call, name: str, root: str) -> Val:
+        src = self.eval(node.args[0])
+        dt_node = self._kw(node, "dtype")
+        explicit = _dtype_of_node(dt_node) if dt_node is not None else None
+        if explicit is not None:
+            acc = explicit
+        elif root in self._NP_ROOTS:
+            # host numpy widens sub-int64 integer accumulation to int64
+            acc = src.dtype if src.dtype in ("i64", "u64", FLOAT, TOP_D,
+                                             PYINT) else "i64"
+        else:
+            # device jnp does NOT widen: int32 in, int32 accumulator
+            acc = src.dtype
+        if (
+            acc in _INT_RANGES
+            and src.known
+            and src.array
+            and not src.tainted
+            and src.n_hi is not None
+        ):
+            dlo, dhi = _INT_RANGES[acc]
+            worst = src.n_hi * max(abs(src.hi), abs(src.lo))
+            if worst > dhi:
+                elem = max(abs(src.hi), abs(src.lo))
+                self.L.flag(
+                    node, "FLV303",
+                    f"{root}.{name} accumulates up to "
+                    f"{src.n_hi} x {elem} = {worst} in {acc} "
+                    f"(max {dhi}) at declared bounds"
+                    + (" — device jnp keeps the input dtype as the "
+                       "accumulator" if root in self._JNP_ROOTS else ""),
+                    detail={
+                        "acc_dtype": acc, "elem_max": elem,
+                        "count_max": src.n_hi,
+                        "witness": {"count": dhi // max(elem, 1) + 1,
+                                    "elem": elem},
+                    },
+                )
+                return Val(dlo, dhi, acc, True, src.n_hi, tainted=True)
+            return Val(min(0, src.n_hi * src.lo), worst, acc, True,
+                       src.n_hi)
+        return Val(dtype=acc if acc else TOP_D, array=name == "cumsum",
+                   n_hi=src.n_hi)
+
+    # -- statements ---------------------------------------------------------
+
+    def run(self) -> None:
+        for p in getattr(self.fn, "args", None).args if hasattr(
+            self.fn, "args"
+        ) else []:
+            seeded = _seed_for(p.arg)
+            if seeded is not None:
+                self.env[p.arg] = seeded
+        self._block(self.fn.body)
+
+    def _block(self, stmts) -> None:
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st) -> None:
+        if isinstance(st, ast.Assign):
+            val = self.eval(st.value)
+            for t in st.targets:
+                self._store(t, val)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            self._store(st.target, self.eval(st.value))
+        elif isinstance(st, ast.AugAssign):
+            cur = self.eval(st.target)
+            rhs = self.eval(st.value)
+            synth = ast.BinOp(left=st.target, op=st.op, right=st.value)
+            ast.copy_location(synth, st)
+            ast.fix_missing_locations(synth)
+            val = self._binop(synth)
+            del cur, rhs
+            self._store(st.target, val)
+        elif isinstance(st, ast.For):
+            self._for(st)
+        elif isinstance(st, ast.While):
+            self.eval(st.test)
+            self._block(st.body)
+        elif isinstance(st, ast.If):
+            self.eval(st.test)
+            self._block(st.body)
+            self._block(st.orelse)
+        elif isinstance(st, ast.With):
+            self._block(st.body)
+        elif isinstance(st, (ast.Try,)):
+            self._block(st.body)
+            for h in st.handlers:
+                self._block(h.body)
+            self._block(st.orelse)
+            self._block(st.finalbody)
+        elif isinstance(st, ast.Return) and st.value is not None:
+            self.eval(st.value)
+        elif isinstance(st, ast.Expr):
+            self.eval(st.value)
+        # nested defs are walked as their own functions by the module
+
+    def _for(self, st: ast.For) -> None:
+        it = st.iter
+        idx_val = Val(0, ROWS_BOUND - 1, PYINT)  # unknown-length loop:
+        # the index widens to the declared row bound by design
+        elem_val = TOP
+        if isinstance(it, ast.Call):
+            name, root, _ = self._call_parts(it)
+            if name == "range":
+                rng = self.eval(it)
+                if rng.known:
+                    idx_val = Val(rng.lo, rng.hi, PYINT)
+                if isinstance(st.target, ast.Name):
+                    self.env[st.target.id] = idx_val
+                    self._block(st.body)
+                    self._block(st.orelse)
+                    return
+            if name == "enumerate":
+                src = self.eval(it.args[0]) if it.args else TOP
+                if src.array and src.n_hi is not None:
+                    idx_val = Val(0, max(src.n_hi - 1, 0), PYINT)
+                if src.array:
+                    elem_val = Val(src.lo, src.hi, src.dtype)
+                if isinstance(st.target, ast.Tuple) and len(
+                    st.target.elts
+                ) == 2:
+                    i_t, e_t = st.target.elts
+                    if isinstance(i_t, ast.Name):
+                        self.env[i_t.id] = idx_val
+                    self._store(e_t, elem_val)
+                    self._block(st.body)
+                    self._block(st.orelse)
+                    return
+        src = self.eval(it)
+        if src.array:
+            elem_val = Val(src.lo, src.hi, src.dtype)
+            self._store(st.target, elem_val)
+        else:
+            self._store(st.target, idx_val)
+        self._block(st.body)
+        self._block(st.orelse)
+
+    def _store(self, target, val: Val) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = val
+        elif isinstance(target, ast.Attribute):
+            key = self._attr_key(target)
+            if key:
+                self.env[key] = val
+        elif isinstance(target, ast.Subscript):
+            self._store_subscript(target, val)
+        elif isinstance(target, ast.Tuple):
+            for elt in target.elts:
+                self._store(elt, TOP)
+
+    def _store_subscript(self, target: ast.Subscript, val: Val) -> None:
+        base = self.eval(target.value)
+        if base.array and base.dtype in _INT_RANGES and val.known and \
+                not val.tainted:
+            dlo, dhi = _INT_RANGES[base.dtype]
+            if val.hi > dhi or val.lo < dlo:
+                self.L.flag(
+                    target, "FLV301",
+                    f"store into {base.dtype} array slot reaches "
+                    f"[{val.lo}, {val.hi}] at declared bounds — exceeds "
+                    f"{base.dtype} range [{dlo}, {dhi}]",
+                    detail={"dtype": base.dtype,
+                            "value": [val.lo, val.hi]},
+                )
+                val = Val(max(val.lo, dlo), min(val.hi, dhi), base.dtype,
+                          val.array, val.n_hi)
+        # widen the stored-into array's element bounds (a later
+        # narrowing cast must see what the stores put there)
+        if base.array and base.known and val.known:
+            widened = Val(
+                min(base.lo, val.lo), max(base.hi, val.hi), base.dtype,
+                True, base.n_hi,
+            )
+            self._store(target.value, widened)
+
+
+# -- the per-module driver ---------------------------------------------------
+
+
+class _ModuleFlow:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings: List[ValueFinding] = []
+        self.suppressed: List[ValueFinding] = []
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            self.findings.append(ValueFinding(
+                path, e.lineno or 1, "FLV300", ERROR,
+                f"syntax error: {e.msg}",
+            ))
+            return
+        self.consts = dict(BOUNDS)
+        self._module_consts()
+
+    def _module_consts(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                v = self._const_int(node.value)
+                if v is not None:
+                    self.consts[node.targets[0].id] = v
+
+    def _const_int(self, node) -> Optional[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.consts.get(node.id)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self._const_int(node.operand)
+            return -v if v is not None else None
+        if isinstance(node, ast.BinOp):
+            a = self._const_int(node.left)
+            b = self._const_int(node.right)
+            if a is None or b is None:
+                return None
+            try:
+                if isinstance(node.op, ast.Add):
+                    return a + b
+                if isinstance(node.op, ast.Sub):
+                    return a - b
+                if isinstance(node.op, ast.Mult):
+                    return a * b
+                if isinstance(node.op, ast.FloorDiv):
+                    return a // b
+                if isinstance(node.op, ast.LShift):
+                    return a << b
+                if isinstance(node.op, ast.Pow) and abs(b) < 256:
+                    return a ** b
+            except (ZeroDivisionError, OverflowError, ValueError):
+                return None
+        return None
+
+    def flag(self, node, code: str, message: str,
+             detail: Optional[dict] = None) -> None:
+        line = getattr(node, "lineno", 1)
+        level = RULES.get(code, (ERROR, ""))[0]
+        f = ValueFinding(self.path, line, code, level, message,
+                         detail or {})
+        if line_suppresses(self.lines, line, code):
+            f.suppressed = True
+            self.suppressed.append(f)
+        else:
+            # one finding per (line, code): chained expressions
+            # re-deriving the same overflow stay one report
+            for prev in self.findings:
+                if prev.line == line and prev.code == code:
+                    return
+            self.findings.append(f)
+
+    def run(self) -> None:
+        if self.tree is None:
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FuncFlow(self, node).run()
+
+
+# -- public API --------------------------------------------------------------
+
+
+def analyze_values_sources(sources: Dict[str, str]) -> ValueFlowReport:
+    """FLV301-304 over ``{path: source}`` (synthetic-module testable,
+    mirroring ``concurrency.analyze_sources``)."""
+    report = ValueFlowReport()
+    for path in sorted(sources):
+        mf = _ModuleFlow(path, sources[path])
+        mf.run()
+        report.findings.extend(mf.findings)
+        report.suppressed.extend(mf.suppressed)
+        report.files += 1
+    return report
+
+
+def analyze_values_package(root: Optional[str] = None) -> ValueFlowReport:
+    """The repo gate: walk every registered arithmetic module."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sources: Dict[str, str] = {}
+    for rel in VALUEFLOW_MODULES:
+        p = os.path.join(root, rel.replace("/", os.sep))
+        if not os.path.exists(p):
+            continue
+        with open(p, "r", encoding="utf-8") as f:
+            sources[p] = f.read()
+    return analyze_values_sources(sources)

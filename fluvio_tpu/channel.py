@@ -9,9 +9,10 @@ the active channel is stored in ``~/.fluvio-tpu/channel.json``.
 from __future__ import annotations
 
 import json
-import os
 from dataclasses import dataclass, field
 from pathlib import Path
+
+from fluvio_tpu.analysis.envreg import env_raw
 from typing import Dict, Optional
 
 STABLE = "stable"
@@ -21,9 +22,7 @@ KNOWN_CHANNELS = (STABLE, LATEST, DEV)
 
 
 def channel_file() -> Path:
-    return Path(
-        os.environ.get("FLUVIO_TPU_CHANNEL_FILE", "~/.fluvio-tpu/channel.json")
-    ).expanduser()
+    return Path(env_raw("FLUVIO_TPU_CHANNEL_FILE")).expanduser()
 
 
 @dataclass

@@ -81,6 +81,22 @@ def add_analyze_parser(sub) -> None:
         "(guard map, lock-order graph, FLV2xx hazards)",
     )
     p.add_argument(
+        "--values",
+        nargs="*",
+        metavar="PATH",
+        help="run the value-flow pass (int32/overflow range analysis "
+        "over kernel/admission/partition arithmetic, FLV3xx) over "
+        "PATHs (no PATH = the registered engine modules)",
+    )
+    p.add_argument(
+        "--env",
+        nargs="*",
+        metavar="PATH",
+        help="run the env-config registry lint (FLV4xx: unregistered "
+        "reads, README drift, divergent defaults) over PATHs (no "
+        "PATH = the whole package + README) and print the registry",
+    )
+    p.add_argument(
         "--partitions",
         type=int,
         metavar="N",
@@ -171,6 +187,8 @@ async def analyze(args) -> int:
         name for name, wanted in (
             ("concurrency", args.concurrency),
             ("lint", args.lint is not None),
+            ("values", args.values is not None),
+            ("env", args.env is not None),
             ("partitions", args.partitions is not None),
             ("chain", bool(args.module) and args.partitions is None),
         ) if wanted
@@ -178,7 +196,8 @@ async def analyze(args) -> int:
     if not jobs:
         raise CliError(
             "nothing to analyze: pass --module "
-            "(or --lint / --concurrency / --partitions)"
+            "(or --lint / --concurrency / --values / --env / "
+            "--partitions)"
         )
     # several passes in json mode merge into ONE top-level document —
     # two concatenated dumps would be unparseable machine output
@@ -193,6 +212,14 @@ async def analyze(args) -> int:
         lrc, payload = _run_lint(args, emit=not merge)
         rc = max(rc, lrc)
         merged["lint"] = payload
+    if "values" in jobs:
+        vrc, payload = _run_values(args, emit=not merge)
+        rc = max(rc, vrc)
+        merged["values"] = payload
+    if "env" in jobs:
+        erc, payload = _run_env(args, emit=not merge)
+        rc = max(rc, erc)
+        merged["env"] = payload
     if "partitions" in jobs:
         prc, payload = _run_partitions(args, emit=not merge)
         rc = max(rc, prc)
@@ -349,6 +376,120 @@ def _run_concurrency(args, emit: bool = True):
     if rc:
         print(f"\n{len(report.errors())} ERROR-severity concurrency finding(s)")
     return rc, report.to_dict()
+
+
+def _read_sources(paths):
+    import os
+
+    from fluvio_tpu.analysis.envreg import _package_sources
+
+    out = {}
+    for p in paths:
+        if os.path.isdir(p):
+            # the same walk (and .git/.xla_cache/_build exclusions) the
+            # package-scope scan uses — generated trees never lint
+            out.update(_package_sources(p))
+        else:
+            with open(p, "r", encoding="utf-8") as fh:
+                out[p] = fh.read()
+    return out
+
+
+def _run_values(args, emit: bool = True):
+    """``analyze --values``: the FLV3xx value-flow pass over the
+    registered arithmetic modules (rc 1 on any unsuppressed ERROR —
+    a predicted overflow at declared bounds is a deploy blocker)."""
+    from fluvio_tpu.analysis import analyze_values, analyze_values_sources
+    from fluvio_tpu.cli.metrics import _rows_to_table
+
+    if args.values:
+        report = analyze_values_sources(_read_sources(args.values))
+    else:
+        report = analyze_values()
+    rc = 1 if report.errors() else 0
+    payload = report.to_dict()
+    if args.format == "json":
+        if emit:
+            print(json.dumps(payload, indent=1))
+        return rc, payload
+    sections = []
+    if report.findings:
+        rows = [
+            (f.level.upper(), f.code, f"{f.path}:{f.line}", f.message)
+            for f in report.findings
+        ]
+        sections.append(
+            "value-flow findings\n"
+            + _rows_to_table(rows, header=("sev", "code", "site", "detail"))
+        )
+    else:
+        sections.append(
+            f"value-flow findings\n(none across {report.files} modules)"
+        )
+    if report.suppressed:
+        rows = [
+            (f.code, f"{f.path}:{f.line}") for f in report.suppressed
+        ]
+        sections.append(
+            "documented relaxations (# noqa)\n"
+            + _rows_to_table(rows, header=("code", "site"))
+        )
+    if emit:
+        print("\n\n".join(sections))
+        if rc:
+            print(f"\n{len(report.errors())} ERROR-severity value-flow "
+                  "finding(s)")
+    return rc, payload
+
+
+def _run_env(args, emit: bool = True):
+    """``analyze --env``: the FLV4xx env-config registry lint + the
+    registry table (rc 1 on unregistered reads / docs drift /
+    divergent defaults)."""
+    from fluvio_tpu.analysis import lint_env, lint_env_sources, registry_report
+    from fluvio_tpu.cli.metrics import _rows_to_table
+
+    if args.env:
+        findings = lint_env_sources(_read_sources(args.env))
+    else:
+        findings = lint_env()
+    rc = 1 if any(f.level == "error" for f in findings) else 0
+    payload = {
+        "findings": [f.to_dict() for f in findings],
+        "registry": registry_report(),
+    }
+    if args.format == "json":
+        if emit:
+            print(json.dumps(payload, indent=1))
+        return rc, payload
+    sections = []
+    rows = [
+        (f["name"], f["kind"],
+         "(computed)" if f["default"] is None else (f["default"] or "''"),
+         f["consumers"][0])
+        for f in payload["registry"]["flags"]
+    ]
+    sections.append(
+        f"env-flag registry ({payload['registry']['count']} flags)\n"
+        + _rows_to_table(rows, header=("flag", "kind", "default", "consumer"))
+    )
+    if findings:
+        rows = [
+            (f.level.upper(), f.code, f"{f.path}:{f.line}", f.message)
+            for f in findings
+        ]
+        sections.append(
+            "findings\n"
+            + _rows_to_table(rows, header=("sev", "code", "site", "detail"))
+        )
+    else:
+        sections.append("findings\n(none)")
+    if emit:
+        print("\n\n".join(sections))
+        if rc:
+            print(f"\n{sum(1 for f in findings if f.level == 'error')} "
+                  "ERROR-severity env-config finding(s)")
+    return rc, payload
 
 
 def _run_lint(args, emit: bool = True):

@@ -13,6 +13,8 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 from pathlib import Path
+
+from fluvio_tpu.analysis.envreg import env_raw
 from typing import Dict, Optional
 
 import yaml
@@ -210,7 +212,7 @@ class ConfigFile:
 
 
 def default_config_path() -> str:
-    override = os.environ.get(CONFIG_ENV)
+    override = env_raw(CONFIG_ENV)
     if override:
         return override
     return str(Path(DEFAULT_CONFIG_DIR).expanduser() / "config")

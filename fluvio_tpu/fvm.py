@@ -14,6 +14,8 @@ import json
 import os
 import sys
 from pathlib import Path
+
+from fluvio_tpu.analysis.envreg import env_raw
 from typing import List, Optional
 
 from fluvio_tpu.channel import ChannelConfig
@@ -21,9 +23,7 @@ from fluvio_tpu.hub.registry import version_sort_key as _version_key
 
 
 def versions_dir() -> Path:
-    return Path(
-        os.environ.get("FLUVIO_TPU_VERSIONS_DIR", "~/.fluvio-tpu/versions")
-    ).expanduser()
+    return Path(env_raw("FLUVIO_TPU_VERSIONS_DIR")).expanduser()
 
 
 def installed_versions() -> List[str]:

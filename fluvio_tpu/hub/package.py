@@ -15,11 +15,12 @@ from __future__ import annotations
 import hashlib
 import io
 import json
-import os
 import tarfile
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
+
+from fluvio_tpu.analysis.envreg import env_raw
 from typing import Dict, Iterable, Optional
 
 MANIFEST_NAME = "package-meta.json"
@@ -32,9 +33,7 @@ class HubError(Exception):
 
 
 def key_path() -> Path:
-    return Path(
-        os.environ.get("FLUVIO_TPU_HUB_KEY", "~/.fluvio-tpu/hub-ed25519.key")
-    ).expanduser()
+    return Path(env_raw("FLUVIO_TPU_HUB_KEY")).expanduser()
 
 
 def _ed25519():

@@ -11,6 +11,8 @@ from __future__ import annotations
 import json
 import os
 from pathlib import Path
+
+from fluvio_tpu.analysis.envreg import env_raw
 from typing import Dict, List, Optional, Tuple
 
 from fluvio_tpu.hub.package import (
@@ -28,9 +30,7 @@ INDEX_NAME = "index.json"
 
 
 def default_hub_dir() -> str:
-    return os.environ.get(
-        "FLUVIO_TPU_HUB_DIR", str(Path("~/.fluvio-tpu/hub").expanduser())
-    )
+    return str(Path(env_raw("FLUVIO_TPU_HUB_DIR")).expanduser())
 
 
 def parse_ref(ref: str) -> Tuple[str, str, Optional[str]]:

@@ -19,6 +19,8 @@ import logging
 import os
 from typing import Optional
 
+from fluvio_tpu.analysis.envreg import env_raw
+
 logger = logging.getLogger(__name__)
 
 _GATE = None
@@ -55,8 +57,7 @@ def __getattr__(name: str):
 
 def partitions_env(env: Optional[dict] = None) -> int:
     """Parsed ``FLUVIO_PARTITIONS`` group count (0 = disabled)."""
-    e = env if env is not None else os.environ
-    spec = (e.get("FLUVIO_PARTITIONS") or "").strip()
+    spec = (env_raw("FLUVIO_PARTITIONS", env) or "").strip()
     if not spec:
         return 0
     try:

@@ -22,8 +22,9 @@ round-robin without changing any placement decision.
 from __future__ import annotations
 
 import hashlib
-import os
 import re
+
+from fluvio_tpu.analysis.envreg import env_raw
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -91,8 +92,7 @@ def parse_placement_rules(spec: Optional[str]) -> Tuple[PlacementRule, ...]:
 
 
 def rules_from_env(env: Optional[dict] = None) -> Tuple[PlacementRule, ...]:
-    e = env if env is not None else os.environ
-    return parse_placement_rules(e.get("FLUVIO_PARTITION_RULES"))
+    return parse_placement_rules(env_raw("FLUVIO_PARTITION_RULES", env))
 
 
 def validate_rules(rules: Sequence[PlacementRule], n_groups: int) -> None:

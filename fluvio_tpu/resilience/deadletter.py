@@ -41,6 +41,8 @@ import os
 import time
 from typing import List, Optional, Tuple
 
+from fluvio_tpu.analysis.envreg import env_int, env_raw
+
 logger = logging.getLogger(__name__)
 
 DEFAULT_DEADLETTER_DIR = "/tmp/fluvio-tpu-deadletter"
@@ -49,13 +51,13 @@ DEFAULT_DEADLETTER_DIR = "/tmp/fluvio-tpu-deadletter"
 def deadletter_dir(override: Optional[str] = None) -> str:
     if override:
         return override
-    return os.environ.get("FLUVIO_DEADLETTER_DIR", DEFAULT_DEADLETTER_DIR)
+    return env_raw("FLUVIO_DEADLETTER_DIR")
 
 
 def deadletter_max(override: Optional[int] = None) -> int:
     if override is not None:
         return override
-    return int(os.environ.get("FLUVIO_DEADLETTER_MAX", "64"))
+    return int(env_int("FLUVIO_DEADLETTER_MAX"))
 
 
 _SEQ = [0]

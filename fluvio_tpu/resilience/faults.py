@@ -46,10 +46,10 @@ armed it is one module-global ``None`` check — the overhead gate in
 from __future__ import annotations
 
 import logging
-import os
 import random
 from typing import Dict, Optional
 
+from fluvio_tpu.analysis.envreg import env_raw
 from fluvio_tpu.analysis.lockwatch import make_lock
 
 logger = logging.getLogger(__name__)
@@ -269,7 +269,7 @@ def maybe_fire(point: str) -> None:
 
 
 def _load_from_env() -> None:
-    spec = os.environ.get("FLUVIO_FAULTS", "")
+    spec = env_raw("FLUVIO_FAULTS") or ""
     if not spec:
         return
     try:

@@ -35,12 +35,12 @@ Env knobs (all read at policy construction):
 
 from __future__ import annotations
 
-import os
 import random
 import time
 from collections import deque
 from typing import Callable, Optional
 
+from fluvio_tpu.analysis.envreg import env_float, env_int
 from fluvio_tpu.resilience.faults import InjectedFault
 
 TRANSIENT = "transient"
@@ -94,23 +94,22 @@ class RetryPolicy:
         cap_ms: Optional[float] = None,
         jitter: Optional[float] = None,
     ):
-        env = os.environ.get
         self.max_retries = (
             max_retries
             if max_retries is not None
-            else int(env("FLUVIO_RETRY_MAX", "2"))
+            else int(env_int("FLUVIO_RETRY_MAX"))
         )
         self.base_ms = (
             base_ms if base_ms is not None
-            else float(env("FLUVIO_RETRY_BASE_MS", "2"))
+            else float(env_float("FLUVIO_RETRY_BASE_MS"))
         )
         self.cap_ms = (
             cap_ms if cap_ms is not None
-            else float(env("FLUVIO_RETRY_CAP_MS", "200"))
+            else float(env_float("FLUVIO_RETRY_CAP_MS"))
         )
         self.jitter = (
             jitter if jitter is not None
-            else float(env("FLUVIO_RETRY_JITTER", "0.25"))
+            else float(env_float("FLUVIO_RETRY_JITTER"))
         )
         self._rng = random.Random()
 
@@ -157,22 +156,21 @@ class CircuitBreaker:
         name: Optional[str] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
-        env = os.environ.get
         self.threshold = (
             threshold if threshold is not None
-            else int(env("FLUVIO_BREAKER_THRESHOLD", "5"))
+            else int(env_int("FLUVIO_BREAKER_THRESHOLD"))
         )
         self.window_s = (
             window_s if window_s is not None
-            else float(env("FLUVIO_BREAKER_WINDOW_S", "30"))
+            else float(env_float("FLUVIO_BREAKER_WINDOW_S"))
         )
         self.cooldown_s = (
             cooldown_s if cooldown_s is not None
-            else float(env("FLUVIO_BREAKER_COOLDOWN_S", "5"))
+            else float(env_float("FLUVIO_BREAKER_COOLDOWN_S"))
         )
         self.probes = (
             probes if probes is not None
-            else int(env("FLUVIO_BREAKER_PROBES", "2"))
+            else int(env_int("FLUVIO_BREAKER_PROBES"))
         )
         if name is None:
             _BREAKER_SEQ[0] += 1

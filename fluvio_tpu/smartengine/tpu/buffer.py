@@ -28,6 +28,49 @@ MIN_WIDTH = 32
 # up to the hard staging ceiling below
 MAX_WIDTH = 1 << 16
 MAX_RECORD_WIDTH = 1 << 20
+# int32 addressing ceiling for one staged batch: every flat byte
+# offset downstream of here is i32 — host `starts`, the device cumsum
+# of aligned lengths (`ragged_repad_words`, `striped_repad_words`),
+# and the packed-payload destination indices. A batch past this must
+# be refused loudly (shard it / smaller slices), never wrapped; the
+# valueflow analyzer's FLV302/FLV303 noqas at those sites cite THIS
+# guard as the reason the device arithmetic cannot overflow.
+FLAT_ADDRESS_MAX = 2**31 - 1
+
+
+class FlatAddressingError(ValueError):
+    """The batch's byte extent exceeds int32 addressing — split the
+    batch before staging (the typed decline, same contract as the
+    MAX_RECORD_WIDTH raise: loud at the seam, impossible on-chip)."""
+
+
+def check_flat_addressing(lengths, count: Optional[int] = None) -> int:
+    """Total 4-aligned flat bytes of the live rows; raises
+    :class:`FlatAddressingError` past ``FLAT_ADDRESS_MAX``. Computed on
+    an int64 host mirror, so the check itself cannot overflow."""
+    lengths64 = np.asarray(lengths, dtype=np.int64)
+    if count is not None:
+        lengths64 = lengths64[:count]
+    total = int(((lengths64 + 3) & ~3).sum())
+    if total > FLAT_ADDRESS_MAX:
+        raise FlatAddressingError(
+            f"4-aligned flat of {total} bytes exceeds int32 addressing "
+            f"({FLAT_ADDRESS_MAX}); split the batch before staging"
+        )
+    return total
+
+
+def _check_matrix_addressing(rows: int, width: int) -> None:
+    """``rows x width`` is the ceiling of every per-batch flat/payload
+    extent (lengths are <= the bucketed width): bounding the dense
+    matrix under int32 bounds them all. O(1), checked BEFORE any
+    allocation."""
+    if rows * width > FLAT_ADDRESS_MAX:
+        raise FlatAddressingError(
+            f"staged matrix {rows} x {width} = {rows * width} bytes "
+            f"exceeds int32 addressing ({FLAT_ADDRESS_MAX}); split the "
+            "batch before staging"
+        )
 
 
 def apply_postops_host(values: np.ndarray, postops) -> np.ndarray:
@@ -163,13 +206,15 @@ class RecordBuffer:
         """
         if self._flat is None:
             width = self.values.shape[1]
+            check_flat_addressing(self.lengths)
             lengths4 = (self.lengths.astype(np.int64) + 3) & ~3
             # rows' padding bytes are already zero in `values`
             mask = np.arange(width, dtype=np.int64)[None, :] < lengths4[:, None]
             self._flat = np.ascontiguousarray(self.values[mask])
             starts = np.zeros(len(self.lengths), dtype=np.int64)
             starts[1:] = np.cumsum(lengths4[:-1])
-            self._starts = starts.astype(np.int32)
+            # check_flat_addressing above: every start fits i32
+            self._starts = starts.astype(np.int32)  # noqa: FLV302
         return self._flat, self._starts
 
     def has_keys(self) -> bool:
@@ -194,6 +239,7 @@ class RecordBuffer:
             raise ValueError(
                 f"record value of {max_v} bytes exceeds {MAX_RECORD_WIDTH}"
             )
+        _check_matrix_addressing(rows, width)
 
         values = np.zeros((rows, width), dtype=np.uint8)
         lengths = np.zeros(rows, dtype=np.int32)
@@ -247,6 +293,7 @@ class RecordBuffer:
         """Adopt pre-staged arrays (bench/broker fast path). Rows must
         already be bucketed; ``count`` defaults to all rows."""
         rows = values.shape[0]
+        _check_matrix_addressing(rows, values.shape[1])
         n = rows if count is None else count
         if keys is None:
             keys = np.zeros((rows, MIN_WIDTH), dtype=np.uint8)
@@ -313,6 +360,7 @@ class RecordBuffer:
         lengths_live = (val_off[1:] - val_off[:-1]).astype(np.int32)
         max_v = int(lengths_live.max()) if n else 0
         width = bucket_width(max_v)
+        _check_matrix_addressing(rows, width)
         if width > MAX_RECORD_WIDTH:
             raise ValueError(
                 f"record value of {max_v} bytes exceeds {MAX_RECORD_WIDTH}"
@@ -362,6 +410,13 @@ class RecordBuffer:
         if width > MAX_RECORD_WIDTH:
             raise ValueError(
                 f"record value of {max_v} bytes exceeds {MAX_RECORD_WIDTH}"
+            )
+        _check_matrix_addressing(rows, width)
+        if n and int(cols["val_off"][-1]) > FLAT_ADDRESS_MAX:
+            raise FlatAddressingError(
+                f"decoded flat of {int(cols['val_off'][-1])} bytes "
+                f"exceeds int32 addressing ({FLAT_ADDRESS_MAX}); split "
+                "the batch before staging"
             )
         lengths = np.zeros(rows, dtype=np.int32)
         lengths[:n] = val_len.astype(np.int32)

@@ -21,7 +21,6 @@ from __future__ import annotations
 import contextlib
 import functools
 import logging
-import os
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
@@ -47,7 +46,6 @@ from fluvio_tpu.smartengine.metrics import SmartModuleChainMetrics
 from fluvio_tpu.smartengine.tpu import glz, kernels, stripes
 from fluvio_tpu.smartengine.tpu.buffer import (
     MAX_RECORD_WIDTH,
-    MAX_WIDTH,
     RecordBuffer,
     apply_postops_host,
     ragged_range_select,
@@ -61,6 +59,7 @@ from fluvio_tpu.smartengine.tpu.lower import (
     materialize_span,
 )
 
+from fluvio_tpu.analysis.envreg import env_int, env_raw
 from fluvio_tpu.analysis.lockwatch import make_lock
 
 _AGG_OP = {
@@ -358,7 +357,9 @@ def ragged_repad_words(flat, lengths, width: int):
     lengths = lengths.astype(jnp.int32)
     n = lengths.shape[0]
     lengths4 = (lengths + 3) & ~3
-    word_starts = (jnp.cumsum(lengths4) - lengths4) >> 2
+    # i32 accumulator is safe: buffer.check_flat_addressing refused any
+    # batch whose 4-aligned flat exceeds i32 before it staged
+    word_starts = (jnp.cumsum(lengths4) - lengths4) >> 2  # noqa: FLV303
     wwidth = width // 4
     jw = jnp.arange(wwidth, dtype=jnp.int32)[None, :]
     widx = word_starts[:, None] + jw
@@ -425,16 +426,18 @@ def stage_link_columns(buf):
         ts_mode, ts_up = "zero", None
     elif live_ts.min() >= 0 and live_ts.max() < 2**16:
         # the common stream shape: small non-negative deltas from the
-        # batch base — half the i32 tier's link bytes
-        ts_mode, ts_up = "u16", buf.timestamp_deltas.astype(np.uint16)
+        # batch base — half the i32 tier's link bytes. Each narrowing
+        # below is branch-guarded by the range test that selects it.
+        ts_mode, ts_up = "u16", buf.timestamp_deltas.astype(np.uint16)  # noqa: FLV302
     elif np.abs(live_ts).max() < 2**31:
-        ts_mode, ts_up = "i32", buf.timestamp_deltas.astype(np.int32)
+        ts_mode, ts_up = "i32", buf.timestamp_deltas.astype(np.int32)  # noqa: FLV302
     else:
         ts_mode, ts_up = "i64", buf.timestamp_deltas
+    # lengths <= width, so the width test guards each narrowing
     if buf.width < (1 << 8):
-        lengths_up = buf.lengths.astype(np.uint8)
+        lengths_up = buf.lengths.astype(np.uint8)  # noqa: FLV302
     elif buf.width < (1 << 16):
-        lengths_up = buf.lengths.astype(np.uint16)
+        lengths_up = buf.lengths.astype(np.uint16)  # noqa: FLV302
     else:
         lengths_up = buf.lengths
     return lengths_up, has_keys, has_offsets, ts_mode, ts_up
@@ -446,7 +449,7 @@ def effective_link_compress() -> bool:
     the CPU backend there is no link to save. The ONE home for this
     policy (the bench records it next to every capture; the sentinel's
     A/B arm pins its opposite)."""
-    mode = os.environ.get("FLUVIO_LINK_COMPRESS", "auto")
+    mode = env_raw("FLUVIO_LINK_COMPRESS")
     return mode == "on" or (mode == "auto" and jax.default_backend() != "cpu")
 
 
@@ -458,7 +461,7 @@ def effective_result_compact() -> bool:
     exists; the broker split-back consumes the flat directly). "auto"
     is ON everywhere: it reduces D2H bytes and host materialization
     cost on every backend."""
-    mode = os.environ.get("FLUVIO_RESULT_COMPACT", "auto")
+    mode = env_raw("FLUVIO_RESULT_COMPACT")
     return mode != "off"
 
 
@@ -469,7 +472,7 @@ def effective_result_compress() -> bool:
     "auto" enables off-CPU only (on CPU there is no link to save), and
     only composes with compaction (the encoder runs over the packed
     streams compaction builds)."""
-    mode = os.environ.get("FLUVIO_RESULT_COMPRESS", "auto")
+    mode = env_raw("FLUVIO_RESULT_COMPRESS")
     if mode == "off":
         return False
     if not effective_result_compact():
@@ -485,7 +488,7 @@ def effective_donation() -> bool:
     unimplemented there and warns). Every dispatch stages FRESH device
     arrays (`jnp.asarray` per call), so heal/retry re-dispatches can
     never read a donated buffer — pinned in tests/test_glz_encode.py."""
-    mode = os.environ.get("FLUVIO_DONATE", "auto")
+    mode = env_raw("FLUVIO_DONATE")
     if mode == "off":
         return False
     return mode == "on" or jax.default_backend() != "cpu"
@@ -498,7 +501,7 @@ def effective_fetch_overlap() -> bool:
     already-downloaded arrays (all executor-state mutation — failure
     ladders, carry bookkeeping — resolves before the thunk exists), so
     the only cost is one shared worker thread."""
-    mode = os.environ.get("FLUVIO_FETCH_OVERLAP", "auto")
+    mode = env_raw("FLUVIO_FETCH_OVERLAP")
     return mode != "off"
 
 
@@ -525,7 +528,7 @@ _NULL_CTX = contextlib.nullcontext()
 
 
 def _transfer_guard_mode() -> str:
-    raw = os.environ.get(_TRANSFER_GUARD_ENV, "").strip().lower()
+    raw = (env_raw(_TRANSFER_GUARD_ENV) or "").strip().lower()
     if raw in _TRANSFER_GUARD_OFF:
         return ""
     if raw not in _TRANSFER_GUARD_MODES:
@@ -659,9 +662,7 @@ class TpuChainExecutor:
         self._striped = None
         self._striped_tried = False
         self._stripe_s, self._stripe_v = stripes.stripe_params()
-        self._stripe_threshold = int(
-            os.environ.get("FLUVIO_STRIPE_THRESHOLD", MAX_WIDTH)
-        )
+        self._stripe_threshold = int(env_int("FLUVIO_STRIPE_THRESHOLD"))
         self._jit_striped = instrument_jit(
             jax.jit(
                 self._chain_fn_striped,
@@ -985,7 +986,10 @@ class TpuChainExecutor:
         Returns (payload u8[rows*width], payload_len scalar)."""
         rows, width = values_c.shape
         l4 = (lengths_c.astype(jnp.int32) + 3) & ~3
-        starts = jnp.cumsum(l4) - l4
+        # i32 accumulator is safe: lengths <= the bucketed width, and
+        # the staging guard (_check_matrix_addressing) bounds
+        # rows * width — hence sum(l4) — under i32
+        starts = jnp.cumsum(l4) - l4  # noqa: FLV303
         cap = rows * width
         col = jnp.arange(width, dtype=jnp.int32)[None, :]
         dst = jnp.where(col < l4[:, None], starts[:, None] + col, cap)
@@ -994,7 +998,8 @@ class TpuChainExecutor:
             .at[dst.reshape(-1)]
             .set(values_c.reshape(-1), mode="drop")
         )
-        return payload, jnp.sum(l4)
+        # same staging bound as the cumsum above: total fits i32
+        return payload, jnp.sum(l4)  # noqa: FLV303
 
     # -- execution ----------------------------------------------------------
 

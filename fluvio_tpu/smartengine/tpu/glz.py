@@ -38,6 +38,7 @@ from typing import NamedTuple, Optional, Tuple
 import numpy as np
 
 from fluvio_tpu.analysis.lockwatch import make_lock
+from fluvio_tpu.analysis.envreg import env_int
 
 logger = logging.getLogger(__name__)
 
@@ -76,7 +77,7 @@ def chunk_bytes() -> int:
     """Configured link-chunk size (``FLUVIO_GLZ_CHUNK``); must stay a
     multiple of 1024 so the Pallas per-chunk block reshapes onto whole
     (sublane, 128-lane) tiles and chunk starts stay word-aligned."""
-    c = int(os.environ.get("FLUVIO_GLZ_CHUNK", GLZ_CHUNK))
+    c = int(env_int("FLUVIO_GLZ_CHUNK"))
     if c < 4096 or c % 1024:
         raise ValueError(f"FLUVIO_GLZ_CHUNK={c}: need a multiple of 1024 >= 4096")
     return c

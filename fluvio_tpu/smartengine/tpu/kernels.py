@@ -10,7 +10,6 @@ tests assert bit-equality against those references.
 
 from __future__ import annotations
 
-import os
 from typing import Tuple
 
 import jax.numpy as jnp
@@ -18,6 +17,7 @@ import numpy as np
 from jax import lax
 
 from fluvio_tpu.ops.regex_dfa import CompiledDfa
+from fluvio_tpu.analysis.envreg import env_int
 
 INT64_MIN = -(2**63)
 INT64_MAX = 2**63 - 1
@@ -86,9 +86,7 @@ def dfa_assoc_max_states() -> int:
     """State-count gate for the associative path: past it, the S x work
     multiplier loses to the sequential scan (and the transition material
     stops fitting VMEM-friendly tiles)."""
-    return int(
-        os.environ.get("FLUVIO_DFA_ASSOC_MAX_STATES", DFA_ASSOC_MAX_STATES)
-    )
+    return int(env_int("FLUVIO_DFA_ASSOC_MAX_STATES"))
 
 
 def dfa_compose(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

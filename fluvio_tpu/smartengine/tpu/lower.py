@@ -10,11 +10,11 @@ DFA tables at lowering time; an unsupported pattern raises
 
 from __future__ import annotations
 
-import os
 from typing import Callable, Dict, Tuple
 
 import jax.numpy as jnp
 
+from fluvio_tpu.analysis.envreg import env_raw
 from fluvio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex_cached, literal_of
 from fluvio_tpu.smartmodule import dsl
 from fluvio_tpu.smartengine.tpu import kernels, pallas_kernels
@@ -37,7 +37,7 @@ def _depth_over_work(env: str) -> bool:
     work multiplier measurably loses there (4-20x on the headline
     shapes). Explicit off values pin the sequential kernel; anything
     else pins the parallel one."""
-    mode = os.environ.get(env, "auto").lower()
+    mode = (env_raw(env) or "auto").lower()
     if mode in ("auto", ""):
         import jax
 

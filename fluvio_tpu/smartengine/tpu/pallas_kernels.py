@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 from typing import Tuple
 
 import jax
@@ -32,6 +31,7 @@ try:  # jax moved the context manager out of the top-level namespace
 except ImportError:  # pragma: no cover — older jax keeps the alias
     _enable_x64 = jax.enable_x64
 
+from fluvio_tpu.analysis.envreg import env_raw
 from fluvio_tpu.telemetry import instrument_jit
 
 try:  # pallas availability is platform-dependent
@@ -89,7 +89,7 @@ def pallas_active(width: int = 0) -> bool:
         return False
     if width > MAX_PALLAS_WIDTH:
         return False
-    mode = os.environ.get("FLUVIO_TPU_PALLAS", "auto")
+    mode = env_raw("FLUVIO_TPU_PALLAS")
     if mode == "0":
         return False
     if mode in ("interpret", "1"):
@@ -423,7 +423,7 @@ def glz_pallas_active() -> bool:
     executor build, never per dispatch."""
     if _disable_depth or not _PALLAS:
         return False
-    mode = os.environ.get("FLUVIO_GLZ_PALLAS", "auto")
+    mode = env_raw("FLUVIO_GLZ_PALLAS")
     if mode == "0":
         return False
     if mode in ("interpret", "1"):
@@ -530,7 +530,7 @@ def glz_enc_pallas_active() -> bool:
     executor build, never per dispatch."""
     if _disable_depth or not _PALLAS:
         return False
-    mode = os.environ.get("FLUVIO_GLZ_ENC_PALLAS", "auto")
+    mode = env_raw("FLUVIO_GLZ_ENC_PALLAS")
     if mode == "0":
         return False
     if mode in ("interpret", "1"):

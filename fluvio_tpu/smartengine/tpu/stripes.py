@@ -55,7 +55,6 @@ data-dependent):
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
 
@@ -65,6 +64,7 @@ import numpy as np
 from jax import lax
 
 from fluvio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex_cached, literal_of
+from fluvio_tpu.analysis.envreg import env_int
 from fluvio_tpu.smartmodule import dsl
 from fluvio_tpu.smartengine.tpu import kernels
 from fluvio_tpu.smartengine.tpu.lower import Unlowerable, apply_postops, lower_expr
@@ -80,8 +80,8 @@ def stripe_params() -> Tuple[int, int]:
     The step (width - overlap) must stay 4-aligned so stripe starts land
     on i32 word boundaries and the ragged word gather stays word-exact.
     """
-    s = int(os.environ.get("FLUVIO_STRIPE_WIDTH", STRIPE_WIDTH))
-    v = int(os.environ.get("FLUVIO_STRIPE_OVERLAP", STRIPE_OVERLAP))
+    s = int(env_int("FLUVIO_STRIPE_WIDTH"))
+    v = int(env_int("FLUVIO_STRIPE_OVERLAP"))
     if s % 4 or v % 4 or v >= s:
         raise ValueError(f"bad stripe params width={s} overlap={v}")
     return s, v
@@ -154,7 +154,9 @@ def striped_repad_words(flat, lengths, plan, s: int):
     same flat — HBM cost only, never link bytes."""
     lengths = lengths.astype(jnp.int32)
     lengths4 = (lengths + 3) & ~3
-    word_starts = (jnp.cumsum(lengths4) - lengths4) >> 2
+    # i32 accumulator is safe: buffer.check_flat_addressing refused any
+    # batch whose 4-aligned flat exceeds i32 before it staged
+    word_starts = (jnp.cumsum(lengths4) - lengths4) >> 2  # noqa: FLV303
     ws = jnp.take(word_starts, plan["seg"]) + (plan["abs_start"] >> 2)
     wwidth = s // 4
     jw = jnp.arange(wwidth, dtype=jnp.int32)[None, :]

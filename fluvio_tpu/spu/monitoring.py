@@ -30,6 +30,8 @@ import logging
 import os
 from typing import Optional
 
+from fluvio_tpu.analysis.envreg import env_raw
+
 logger = logging.getLogger(__name__)
 
 SPU_MONITORING_UNIX_SOCKET = "/tmp/fluvio-spu.sock"
@@ -42,7 +44,7 @@ _MODE_LINE_TIMEOUT_S = 0.2
 def monitoring_path(override: Optional[str] = None) -> str:
     if override:
         return override
-    return os.environ.get("FLUVIO_METRIC_SPU", SPU_MONITORING_UNIX_SOCKET)
+    return env_raw("FLUVIO_METRIC_SPU")
 
 
 class MonitoringServer:

@@ -62,6 +62,11 @@ class SpuServer:
         return self.internal_server.local_addr
 
     async def start(self) -> None:
+        # a FLUVIO_* var nothing reads is a deploy-manifest typo: warn
+        # at boot, not after a silent week of the flag never applying
+        from fluvio_tpu.analysis.envreg import warn_unknown_env
+
+        warn_unknown_env()
         if self.config.smart_engine.backend in ("auto", "native"):
             # warm the native engine's g++ build off the event loop so the
             # first SmartModule chain build doesn't stall request handling

@@ -12,19 +12,19 @@ from __future__ import annotations
 
 import hashlib
 import logging
-import os
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 import numpy as np
 
+from fluvio_tpu.analysis.envreg import env_int
 # dense staging cap for the coalesced fast path (bytes of padded values)
-_MAX_STAGING_BYTES = int(os.environ.get("FLUVIO_TPU_MAX_STAGING", 1 << 29))
+_MAX_STAGING_BYTES = int(env_int("FLUVIO_TPU_MAX_STAGING"))
 
 # records per device dispatch on the stateless fast path; a 16 MB read
 # slice of short records becomes ~4-15 concurrently-in-flight dispatches
-_DISPATCH_CHUNK_ROWS = int(os.environ.get("FLUVIO_TPU_DISPATCH_CHUNK", 65536))
+_DISPATCH_CHUNK_ROWS = int(env_int("FLUVIO_TPU_DISPATCH_CHUNK"))
 
 
 def _slice_columns(cols: dict, lo: int, hi: int) -> dict:

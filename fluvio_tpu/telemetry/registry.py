@@ -30,7 +30,6 @@ as the existing `SmartModuleChainMetrics` adds.
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Dict, List, Optional
 
@@ -44,6 +43,7 @@ from fluvio_tpu.telemetry.spans import (
 )
 
 from fluvio_tpu.analysis.lockwatch import make_lock
+from fluvio_tpu.analysis.envreg import env_bool, env_float, env_int
 
 SPAN_RING_CAPACITY = 256
 EVENT_RING_CAPACITY = 512
@@ -53,15 +53,13 @@ EVENT_RING_CAPACITY = 512
 # across bucket boundaries recompiles per batch) — each compile past the
 # threshold counts a "recompile-storm" decline so the storm is visible
 # on every decline surface (Prometheus, CLI table, snapshot)
-COMPILE_STORM_N = int(os.environ.get("FLUVIO_COMPILE_STORM_N", "8"))
-COMPILE_STORM_WINDOW_S = float(
-    os.environ.get("FLUVIO_COMPILE_STORM_WINDOW_S", "60")
-)
+COMPILE_STORM_N = int(env_int("FLUVIO_COMPILE_STORM_N"))
+COMPILE_STORM_WINDOW_S = float(env_float("FLUVIO_COMPILE_STORM_WINDOW_S"))
 
 
 class PipelineTelemetry:
     def __init__(self, ring_capacity: int = SPAN_RING_CAPACITY) -> None:
-        self.enabled = os.environ.get("FLUVIO_TELEMETRY", "1") != "0"
+        self.enabled = env_bool("FLUVIO_TELEMETRY")
         self._lock = make_lock("telemetry.registry")
         # bumped by reset(): cumulative counters going BACKWARDS would
         # corrupt the time-series layer's window deltas, so its ring

@@ -63,6 +63,7 @@ from fluvio_tpu.telemetry.registry import TELEMETRY, PipelineTelemetry
 from fluvio_tpu.telemetry.timeseries import TimeSeries, WindowDelta
 
 from fluvio_tpu.analysis.lockwatch import make_lock
+from fluvio_tpu.analysis.envreg import env_float
 
 logger = logging.getLogger(__name__)
 
@@ -256,7 +257,7 @@ class SloEngine:
         self.profile_cooldown_s = (
             profile_cooldown_s
             if profile_cooldown_s is not None
-            else float(os.environ.get(PROFILE_COOLDOWN_ENV, "60"))
+            else float(env_float(PROFILE_COOLDOWN_ENV))
         )
         self._lock = make_lock("telemetry.slo")
         self._verdicts: Dict[Tuple[str, str], str] = {}
@@ -398,7 +399,7 @@ class SloEngine:
             import jax
             import jax.numpy as jnp
 
-            dwell_ms = float(os.environ.get(PROFILE_DWELL_MS_ENV, "0"))
+            dwell_ms = float(env_float(PROFILE_DWELL_MS_ENV))
             jax.profiler.start_trace(path)
             try:
                 # one tiny dispatch guarantees device activity inside

@@ -28,7 +28,6 @@ a fixed number of further ticks.
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Dict, List, Optional
 
@@ -36,6 +35,7 @@ from fluvio_tpu.telemetry.histogram import LatencyHistogram
 from fluvio_tpu.telemetry.registry import TELEMETRY, PipelineTelemetry
 
 from fluvio_tpu.analysis.lockwatch import make_lock
+from fluvio_tpu.analysis.envreg import env_float, env_int
 
 # window geometry: FLUVIO_SLO_WINDOW_S seconds per window, ring of
 # FLUVIO_SLO_WINDOWS windows (defaults: 10 s x 30 = 5 min of history)
@@ -44,11 +44,11 @@ DEFAULT_WINDOWS = 30
 
 
 def _env_window_s() -> float:
-    return float(os.environ.get("FLUVIO_SLO_WINDOW_S", DEFAULT_WINDOW_S))
+    return float(env_float("FLUVIO_SLO_WINDOW_S"))
 
 
 def _env_windows() -> int:
-    return max(int(os.environ.get("FLUVIO_SLO_WINDOWS", DEFAULT_WINDOWS)), 1)
+    return max(int(env_int("FLUVIO_SLO_WINDOWS")), 1)
 
 
 class _Cum:

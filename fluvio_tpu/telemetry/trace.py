@@ -37,6 +37,7 @@ from fluvio_tpu.telemetry.registry import TELEMETRY, PipelineTelemetry
 from fluvio_tpu.telemetry.spans import PHASES, BatchSpan, InstantEvent
 
 from fluvio_tpu.analysis.lockwatch import make_lock
+from fluvio_tpu.analysis.envreg import env_float
 
 TRACE_ENV = "FLUVIO_TRACE"
 TRACE_MAX_MB_ENV = "FLUVIO_TRACE_MAX_MB"
@@ -388,9 +389,7 @@ def install_env_sink(
     path = os.environ.get(TRACE_ENV)
     if not path or not t.enabled:
         return None
-    max_bytes = int(
-        float(os.environ.get(TRACE_MAX_MB_ENV, DEFAULT_TRACE_MAX_MB)) * 1e6
-    )
+    max_bytes = int(float(env_float(TRACE_MAX_MB_ENV)) * 1e6)
     # construction touches no files (lazy open on the first write), so
     # a scraper/CLI process importing the package with FLUVIO_TRACE set
     # cannot clobber the engine's live trace

@@ -164,3 +164,73 @@ class TestAnalyzeExitCodes:
 
         found = json.loads(capsys.readouterr().out)
         assert {v["code"] for v in found} == {"FLV101", "FLV102"}
+
+    # -- ISSUE-14: --values / --env exit-code suite --------------------------
+
+    def test_values_repo_scope_exits_zero(self, capsys):
+        rc = self._main(["analyze", "--values", "--format", "json"])
+        assert rc == 0
+        import json
+
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["findings"] == []
+        assert doc["suppressed"], "documented relaxations should list"
+
+    def test_values_flags_injected_overflow(self, tmp_path, capsys):
+        bad = tmp_path / "overflow.py"
+        bad.write_text(
+            "import jax.numpy as jnp\n"
+            "def f(lengths):\n"
+            "    return jnp.cumsum(lengths)\n"
+        )
+        rc = self._main(
+            ["analyze", "--values", str(bad), "--format", "json"]
+        )
+        assert rc == 1
+        import json
+
+        doc = json.loads(capsys.readouterr().out)
+        assert [f["code"] for f in doc["findings"]] == ["FLV303"]
+
+    def test_env_repo_scope_exits_zero(self, capsys):
+        rc = self._main(["analyze", "--env", "--format", "json"])
+        assert rc == 0
+        import json
+
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["findings"] == []
+        assert doc["registry"]["count"] >= 60
+
+    def test_env_flags_injected_typo(self, tmp_path, capsys):
+        bad = tmp_path / "typo.py"
+        bad.write_text(
+            'import os\nx = os.environ.get("FLUVIO_TPYO_FLAG", "1")\n'
+        )
+        rc = self._main(["analyze", "--env", str(bad), "--format", "json"])
+        assert rc == 1
+        import json
+
+        doc = json.loads(capsys.readouterr().out)
+        assert [f["code"] for f in doc["findings"]] == ["FLV401"]
+
+    def test_all_four_passes_merge_into_one_document(self, tmp_path,
+                                                     capsys):
+        bad = tmp_path / "overflow.py"
+        bad.write_text(
+            "import numpy as np\n"
+            "def f(rows, width):\n"
+            "    out = np.zeros(rows, dtype=np.int32)\n"
+            "    out[0] = rows * width\n"
+            "    return out\n"
+        )
+        rc = self._main(
+            ["analyze", "--values", str(bad), "--env", str(bad),
+             "--format", "json"]
+        )
+        assert rc == 1  # the values half fails, the env half is clean
+        import json
+
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc) == {"values", "env"}
+        assert [f["code"] for f in doc["values"]["findings"]] == ["FLV301"]
+        assert doc["env"]["findings"] == []

@@ -62,26 +62,26 @@ def test_pyproject_carries_ruff_config():
     assert "F401" in text and "B006" in text
 
 
-def test_cli_lint_and_concurrency_gate_is_clean():
-    """ISSUE-7 CI satellite: `fluvio-tpu analyze --lint --concurrency`
-    over the repo must exit 0 — the AST invariants AND the whole-package
-    lock-discipline pass (guard map, lock-order graph, FLV2xx hazards)
-    are both pre-deploy gates, enforced through the same CLI the
-    operator runs."""
+def test_cli_full_analysis_gate_is_clean():
+    """The CI deploy gate, all four repo passes through the one CLI the
+    operator runs: `analyze --lint --concurrency --values --env` must
+    exit 0 — AST invariants, lock discipline (FLV2xx), value flow
+    (FLV3xx), and the env-config registry (FLV4xx)."""
     import json
     import subprocess
     import sys
 
     proc = subprocess.run(
         [sys.executable, "-m", "fluvio_tpu.cli",
-         "analyze", "--lint", "--concurrency", "--format", "json"],
+         "analyze", "--lint", "--concurrency", "--values", "--env",
+         "--format", "json"],
         cwd=_REPO_ROOT,
         capture_output=True,
         text=True,
         timeout=300,
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
-    # combined passes must emit ONE parseable document, not two
+    # combined passes must emit ONE parseable document, not four
     # concatenated dumps
     doc = json.loads(proc.stdout)
     assert doc["lint"] == []
@@ -89,6 +89,26 @@ def test_cli_lint_and_concurrency_gate_is_clean():
     assert not [
         f for f in doc["concurrency"]["findings"] if f["level"] == "error"
     ]
+    assert doc["values"]["findings"] == []
+    assert doc["env"]["findings"] == []
+    assert doc["env"]["registry"]["count"] >= 60
+
+
+def test_valueflow_pass_clean_in_process():
+    """Same gate without the subprocess: unsuppressed FLV3xx findings
+    anywhere in the registered engine modules fail tier-1."""
+    from fluvio_tpu.analysis import analyze_values
+
+    report = analyze_values()
+    assert not report.findings, "\n".join(str(f) for f in report.findings)
+
+
+def test_env_lint_clean_in_process():
+    """FLV401/402/403 over the package + README fail tier-1 here."""
+    from fluvio_tpu.analysis import lint_env
+
+    findings = lint_env()
+    assert not findings, "\n".join(str(f) for f in findings)
 
 
 def test_concurrency_pass_clean_in_process():
