@@ -537,6 +537,27 @@ def _run_partitioned_config(
         groups,
     )
     runtime = PartitionRuntime(chain.tpu_chain, plan, chain=chain)
+    # streaming-lag evidence (ISSUE-15): each partition gets a stand-in
+    # leader whose LEO advances as the pass "appends" its slice, so the
+    # lag engine's committed-vs-HW join and the record-age histogram
+    # (append stamp -> served) produce real numbers for the lag block
+    from fluvio_tpu.telemetry import lag as lag_mod
+
+    class _BenchLeader:
+        def __init__(self):
+            self._leo = 0
+
+        def leo(self):
+            return self._leo
+
+        def hw(self):
+            return self._leo
+
+    leaders = {}
+    for p in range(parts):
+        key = partition_key("bench", p)
+        leaders[key] = _BenchLeader()
+        runtime.offsets.attach_leader(key, leaders[key])
     pr0 = TELEMETRY.path_records()
     stream = [("bench", p, bufs[p]) for p in range(parts)]
     t0 = time.time()
@@ -552,12 +573,17 @@ def _run_partitioned_config(
             # later passes INCLUDES the rebalanced layout
             runtime.fail_group(0)
             rebal_done = True
+        t_append = time.time()
+        for p in range(parts):
+            leaders[partition_key("bench", p)]._leo += bufs[p].count
         t0 = time.time()
         for topic, p, buf, out in runtime.process_interleaved(list(stream)):
+            key = partition_key(topic, p)
             runtime.offsets.advance(
-                partition_key(topic, p),
-                runtime.offsets.committed(partition_key(topic, p))
-                + buf.count,
+                key, runtime.offsets.committed(key) + buf.count
+            )
+            lag_mod.note_serve(
+                key, int(buf.count), max(time.time() - t_append, 0.0)
             )
         times.append(time.time() - t0)
         if deadline is not None and time.time() > deadline:
@@ -625,6 +651,30 @@ def _run_partitioned_config(
             "plan": runtime.plan.to_dict()["assignments"],
         },
     }
+    # per-config streaming-lag block (ISSUE-15): max residual consumer
+    # lag across partitions after the run + worst record-age p99. The
+    # compact line carries one tiny suite-wide lag:{max,age_p99} key;
+    # full per-partition detail stays in BENCH_DETAIL.json
+    try:
+        lag_mod.engine().sample()
+        per_part_lag = lag_mod.engine().snapshot()
+        if per_part_lag:
+            result["lag"] = {
+                "max": max(
+                    int(e.get("lag", 0)) for e in per_part_lag.values()
+                ),
+                "age_p99_ms": max(
+                    float(e.get("age_p99_ms", 0.0))
+                    for e in per_part_lag.values()
+                ),
+                "per_partition": per_part_lag,
+            }
+            log(
+                f"  lag: max {result['lag']['max']} records, "
+                f"age_p99 {result['lag']['age_p99_ms']:.0f}ms"
+            )
+    except Exception as e:  # noqa: BLE001 — lag evidence must not cost a run
+        log(f"  lag evidence unavailable: {type(e).__name__}: {e}")
     if preflight is not None:
         preflight["actual"] = path
         preflight["agree"] = (
@@ -1332,6 +1382,27 @@ def _partition_counts(configs: dict):
     }
 
 
+def _lag_counts(configs: dict):
+    """Suite-wide streaming-lag evidence for the compact line's tiny
+    ``lag`` key: worst residual consumer lag + worst record-age p99
+    (ms) across every config that carried a lag block. None when no
+    config tracked lag. Full per-partition joins stay in
+    BENCH_DETAIL.json only (the ≤1500-char contract)."""
+    blocks = [
+        c["lag"]
+        for c in configs.values()
+        if isinstance(c, dict) and isinstance(c.get("lag"), dict)
+    ]
+    if not blocks:
+        return None
+    return {
+        "max": max(int(b.get("max", 0)) for b in blocks),
+        "age_p99": round(
+            max(float(b.get("age_p99_ms", 0.0)) for b in blocks), 1
+        ),
+    }
+
+
 def _admission_counts(configs: dict):
     """Suite-wide admission evidence for the compact line's tiny
     ``adm`` key: total shed decisions + total warmed buckets. None when
@@ -1456,6 +1527,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         adm = _admission_counts(out["configs"])
         if adm:
             compact["adm"] = adm
+        lg = _lag_counts(out["configs"])
+        if lg:
+            compact["lag"] = lg
         pt = _partition_counts(out["configs"])
         if pt:
             compact["part"] = pt
@@ -1471,7 +1545,7 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "part", "adm", "slo", "preflight",
+        "configs", "cpu_fallback", "lag", "part", "adm", "slo", "preflight",
         "down", "compile", "phases", "error", "xla_cache", "link",
     ):
         if len(json.dumps(compact)) <= limit:

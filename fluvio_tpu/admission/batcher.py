@@ -221,6 +221,9 @@ class ShapeBucketBatcher:
         co-batched slices to the `coalesce_buffers` backstop raise."""
         from fluvio_tpu.smartengine.tpu.buffer import bucket_width
 
+        flw = getattr(buf, "_flow", None)
+        if flw is not None:
+            flw.note_batcher()  # residence clock: add -> flush
         key = (chain, bucket_width(max(int(buf.width), 1)))
         c = int(buf.count)
         if c and int(buf.offset_deltas[:c].max()) >= SLICE_STRIDE:
@@ -282,6 +285,17 @@ class ShapeBucketBatcher:
             TELEMETRY.add_admission("cold-bucket")
         merged, bases = coalesce_buffers(bucket.items, target_width=cover)
         TELEMETRY.add_admission(cause)
+        # per-slice causality: every co-batched slice's flow records the
+        # batcher residence it paid, the flush cause, and how many
+        # tenant slices rode the same coalesced dispatch
+        flows = [
+            f
+            for f in (getattr(b, "_flow", None) for b in bucket.items)
+            if f is not None
+        ]
+        for f in flows:
+            f.end_batcher(cause, len(bucket.items))
+            f.mark_dispatch()
         flush = Flush(
             chain=chain,
             width_bucket=merged.width,
@@ -291,4 +305,10 @@ class ShapeBucketBatcher:
             cause=cause,
         )
         flush.result = self.dispatch(flush)
+        for b in bucket.items:
+            f = getattr(b, "_flow", None)
+            if f is not None:
+                TELEMETRY.end_flow(
+                    f, records=int(getattr(b, "count", 0) or 0)
+                )
         return flush

@@ -356,7 +356,10 @@ class AdmissionPipeline:
         """Admit-or-shed one slice. Admitted slices enter the chain's
         fair queue (full queue downgrades the admission to a
         ``queue-full`` shed — the token is gone, which is correct: the
-        queue IS the credit's backing store)."""
+        queue IS the credit's backing store). Admitted slices also get
+        their causal flow record (telemetry/flow.py): queue-wait and
+        batcher residence land on it, and the batcher closes it after
+        the coalesced dispatch it rode."""
         decision = self.controller.admit(chain, breaker=breaker)
         if not decision:
             return decision
@@ -366,6 +369,17 @@ class AdmissionPipeline:
                 chain=chain, reason="queue-full",
                 verdict=decision.verdict, retry_after_s=0.01,
             )
+        # the flow is born only once the slice is really IN (a
+        # queue-full shed must not leave a stale flow, still counting
+        # queue-wait, riding the buf into a later retry)
+        flow = TELEMETRY.begin_flow(chain)
+        if flow is not None:
+            flow.decision = "admit"
+            flow.note_queue()
+            try:
+                buf._flow = flow
+            except AttributeError:  # slotted/foreign buffer: no flow ride
+                pass
         return decision
 
     # -- drain ---------------------------------------------------------------
@@ -383,6 +397,9 @@ class AdmissionPipeline:
                 break
             chain, buf = nxt
             drained += 1
+            flw = getattr(buf, "_flow", None)
+            if flw is not None:
+                flw.end_queue()  # fair-queue residence onto the record
             if self._coalesce.get(chain, True):
                 flushes = self.batcher.add(chain, buf)
             else:
@@ -402,7 +419,14 @@ class AdmissionPipeline:
             chain=chain, width_bucket=int(getattr(buf, "width", 0)),
             items=[buf], bases=[0], buffer=buf, cause="solo",
         )
+        flw = getattr(buf, "_flow", None)
+        if flw is not None:
+            flw.mark_dispatch()
         flush.result = self._solo_dispatch(flush)
+        if flw is not None:
+            TELEMETRY.end_flow(
+                flw, records=int(getattr(buf, "count", 0) or 0)
+            )
         return flush
 
     def _account_compiles(self, chain: str, flushes) -> None:

@@ -152,6 +152,9 @@ REGISTRY: Tuple[EnvFlag, ...] = (
     _f("FLUVIO_FETCH_OVERLAP", "mode", "auto", "auto|1|0",
        "smartengine/tpu/executor.py",
        "defer pure split-back materialization to the overlap worker"),
+    _f("FLUVIO_FLOW_TRACE", "bool01", "1", "1|0",
+       "telemetry/registry.py",
+       "per-slice causal flow tracing (arms with telemetry capture)"),
     _f("FLUVIO_GLZ_CHUNK", "int", "262144", "bytes",
        "smartengine/tpu/glz.py",
        "glz compress_link chunk size (GLZ_CHUNK)"),
@@ -189,6 +192,9 @@ REGISTRY: Tuple[EnvFlag, ...] = (
        "resilience/policy.py", "randomized fraction of each backoff"),
     _f("FLUVIO_RETRY_MAX", "int", "2", "attempts",
        "resilience/policy.py", "retries after the first attempt"),
+    _f("FLUVIO_SLICE_RING", "int", "512", "flows",
+       "telemetry/registry.py",
+       "completed per-slice flow records retained for the trace export"),
     _f("FLUVIO_SLO", "spec", "", "rule:param=v;rule:param=v",
        "telemetry/slo.py", "declarative SLO rules (burn-rate verdicts)"),
     _f("FLUVIO_SLO_PROFILE", "path", "", "directory",

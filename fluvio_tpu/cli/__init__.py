@@ -2,7 +2,7 @@
 
 Run as ``python -m fluvio_tpu.cli <command>``. Commands: produce, consume,
 topic, partition, smartmodule, tableformat, spu, profile, cluster, run,
-metrics, trace, analyze, health, warmup, version.
+metrics, trace, analyze, health, lag, warmup, version.
 """
 
 from __future__ import annotations
@@ -21,6 +21,7 @@ def build_parser() -> argparse.ArgumentParser:
     from fluvio_tpu.cli import crud
     from fluvio_tpu.cli import health as health_cmd
     from fluvio_tpu.cli import hub as hub_cmd
+    from fluvio_tpu.cli import lag as lag_cmd
     from fluvio_tpu.cli import metrics as metrics_cmd
     from fluvio_tpu.cli import produce as produce_cmd
     from fluvio_tpu.cli import trace as trace_cmd
@@ -49,6 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
         trace_cmd.add_trace_parser,
         analyze_cmd.add_analyze_parser,
         health_cmd.add_health_parser,
+        lag_cmd.add_lag_parser,
         warmup_cmd.add_warmup_parser,
     ):
         add(sub)

@@ -1,0 +1,121 @@
+"""`lag` subcommand — streaming consumer lag / record age per partition.
+
+Reads the monitoring socket's ``lag`` mode (the lag engine's join of
+committed consumer offsets against replica high watermarks,
+telemetry/lag.py) and renders it as a table or JSON. Exit code is the
+deploy-gate contract, symmetric with ``fluvio-tpu health``: 0 when
+every lag-rule verdict is ``ok``/``warn``, 1 when any
+``chain@topic/partition`` is in ``breach`` on ``consumer_lag`` or
+``record_age_p99`` — so ``fluvio-tpu lag && promote`` refuses to
+advance a rollout whose consumers are falling behind.
+
+``--watch N`` re-reads and re-renders every N seconds (rc reflects the
+LAST document). ``--local`` evaluates the in-process engines instead of
+connecting to a socket (bench-style single-process runs and tests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+
+def add_lag_parser(sub) -> None:
+    p = sub.add_parser(
+        "lag",
+        help="consumer lag / record age per chain@topic/partition",
+    )
+    p.add_argument(
+        "--path",
+        help="monitoring unix-socket path (default: FLUVIO_METRIC_SPU)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("table", "json"),
+        default="table",
+        help="output format (default: table)",
+    )
+    p.add_argument(
+        "--local",
+        action="store_true",
+        help="evaluate the in-process lag engine instead of a socket",
+    )
+    p.add_argument(
+        "--watch",
+        type=float,
+        metavar="SECONDS",
+        help="re-read and re-render every SECONDS until interrupted",
+    )
+    p.set_defaults(fn=lag)
+
+
+def _fmt_age(entry: dict) -> str:
+    p99 = entry.get("age_p99_ms")
+    if p99 is None:
+        return "-"
+    return f"{p99 / 1000:.2f}s" if p99 >= 1000 else f"{p99:.1f}ms"
+
+
+def render_lag_table(doc: dict) -> str:
+    """Lag document -> operator-facing table. Pure function so the
+    surface tests render without a socket."""
+    from fluvio_tpu.cli.metrics import _rows_to_table
+
+    if not doc.get("enabled", False):
+        return "telemetry capture is off (FLUVIO_TELEMETRY=0): no lag data"
+    sections = [f"lag verdict: {doc.get('verdict', 'ok')}"]
+    verdicts = doc.get("slo") or {}
+    rows = []
+    for key, entry in sorted((doc.get("partitions") or {}).items()):
+        v = verdicts.get(key) or {}
+        rows.append(
+            (
+                key,
+                entry.get("committed", -1),
+                entry.get("hw", entry.get("leo", "-")),
+                entry.get("lag", "-"),
+                entry.get("served_records", 0),
+                _fmt_age(entry),
+                v.get("consumer_lag", "-"),
+                v.get("record_age_p99", "-"),
+            )
+        )
+    if rows:
+        sections.append(
+            _rows_to_table(
+                rows,
+                header=(
+                    "partition", "committed", "hw", "lag", "served",
+                    "age_p99", "lag_slo", "age_slo",
+                ),
+            )
+        )
+    else:
+        sections.append("no tracked partitions (nothing is serving)")
+    return "\n\n".join(sections)
+
+
+async def _read_doc(args) -> dict:
+    if args.local:
+        from fluvio_tpu.telemetry.lag import lag_snapshot
+
+        return lag_snapshot()
+    from fluvio_tpu.spu.monitoring import read_lag
+
+    return await read_lag(args.path)
+
+
+async def lag(args) -> int:
+    while True:
+        doc = await _read_doc(args)
+        if args.format == "json":
+            print(json.dumps(doc, indent=1))
+        else:
+            print(render_lag_table(doc))
+        if not args.watch:
+            break
+        try:
+            await asyncio.sleep(max(args.watch, 0.1))
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            break
+    return 1 if doc.get("verdict") == "breach" else 0

@@ -36,6 +36,7 @@ from fluvio_tpu.partition.placement import (
     make_partition_mesh,
     partition_key,
 )
+from fluvio_tpu.telemetry import TELEMETRY
 from fluvio_tpu.types import OffsetPublisher
 
 logger = logging.getLogger(__name__)
@@ -70,9 +71,16 @@ class PartitionOffsets:
 
     def attach_leader(self, key: str, leader) -> None:
         """Bind the partition to its leader replica state (LEO/HW
-        source); ``lag`` and the failover replay read through it."""
+        source); ``lag`` and the failover replay read through it. The
+        pair also registers with the streaming lag engine, so the
+        partition's consumer lag joins the SLO/admission control loop
+        (telemetry/lag.py)."""
         with self._lock:
             self._leaders[key] = leader
+        if TELEMETRY.enabled:
+            from fluvio_tpu.telemetry import lag as lag_mod
+
+            lag_mod.track_stream(key, leader)
 
     def leader(self, key: str):
         with self._lock:
@@ -92,6 +100,10 @@ class PartitionOffsets:
             pub = self._publishers.get(key)
         if pub is not None:
             pub.update(next_offset)
+        if TELEMETRY.enabled:
+            from fluvio_tpu.telemetry import lag as lag_mod
+
+            lag_mod.note_commit(key, next_offset)
         return True
 
     def lag(self, key: str) -> Optional[int]:

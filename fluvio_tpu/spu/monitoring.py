@@ -15,7 +15,10 @@ Protocol: the client MAY send one mode line before reading:
   Chrome-trace/Perfetto JSON document (load it in ui.perfetto.dev),
 - ``health``→ the SLO engine's machine-readable verdict document
   (per-chain ok|warn|breach with window evidence — the future
-  admission controller's input; see telemetry/slo.py).
+  admission controller's input; see telemetry/slo.py),
+- ``lag``   → the streaming lag document: per-chain@topic/partition
+  consumer lag / record age joined against the replica high
+  watermarks, plus the lag-rule SLO verdicts (telemetry/lag.py).
 
 A client that sends nothing still gets JSON after a short grace wait,
 so pre-existing scrapers keep working unchanged. One document per
@@ -79,6 +82,10 @@ class MonitoringServer:
             from fluvio_tpu.telemetry.slo import health_snapshot
 
             return (json.dumps(health_snapshot(), indent=1) + "\n").encode()
+        if mode == "lag":
+            from fluvio_tpu.telemetry.lag import lag_snapshot
+
+            return (json.dumps(lag_snapshot(), indent=1) + "\n").encode()
         return json.dumps(self.ctx.metrics.to_dict(), indent=2).encode()
 
     async def _handle(
@@ -96,7 +103,9 @@ class MonitoringServer:
                     reader.readline(), _MODE_LINE_TIMEOUT_S
                 )
                 requested = line.decode("ascii", "replace").strip().lower()
-                if requested in ("prom", "spans", "trace", "health", "json"):
+                if requested in (
+                    "prom", "spans", "trace", "health", "lag", "json"
+                ):
                     mode = requested
             except (asyncio.TimeoutError, ValueError):
                 # legacy client (no mode line) or a line exceeding the
@@ -174,3 +183,9 @@ async def read_health(path: Optional[str] = None) -> dict:
     """Fetch the SLO engine's verdict document (per-chain ok|warn|breach
     with window evidence)."""
     return json.loads(await _read_mode(path, "health"))
+
+
+async def read_lag(path: Optional[str] = None) -> dict:
+    """Fetch the streaming lag document (per-chain@topic/partition
+    consumer lag / record age + lag-rule SLO verdicts)."""
+    return json.loads(await _read_mode(path, "lag"))

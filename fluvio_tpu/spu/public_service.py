@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 import weakref
 from typing import Dict, Optional
 
@@ -48,6 +49,7 @@ from fluvio_tpu.spu.smart_chain import (
     BatchProcessResult,
     PendingSlice,
     SmartModuleResolutionError,
+    admission_chain_sig,
     admission_check,
     admission_note_warm,
     admission_require_warm,
@@ -64,6 +66,8 @@ from fluvio_tpu.spu.smart_chain import (
 )
 from fluvio_tpu.smartengine.engine import EngineError, SmartModuleChainInitError
 from fluvio_tpu.smartengine.metering import SmartModuleFuelError
+from fluvio_tpu.telemetry import TELEMETRY
+from fluvio_tpu.telemetry import lag as lag_mod
 from fluvio_tpu.transport.service import FluvioService
 from fluvio_tpu.transport.sink import ExclusiveSink, FluvioSink
 from fluvio_tpu.transport.socket import FluvioSocket, SocketClosed
@@ -479,6 +483,16 @@ class StreamFetchHandler:
         self.ack_publisher = ack_publisher
         self.metrics = ctx.metrics.smartmodule
         self._ended = False  # terminal error pushed; stop the stream
+        # shed-hold visibility (ISSUE-15 satellite): while a slice is
+        # held by admission backpressure this stamps the hold start, the
+        # held_slices gauge is up, and the release books one
+        # admission_hold_seconds observation — a held slice is
+        # distinguishable from a hung client on every metrics surface
+        self._hold_t0: Optional[float] = None
+        # streaming-lag identity: chain@topic/partition for SmartModule
+        # streams (matching the admission/SLO key), stream@topic/partition
+        # for plain consumes
+        self._lag_key = f"stream@{req.topic}/{req.partition}"
 
     async def run(self) -> None:
         try:
@@ -489,6 +503,31 @@ class StreamFetchHandler:
             logger.exception(
                 "stream fetch failed (%s-%s)", self.req.topic, self.req.partition
             )
+        finally:
+            if self._hold_t0 is not None:
+                # stream died mid-hold: the gauge must not leak
+                self._hold_t0 = None
+                TELEMETRY.gauge_add("held_slices", -1)
+
+    def _note_hold(self) -> None:
+        """First shed of a held slice: stamp the hold + raise the gauge
+        (idempotent across the retry loop)."""
+        if self._hold_t0 is None:
+            self._hold_t0 = time.monotonic()
+            TELEMETRY.gauge_add("held_slices", 1)
+
+    def _release_hold(self, flow=None) -> None:
+        """A held slice was re-admitted: book the hold duration (the
+        admission_hold_seconds histogram + the slice's flow record) and
+        drop the gauge."""
+        if self._hold_t0 is None:
+            return
+        held_s = time.monotonic() - self._hold_t0
+        self._hold_t0 = None
+        TELEMETRY.gauge_add("held_slices", -1)
+        TELEMETRY.add_slice_phase("hold", held_s)
+        if flow is not None:
+            flow.hold(held_s)
 
     async def _run(self) -> None:
         req = self.req
@@ -529,22 +568,41 @@ class StreamFetchHandler:
 
         if chain is not None:
             _schedule_chain_warmup(chain)
+            self._lag_key = admission_chain_sig(
+                chain, req.topic, req.partition
+            )
+        if TELEMETRY.enabled:
+            # register with the lag engine: committed-offset /
+            # high-watermark joins for this stream's key from here on
+            lag_mod.track_stream(self._lag_key, leader)
 
         # clamp the starting offset into the valid window (stream_fetch.rs
         # resolves the requested offset against [start, bound])
         info = leader.offsets()
         bound = leader.read_bound(req.isolation)
         current = max(info.start_offset, min(req.fetch_offset, bound))
+        if TELEMETRY.enabled and current >= 0:
+            # seed the committed cursor at the RESOLVED start: a tail
+            # consumer on a deep log must not report the whole log as
+            # lag until its first ack (which would false-breach the
+            # consumer_lag SLO and shed a caught-up partition)
+            lag_mod.note_commit(self._lag_key, current)
 
         end_wait = asyncio.ensure_future(self.conn.end.wait())
         try:
             if chain is not None and tpu_pipelinable(chain):
                 await self._run_pipelined(leader, chain, end_wait, current)
                 return
+            flow = None  # the current slice's causal flow record
             while not self.conn.end.is_set() and not self._ended:
                 bound = leader.read_bound(req.isolation)
                 if current < bound:
                     if chain is not None:
+                        # the slice's flow is born at ARRIVAL — before
+                        # the admission decision — and survives the
+                        # hold-retry loop, so held time is on its record
+                        if flow is None:
+                            flow = TELEMETRY.begin_flow(self._lag_key)
                         # admission front door: a health/credit shed
                         # HOLDS the slice (offsets untouched — nothing
                         # lost, nothing duplicated); breaker-open
@@ -553,11 +611,26 @@ class StreamFetchHandler:
                             chain, topic=req.topic, partition=req.partition
                         )
                         if rej is not None and rej.reason != "breaker-open":
+                            if flow is not None:
+                                flow.decision = rej.reason
+                            self._note_hold()
                             await asyncio.sleep(
                                 min(max(rej.retry_after_s, 0.005), 0.25)
                             )
                             continue
-                    sent_next = await self._send_back_records(leader, chain, current)
+                        self._release_hold(flow)
+                        if flow is not None:
+                            # breaker-open slices serve on the degraded
+                            # per-record path — the flow record must say
+                            # so, not claim a clean admit
+                            flow.decision = (
+                                "breaker-open" if rej is not None
+                                else "admit"
+                            )
+                    sent_next = await self._send_back_records(
+                        leader, chain, current, flow=flow
+                    )
+                    flow = None
                     if self._ended:
                         return
                     if sent_next > current:
@@ -591,13 +664,18 @@ class StreamFetchHandler:
         """
         req = self.req
         pending: Optional[PendingSlice] = None
+        held_flow = None  # the next slice's flow, born at arrival and
+        # carried across shed-hold retries until it stages or serves
         while not self.conn.end.is_set() and not self._ended:
             planned = pending.planned_next if pending is not None else current
             nxt: Optional[PendingSlice] = None
             nxt_batches = None
+            nxt_flow = None
             read_from = planned
             shed = None
             if planned < leader.read_bound(req.isolation):
+                if held_flow is None:
+                    held_flow = TELEMETRY.begin_flow(self._lag_key)
                 # admission front door for the speculative read: a shed
                 # skips THIS slice's intake (the in-flight one still
                 # finishes below) and, when nothing is in flight,
@@ -607,8 +685,20 @@ class StreamFetchHandler:
                     chain, topic=req.topic, partition=req.partition
                 )
                 if shed is not None and shed.reason == "breaker-open":
-                    shed = None  # per-record path serves breaker-open
+                    # per-record path serves breaker-open; the flow
+                    # record keeps the degraded-path label
+                    if held_flow is not None:
+                        held_flow.decision = "breaker-open"
+                    shed = None
+                elif shed is not None and held_flow is not None:
+                    held_flow.decision = shed.reason
             if shed is None and planned < leader.read_bound(req.isolation):
+                self._release_hold(held_flow)
+                nxt_flow, held_flow = held_flow, None
+                if nxt_flow is not None and nxt_flow.decision != (
+                    "breaker-open"
+                ):
+                    nxt_flow.decision = "admit"
                 try:
                     rslice = leader.read_records(
                         planned, req.max_bytes, req.isolation
@@ -624,6 +714,7 @@ class StreamFetchHandler:
                     nxt = tpu_stage_dispatch(
                         chain, nxt_batches, self.metrics, start_offset=planned,
                         topic=req.topic, partition=req.partition,
+                        flow=nxt_flow,
                     )
 
             if pending is not None:
@@ -640,12 +731,16 @@ class StreamFetchHandler:
                         chain, pending.batches, req.max_bytes, self.metrics,
                     )
                 sent_next = await self._push_processed(leader, result)
+                TELEMETRY.end_flow(
+                    pending.flow, records=result.records.total_records()
+                )
                 if self._ended:
                     return
                 truncated = sent_next != pending.planned_next
                 pending = None
                 if truncated and nxt is not None:
                     # the speculative slice read from the wrong offset
+                    # (its flow record dies with it — never served)
                     nxt.discard(chain.tpu_chain)
                     nxt = None
                     nxt_batches = None
@@ -657,6 +752,7 @@ class StreamFetchHandler:
             if shed is not None:
                 # nothing in flight and this slice was shed: sleep out
                 # the backpressure hint before retrying the same offset
+                self._note_hold()
                 await asyncio.sleep(
                     min(max(shed.retry_after_s, 0.005), 0.25)
                 )
@@ -672,6 +768,9 @@ class StreamFetchHandler:
                     req.topic, req.partition,
                 )
                 sent_next = await self._push_processed(leader, result)
+                TELEMETRY.end_flow(
+                    nxt_flow, records=result.records.total_records()
+                )
                 if self._ended:
                     return
                 sent_next = max(sent_next, read_from)
@@ -717,6 +816,17 @@ class StreamFetchHandler:
         )
         nbytes = sum(b.write_size() for b in result.records.batches)
         self.ctx.metrics.outbound.add(result.records.total_records(), nbytes)
+        if TELEMETRY.enabled and result.records.batches:
+            # streaming lag: served-record rate + ONE end-to-end
+            # record-age observation per pushed slice (append wall-time
+            # from the first output batch's header -> now)
+            lag_mod.note_serve(
+                self._lag_key,
+                result.records.total_records(),
+                lag_mod.serve_age_s(
+                    result.records.batches[0].header.first_timestamp
+                ),
+            )
         return result.next_offset
 
     async def _wait_for_ack(self, target: int, end_wait: asyncio.Future) -> None:
@@ -733,8 +843,16 @@ class StreamFetchHandler:
             if end_wait in done:
                 listen.cancel()
                 return
+        if TELEMETRY.enabled:
+            # the consumer's ack IS the committed offset: the lag
+            # engine's join reads hw - committed from here
+            acked = self.ack_publisher.current_value()
+            if acked >= 0:
+                lag_mod.note_commit(self._lag_key, acked)
 
-    async def _send_back_records(self, leader, chain, offset: int) -> int:
+    async def _send_back_records(
+        self, leader, chain, offset: int, flow=None
+    ) -> int:
         """Push one chunk; returns the next offset (== offset if nothing sent)."""
         req = self.req
         try:
@@ -778,6 +896,7 @@ class StreamFetchHandler:
             self.metrics, offset, req.topic, req.partition,
         )
         sent_next = await self._push_processed(leader, result)
+        TELEMETRY.end_flow(flow, records=result.records.total_records())
         return max(sent_next, offset)
 
     async def _send_error(

@@ -377,6 +377,10 @@ class PendingSlice:
     # chunks currently counted in the inflight_queue_depth gauge (set at
     # dispatch; release is idempotent — finish and discard both call it)
     tracked_depth: int = 0
+    # the slice's causal flow record (telemetry/flow.py), carried from
+    # arrival through dispatch to the serve that closes it; None when
+    # flow tracing is off (the zero-cost seam)
+    flow: Optional[object] = None
 
     def release_depth(self) -> None:
         if self.tracked_depth:
@@ -534,6 +538,7 @@ def tpu_stage_dispatch(
     start_offset: Optional[int] = None,
     topic: Optional[str] = None,
     partition: Optional[int] = None,
+    flow=None,
 ) -> Optional[PendingSlice]:
     """Phase 1 of the TPU fast path: stage a read slice into columnar
     buffers through the native parser (no per-record Python objects),
@@ -724,6 +729,10 @@ def tpu_stage_dispatch(
     finally:
         if pscope is not None:
             pscope.__exit__(None, None, None)
+    if flow is not None:
+        # causal flow link: the renderer joins batch spans against the
+        # [dispatch, serve] window of this slice's flow record
+        flow.mark_dispatch()
     pending = PendingSlice(
         batches=batches,
         chunks=chunks,
@@ -733,6 +742,7 @@ def tpu_stage_dispatch(
         ts0=ts0,
         count=n_total,
         read_from=start_offset,
+        flow=flow,
     )
     # pipelined occupancy gauge: every dispatched chunk counts until its
     # finish (tpu_finish) or the slice's discard retires it

@@ -36,6 +36,7 @@ entirely (event counters stay on — they are as cheap as the existing
 """
 
 from fluvio_tpu.telemetry.histogram import LatencyHistogram
+from fluvio_tpu.telemetry.flow import SLICE_PHASES, FlowRing, SliceFlow
 from fluvio_tpu.telemetry.spans import (
     PHASES,
     BatchSpan,
@@ -61,6 +62,9 @@ install_env_sink()
 
 __all__ = [
     "LatencyHistogram",
+    "SLICE_PHASES",
+    "FlowRing",
+    "SliceFlow",
     "PHASES",
     "BatchSpan",
     "EventRing",

@@ -69,6 +69,9 @@ def render_prometheus(
     t = telemetry if telemetry is not None else TELEMETRY
     w = _Writer()
 
+    # pull-join the consumer-lag gauges at the scrape edge (outside the
+    # registry lock; one attribute check when nothing is tracked)
+    t.refresh_lag()
     with t._lock:
         batch_series = [
             ({"path": path}, h.copy()) for path, h in t.batch_latency.items()
@@ -97,6 +100,15 @@ def render_prometheus(
         pc_hits, pc_misses = t.persistent_cache_hits, t.persistent_cache_misses
         jit_hits = t.jit_cache_hits
         gauges = dict(t.gauges)
+        slice_series = [
+            ({"phase": p}, h.copy())
+            for p, h in t.slice_hist.items()
+            if p != "hold"
+        ]
+        hold_hist = t.slice_hist["hold"].copy()
+        consumer_lag = dict(t.consumer_lag)
+        served_records = dict(t.served_records)
+        record_age = {k: h.copy() for k, h in t.record_age.items()}
     spans_dropped = t.spans.dropped
 
     _histogram(
@@ -286,6 +298,44 @@ def render_prometheus(
         w.header(f"{_PREFIX}_{name}", help_text, "counter")
         w.sample(f"{_PREFIX}_{name}", {}, value)
 
+    # -- slice flow / streaming lag (ISSUE-15) -------------------------------
+    _histogram(
+        w,
+        f"{_PREFIX}_slice_wait_seconds",
+        "Per-slice lifecycle phase latency (queue-wait, batcher "
+        "residence, arrival->served).",
+        slice_series,
+    )
+    _histogram(
+        w,
+        f"{_PREFIX}_admission_hold_seconds",
+        "Shed-held stream slice hold time before re-admission.",
+        [({}, hold_hist)],
+    )
+    w.header(
+        f"{_PREFIX}_consumer_lag",
+        "Consumer lag (records behind the replica high watermark) per "
+        "chain@topic/partition.",
+        "gauge",
+    )
+    for key, v in sorted(consumer_lag.items()):
+        w.sample(f"{_PREFIX}_consumer_lag", {"key": key}, v)
+    w.header(
+        f"{_PREFIX}_served_records_total",
+        "Records served to consumers per chain@topic/partition.",
+        "counter",
+    )
+    for key, v in sorted(served_records.items()):
+        w.sample(f"{_PREFIX}_served_records_total", {"key": key}, v)
+    if record_age:
+        _histogram(
+            w,
+            f"{_PREFIX}_record_age_seconds",
+            "End-to-end record age (append wall-time -> served) per "
+            "chain@topic/partition.",
+            [({"key": k}, h) for k, h in sorted(record_age.items())],
+        )
+
     # -- gauges --------------------------------------------------------------
     for name, help_text in (
         ("hbm_staged_bytes",
@@ -300,13 +350,15 @@ def render_prometheus(
          "Slices held in the admission fair queues, not yet dispatched."),
         ("warmed_buckets",
          "Shape buckets precompiled by the AOT warmup pass."),
+        ("held_slices",
+         "Stream slices currently shed-held by admission backpressure."),
     ):
         w.header(f"{_PREFIX}_{name}", help_text, "gauge")
         w.sample(f"{_PREFIX}_{name}", {}, gauges.get(name, 0))
     for name in sorted(set(gauges) - {
         "hbm_staged_bytes", "live_batch_handles",
         "inflight_queue_depth", "deadletter_entries",
-        "admission_queue_depth", "warmed_buckets",
+        "admission_queue_depth", "warmed_buckets", "held_slices",
     }):
         w.header(f"{_PREFIX}_{name}", "Engine gauge.", "gauge")
         w.sample(f"{_PREFIX}_{name}", {}, gauges[name])

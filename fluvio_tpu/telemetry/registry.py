@@ -34,6 +34,7 @@ import time
 from typing import Dict, List, Optional
 
 from fluvio_tpu.telemetry.histogram import LatencyHistogram
+from fluvio_tpu.telemetry.flow import SLICE_PHASES, FlowRing, SliceFlow
 from fluvio_tpu.telemetry.spans import (
     PHASES,
     BatchSpan,
@@ -47,6 +48,9 @@ from fluvio_tpu.analysis.envreg import env_bool, env_float, env_int
 
 SPAN_RING_CAPACITY = 256
 EVENT_RING_CAPACITY = 512
+# completed per-slice lifecycle records retained for the flow-trace
+# export (one entry per SLICE, so 512 covers minutes of broker serving)
+FLOW_RING_CAPACITY = int(env_int("FLUVIO_SLICE_RING"))
 
 # recompile-storm detection: more than N compile events inside the
 # window means shape buckets are churning (a stream whose widths wander
@@ -145,6 +149,30 @@ class PipelineTelemetry:
         # instant events (heals, spills, retries, breaker transitions,
         # compiles, quarantines) for the flight recorder's trace view
         self.events = EventRing(EVENT_RING_CAPACITY)
+        # per-slice causal flow layer (ISSUE-15): flow tracing arms with
+        # capture unless FLUVIO_FLOW_TRACE=0; begin_flow returns None
+        # when either is off (the zero-cost seam every site guards on)
+        self.flow_trace = env_bool("FLUVIO_FLOW_TRACE")
+        self.flows = FlowRing(FLOW_RING_CAPACITY)
+        self._flow_seq = 0
+        # per-phase slice lifecycle histograms (queue-wait, batcher
+        # residence, shed-hold, arrival->served): the Prometheus
+        # slice_wait_seconds / admission_hold_seconds families
+        self.slice_hist: Dict[str, LatencyHistogram] = {
+            p: LatencyHistogram() for p in SLICE_PHASES
+        }
+        # streaming-lag families (telemetry/lag.py writes them): point-
+        # in-time consumer lag per chain@topic/partition, served-record
+        # counters, and the end-to-end record-age histogram (append
+        # wall-time -> served). Bounded like chain_latency.
+        self.consumer_lag: Dict[str, float] = {}
+        self.served_records: Dict[str, int] = {}
+        self.record_age: Dict[str, LatencyHistogram] = {}
+        # pull-join hook: telemetry/lag.py installs its sampler here so
+        # the time-series tick (and the Prometheus scrape) re-joins
+        # committed offsets against replica high watermarks at the
+        # sampling edge — lag keeps moving while serving is fully shed
+        self.lag_sampler = None
         # optional flight-recorder sink (telemetry/trace.py installs it
         # from FLUVIO_TRACE): completed spans and instant events stream
         # into it as they happen
@@ -202,6 +230,126 @@ class PipelineTelemetry:
             return
         with self._lock:
             self.phase_hist[name].record(seconds)
+
+    # -- slice flows (per-slice causal tracing, ISSUE-15) --------------------
+
+    def begin_flow(self, chain: str = "") -> Optional[SliceFlow]:
+        """A new slice's flow record, or None when capture/flow tracing
+        is off (every caller guards on that — the zero-cost seam)."""
+        if not (self.enabled and self.flow_trace):
+            return None
+        with self._lock:
+            self._flow_seq += 1
+            fid = self._flow_seq
+        return SliceFlow(fid, chain)
+
+    def end_flow(self, flow: Optional[SliceFlow], records: int = 0) -> None:
+        """Close a slice flow: record its lifecycle phases into the
+        per-phase slice histograms and push it onto the flow ring (and
+        the continuous trace sink when one is armed). ``hold`` phases
+        are NOT re-recorded here — the handler books them at each hold
+        release via `add_slice_phase`, so a stream cancelled mid-hold
+        still counts and nothing double-records."""
+        if flow is None:
+            return
+        flow.close(records)
+        with self._lock:
+            for name, s in flow.phase_totals().items():
+                if name == "hold":
+                    continue
+                h = self.slice_hist.get(name)
+                if h is not None:
+                    h.record(s)
+            self.slice_hist["serve"].record(flow.serve_seconds())
+        self.flows.push(flow)
+        sink = self.trace_sink
+        if sink is not None:
+            on_flow = getattr(sink, "on_flow", None)
+            if on_flow is not None:
+                on_flow(flow)
+
+    def add_slice_phase(self, name: str, seconds: float) -> None:
+        """Record one slice-phase observation outside a flow close (the
+        hold release in the stream handler, flow-less slices)."""
+        if not self.enabled or seconds <= 0.0:
+            return
+        with self._lock:
+            h = self.slice_hist.get(name)
+            if h is not None:
+                h.record(seconds)
+
+    def flows_json(self, limit: Optional[int] = None) -> List[dict]:
+        return [f.to_dict() for f in self.flows.recent(limit)]
+
+    # -- streaming lag / record age (telemetry/lag.py writes these) ----------
+
+    def set_consumer_lag(self, key: str, lag: float) -> None:
+        """Point-in-time consumer lag (records behind the replica high
+        watermark) for one ``chain@topic/partition``. Bounded +
+        recency-refreshed like the breaker map."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.consumer_lag.pop(key, None)
+            self.consumer_lag[key] = float(lag)
+            while len(self.consumer_lag) > 128:
+                self.consumer_lag.pop(next(iter(self.consumer_lag)))
+
+    def clear_consumer_lag(self, key: str) -> None:
+        with self._lock:
+            self.consumer_lag.pop(key, None)
+
+    def add_served(self, key: str, records: int) -> None:
+        if not self.enabled or records <= 0:
+            return
+        with self._lock:
+            # pop+reinsert refreshes recency (like the breaker map), so
+            # with >128 active keys the IDLE ones evict, not the hottest
+            total = self.served_records.pop(key, 0) + records
+            self.served_records[key] = total
+            while len(self.served_records) > 128:
+                self.served_records.pop(next(iter(self.served_records)))
+
+    def add_record_age(self, key: str, seconds: float) -> None:
+        """One end-to-end record-age observation (append wall-time ->
+        served) for one ``chain@topic/partition`` — one observation per
+        served SLICE, never per record."""
+        if not self.enabled:
+            return
+        with self._lock:
+            # recency-refreshed like set_consumer_lag: insertion-order
+            # eviction would destroy (and silently restart) the BUSIEST
+            # stream's histogram once >64 keys are active, and the
+            # record_age_p99 window delta would go blind on it
+            h = self.record_age.pop(key, None)
+            if h is None:
+                h = LatencyHistogram()
+            self.record_age[key] = h
+            while len(self.record_age) > 64:
+                self.record_age.pop(next(iter(self.record_age)))
+            h.record(max(seconds, 0.0))
+
+    def lag_families(self):
+        """(consumer_lag, served_records, record-age copies) under ONE
+        lock hold — the lag snapshot surface reads all three coherently."""
+        with self._lock:
+            return (
+                dict(self.consumer_lag),
+                dict(self.served_records),
+                {k: h.copy() for k, h in self.record_age.items()},
+            )
+
+    def refresh_lag(self) -> None:
+        """Pull-join the lag gauges (telemetry/lag.py installs the
+        sampler). One attribute check when nothing is tracked; never
+        raises — a dead leader ref must not take a scrape with it."""
+        sampler = self.lag_sampler
+        if sampler is None or not self.enabled:
+            return
+        try:
+            sampler()
+        except Exception:  # noqa: BLE001 — scrape surfaces must stay live
+            pass
 
     # -- instant events (flight recorder) ------------------------------------
 
@@ -448,6 +596,15 @@ class PipelineTelemetry:
                     "breaker_short_circuits": self.breaker_short_circuits,
                 },
                 "gauges": dict(self.gauges),
+                # streaming-lag families: point-in-time lag per
+                # chain@topic/partition, monotone served counters, and
+                # the record-age histograms (the consumer_lag /
+                # record_age_p99 SLO rules window these)
+                "lag": dict(self.consumer_lag),
+                "served": dict(self.served_records),
+                "record_age": {
+                    k: h.copy() for k, h in self.record_age.items()
+                },
             }
 
     def path_records(self) -> Dict[str, int]:
@@ -513,20 +670,37 @@ class PipelineTelemetry:
                     "jit_cache_hits": self.jit_cache_hits,
                 },
                 "gauges": dict(self.gauges),
+                "slices": {
+                    p: h.to_dict()
+                    for p, h in self.slice_hist.items()
+                    if h.count
+                },
+                "lag": {
+                    "consumer_lag": dict(self.consumer_lag),
+                    "served_records": dict(self.served_records),
+                    "record_age": {
+                        k: h.to_dict()
+                        for k, h in self.record_age.items()
+                        if h.count
+                    },
+                },
             } | self._ring_stats()
 
     def _ring_stats(self) -> dict:
-        """Span/event ring bookkeeping, each triple read under ONE ring
-        lock acquisition so total == retained + dropped holds even while
-        a concurrent end_batch pushes mid-snapshot."""
+        """Span/event/flow ring bookkeeping, each triple read under ONE
+        ring lock acquisition so total == retained + dropped holds even
+        while a concurrent end_batch pushes mid-snapshot."""
         spans_total, spans_retained, spans_dropped = self.spans.stats()
         events_total, _, events_dropped = self.events.stats()
+        flows_total, _, flows_dropped = self.flows.stats()
         return {
             "spans_retained": spans_retained,
             "spans_total": spans_total,
             "spans_dropped": spans_dropped,
             "events_total": events_total,
             "events_dropped": events_dropped,
+            "flows_total": flows_total,
+            "flows_dropped": flows_dropped,
         }
 
     def spans_json(self, limit: Optional[int] = None) -> List[dict]:
@@ -568,8 +742,18 @@ class PipelineTelemetry:
             self.jit_cache_hits = 0
             self._compile_times = []
             self.gauges = {}
+            for h in self.slice_hist.values():
+                h.__init__()
+            self.consumer_lag = {}
+            self.served_records = {}
+            self.record_age = {}
+            self._flow_seq = 0
+            # lag_sampler survives reset on purpose: the bench resets
+            # between configs and the lag engine's tracked leaders must
+            # keep re-joining; tests drop it via lag.reset_engine()
         self.spans = SpanRing(self.spans.capacity)
         self.events = EventRing(self.events.capacity)
+        self.flows = FlowRing(self.flows.capacity)
 
 
 TELEMETRY = PipelineTelemetry()

@@ -19,6 +19,10 @@ Rules (defaults overridable via the ``FLUVIO_SLO`` grammar):
 ``recompile_rate``  compiles per minute (the storm signal, windowed)
 ``queue_depth``     ``inflight_queue_depth`` gauge ceiling
 ``hbm_staged``      ``hbm_staged_bytes`` gauge ceiling
+``consumer_lag``    records behind the replica high watermark, per
+                    ``chain@topic/partition`` (telemetry/lag.py join)
+``record_age_p99``  end-to-end append-wall-time -> served p99, per
+                    ``chain@topic/partition``
 ==================  =====================================================
 
 Grammar — ``;``-separated entries, ``rule:field=value[,field=value]``::
@@ -101,6 +105,12 @@ DEFAULT_RULES: Tuple[SloRule, ...] = (
     SloRule("recompile_rate", 8.0, "compiles/min"),
     SloRule("queue_depth", 128.0, "chunks"),
     SloRule("hbm_staged", 2e9, "bytes"),
+    # streaming-lag rules (ISSUE-15): the canonical Kafka-class health
+    # signals, keyed per chain@topic/partition by the lag engine's
+    # offset/high-watermark join — so a hot partition breaches (and the
+    # admission controller sheds it) without touching its siblings
+    SloRule("consumer_lag", 65536.0, "records", per_chain=True),
+    SloRule("record_age_p99", 60.0, "s", per_chain=True, latency=True),
 )
 
 
@@ -163,6 +173,17 @@ def _observe(rule: SloRule, delta: WindowDelta) -> Dict[str, float]:
         return {
             chain: h.percentile(99)
             for chain, h in delta.chain_hists().items()
+        }
+    if rule.name == "consumer_lag":
+        # point-in-time join from the NEW snapshot (a level, like the
+        # gauge ceilings): short and long windows agree by construction,
+        # so a backlog injected NOW breaches on the next evaluation and
+        # ages out the moment the join reads a drained partition
+        return dict(delta.lag)
+    if rule.name == "record_age_p99":
+        return {
+            key: h.percentile(99)
+            for key, h in delta.record_age_hists().items()
         }
     if rule.name in ("queue_depth", "hbm_staged"):
         gauge = {

@@ -56,7 +56,7 @@ class _Cum:
 
     __slots__ = (
         "t", "generation", "chains", "paths", "compile_hist", "counters",
-        "gauges",
+        "gauges", "lag", "served", "record_age",
     )
 
     def __init__(self, t: float, sample: dict) -> None:
@@ -67,6 +67,14 @@ class _Cum:
         self.compile_hist: LatencyHistogram = sample["compile_hist"]
         self.counters: Dict[str, float] = sample["counters"]
         self.gauges: Dict[str, float] = sample["gauges"]
+        # streaming-lag families (ISSUE-15): lag is point-in-time per
+        # chain@topic/partition, served is monotone, record_age is a
+        # mergeable-histogram family like chains/paths
+        self.lag: Dict[str, float] = sample.get("lag", {})
+        self.served: Dict[str, int] = sample.get("served", {})
+        self.record_age: Dict[str, LatencyHistogram] = sample.get(
+            "record_age", {}
+        )
 
 
 class WindowDelta:
@@ -82,8 +90,12 @@ class WindowDelta:
         self._new = new
         self.duration_s = max(new.t - old.t, 1e-9)
         self.gauges = dict(new.gauges)
+        # consumer lag is a level, not a movement: the lag rules read
+        # the NEW snapshot's joined values (like the gauge ceilings)
+        self.lag = dict(new.lag)
         self._chain_hists: Optional[Dict[str, LatencyHistogram]] = None
         self._path_hists: Optional[Dict[str, LatencyHistogram]] = None
+        self._record_age: Optional[Dict[str, LatencyHistogram]] = None
         self._counters: Optional[Dict[str, float]] = None
 
     @staticmethod
@@ -124,6 +136,25 @@ class WindowDelta:
             )
         return self._path_hists
 
+    def record_age_hists(self) -> Dict[str, LatencyHistogram]:
+        """{chain@topic/partition: record-age delta histogram} — only
+        keys with served observations inside the window (the
+        ``record_age_p99`` rule reads this)."""
+        if self._record_age is None:
+            self._record_age = self._hist_deltas(
+                self._new.record_age, self._old.record_age
+            )
+        return self._record_age
+
+    def served(self) -> Dict[str, float]:
+        """{key: records served inside the window} (windowed serve
+        rate = served()/duration_s)."""
+        return {
+            k: v - self._old.served.get(k, 0)
+            for k, v in self._new.served.items()
+            if v - self._old.served.get(k, 0) > 0
+        }
+
     def compile_hist(self) -> LatencyHistogram:
         return self._new.compile_hist.diff(self._old.compile_hist)
 
@@ -149,7 +180,7 @@ class WindowDelta:
                 "p50_ms": round(d.percentile(50) * 1000, 3),
                 "p99_ms": round(d.percentile(99) * 1000, 3),
             }
-        return {
+        out = {
             "duration_s": round(self.duration_s, 3),
             "chains": chains,
             "paths": {
@@ -159,6 +190,14 @@ class WindowDelta:
                 k: round(v, 6) for k, v in sorted(self.counters().items()) if v
             },
         }
+        if self.lag:
+            out["lag"] = {k: round(v, 1) for k, v in sorted(self.lag.items())}
+        served = self.served()
+        if served:
+            out["served"] = {
+                k: int(v) for k, v in sorted(served.items())
+            }
+        return out
 
 
 class TimeSeries:
@@ -190,6 +229,10 @@ class TimeSeries:
         One truthiness check when telemetry capture is off."""
         if not self.telemetry.enabled:
             return 0
+        # pull-join the lag gauges OUTSIDE the ring lock (the sampler
+        # takes the lag-engine + registry locks): one attribute check
+        # when nothing is tracked
+        self.telemetry.refresh_lag()
         now = self.clock()
         with self._lock:
             if not self._ring:
@@ -236,6 +279,7 @@ class TimeSeries:
         boundaries (bench run-scoped evaluation + tests)."""
         if not self.telemetry.enabled:
             return
+        self.telemetry.refresh_lag()
         with self._lock:
             sample = self.telemetry.timeseries_sample()
             if self._ring and sample.get("generation", 0) != (

@@ -33,6 +33,7 @@ import os
 import time
 from typing import Dict, List, Optional
 
+from fluvio_tpu.telemetry.flow import SliceFlow
 from fluvio_tpu.telemetry.registry import TELEMETRY, PipelineTelemetry
 from fluvio_tpu.telemetry.spans import PHASES, BatchSpan, InstantEvent
 
@@ -45,8 +46,9 @@ DEFAULT_TRACE_MAX_MB = 64.0
 
 _PID = 1
 # tid layout: tid 0 is the instant-event track; batch lanes start at
-# path_rank * stride + 1 so each path family groups its lanes together
-_PATH_RANK = {"fused": 0, "striped": 1, "interpreter": 2}
+# path_rank * stride + 1 so each path family groups its lanes together;
+# per-slice flow lanes are their own "slice" family (rank 3)
+_PATH_RANK = {"fused": 0, "striped": 1, "interpreter": 2, "slice": 3}
 _LANE_STRIDE = 100
 
 
@@ -74,7 +76,7 @@ class _LaneAllocator:
 
 
 def _tid(path: str, lane: int) -> int:
-    return _PATH_RANK.get(path, 3) * _LANE_STRIDE + lane + 1
+    return _PATH_RANK.get(path, 4) * _LANE_STRIDE + lane + 1
 
 
 def _thread_meta(path: str, lane: int) -> List[dict]:
@@ -131,6 +133,89 @@ def span_trace_events(span: BatchSpan, lane: int, base: float) -> List[dict]:
     return out
 
 
+def _flow_matches_span(flow: SliceFlow, span: BatchSpan) -> bool:
+    """Does this batch span plausibly carry (part of) this slice's
+    work? Join rule: base chain signatures agree (a flow keyed
+    ``sig@topic/partition`` matches spans labelled ``sig`` or
+    ``sig@...``) and the span overlaps the flow's dispatch->serve
+    window."""
+    if span.t_end is None:
+        return False
+    lo = flow.dispatch_t if flow.dispatch_t is not None else flow.t0
+    hi = flow.t_end if flow.t_end is not None else lo
+    if span.t_end < lo or span.t0 > hi:
+        return False
+    fbase = (flow.chain or "").split("@", 1)[0]
+    sbase = (span.chain or "").split("@", 1)[0]
+    return not fbase or not sbase or fbase == sbase
+
+
+def flow_trace_events(
+    flow: SliceFlow,
+    lane: int,
+    base: float,
+    span_tracks: Optional[List[tuple]] = None,
+) -> List[dict]:
+    """One slice envelope on the ``slice`` lane group, its lifecycle
+    phases (hold / queue-wait / batcher) at their wall positions, and
+    the Chrome-trace flow chain: ``s`` (arrival) on the slice track,
+    one ``t`` step per batch span the slice rode (bound to that span's
+    track by ts), and ``f`` at serve — so Perfetto draws arrows from
+    slice arrival through the coalesced batch to the served response.
+    ``span_tracks`` is ``[(BatchSpan, tid)]`` from the span pass; the
+    continuous sink passes None (it renders incrementally and leaves
+    the batch join to the on-demand renderer)."""
+    tid = _tid("slice", lane)
+    t_end = flow.t_end if flow.t_end is not None else flow.t0
+    args: Dict = {"flow_id": flow.flow_id, "records": flow.records}
+    if flow.chain:
+        args["chain"] = flow.chain
+    if flow.decision:
+        args["decision"] = flow.decision
+    if flow.holds:
+        args["holds"] = flow.holds
+    if flow.cause:
+        args["cause"] = flow.cause
+        args["sources"] = flow.sources
+    out = [
+        {
+            "name": f"slice[{flow.records}]",
+            "cat": "slice",
+            "ph": "X",
+            "pid": _PID,
+            "tid": tid,
+            "ts": _us(flow.t0, base),
+            "dur": round(max(t_end - flow.t0, 0.0) * 1e6, 3),
+            "args": args,
+        }
+    ]
+    for name, p_t0, s in flow.phases:
+        out.append(
+            {
+                "name": name,
+                "cat": "slice-phase",
+                "ph": "X",
+                "pid": _PID,
+                "tid": tid,
+                "ts": _us(p_t0, base),
+                "dur": round(s * 1e6, 3),
+            }
+        )
+    head = {"name": "slice-flow", "cat": "flow", "id": flow.flow_id,
+            "pid": _PID}
+    out.append(dict(head, ph="s", tid=tid, ts=_us(flow.t0, base)))
+    for span, stid in span_tracks or ():
+        if _flow_matches_span(flow, span):
+            out.append(
+                dict(
+                    head, ph="t", tid=stid,
+                    ts=_us(max(span.t0, flow.t0), base),
+                )
+            )
+    out.append(dict(head, ph="f", bp="e", tid=tid, ts=_us(t_end, base)))
+    return out
+
+
 def instant_trace_event(ev: InstantEvent, base: float) -> dict:
     """Heals/spills/retries/breaker/compiles as process-scoped instant
     markers — vertical lines across the batch tracks."""
@@ -162,31 +247,48 @@ def _base_meta() -> List[dict]:
 
 
 def build_trace(
-    spans: List[BatchSpan], events: Optional[List[InstantEvent]] = None
+    spans: List[BatchSpan],
+    events: Optional[List[InstantEvent]] = None,
+    flows: Optional[List[SliceFlow]] = None,
 ) -> dict:
     """Assemble one complete Chrome-trace document from a span list
-    (completion order) and an instant-event list."""
+    (completion order), an instant-event list, and the per-slice flow
+    records (rendered as their own ``slice`` lane group, flow-linked to
+    the batch spans they rode)."""
     events = events or []
-    times = [s.t0 for s in spans] + [e.t for e in events]
+    flows = flows or []
+    times = (
+        [s.t0 for s in spans]
+        + [e.t for e in events]
+        + [f.t0 for f in flows]
+    )
     base = min(times) if times else 0.0
     out = list(_base_meta())
     alloc = _LaneAllocator()
     seen: set = set()
+    span_tracks: List[tuple] = []
     for span in sorted(spans, key=lambda s: s.t0):
         lane = alloc.lane(span)
         if (span.path, lane) not in seen:
             seen.add((span.path, lane))
             out.extend(_thread_meta(span.path, lane))
+        span_tracks.append((span, _tid(span.path, lane)))
         out.extend(span_trace_events(span, lane, base))
     for ev in events:
         out.append(instant_trace_event(ev, base))
+    for flow in sorted(flows, key=lambda f: f.t0):
+        lane = alloc.lane(flow)
+        if ("slice", lane) not in seen:
+            seen.add(("slice", lane))
+            out.extend(_thread_meta("slice", lane))
+        out.extend(flow_trace_events(flow, lane, base, span_tracks))
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
 def render_trace(telemetry: Optional[PipelineTelemetry] = None) -> dict:
     """The current flight-recorder contents as one trace document."""
     t = telemetry if telemetry is not None else TELEMETRY
-    return build_trace(t.spans.recent(), t.events.recent())
+    return build_trace(t.spans.recent(), t.events.recent(), t.flows.recent())
 
 
 def trace_json(telemetry: Optional[PipelineTelemetry] = None) -> str:
@@ -361,6 +463,22 @@ class TraceFileSink:
             if self._base is None:
                 self._base = ev.t
             self._push([instant_trace_event(ev, self._base)])
+
+    def on_flow(self, flow: SliceFlow) -> None:
+        """Stream one completed slice flow (envelope + phases + its s/f
+        flow pair). The batch-span ``t`` steps need the full span->track
+        map and are the on-demand renderer's job — a stitched continuous
+        file still shows every slice lane and its arrival/serve arrows."""
+        with self._lock:
+            if self._base is None:
+                self._base = flow.t0
+            lane = self._alloc.lane(flow)
+            events: List[dict] = []
+            if ("slice", lane) not in self._seen_tracks:
+                self._seen_tracks.add(("slice", lane))
+                events.extend(_thread_meta("slice", lane))
+            events.extend(flow_trace_events(flow, lane, self._base))
+            self._push(events)
 
     def flush(self) -> None:
         """Force the coalesced tail onto disk (tests + shutdown)."""

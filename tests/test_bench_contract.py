@@ -693,3 +693,68 @@ def test_part_key_fits_contract_and_trims_before_link():
     ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
     assert ladder.index('"part"') < ladder.index('"link"')
     assert ladder.index('"part"') < ladder.index('"compile"')
+
+
+def test_lag_line_key_rides_compact_line():
+    """ISSUE-15: a tiny ``lag:{max,age_p99}`` key rides the compact
+    line when any config carried a streaming-lag block; the full
+    per-partition join stays in BENCH_DETAIL.json only."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    cfg = dict(GOOD)
+    cfg["lag"] = {
+        "max": 12,
+        "age_p99_ms": 84.5,
+        "per_partition": {
+            "bench/0": {"committed": 4999, "hw": 5011, "lag": 12,
+                        "age_p99_ms": 84.5},
+            "bench/1": {"committed": 4999, "hw": 4999, "lag": 0,
+                        "age_p99_ms": 60.0},
+        },
+    }
+    out, rc = b._build_output({"9_partitioned": cfg})
+    assert rc == 0
+    assert out["configs"]["9_partitioned"]["lag"]["max"] == 12
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["lag"] == {"max": 12, "age_p99": 84.5}
+    # the bulky per-partition join never reaches the line
+    assert "lag" not in line["configs"].get("9_partitioned", {})
+    # without a lag block the key stays off entirely
+    out2, _ = b._build_output({"2_filter_map": dict(GOOD)})
+    assert "lag" not in json.loads(json.dumps(b._compact_line(out2)))
+
+
+def test_lag_key_fits_contract_and_trims_before_part():
+    """The full-matrix line with the lag key stays ≤1500 chars and the
+    blowup trim ladder drops ``lag`` BEFORE ``part`` (and therefore
+    before ``link``, the sentinel's contract field)."""
+    import json
+    import re
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = _full_results()
+    results["9_partitioned"] = dict(GOOD)
+    results["9_partitioned"]["part"] = {
+        "n": 4, "groups": 2, "rebal": 1, "exact": True,
+        "offsets": {f"bench/{i}": 4999 for i in range(4)},
+        "plan": {f"bench/{i}": i % 2 for i in range(4)},
+    }
+    results["9_partitioned"]["lag"] = {
+        "max": 3, "age_p99_ms": 42.0,
+        "per_partition": {
+            f"bench/{i}": {"lag": i, "age_p99_ms": 42.0} for i in range(4)
+        },
+    }
+    out, _ = b._build_output(results)
+    line = json.dumps(b._compact_line(out))
+    assert len(line) <= 1500, f"compact line is {len(line)} chars"
+    parsed = json.loads(line)
+    assert parsed["lag"] == {"max": 3, "age_p99": 42.0}
+    assert parsed["part"] == {"n": 4, "rebal": 1}
+    src = open(_BENCH_PATH).read()
+    ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
+    assert ladder.index('"lag"') < ladder.index('"part"')
+    assert ladder.index('"lag"') < ladder.index('"link"')

@@ -183,6 +183,7 @@ class TestGrammar:
         assert names == {
             "e2e_p99", "spill_ratio", "error_rate", "compile_budget",
             "recompile_rate", "queue_depth", "hbm_staged",
+            "consumer_lag", "record_age_p99",
         }
 
     def test_target_and_warn_overrides(self):

@@ -490,6 +490,103 @@ def test_admission_seams_zero_cost_when_disabled(monkeypatch):
     TELEMETRY.reset()
 
 
+def test_flow_tracing_armed_overhead_under_gate():
+    """ISSUE-15 CI satellite: per-slice flow tracing armed — one
+    begin_flow/end_flow pair per slice around the REAL dispatch path —
+    must stay inside the same <2% rps gate. A flow is one object plus a
+    handful of clock reads per SLICE, never per record or chunk."""
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    buf = _corpus_buf()
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+    assert TELEMETRY.flow_trace, "FLUVIO_FLOW_TRACE default must arm"
+    sig = executor._chain_sig
+
+    def _measure_flows():
+        times = {"bare": [], "armed": []}
+        for _ in range(PASSES_PER_ARM):
+            for arm in ("bare", "armed"):
+                t0 = time.perf_counter()
+                for _i in range(BATCHES_PER_PASS):
+                    if arm == "armed":
+                        f = TELEMETRY.begin_flow(sig)
+                        f.mark_dispatch()
+                        executor.process_buffer(buf)
+                        TELEMETRY.end_flow(f, records=N_RECORDS)
+                    else:
+                        executor.process_buffer(buf)
+                times[arm].append(
+                    (time.perf_counter() - t0) / BATCHES_PER_PASS
+                )
+        return min(times["bare"]), min(times["armed"])
+
+    for attempt in range(5):
+        bare_s, armed_s = _measure_flows()
+        overhead = max(armed_s - bare_s, 0.0)
+        if overhead <= bare_s * GATE or overhead < 500e-6:
+            break
+    else:
+        raise AssertionError(
+            f"flow tracing cost {overhead*1e6:.0f}us/slice on a "
+            f"{bare_s*1e3:.2f}ms batch — exceeds the {GATE:.0%} gate "
+            f"after 5 measurement rounds"
+        )
+    rps_bare = N_RECORDS / bare_s
+    rps_armed = N_RECORDS / armed_s
+    assert rps_armed >= rps_bare * (1 - GATE) or overhead < 500e-6
+
+
+def test_flow_lag_seams_zero_cost_when_telemetry_off(monkeypatch):
+    """ISSUE-15 CI satellite, the strict half: with FLUVIO_TELEMETRY=0
+    every new seam — slice ring, flow emit, slice histograms, lag
+    sampler/registration — is ZERO work. Tripwires prove none is
+    touched through a full pipelined pass plus direct seam calls."""
+    from fluvio_tpu.telemetry import flow as flow_module
+    from fluvio_tpu.telemetry import lag as lag_module
+
+    lag_module.reset_engine()
+    TELEMETRY.reset()
+    prior = TELEMETRY.enabled
+    TELEMETRY.enabled = False
+    try:
+
+        def tripwire(*a, **k):
+            raise AssertionError("flow/lag seam touched with telemetry off")
+
+        monkeypatch.setattr(flow_module.SliceFlow, "__init__", tripwire)
+        monkeypatch.setattr(TELEMETRY.flows, "push", tripwire)
+        monkeypatch.setattr(lag_module.LagEngine, "track", tripwire)
+        monkeypatch.setattr(lag_module.LagEngine, "sample", tripwire)
+
+        assert TELEMETRY.begin_flow("c") is None
+        TELEMETRY.end_flow(None, records=4)
+        TELEMETRY.add_slice_phase("hold", 1.0)
+        TELEMETRY.add_record_age("c", 1.0)
+        TELEMETRY.set_consumer_lag("c", 5)
+        TELEMETRY.add_served("c", 5)
+        lag_module.track_stream("c", object())
+        lag_module.note_commit("c", 1)
+        lag_module.note_serve("c", 1, 1.0)
+        TELEMETRY.refresh_lag()
+        assert TELEMETRY.lag_sampler is None
+
+        chain = _headline_chain()
+        buf = _corpus_buf()
+        for out in chain.tpu_chain.process_stream(iter([buf] * 2)):
+            pass
+        snap = TELEMETRY.snapshot()
+        assert snap["flows_total"] == 0
+        assert snap["slices"] == {}
+        assert snap["lag"] == {
+            "consumer_lag": {}, "served_records": {}, "record_age": {},
+        }
+    finally:
+        TELEMETRY.enabled = prior
+        TELEMETRY.reset()
+        lag_module.reset_engine()
+
+
 def test_telemetry_disabled_skips_span_capture_entirely():
     """The off switch must mean OFF: no spans, no histogram writes."""
     chain = _headline_chain()
