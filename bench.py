@@ -203,6 +203,20 @@ CONFIGS = {
         "partitions": 4,
         "groups": 2,
     },
+    # JsonGet-sourced NON-literal regex over fat records (ISSUE-16):
+    # formerly the interpreter spill family, now the striped in-span
+    # DFA path. The 22-state pattern crosses the legacy 16-state
+    # associative gate, so this config only stays striped under the
+    # class-packed 64-state default — it is the bench's live pin that
+    # the raised gate + class packing actually moved a spill family.
+    "10_regex_json_fat": {
+        "specs": [
+            ("json-regex-filter",
+             {"key": "name", "regex": "^(fluvio|kafka|pulsar)-[0-3]$"}),
+        ],
+        "corpus": gen_fat_70k,
+        "divisor": 1024,
+    },
 }
 
 
@@ -756,14 +770,15 @@ def _run_config(
     except Exception as e:  # noqa: BLE001 — analysis must never cost a run
         log(f"  preflight analysis failed: {type(e).__name__}: {e}")
 
-    if name == "7_fat70k":
-        # sanity: the striped layout must engage (no record-too-wide
-        # spill left in the matrix) — a chain that silently fell back
-        # would report interpreter numbers under a fused label
+    if name in ("7_fat70k", "10_regex_json_fat"):
+        # sanity: the striped layout must engage (no record-too-wide or
+        # JsonGet-regex spill left in the matrix) — a chain that
+        # silently fell back would report interpreter numbers under a
+        # fused label
         probe = build_chain("tpu", cfg["specs"])
         assert probe.backend_in_use == "tpu", name
         assert probe.tpu_chain._striped_chain() is not None, (
-            "7_fat70k chain must lower striped"
+            f"{name} chain must lower striped"
         )
     buf = _pack(values, ts)
 
@@ -984,6 +999,13 @@ def _run_config(
         result["preflight"] = preflight
     if staging_ab:
         result["staging_ab"] = staging_ab
+    # DFA table-shape evidence (ISSUE-16 class packing): per-pattern
+    # packed state/class counts + table bytes for every regex param
+    # this config compiled; the compact line carries one tiny
+    # dfa:{classes,states} key from the suite's largest table
+    dfa_detail = _dfa_detail(cfg["specs"])
+    if dfa_detail:
+        result["dfa"] = dfa_detail
     # glz link compression attribution: which form the flat crossed in
     # (link_mb above already reflects the compressed byte count)
     glz_cache = getattr(buf, "_glz_cache", None)
@@ -1004,6 +1026,32 @@ def _run_config(
         result["link_floor_ms"] = round(floor_ms)
         result["link_saturation"] = round(floor_ms / (t_med * 1000), 2)
     return result
+
+
+def _dfa_detail(specs) -> list:
+    """Per-pattern DFA table shapes for a config's regex params — the
+    BENCH_DETAIL.json record behind the compact line's tiny
+    ``dfa:{classes,states}`` key (ISSUE-16 byte-class packing
+    evidence: class count, state count, packed table bytes)."""
+    out = []
+    try:
+        from fluvio_tpu.ops.regex_dfa import compile_regex_cached
+
+        for _sm_name, params in specs:
+            pattern = (params or {}).get("regex")
+            if not pattern:
+                continue
+            dfa = compile_regex_cached(pattern)
+            out.append({
+                "pattern_len": len(pattern),
+                "states": int(dfa.n_states),
+                "classes": int(dfa.n_classes),
+                "table_bytes": int(dfa.table_bytes),
+                "packed": bool(dfa.packed),
+            })
+    except Exception:  # noqa: BLE001 — evidence must never cost a run
+        return []
+    return out
 
 
 NORTH_STAR_FILTER_SM = b"""
@@ -1420,6 +1468,25 @@ def _admission_counts(configs: dict):
     }
 
 
+def _dfa_counts(configs: dict):
+    """Largest compiled DFA table across the suite — the compact
+    line's tiny ``dfa`` key ({"classes": c, "states": s}: the packing
+    evidence at a glance). None when no config carried a dfa block.
+    Per-pattern shapes (table bytes, packed flag) stay in
+    BENCH_DETAIL.json only (the ≤1500-char contract)."""
+    rows = [
+        d
+        for c in configs.values()
+        if isinstance(c, dict) and isinstance(c.get("dfa"), list)
+        for d in c["dfa"]
+        if isinstance(d, dict)
+    ]
+    if not rows:
+        return None
+    top = max(rows, key=lambda d: int(d.get("table_bytes", 0)))
+    return {"classes": top.get("classes"), "states": top.get("states")}
+
+
 def _slo_verdict(configs: dict):
     """Worst per-config SLO verdict across the suite — the compact
     line's tiny ``slo`` key; full per-config blocks (targets, observed
@@ -1533,6 +1600,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         pt = _partition_counts(out["configs"])
         if pt:
             compact["part"] = pt
+        df = _dfa_counts(out["configs"])
+        if df:
+            compact["dfa"] = df
     if "cpu_fallback" in out:
         inner = out["cpu_fallback"]
         compact["cpu_fallback"] = {
@@ -1545,8 +1615,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "lag", "part", "adm", "slo", "preflight",
-        "down", "compile", "phases", "error", "xla_cache", "link",
+        "configs", "cpu_fallback", "dfa", "lag", "part", "adm", "slo",
+        "preflight", "down", "compile", "phases", "error", "xla_cache",
+        "link",
     ):
         if len(json.dumps(compact)) <= limit:
             break

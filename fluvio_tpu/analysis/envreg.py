@@ -141,9 +141,19 @@ REGISTRY: Tuple[EnvFlag, ...] = (
     _f("FLUVIO_DFA_ASSOC", "mode", "auto", "auto|1|0",
        ("smartengine/tpu/lower.py", "analysis/spec.py"),
        "associative-scan DFA compose kernel policy (auto: off-CPU only)"),
-    _f("FLUVIO_DFA_ASSOC_MAX_STATES", "int", "16", "states",
+    _f("FLUVIO_DFA_ASSOC_MAX_STATES", "int", "64", "states",
        "smartengine/tpu/kernels.py",
-       "largest DFA state count the striped compose engine accepts"),
+       "largest DFA state count the striped compose engine accepts "
+       "(sized for packed tables; falls back to 16 when "
+       "FLUVIO_DFA_CLASSES=0 or the class ceiling overflows)"),
+    _f("FLUVIO_DFA_CLASSES", "mode", "auto", "auto|0",
+       ("ops/regex_dfa.py", "smartengine/tpu/kernels.py"),
+       "byte-equivalence-class DFA table packing (0: unpacked "
+       "258-column tables + legacy state gate)"),
+    _f("FLUVIO_DFA_PALLAS", "mode", "auto", "auto|1|0|interpret",
+       ("smartengine/tpu/pallas_kernels.py", "smartengine/tpu/kernels.py"),
+       "fused DFA block-compose kernel ladder (auto: off-CPU; demotes "
+       "to the XLA associative scan on failure)"),
     _f("FLUVIO_DONATE", "mode", "auto", "auto|1|0",
        "smartengine/tpu/executor.py",
        "donate_argnums on the chain jits (auto: off-CPU only)"),

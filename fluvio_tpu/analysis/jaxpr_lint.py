@@ -274,6 +274,7 @@ def trace_chain_entry_points(
             )
         reports.extend(_pallas_reports(executor, buf))
         reports.extend(_glz_reports(executor, buf))
+        reports.extend(_dfa_compose_reports(executor, buf))
     return reports
 
 
@@ -403,16 +404,77 @@ def dfa_table_reports(programs) -> List[JaxprReport]:
                 "states": dfa.n_states,
                 "classes": dfa.n_classes,
                 "table_bytes": int(dfa.table.nbytes),
+                "packed": bool(dfa.packed),
                 "pallas_ok": bool(pallas_kernels.dfa_supported(dfa)),
             }
-            if dfa.n_states > kernels.dfa_assoc_max_states():
+            limit, reason = kernels.dfa_effective_max_states(dfa)
+            if dfa.n_states > limit:
                 report.hazards.append(
                     Hazard(
                         WARN, "dfa-states-over-gate",
                         f"{dfa.n_states} states exceeds the associative "
-                        f"gate ({kernels.dfa_assoc_max_states()})",
+                        f"gate ({limit})"
+                        + (
+                            " — packed class ceiling reduced the limit"
+                            if reason == "dfa-classes-overflow" else ""
+                        ),
                         source="jaxpr",
                     )
                 )
             reports.append(report)
+    return reports
+
+
+def _dfa_compose_reports(executor, buf) -> List[JaxprReport]:
+    """Trace the fused DFA block-compose kernel at each distinct table
+    bucket the chain would run it for (mirrors the chooser inside
+    `kernels.dfa_compose_columns`): one AOT-warmup work-list entry per
+    (states, classes) table at this width bucket's compose shape."""
+    from fluvio_tpu.ops.regex_dfa import (
+        UnsupportedRegex,
+        compile_regex_cached,
+        literal_of,
+    )
+    from fluvio_tpu.smartengine.tpu import pallas_kernels, stripes
+    from fluvio_tpu.smartmodule import dsl
+
+    if not pallas_kernels.dfa_pallas_active():
+        return []
+    striped = buf.width > executor._stripe_threshold
+    if striped:
+        s, _v = stripes.stripe_params()
+        t_len = s
+    else:
+        t_len = buf.width + 1  # EOS tail column
+    seen = set()
+    reports = []
+    for prog in getattr(executor, "_programs", []):
+        for expr in _walk_exprs(prog):
+            if not isinstance(expr, dsl.RegexMatch):
+                continue
+            if literal_of(expr.pattern) is not None:
+                continue
+            try:
+                dfa = compile_regex_cached(expr.pattern)
+            except UnsupportedRegex:
+                continue
+            bucket = (dfa.n_states, dfa.n_classes, dfa.packed)
+            if bucket in seen:
+                continue
+            seen.add(bucket)
+            cls = np.zeros((buf.rows, t_len), np.int32)
+            table_t = dfa.table.T.astype(np.int32)
+            reports.append(
+                _trace_report(
+                    "dfa_compose",
+                    f"dfa_compose states={dfa.n_states} "
+                    f"classes={dfa.n_classes} packed={int(dfa.packed)} "
+                    f"shape=({buf.rows}, {t_len})",
+                    lambda c=cls, t=table_t, n=dfa.n_states: scan_function(
+                        pallas_kernels.dfa_compose_columns_pallas,
+                        c, t, n,
+                        interpret=pallas_kernels.interpret_mode(),
+                    ),
+                )
+            )
     return reports

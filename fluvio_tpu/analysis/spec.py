@@ -40,6 +40,7 @@ from fluvio_tpu.ops.regex_dfa import (
     compile_regex_cached,
     literal_of,
 )
+from fluvio_tpu.ops.regex_dfa import classes_enabled as regex_classes_enabled
 from fluvio_tpu.smartmodule import dsl
 
 ERROR = "error"
@@ -159,6 +160,11 @@ def resolve_gates() -> dict:
         "dfa_assoc": _depth_over_work("FLUVIO_DFA_ASSOC"),
         "fast_json": _depth_over_work("FLUVIO_TPU_FAST_JSON"),
         "dfa_assoc_max_states": kernels.dfa_assoc_max_states(),
+        # round-2 DFA engine gates: byte-class table packing (the
+        # raised state default is sized for packed tables) and the
+        # fused Pallas block-compose ladder
+        "dfa_classes": regex_classes_enabled(),
+        "dfa_pallas": pallas_kernels.dfa_pallas_active(),
         "stripe_threshold": int(env_int("FLUVIO_STRIPE_THRESHOLD")),
         "max_record_width": MAX_RECORD_WIDTH,
         # link-staging gates: the H2D variant ladder the executor
@@ -264,9 +270,10 @@ def _type_of(expr) -> Optional[str]:
 def _expr_problems(expr, gates, declines: List[str], problems: List[str]) -> None:
     """Mirror of `lower.lower_expr` coverage: append a problem string
     for every sub-expression outside the TPU-compilable subset, and a
-    predicted ``dfa-assoc-states`` decline for every non-literal regex
-    whose DFA trips the associative state gate on a backend that wanted
-    the associative path (the exact condition `lower_expr` counts)."""
+    predicted ``dfa-assoc-states`` (or ``dfa-classes-overflow``) decline
+    for every non-literal regex whose DFA trips the effective
+    associative state gate on a backend that wanted the associative
+    path (the exact condition `lower_expr` counts)."""
     if isinstance(expr, (dsl.Value, dsl.Key, dsl.Const)):
         return
     if isinstance(expr, (dsl.Upper, dsl.Lower, dsl.Len, dsl.ParseInt,
@@ -287,8 +294,10 @@ def _expr_problems(expr, gates, declines: List[str], problems: List[str]) -> Non
         except UnsupportedRegex as e:
             problems.append(f"unsupported regex: {e}")
             return
-        if gates["dfa_assoc"] and dfa.n_states > gates["dfa_assoc_max_states"]:
-            declines.append("dfa-assoc-states")
+        if gates["dfa_assoc"]:
+            limit, reason = _effective_dfa_limit(dfa)
+            if dfa.n_states > limit:
+                declines.append(reason or "dfa-assoc-states")
         return
     if isinstance(expr, dsl.Cmp):
         if _type_of(expr.left) != "int" or _type_of(expr.right) != "int":
@@ -455,28 +464,44 @@ def _striped_predicate_check(expr, gates, s: int, v: int, declines) -> None:
         _seg_exact_check(expr)
         return
     if isinstance(expr, (dsl.Contains, dsl.StartsWith, dsl.EndsWith)):
-        if _jsonget_source_mirror(expr.arg) is not None:
-            _striped_json_literal_check(expr.literal, v)
-            return
-        postops = _value_postops_mirror(expr.arg)
-        if postops is None:
-            _seg_exact_check(expr)
-            return
         kind = {
             dsl.Contains: "contains",
             dsl.StartsWith: "startswith",
             dsl.EndsWith: "endswith",
         }[type(expr)]
-        _striped_literal_check(kind, expr.literal, s, v)
+        if _jsonget_source_mirror(expr.arg) is not None:
+            try:
+                _striped_json_literal_check(expr.literal, v)
+                return
+            except _NotStriped:
+                pass  # overlap-exceeding: in-span DFA
+            _striped_dfa_gate_check(
+                _striped_literal_regex(expr.literal, kind), declines
+            )
+            return
+        postops = _value_postops_mirror(expr.arg)
+        if postops is None:
+            _seg_exact_check(expr)
+            return
+        try:
+            _striped_literal_check(kind, expr.literal, s, v)
+            return
+        except _NotStriped:
+            pass  # overlap-exceeding literal: chains as a DFA
+        _striped_dfa_gate_check(
+            _striped_literal_regex(expr.literal, kind), declines
+        )
         return
     if isinstance(expr, dsl.RegexMatch):
         if _jsonget_source_mirror(expr.arg) is not None:
             info = literal_of(expr.pattern)
-            if info is None:
-                raise _NotStriped(
-                    "JsonGet-sourced regex predicate is not stripeable"
-                )
-            _striped_json_literal_check(info[0], v)
+            if info is not None:
+                try:
+                    _striped_json_literal_check(info[0], v)
+                    return
+                except _NotStriped:
+                    pass  # overlap-exceeding: in-span DFA
+            _striped_dfa_gate_check(expr.pattern, declines)
             return
         postops = _value_postops_mirror(expr.arg)
         if postops is None:
@@ -497,21 +522,45 @@ def _striped_predicate_check(expr, gates, s: int, v: int, declines) -> None:
                 return
             except _NotStriped:
                 pass  # overlap-exceeding literal: chains as a DFA
-        try:
-            dfa = compile_regex_cached(expr.pattern)
-        except UnsupportedRegex as e:
-            raise _NotStriped(str(e)) from e
-        if dfa.n_states > gates["dfa_assoc_max_states"]:
-            # the runtime fires the decline AND abandons the striped
-            # build (distinct reason from dfa-assoc-states: the
-            # consequence is an interpreter spill, not a slower scan)
-            declines.append("dfa-stripe-states")
-            raise _NotStriped(
-                f"DFA of {dfa.n_states} states exceeds the associative "
-                "gate (FLUVIO_DFA_ASSOC_MAX_STATES)"
-            )
+        _striped_dfa_gate_check(expr.pattern, declines)
         return
     raise _NotStriped(f"{type(expr).__name__} not stripeable as a predicate")
+
+
+def _striped_literal_regex(lit: bytes, kind: str) -> str:
+    """Mirror of `stripes._literal_regex` (keep byte-for-byte equal —
+    the compiled DFA's state count must match the runtime's)."""
+    body = "".join(f"\\x{b:02x}" for b in lit)
+    pre = "^" if kind in ("startswith", "equals") else ""
+    post = "$" if kind in ("endswith", "equals") else ""
+    return pre + body + post
+
+
+def _striped_dfa_gate_check(pattern: str, declines) -> None:
+    """Mirror of `stripes._striped_dfa_gate`: the runtime fires the
+    decline AND abandons the striped build (distinct reason from
+    dfa-assoc-states: the consequence is an interpreter spill, not a
+    slower scan; dfa-classes-overflow when the packed class ceiling
+    reduced the limit)."""
+    try:
+        dfa = compile_regex_cached(pattern)
+    except UnsupportedRegex as e:
+        raise _NotStriped(str(e)) from e
+    limit, reason = _effective_dfa_limit(dfa)
+    if dfa.n_states > limit:
+        declines.append(reason or "dfa-stripe-states")
+        raise _NotStriped(
+            f"DFA of {dfa.n_states} states exceeds the associative "
+            "gate (FLUVIO_DFA_ASSOC_MAX_STATES)"
+        )
+
+
+def _effective_dfa_limit(dfa):
+    """The runtime's per-DFA gate, verbatim (class-ceiling fallback
+    included) — predictions must stay differential-exact."""
+    from fluvio_tpu.smartengine.tpu import kernels
+
+    return kernels.dfa_effective_max_states(dfa)
 
 
 def _striped_view_mirror(value):
@@ -831,15 +880,21 @@ def analyze_entries(
                    f"chain cannot lower ({p}): every batch runs interpreted")
         )
     for reason in narrow_declines:
-        report.hazards.append(
-            Hazard(
-                WARN, "decline:" + reason,
+        if reason == "dfa-classes-overflow":
+            detail = (
+                "regex DFA's byte-class count exceeds the packed ceiling, "
+                "so only the legacy state gate applies: the narrow build "
+                "declines the associative path and keeps the O(L) "
+                "sequential scan"
+            )
+        else:
+            detail = (
                 "regex DFA exceeds FLUVIO_DFA_ASSOC_MAX_STATES "
                 f"({gates['dfa_assoc_max_states']}): the narrow build "
                 "declines the associative path and keeps the O(L) "
-                "sequential scan",
+                "sequential scan"
             )
-        )
+        report.hazards.append(Hazard(WARN, "decline:" + reason, detail))
     for prog in programs:
         if isinstance(prog, dsl.ArrayMapProgram) and prog.mode == "json_array":
             report.hazards.append(

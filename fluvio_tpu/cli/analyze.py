@@ -158,11 +158,21 @@ def _render_report(report) -> str:
         + _rows_to_table(rows, header=("width", "bucket", "path", "reasons"))
     )
     if report.jaxprs:
-        rows = [
-            (j.kind, j.signature, j.n_eqns,
-             sum(1 for h in j.hazards if h.level == "error"))
-            for j in report.jaxprs
-        ]
+        rows = []
+        for j in report.jaxprs:
+            sig = j.signature
+            if j.kind == "dfa_table" and j.prims:
+                # ISSUE-16: the packed table shape is the report — put
+                # class/state counts and table bytes on the row itself
+                sig += (
+                    f" states={j.prims.get('states')}"
+                    f" classes={j.prims.get('classes')}"
+                    f" table_bytes={j.prims.get('table_bytes')}"
+                )
+            rows.append((
+                j.kind, sig, j.n_eqns,
+                sum(1 for h in j.hazards if h.level == "error"),
+            ))
         sections.append(
             "jit entry points (AOT warmup work list)\n"
             + _rows_to_table(

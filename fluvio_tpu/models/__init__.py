@@ -31,6 +31,7 @@ def lookup(name: str) -> SmartModuleDef:
         array_map_explode,
         dedup_filter,
         json_map,
+        json_regex_filter,
         regex_filter,
         windowed_aggregate,
     )
@@ -62,6 +63,7 @@ def builtin_names() -> list:
         array_map_explode,
         dedup_filter,
         json_map,
+        json_regex_filter,
         regex_filter,
         windowed_aggregate,
     )

@@ -1,0 +1,47 @@
+"""json-regex-filter — regex predicate over a JSON field.
+
+The JsonGet-sourced regex family: keep records whose extracted
+``params["key"]`` field matches ``params["regex"]`` (unanchored search,
+empty bytes for a missing field — `dsl.json_get_bytes` semantics). On
+the TPU backend non-literal patterns spilled wide batches to the
+interpreter until the in-span DFA chain (`stripes.striped_dfa_in_span`,
+ISSUE-16); narrow batches lower to the same DFA over the extracted
+span. The Python hooks pin the reference semantics the device paths
+are differentially tested against.
+"""
+
+from __future__ import annotations
+
+import re
+
+from fluvio_tpu.models import register
+from fluvio_tpu.smartmodule import dsl
+from fluvio_tpu.smartmodule.sdk import SmartModuleDef
+from fluvio_tpu.smartmodule.types import SmartModuleKind
+
+
+def module(with_hooks: bool = True) -> SmartModuleDef:
+    m = SmartModuleDef(name="json-regex-filter")
+    m.dsl[SmartModuleKind.FILTER] = dsl.FilterProgram(
+        predicate=dsl.RegexMatch(
+            arg=dsl.JsonGet(arg=dsl.Value(), key="@param:key=name"),
+            pattern="@param:regex",
+        )
+    )
+    if with_hooks:
+        state = {}
+
+        def init(params: dict) -> None:
+            state["re"] = re.compile(params["regex"].encode("utf-8"))
+            state["key"] = params.get("key", "name")
+
+        def fil(record) -> bool:
+            field = dsl.json_get_bytes(record.value, state["key"]) or b""
+            return state["re"].search(field) is not None
+
+        m.hooks[SmartModuleKind.INIT] = init
+        m.hooks[SmartModuleKind.FILTER] = fil
+    return m
+
+
+register("json-regex-filter", module)

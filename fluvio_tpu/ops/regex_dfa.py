@@ -11,7 +11,8 @@ semantics match Python ``re.search`` on bytes for the supported subset,
 which tests enforce by fuzzing against ``re``.
 
 Supported: literals, escapes (\\d \\D \\w \\W \\s \\S \\n \\t \\r \\xhh and
-escaped metachars), ``.``, character classes ``[...]`` (ranges, negation),
+escaped metachars), ``.``, character classes ``[...]`` (ranges — incl.
+single-codepoint escape endpoints like ``[\\x7e-\\xff]`` — and negation),
 ``*`` ``+`` ``?`` ``{m}`` ``{m,n}`` ``{m,}`` (n bounded), alternation ``|``,
 groups ``(...)`` (incl. ``(?:...)``), anchors ``^`` (pattern start) and
 ``$`` (pattern end). Unsupported constructs raise
@@ -259,6 +260,15 @@ class _Parser:
             raise self.error(f"unsupported escape \\{c}")
         return frozenset([ord(c)])
 
+    def _range_follows(self) -> bool:
+        """True when the cursor sits on a '-' that opens a class range
+        (not the trailing literal '-' before ']')."""
+        return (
+            self.peek() == "-"
+            and self.i + 1 < len(self.p)
+            and self.p[self.i + 1] != "]"
+        )
+
     def parse_class(self) -> FrozenSet[int]:
         negate = False
         if self.peek() == "^":
@@ -276,16 +286,26 @@ class _Parser:
             first = False
             if c == "\\":
                 self.next()
-                members |= self.parse_escape()
-                continue
-            self.next()
-            lo = ord(c)
-            if self.peek() == "-" and self.i + 1 < len(self.p) and self.p[self.i + 1] != "]":
+                esc = self.parse_escape()
+                if len(esc) != 1 or not self._range_follows():
+                    # set escapes (\d, \w, ...) never open a range —
+                    # matching re, which rejects them as endpoints
+                    members |= esc
+                    continue
+                lo = next(iter(esc))
+            else:
+                self.next()
+                lo = ord(c)
+            if self._range_follows():
                 self.next()  # '-'
                 hi_ch = self.next()
                 if hi_ch == "\\":
-                    raise self.error("escape as range endpoint")
-                hi = ord(hi_ch)
+                    hi_set = self.parse_escape()
+                    if len(hi_set) != 1:
+                        raise self.error("set escape as range endpoint")
+                    hi = next(iter(hi_set))
+                else:
+                    hi = ord(hi_ch)
                 if hi < lo:
                     raise self.error("inverted class range")
                 members |= set(range(lo, hi + 1))
@@ -419,6 +439,10 @@ class CompiledDfa:
     accept: np.ndarray  # bool [S]
     start: int
     pattern: str = ""
+    # False = byte-class compression skipped: table keeps all 258 symbol
+    # columns and byte_class is the identity map (the differential
+    # baseline behind FLUVIO_DFA_CLASSES=0)
+    packed: bool = True
 
     @property
     def n_states(self) -> int:
@@ -427,6 +451,12 @@ class CompiledDfa:
     @property
     def n_classes(self) -> int:
         return self.table.shape[1]
+
+    @property
+    def table_bytes(self) -> int:
+        """Device footprint of the transition table (what class packing
+        shrinks ~8x; reported by analyze/bench as evidence)."""
+        return int(self.table.nbytes)
 
     def match_numpy(self, values: np.ndarray, lengths: np.ndarray) -> np.ndarray:
         """Reference batch matcher (numpy): values u8 [N, L], lengths [N]."""
@@ -450,8 +480,29 @@ class CompiledDfa:
         return bool(self.match_numpy(arr, np.array([len(data)]))[0])
 
 
-def compile_regex(pattern: str) -> CompiledDfa:
-    """Compile a pattern (search semantics) to a byte-class DFA."""
+def classes_enabled() -> bool:
+    """FLUVIO_DFA_CLASSES: "auto" (default) builds byte-equivalence-class
+    packed tables; "0"/"off" builds the unpacked 258-column table — the
+    zero-cost escape hatch and the differential baseline the packed
+    engine is fuzz-pinned against."""
+    from fluvio_tpu.analysis.envreg import env_raw
+
+    return (env_raw("FLUVIO_DFA_CLASSES") or "auto").strip().lower() not in (
+        "0",
+        "off",
+        "false",
+        "no",
+    )
+
+
+def compile_regex(pattern: str, packed: bool = True) -> CompiledDfa:
+    """Compile a pattern (search semantics) to a byte-class DFA.
+
+    ``packed=False`` skips byte-class compression: the table keeps one
+    column per symbol (256 bytes + EOS + PAD) and ``byte_class`` is the
+    identity map. Semantically identical — every packed column is the
+    shared copy of the unpacked columns its bytes map to — but ~8x the
+    device footprint for real-world patterns."""
     parser = _Parser(pattern)
     ast = parser.parse()
 
@@ -530,6 +581,19 @@ def compile_regex(pattern: str) -> CompiledDfa:
     # EOS column: for accepting states, stay (absorbing covers via row loop)
     # PAD for dead stays dead (default).
 
+    if not packed:
+        # identity classes: table IS the full symbol table
+        return CompiledDfa(
+            table=full,
+            byte_class=np.arange(256, dtype=np.int16),
+            eos_class=EOS,
+            pad_class=PAD,
+            accept=accept_arr,
+            start=0,
+            pattern=pattern,
+            packed=False,
+        )
+
     # ---- byte-class compression: identical columns merge ----
     col_keys: Dict[bytes, int] = {}
     class_of_symbol = np.zeros(N_SYMBOLS, dtype=np.int16)
@@ -550,6 +614,7 @@ def compile_regex(pattern: str) -> CompiledDfa:
         accept=accept_arr,
         start=0,
         pattern=pattern,
+        packed=True,
     )
 
 
@@ -560,7 +625,7 @@ def compile_regex(pattern: str) -> CompiledDfa:
 # one CompiledDfa across executors is safe; lru_cache is thread-safe,
 # bounds the table count, and does not cache the UnsupportedRegex that
 # callers treat as control flow.
-_compile_regex_lru = functools.lru_cache(maxsize=256)(compile_regex)
+_compile_regex_lru = functools.lru_cache(maxsize=256)(compile_regex)  # key: (pattern, packed)
 # largest miss count already accounted for as a compile event: a thread
 # whose cache hit races another thread's miss observes no NEW growth
 # past this mark and records nothing (same dedupe as instrument_jit)
@@ -571,12 +636,15 @@ _dfa_seen_lock = make_lock("regex_dfa.seen")
 def compile_regex_cached(pattern: str) -> "CompiledDfa":
     """Cached table build, with compile observability: an lru miss
     records a "dfa_table" compile event (the signature carries table
-    size, never the pattern text). The cache-hit path costs one
-    cache_info read — this runs per chain build, never per batch."""
+    size and the packed/unpacked tag, never the pattern text). The
+    cache-hit path costs one cache_info read — this runs per chain
+    build, never per batch. The packing gate is resolved per call, so
+    flipping FLUVIO_DFA_CLASSES never serves a stale-mode table (the
+    mode is part of the cache key)."""
     from fluvio_tpu.telemetry.registry import TELEMETRY
 
     t0 = time.perf_counter()
-    dfa = _compile_regex_lru(pattern)
+    dfa = _compile_regex_lru(pattern, classes_enabled())
     if TELEMETRY.enabled:
         misses = _compile_regex_lru.cache_info().misses
         with _dfa_seen_lock:
@@ -586,7 +654,7 @@ def compile_regex_cached(pattern: str) -> "CompiledDfa":
             TELEMETRY.add_compile(
                 "dfa_table",
                 f"pattern_len={len(pattern)} states={dfa.table.shape[0]} "
-                f"classes={dfa.table.shape[1]}",
+                f"classes={dfa.table.shape[1]} packed={int(dfa.packed)}",
                 time.perf_counter() - t0,
             )
     return dfa

@@ -1614,6 +1614,15 @@ class TpuChainExecutor:
                     # heal and latches the executor's rung)
                     enc_now = self._enc_demote(e, enc_now, where="dispatch")
                     continue
+                # fused DFA compose rung: if the chain traced the Pallas
+                # block-compose kernel, latch it off process-wide and
+                # re-trace on the XLA associative-scan path (failed
+                # compiles are not cached, so the retry re-lowers). A
+                # no-op (False) when the kernel never engaged.
+                from fluvio_tpu.smartengine.tpu import pallas_kernels
+
+                if pallas_kernels.dfa_pallas_demote(e, where="dispatch"):
+                    continue
                 if not glz_bytes:
                     raise
                 # self-healing decode ladder (trace/compile errors

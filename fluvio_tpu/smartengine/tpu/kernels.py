@@ -10,13 +10,14 @@ tests assert bit-equality against those references.
 
 from __future__ import annotations
 
-from typing import Tuple
+import os
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from fluvio_tpu.ops.regex_dfa import CompiledDfa
+from fluvio_tpu.ops.regex_dfa import CompiledDfa, classes_enabled
 from fluvio_tpu.analysis.envreg import env_int
 
 INT64_MIN = -(2**63)
@@ -77,7 +78,9 @@ def dfa_match(values: jnp.ndarray, lengths: jnp.ndarray, dfa: CompiledDfa) -> jn
 # per-stripe-row vectors across a segment's rows to chain DFA state
 # across stripes.
 
-DFA_ASSOC_MAX_STATES = 16  # default FLUVIO_DFA_ASSOC_MAX_STATES
+DFA_ASSOC_MAX_STATES = 64  # default FLUVIO_DFA_ASSOC_MAX_STATES (packed tables)
+DFA_ASSOC_MAX_STATES_UNPACKED = 16  # legacy gate when class packing is off
+DFA_MAX_CLASSES = 32  # packed class ceiling the raised state default is sized for
 _DFA_ASSOC_BLOCK = 256  # max columns composed per parallel tree
 _DFA_ASSOC_BLOCK_ELEMS = 1 << 25  # live transition-vector element budget
 
@@ -85,8 +88,44 @@ _DFA_ASSOC_BLOCK_ELEMS = 1 << 25  # live transition-vector element budget
 def dfa_assoc_max_states() -> int:
     """State-count gate for the associative path: past it, the S x work
     multiplier loses to the sequential scan (and the transition material
-    stops fitting VMEM-friendly tiles)."""
+    stops fitting VMEM-friendly tiles).
+
+    The raised default (64) is sized for byte-class-packed tables, whose
+    live material is classes x S rather than 258 x S. With packing
+    disabled (FLUVIO_DFA_CLASSES=0) and no explicit operator override,
+    the gate falls back to the legacy 16 — that pairing is the zero-cost
+    tripwire's "today's paths" baseline."""
+    if (
+        os.environ.get("FLUVIO_DFA_ASSOC_MAX_STATES") is None
+        and not classes_enabled()
+    ):
+        return DFA_ASSOC_MAX_STATES_UNPACKED
     return int(env_int("FLUVIO_DFA_ASSOC_MAX_STATES"))
+
+
+def dfa_effective_max_states(dfa: CompiledDfa) -> Tuple[int, Optional[str]]:
+    """Per-DFA associative gate: ``(limit, decline_reason | None)``.
+
+    What the raised default actually budgets is the S x C live-element
+    product, not S alone — so a PACKED table whose class count blew past
+    DFA_MAX_CLASSES only keeps the legacy unpacked limit. When that
+    reduction is what rejects the DFA, the decline reason is
+    ``dfa-classes-overflow`` (distinct from the plain gate reasons so
+    the two causes never blur in telemetry). An explicit
+    FLUVIO_DFA_ASSOC_MAX_STATES override always wins: the operator
+    pinned the limit, the heuristic steps aside. Mirrored by
+    analysis/spec.py — keep prediction and runtime in lockstep."""
+    limit = dfa_assoc_max_states()
+    if (
+        getattr(dfa, "packed", True)
+        and dfa.n_classes > DFA_MAX_CLASSES
+        and limit > DFA_ASSOC_MAX_STATES_UNPACKED
+        and os.environ.get("FLUVIO_DFA_ASSOC_MAX_STATES") is None
+    ):
+        limit = DFA_ASSOC_MAX_STATES_UNPACKED
+        if dfa.n_states > limit:
+            return limit, "dfa-classes-overflow"
+    return limit, None
 
 
 def dfa_compose(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -161,7 +200,18 @@ def dfa_compose_columns(
     bounds live transition material at rows x block x S elements
     (block shrinks as rows x S grows) instead of rows x T x S, while
     keeping the sequential depth at T/block instead of T.
+
+    When the FLUVIO_DFA_PALLAS ladder is active the whole composition
+    runs as one fused Pallas kernel instead (compositions never leave
+    VMEM); bit-equal by associativity, demoted back here by the
+    executor's self-heal rung on any failure.
     """
+    from fluvio_tpu.smartengine.tpu import pallas_kernels
+
+    if pallas_kernels.dfa_pallas_active():
+        return pallas_kernels.dfa_compose_columns_pallas(
+            cls, table_t, n_states, interpret=pallas_kernels.interpret_mode()
+        )
     rows = cls.shape[0]
     blocks, tv_of = _dfa_column_blocks(cls, n_states)
     ident = jnp.broadcast_to(

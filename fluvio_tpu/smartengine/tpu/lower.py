@@ -231,9 +231,11 @@ def lower_expr(expr: dsl.Expr) -> Callable[[Dict[str, jnp.ndarray]], object]:
         # gate trip on a backend that WANTED the associative path counts
         # as a decline
         assoc_ok = _depth_over_work("FLUVIO_DFA_ASSOC")
-        if assoc_ok and dfa.n_states > kernels.dfa_assoc_max_states():
-            assoc_ok = False
-            TELEMETRY.add_decline("dfa-assoc-states")
+        if assoc_ok:
+            limit, reason = kernels.dfa_effective_max_states(dfa)
+            if dfa.n_states > limit:
+                assoc_ok = False
+                TELEMETRY.add_decline(reason or "dfa-assoc-states")
 
         def regex_fn(s):
             v, l = inner(s)

@@ -774,3 +774,160 @@ def dfa_match_pallas(
             interpret=interpret,
         )(vt, len2d)
     return out[0, :n] != 0
+
+
+# ---------------------------------------------------------------------------
+# DFA block-compose fusion (associative-engine compose stage in VMEM)
+# ---------------------------------------------------------------------------
+#
+# The XLA associative-scan engine (kernels.dfa_compose_columns)
+# materializes [rows, block, S] transition vectors per column block and
+# round-trips them through HBM between the scan tree's levels — the
+# compose/reduce stage, not the per-byte classify, is the bandwidth hog.
+# This rung folds each row's class stream through the transition table
+# with ONE fused kernel: only the class block, the C x S table, and the
+# running [rows, S] composition are ever live, all in VMEM.
+
+DFA_COMPOSE_LANES = 128  # lane alignment of the class/state blocks
+_DFA_COMPOSE_ROW_ELEMS = 1 << 20  # class-block element budget per grid step
+
+# self-heal ladder state (process-wide, like the glz executor latches
+# but global: the compose chooser sits inside kernels.py, below any
+# executor). `_dfa_pallas_engaged` flips at trace time so a demotion
+# request from an executor whose chain never traced the kernel is a
+# no-op — the dispatch seam offers every failure to this rung.
+_dfa_pallas_off = False
+_dfa_pallas_engaged = False
+
+
+def dfa_pallas_active() -> bool:
+    """Should `kernels.dfa_compose_columns` run the fused Pallas rung?
+    ``FLUVIO_DFA_PALLAS``: ``0`` disables (XLA associative scan),
+    ``1``/``interpret`` forces it (interpreted on CPU for equivalence
+    testing), ``auto`` (default) enables off-CPU only — the same ladder
+    shape as the glz ``FLUVIO_GLZ_PALLAS`` rungs. A runtime demotion
+    (`dfa_pallas_demote`) latches it off process-wide."""
+    if _disable_depth or not _PALLAS or _dfa_pallas_off:
+        return False
+    mode = env_raw("FLUVIO_DFA_PALLAS")
+    if mode == "0":
+        return False
+    if mode in ("interpret", "1"):
+        return True
+    return not interpret_mode()
+
+
+def dfa_pallas_demote(e=None, where: str = "dispatch") -> bool:
+    """One rung down the DFA compose ladder: latch the Pallas rung off
+    so the next trace takes the XLA associative-scan path. Returns True
+    iff this call newly demoted (callers retry the batch on True) —
+    False when the kernel never engaged (the failure is someone else's)
+    or the latch was already down (no double-count)."""
+    global _dfa_pallas_off
+    if not _dfa_pallas_engaged or _dfa_pallas_off:
+        return False
+    _dfa_pallas_off = True
+    from fluvio_tpu.telemetry.registry import TELEMETRY
+
+    TELEMETRY.add_heal()
+    TELEMETRY.add_decline("dfa-pallas-demoted")
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "fused DFA compose kernel failed at %s; demoting to the XLA "
+        "associative-scan path: %s", where, e,
+    )
+    return True
+
+
+def _dfa_pallas_reset() -> None:
+    """Test hook: clear the demotion latch + engagement flag."""
+    global _dfa_pallas_off, _dfa_pallas_engaged
+    _dfa_pallas_off = False
+    _dfa_pallas_engaged = False
+
+
+def _dfa_compose_kernel(s_pad: int, t_len: int, cls_ref, table_ref, out_ref):
+    """One row-block: fold the class stream through the transition table.
+
+    ``cls_ref`` (rows, t_len) int32 class per column (-1 = identity:
+    padding / un-owned stripe bytes), ``table_ref`` (C_pad, s_pad) the
+    padded transposed table. The carry is the running transition vector
+    f[row, s] = state after the consumed columns starting from s; each
+    column updates it with one table gather — sequential over columns
+    but with zero HBM traffic, which beats the log-depth XLA tree that
+    streams [rows, block, S] material per level. Bit-equal to
+    `kernels.dfa_compose_columns` by associativity (exact int ops, same
+    composition order up to regrouping).
+
+    NOTE: the in-kernel gather indexes the flattened VMEM table with a
+    vector of dynamic indices (same construct as `_glz_resolve_kernel`).
+    Mosaic's dynamic-gather lowering is version-dependent; a backend
+    that rejects it fails at compile time and the executor's self-heal
+    rung (`dfa_pallas_demote`) re-traces on the XLA path — correctness
+    never rides on this kernel lowering.
+    """
+    blk = cls_ref[:, :]
+    rows = blk.shape[0]
+    flat = table_ref[:, :].reshape(-1)
+    f0 = jax.lax.broadcasted_iota(jnp.int32, (rows, s_pad), 1)
+
+    def step(t, f):
+        c = jax.lax.dynamic_slice_in_dim(blk, t, 1, axis=1)  # (rows, 1)
+        idx = c * jnp.int32(s_pad) + f
+        nxt = jnp.take(
+            flat, jnp.clip(idx, jnp.int32(0), jnp.int32(flat.shape[0] - 1))
+        )
+        return jnp.where(c >= 0, nxt, f)
+
+    out_ref[:, :] = jax.lax.fori_loop(jnp.int32(0), jnp.int32(t_len), step, f0)
+
+
+def dfa_compose_columns_pallas(
+    cls: jnp.ndarray, table_t: jnp.ndarray, n_states: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused rung of `kernels.dfa_compose_columns` (same contract:
+    ``cls`` int32[rows, T] with -1 identity, ``table_t`` int32[C, S],
+    returns int32[rows, S]).
+
+    The grid walks row blocks sized so each class block stays under the
+    element budget; states and columns pad to lane multiples (padded
+    states compose to garbage that the final slice drops — real states
+    never reach them because table entries stay < n_states)."""
+    if not _PALLAS:
+        raise RuntimeError("pallas unavailable")
+    global _dfa_pallas_engaged
+    _dfa_pallas_engaged = True
+    rows, t_len = cls.shape
+    lanes = DFA_COMPOSE_LANES
+    s_pad = -(-max(n_states, 1) // lanes) * lanes
+    t_pad = -(-max(t_len, 1) // lanes) * lanes
+    rb = max(8, min(512, _DFA_COMPOSE_ROW_ELEMS // t_pad))
+    rb = -(-rb // 8) * 8
+    nb = -(-max(rows, 1) // rb)
+    r_pad = nb * rb
+    cls_i = jnp.pad(
+        cls.astype(jnp.int32),
+        ((0, r_pad - rows), (0, t_pad - t_len)),
+        constant_values=-1,
+    )
+    c_pad = -(-table_t.shape[0] // 8) * 8
+    table_p = jnp.pad(
+        table_t.astype(jnp.int32),
+        ((0, c_pad - table_t.shape[0]), (0, s_pad - table_t.shape[1])),
+    )
+    kernel = functools.partial(_dfa_compose_kernel, s_pad, t_pad)
+    with _enable_x64(False):  # see the x64/Mosaic note in json_get_pallas
+        out = pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((rb, t_pad), lambda b: (b, 0)),
+                pl.BlockSpec((c_pad, s_pad), lambda b: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((rb, s_pad), lambda b: (b, 0)),
+            out_shape=jax.ShapeDtypeStruct((r_pad, s_pad), jnp.int32),
+            interpret=interpret,
+        )(cls_i, table_p)
+    return out[:rows, :n_states]

@@ -40,14 +40,14 @@ data-dependent):
   stripe joints, gated on ``FLUVIO_DFA_ASSOC_MAX_STATES``); a
   single-level ``JsonGet`` map carries the structural machine state
   across stripes (`striped_json_span`) and ships view descriptors;
-  ``JsonGet``-sourced LITERAL predicates run fused too — the same
-  cross-stripe span machine resolves the field's absolute span and a
-  windowed compare matches inside it (`striped_literal_in_span`;
-  literals bounded by the overlap, exactly like record-level
-  containment) — while JsonGet-sourced non-literal regexes,
-  ``word_count``, and ``json_array`` explodes remain outside the
-  subset — chains containing them keep the interpreter spill for wide
-  batches;
+  ``JsonGet``-sourced predicates run fused too — the same cross-stripe
+  span machine resolves the field's absolute span, then short literals
+  window-compare inside it (`striped_literal_in_span`) and non-literal
+  regexes / overlap-exceeding literals chain an in-span DFA
+  (`striped_dfa_in_span`, the round-2 de-spill) — while nested
+  ``JsonGet`` sources, ``word_count``, and ``json_array`` explodes
+  remain outside the subset — chains containing them keep the
+  interpreter spill for wide batches;
 - ``ParseInt`` contributions parse the record's leading int from the
   first stripe: a record whose int prefix (whitespace + sign + digits)
   extends past ``STRIPE_WIDTH`` bytes parses only the in-stripe prefix.
@@ -202,6 +202,17 @@ def striped_dfa_verdict(sv, plan, dfa, n: int):
     cls = jnp.take(byte_class, sv.astype(jnp.int32))
     jidx = jnp.arange(s, dtype=jnp.int32)[None, :]
     cls = jnp.where(jidx < owned_lengths(plan)[:, None], cls, -1)
+    return _seg_dfa_accept(cls, plan, dfa, n)
+
+
+def _seg_dfa_accept(cls, plan, dfa, n: int):
+    """Segment verdicts from per-position class symbols int32[r, s]
+    (-1 = identity): per-row composition (`kernels.dfa_compose_columns`),
+    segmented composition across each segment's rows, one EOS per
+    segment, accept check — the shared tail of the record-level and
+    in-span striped DFA chains (the two must never diverge on the
+    carry/EOS semantics)."""
+    r = cls.shape[0]
     table_t = jnp.asarray(dfa.table.T.astype(np.int32))
     rowf = kernels.dfa_compose_columns(cls, table_t, dfa.n_states)  # [r, S]
 
@@ -219,6 +230,34 @@ def striped_dfa_verdict(sv, plan, dfa, n: int):
     table_flat = jnp.asarray(dfa.table.reshape(-1).astype(np.int32))
     state = jnp.take(table_flat, state * dfa.n_classes + dfa.eos_class)
     return jnp.take(jnp.asarray(dfa.accept), state) & (plan["k"] > 0)
+
+
+def striped_dfa_in_span(sv, plan, dfa, vst, vln, n: int):
+    """Regex match per segment INSIDE a JsonGet-extracted field span.
+
+    The same cross-stripe composition as `striped_dfa_verdict`, with the
+    class stream additionally masked to the slab-absolute span
+    ``[vst, vst+vln)``: bytes outside the span (or un-owned) compose as
+    identity, so each row's transition function covers exactly its owned
+    slice of the FIELD bytes and the segmented scan chains them across
+    stripe joints — bit-equal to running the DFA over the extracted
+    bytes. A missing or empty field composes pure identity and the EOS
+    step evaluates the empty string, matching the narrow extract's
+    ``json_get_bytes(...) or b""`` semantics. This is the chain that
+    moves the non-literal-regex-over-JsonGet family (and, via the
+    escaped-literal fallback, overlap-exceeding JsonGet literals) off
+    the interpreter."""
+    r, s = sv.shape
+    byte_class = jnp.asarray(dfa.byte_class.astype(np.int32))
+    cls = jnp.take(byte_class, sv.astype(jnp.int32))
+    jidx = jnp.arange(s, dtype=jnp.int32)[None, :]
+    lo = jnp.take(vst.astype(jnp.int32), plan["seg"])[:, None]
+    hi = lo + jnp.take(vln.astype(jnp.int32), plan["seg"])[:, None]
+    abs_pos = plan["abs_start"][:, None] + jidx
+    owned = jidx < owned_lengths(plan)[:, None]
+    in_span = (abs_pos >= lo) & (abs_pos < hi)
+    cls = jnp.where(owned & in_span, cls, -1)
+    return _seg_dfa_accept(cls, plan, dfa, n)
 
 
 def striped_json_span(sv, plan, lengths, key: str, kmax: int, n: int):
@@ -557,38 +596,57 @@ def lower_striped_predicate(expr, s: int, v: int) -> Callable:
         json_src = _jsonget_source(expr.arg)
         if json_src is not None:
             key, pre, outer = json_src
-            return _lower_striped_json_literal(
-                kind, expr.literal, key, pre, outer, s, v
+            try:
+                return _lower_striped_json_literal(
+                    kind, expr.literal, key, pre, outer, s, v
+                )
+            except Unlowerable:
+                # literal longer than the overlap: no containment inside
+                # the span — chain it as an in-span DFA instead (same
+                # fallback as record-level overlap-exceeding literals)
+                pass
+            return _lower_striped_dfa_in_span(
+                _literal_regex(expr.literal, kind), key, pre, outer
             )
         postops = _value_postops(expr.arg)
         if postops is None:  # key/const source: exact on the segment state
             _check_seg_exact(expr)
             fn = lower_expr(expr)
             return lambda c: fn(c["seg_state"])
-        return _lower_striped_literal(kind, expr.literal, postops, s, v)
+        try:
+            return _lower_striped_literal(kind, expr.literal, postops, s, v)
+        except Unlowerable:
+            # literal longer than the overlap: chain it across stripes
+            # as a DFA instead of spilling (same fallback as the
+            # literal-regex form below)
+            pass
+        return _lower_striped_dfa(_literal_regex(expr.literal, kind), postops)
     if isinstance(expr, dsl.RegexMatch):
         json_src = _jsonget_source(expr.arg)
         if json_src is not None:
-            # JsonGet-sourced regex: only the literal family fuses (the
-            # span machine pins the field; the windowed compare pins the
-            # match) — a real DFA over an extracted sub-span stays in
-            # the interpreter spill set
-            info = literal_of(expr.pattern)
-            if info is None:
-                raise Unlowerable(
-                    "JsonGet-sourced regex predicate is not stripeable"
-                )
-            lit, a_start, a_end = info
-            if a_start and a_end:
-                kind = "equals"
-            elif a_start:
-                kind = "startswith"
-            elif a_end:
-                kind = "endswith"
-            else:
-                kind = "contains"
+            # JsonGet-sourced regex: the literal family fuses via the
+            # windowed compare inside the span; everything else (real
+            # regexes, overlap-exceeding literals) chains an in-span
+            # DFA — the spill family the round-2 engine retired
             key, pre, outer = json_src
-            return _lower_striped_json_literal(kind, lit, key, pre, outer, s, v)
+            info = literal_of(expr.pattern)
+            if info is not None:
+                lit, a_start, a_end = info
+                if a_start and a_end:
+                    kind = "equals"
+                elif a_start:
+                    kind = "startswith"
+                elif a_end:
+                    kind = "endswith"
+                else:
+                    kind = "contains"
+                try:
+                    return _lower_striped_json_literal(
+                        kind, lit, key, pre, outer, s, v
+                    )
+                except Unlowerable:
+                    pass  # overlap-exceeding: in-span DFA below
+            return _lower_striped_dfa_in_span(expr.pattern, key, pre, outer)
         postops = _value_postops(expr.arg)
         if postops is None:
             raise Unlowerable("striped regex must read the record value")
@@ -613,29 +671,66 @@ def lower_striped_predicate(expr, s: int, v: int) -> Callable:
     raise Unlowerable(f"{type(expr).__name__} not stripeable as a predicate")
 
 
+def _literal_regex(lit: bytes, kind: str) -> str:
+    """A literal predicate as an equivalent regex pattern (every byte
+    \\xhh-escaped, so metacharacters and non-ASCII bytes are inert) —
+    the bridge that lets overlap-exceeding JsonGet literals ride the
+    in-span DFA chain."""
+    body = "".join(f"\\x{b:02x}" for b in lit)
+    pre = "^" if kind in ("startswith", "equals") else ""
+    post = "$" if kind in ("endswith", "equals") else ""
+    return pre + body + post
+
+
+def _striped_dfa_gate(pattern: str):
+    """Compile + state-count gate shared by the record-level DFA chain
+    and the in-span DFA. Past the gate the striped build spills, with
+    the cause on the decline counter: ``dfa-classes-overflow`` when the
+    packed class ceiling reduced the limit, ``dfa-stripe-states``
+    otherwise — distinct from the narrow lowering's "dfa-assoc-states"
+    (one gate trip would otherwise double-count across the two builds,
+    and the consequences differ: sequential scan vs spill)."""
+    try:
+        dfa = compile_regex_cached(pattern)
+    except UnsupportedRegex as e:
+        raise Unlowerable(str(e)) from e
+    limit, reason = kernels.dfa_effective_max_states(dfa)
+    if dfa.n_states > limit:
+        TELEMETRY.add_decline(reason or "dfa-stripe-states")
+        raise Unlowerable(
+            f"DFA of {dfa.n_states} states exceeds the associative gate "
+            "(FLUVIO_DFA_ASSOC_MAX_STATES)"
+        )
+    return dfa
+
+
 def _lower_striped_dfa(pattern: str, postops):
     """Non-literal regex (or an overlap-exceeding literal) as a
     cross-stripe DFA chain — the composition trick that lifts the
     literal-only restriction on striped regex filters. Same state-count
     gate as the narrow associative path; past it the chain spills to the
     interpreter (with the decline reason on the telemetry counter)."""
-    try:
-        dfa = compile_regex_cached(pattern)
-    except UnsupportedRegex as e:
-        raise Unlowerable(str(e)) from e
-    if dfa.n_states > kernels.dfa_assoc_max_states():
-        # distinct reason from the narrow lowering's "dfa-assoc-states":
-        # one gate trip would otherwise double-count across the two
-        # builds, and the consequences differ (sequential scan vs spill)
-        TELEMETRY.add_decline("dfa-stripe-states")
-        raise Unlowerable(
-            f"DFA of {dfa.n_states} states exceeds the associative gate "
-            "(FLUVIO_DFA_ASSOC_MAX_STATES)"
-        )
+    dfa = _striped_dfa_gate(pattern)
 
     def fn(ctx):
         sv = apply_postops(ctx["sv"], postops)
         return striped_dfa_verdict(sv, ctx["plan"], dfa, ctx["n"])
+
+    return fn
+
+
+def _lower_striped_dfa_in_span(pattern: str, key: str, pre, outer):
+    """Regex over a JsonGet-extracted field as an in-span DFA chain
+    (`striped_dfa_in_span`): the cross-stripe span machine resolves the
+    field's slab-absolute bounds, the DFA composes over exactly those
+    bytes. Same gate + spill semantics as `_lower_striped_dfa`."""
+    dfa = _striped_dfa_gate(pattern)
+
+    def fn(ctx):
+        sv_pre, (vst, vln) = _cached_json_span(ctx, key, pre)
+        # outer folds are length-preserving: span positions stay valid
+        sv_m = apply_postops(sv_pre, outer)
+        return striped_dfa_in_span(sv_m, ctx["plan"], dfa, vst, vln, ctx["n"])
 
     return fn
 

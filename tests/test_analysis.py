@@ -383,15 +383,49 @@ def test_jsonget_sourced_literal_predicate_runs_striped(monkeypatch):
     ]
 
 
-def test_jsonget_predicate_overlap_exceeding_literal_still_spills(monkeypatch):
-    """The family's remaining boundary: a literal longer than the
-    stripe overlap has no containment argument inside the extracted
-    span — predicted and observed spill, with the JsonGet-sourced
-    cause string."""
+def _despilled_family_case(monkeypatch, mods, values):
+    """Predicted AND observed striped, no spill, survivors bit-equal to
+    the python reference engine — the pin shape for families ISSUE-16
+    moved off the interpreter."""
+    for k, v in _SMALL_STRIPES.items():
+        monkeypatch.setenv(k, v)
+    entries, chain = _entries(mods)
+    width = max(len(v) for v in values)
+    report = analyze_entries(entries, widths=(width,))
+    pred = report.predictions[0]
+    assert pred.path == "striped", (pred.path, pred.causes)
+    assert not pred.spill_reasons
+
+    s0 = dict(TELEMETRY.spills)
+    pr0 = TELEMETRY.path_records()
+    out = _run(chain, values)
+    assert _observed_path(pr0) == "striped"
+    assert not _spill_delta(s0)
+    py = SmartEngine(backend="python").builder()
+    for module, params in mods:
+        py.add_smart_module(
+            SmartModuleConfig(params=dict(params or {})), module
+        )
+    ref_out = _run(py.initialize(), values)
+    assert [r.value for r in out.successes] == [
+        r.value for r in ref_out.successes
+    ]
+
+
+def test_jsonget_predicate_overlap_exceeding_literal_runs_striped(monkeypatch):
+    """ISSUE-16: a literal longer than the stripe overlap has no
+    containment argument inside the extracted span, so it used to
+    spill — now it chains as an in-span DFA (escaped-literal regex;
+    its ~1-state-per-byte DFA needs the raised 64-state gate)."""
     pad = "p" * 160
     lit = b"x" * 20  # > the 16-byte test overlap
     values = [
-        f'{{"name":"{"x" * 24}","pad":"{pad}"}}'.encode() for i in range(8)
+        (
+            f'{{"name":"{"x" * 24}","pad":"{pad}"}}'
+            if i % 2 == 0
+            else f'{{"name":"{"y" * 24}","pad":"{pad}"}}'
+        ).encode()
+        for i in range(8)
     ]
     mods = [(
         _predicate_module(
@@ -401,15 +435,18 @@ def test_jsonget_predicate_overlap_exceeding_literal_still_spills(monkeypatch):
         ),
         None,
     )]
-    _spill_family_case(monkeypatch, mods, values, "JsonGet-sourced")
+    _despilled_family_case(monkeypatch, mods, values)
 
 
-def test_jsonget_sourced_regex_predicate_still_spills(monkeypatch):
-    """Non-literal regexes over a JsonGet source stay in the spill set
-    (a DFA over an extracted sub-span has no striped lowering)."""
+def test_jsonget_sourced_regex_predicate_runs_striped(monkeypatch):
+    """ISSUE-16: non-literal regexes over a JsonGet source left the
+    spill set — the in-span DFA chain (`stripes.striped_dfa_in_span`)
+    masks the class stream to the span the cross-stripe machine
+    resolves."""
     pad = "p" * 160
     values = [
-        f'{{"name":"fluvio-{i}","pad":"{pad}"}}'.encode() for i in range(8)
+        f'{{"name":"{"cat" if i % 3 == 0 else "bird"}-{i}","pad":"{pad}"}}'.encode()
+        for i in range(12)
     ]
     mods = [(
         _predicate_module(
@@ -420,7 +457,31 @@ def test_jsonget_sourced_regex_predicate_still_spills(monkeypatch):
         ),
         None,
     )]
-    _spill_family_case(monkeypatch, mods, values, "JsonGet-sourced")
+    _despilled_family_case(monkeypatch, mods, values)
+
+
+def test_nested_jsonget_regex_still_spills(monkeypatch):
+    """The family's remaining boundary: a regex over a NESTED JsonGet
+    source (two structural levels) stays in the spill set — the span
+    machine carries one structural level across stripes."""
+    pad = "p" * 160
+    values = [
+        f'{{"outer":{{"name":"fluvio-{i}"}},"pad":"{pad}"}}'.encode()
+        for i in range(8)
+    ]
+    mods = [(
+        _predicate_module(
+            dsl.RegexMatch(
+                arg=dsl.JsonGet(
+                    arg=dsl.JsonGet(arg=dsl.Value(), key="outer"),
+                    key="name",
+                ),
+                pattern="cat|dog",
+            )
+        ),
+        None,
+    )]
+    _spill_family_case(monkeypatch, mods, values, "JsonGet")
 
 
 def test_word_count_spills_wide(monkeypatch):

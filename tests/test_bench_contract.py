@@ -758,3 +758,56 @@ def test_lag_key_fits_contract_and_trims_before_part():
     ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
     assert ladder.index('"lag"') < ladder.index('"part"')
     assert ladder.index('"lag"') < ladder.index('"link"')
+
+def test_dfa_line_key_rides_compact_line():
+    """ISSUE-16: a tiny ``dfa:{classes,states}`` key rides the compact
+    line when any config carried a DFA table block, read from the
+    suite's LARGEST table; per-pattern shapes (table bytes, packed
+    flag) stay in BENCH_DETAIL.json only."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    cfg = dict(GOOD)
+    cfg["dfa"] = [
+        {"pattern_len": 6, "states": 8, "classes": 7,
+         "table_bytes": 112, "packed": True},
+        {"pattern_len": 29, "states": 22, "classes": 15,
+         "table_bytes": 660, "packed": True},
+    ]
+    out, rc = b._build_output({"10_regex_json_fat": cfg})
+    assert rc == 0
+    assert out["configs"]["10_regex_json_fat"]["dfa"][1]["table_bytes"] == 660
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["dfa"] == {"classes": 15, "states": 22}
+    # the per-pattern detail never reaches the line
+    assert "dfa" not in line["configs"].get("10_regex_json_fat", {})
+    # without a dfa block the key stays off entirely
+    out2, _ = b._build_output({"2_filter_map": dict(GOOD)})
+    assert "dfa" not in json.loads(json.dumps(b._compact_line(out2)))
+
+
+def test_dfa_key_fits_contract_and_trims_before_link():
+    """The full-matrix line with the dfa key stays ≤1500 chars and the
+    blowup trim ladder drops ``dfa`` BEFORE ``lag``/``part``/``link``
+    (link.glz is the sentinel's contract field)."""
+    import json
+    import re
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = _full_results()
+    results["10_regex_json_fat"] = _full_config(41210, 8.3, "striped")
+    results["10_regex_json_fat"]["dfa"] = [
+        {"pattern_len": 29, "states": 22, "classes": 15,
+         "table_bytes": 660, "packed": True},
+    ]
+    out, _ = b._build_output(results)
+    line = json.dumps(b._compact_line(out))
+    assert len(line) <= 1500, f"compact line is {len(line)} chars"
+    parsed = json.loads(line)
+    assert parsed["dfa"] == {"classes": 15, "states": 22}
+    src = open(_BENCH_PATH).read()
+    ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
+    assert ladder.index('"dfa"') < ladder.index('"lag"')
+    assert ladder.index('"dfa"') < ladder.index('"link"')

@@ -439,29 +439,36 @@ class TestStripedEquivalence:
             vals,
         )
 
-    def test_json_sourced_regex_predicate_still_spills(self, small_stripes):
-        # the remaining boundary: a real DFA over an extracted sub-span
-        # has no striped lowering
+    def test_json_sourced_regex_predicate_runs_striped(self, small_stripes):
+        # ISSUE-16 flipped this boundary: a real DFA over an extracted
+        # sub-span now lowers striped (stripes.striped_dfa_in_span)
         pred = dsl.RegexMatch(
             arg=dsl.JsonGet(arg=dsl.Value(), key="name"), pattern="cat|dog"
         )
         vals = [
-            (f'{{"name":"cat-{i}","pad":"{"x" * 120}"}}').encode()
+            (
+                f'{{"name":"{"cat" if i % 3 else "bird"}-{i}",'
+                f'"pad":"{"x" * 120}"}}'
+            ).encode()
             for i in range(40)
         ]
         _assert_equivalent(
-            lambda: [(predicate_module(pred), None)], vals, striped=False
+            lambda: [(predicate_module(pred), None)], vals, striped=True
         )
 
-    def test_literal_longer_than_overlap_spills(self, small_stripes):
-        lit = b"q" * 20  # > 16-byte overlap: containment not guaranteed
+    def test_literal_longer_than_overlap_runs_striped(self, small_stripes):
+        # ISSUE-16 flipped this boundary: a literal that outgrows the
+        # stripe overlap chains across stripes as a DFA now instead of
+        # spilling to the interpreter.
+        lit = b"q" * 20  # > 16-byte overlap: windowed match insufficient
         vals = [b"x" * n + lit + b"y" * 30 for n in range(0, 90, 5)]
+        vals += [b"x" * n + b"q" * 19 + b"y" * 30 for n in range(0, 90, 10)]
         _assert_equivalent(
             lambda: [
                 (predicate_module(dsl.Contains(arg=dsl.Value(), literal=lit)), None)
             ],
             vals,
-            striped=False,
+            striped=True,
         )
 
     def test_word_count_spills(self, small_stripes):
