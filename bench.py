@@ -1451,6 +1451,23 @@ def _lag_counts(configs: dict):
     }
 
 
+def _soak_counts(configs: dict):
+    """Soak-family evidence for the compact line's tiny ``soak`` key:
+    the nominal scenario's steady-state p99 record age (ms) + shed
+    ratio. None when the soak family didn't run. Full per-scenario
+    verdict documents stay in BENCH_DETAIL.json only (the ≤1500-char
+    contract)."""
+    blocks = [
+        c["soak"]
+        for c in configs.values()
+        if isinstance(c, dict) and isinstance(c.get("soak"), dict)
+    ]
+    if not blocks:
+        return None
+    b = blocks[0]
+    return {"p99_age": b.get("p99_age"), "shed_ratio": b.get("shed_ratio")}
+
+
 def _admission_counts(configs: dict):
     """Suite-wide admission evidence for the compact line's tiny
     ``adm`` key: total shed decisions + total warmed buckets. None when
@@ -1597,6 +1614,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         lg = _lag_counts(out["configs"])
         if lg:
             compact["lag"] = lg
+        sk = _soak_counts(out["configs"])
+        if sk:
+            compact["soak"] = sk
         pt = _partition_counts(out["configs"])
         if pt:
             compact["part"] = pt
@@ -1615,9 +1635,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "dfa", "lag", "part", "adm", "slo",
-        "preflight", "down", "compile", "phases", "error", "xla_cache",
-        "link",
+        "configs", "cpu_fallback", "dfa", "soak", "lag", "part", "adm",
+        "slo", "preflight", "down", "compile", "phases", "error",
+        "xla_cache", "link",
     ):
         if len(json.dumps(compact)) <= limit:
             break
@@ -1880,6 +1900,15 @@ def run_suite(results: dict, n: int, smoke: bool, budget: float, only) -> None:
             traceback.print_exc(file=sys.stderr)
             results["codecs"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # LAST: soak scenarios reset the telemetry registry per run, so
+    # they must not precede any block that reads it mid-measurement
+    if os.environ.get("BENCH_SOAK", "1") == "1":
+        try:
+            results["soak"] = run_soak_bench()
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc(file=sys.stderr)
+            results["soak"] = {"error": f"{type(e).__name__}: {e}"}
+
 
 def run_codec_bench() -> dict:
     """Per-codec MB/s on a 1 MB json-ish corpus (VERDICT r4 weak #6).
@@ -1930,6 +1959,54 @@ def run_codec_bench() -> dict:
             f"[codecs] {name} ({impl}): {c_mbs:.0f} MB/s c, "
             f"{d_mbs:.0f} MB/s d, ratio {len(c)/len(data):.2f}"
         )
+    return report
+
+
+def run_soak_bench() -> dict:
+    """Multi-tenant soak smoke family (ISSUE-17): the three tier-1
+    scenarios through the real serving paths, scored against the
+    observability surfaces. The expected exit codes are pinned —
+    ``nominal`` and ``fairness`` must pass, ``overload`` must be
+    detected as queueing collapse — so a bench run catches a scoring
+    regression, not just a perf one. The compact line carries the
+    nominal scenario's steady-state health as ``soak:{p99_age,
+    shed_ratio}``; full per-scenario verdicts stay in
+    BENCH_DETAIL.json (the ≤1500-char contract)."""
+    from fluvio_tpu.soak import build_verdict, parse_scenario, run_scenario
+    from fluvio_tpu.telemetry import TELEMETRY
+
+    if not TELEMETRY.enabled:
+        return {"skipped": "telemetry capture off"}
+    expected = {"nominal": 0, "overload": 1, "fairness": 0}
+    report = {"scenarios": {}}
+    for name, want_rc in expected.items():
+        sc = parse_scenario(name)
+        doc = build_verdict(sc, run_scenario(sc))
+        report["scenarios"][name] = {
+            "verdict": doc["verdict"],
+            "rc": doc["rc"],
+            "expected_rc": want_rc,
+            "p99_age_ms": doc["p99_age_ms"],
+            "shed_ratio": doc["shed_ratio"],
+            "fairness": doc["fairness"],
+            "checks": {c["name"]: c["ok"] for c in doc["checks"]},
+        }
+        log(
+            f"[soak] {name}: verdict={doc['verdict']} rc={doc['rc']} "
+            f"(want {want_rc}) p99_age={doc['p99_age_ms']}ms "
+            f"shed={doc['shed_ratio']} fairness={doc['fairness']}"
+        )
+    nominal = report["scenarios"]["nominal"]
+    report["soak"] = {
+        "p99_age": round(float(nominal["p99_age_ms"]), 1),
+        "shed_ratio": nominal["shed_ratio"],
+        "ok": sum(
+            1
+            for s in report["scenarios"].values()
+            if s["rc"] == s["expected_rc"]
+        ),
+        "of": len(report["scenarios"]),
+    }
     return report
 
 

@@ -228,13 +228,15 @@ class AdmissionController:
     # -- the decision --------------------------------------------------------
 
     def admit(
-        self, chain: str, cost: float = 1.0, breaker=None
+        self, chain: str, cost: float = 1.0, breaker=None, tenant: str = ""
     ) -> Decision:
         """One slice's admission decision. Order: breaker short-circuit
-        (shared decline surface), warm gate, health shed, token charge."""
+        (shared decline surface), warm gate, health shed, token charge.
+        ``tenant`` attributes shed decisions to the per-tenant
+        accounting plane (ISSUE-17) — empty skips attribution."""
         now = self.clock()
         if breaker is not None and not breaker.allow_fused():
-            return self._shed(chain, "breaker-open", "ok")
+            return self._shed(chain, "breaker-open", "ok", tenant)
         # partition-keyed identity: "sig@topic/partition" keys get their
         # own token buckets and SLO-verdict families (a hot partition
         # sheds alone), but warm bookkeeping is per-CHAIN — the AOT
@@ -246,13 +248,13 @@ class AdmissionController:
                 base
             )
         if cold:
-            return self._shed(chain, "cold-chain", "ok")
+            return self._shed(chain, "cold-chain", "ok", tenant)
         self._refresh_verdicts(now)
         verdict = self.chain_verdict(chain)
         if verdict == "breach":
-            return self._shed(chain, "breach-shed", verdict)
+            return self._shed(chain, "breach-shed", verdict, tenant)
         if verdict == "warn" and self.rng.random() < self.warn_shed:
-            return self._shed(chain, "warn-shed", verdict)
+            return self._shed(chain, "warn-shed", verdict, tenant)
         with self._lock:
             # LRU-bounded like the registry's breaker map: pop+reinsert
             # makes every ACCESS refresh recency, so churny short-lived
@@ -266,12 +268,16 @@ class AdmissionController:
                 self._buckets.pop(next(iter(self._buckets)))
             ok = bucket.take(cost, now, _REFILL_SCALE.get(verdict, 1.0))
         if not ok:
-            return self._shed(chain, "no-tokens", verdict)
+            return self._shed(chain, "no-tokens", verdict, tenant)
         TELEMETRY.add_admission("admit")
         return Decision(True, chain=chain, verdict=verdict)
 
-    def _shed(self, chain: str, reason: str, verdict: str) -> Rejected:
+    def _shed(
+        self, chain: str, reason: str, verdict: str, tenant: str = ""
+    ) -> Rejected:
         TELEMETRY.add_admission(reason)
+        if tenant:
+            TELEMETRY.add_tenant_shed(tenant)
         retry = (
             self.refresh_s
             if reason in ("breach-shed", "warn-shed")
@@ -352,19 +358,24 @@ class AdmissionPipeline:
 
     # -- intake --------------------------------------------------------------
 
-    def submit(self, chain: str, buf, breaker=None) -> Decision:
+    def submit(
+        self, chain: str, buf, breaker=None, tenant: str = ""
+    ) -> Decision:
         """Admit-or-shed one slice. Admitted slices enter the chain's
         fair queue (full queue downgrades the admission to a
         ``queue-full`` shed — the token is gone, which is correct: the
         queue IS the credit's backing store). Admitted slices also get
         their causal flow record (telemetry/flow.py): queue-wait and
         batcher residence land on it, and the batcher closes it after
-        the coalesced dispatch it rode."""
-        decision = self.controller.admit(chain, breaker=breaker)
+        the coalesced dispatch it rode. ``tenant`` rides both the shed
+        counters and the flow record (ISSUE-17 accounting plane)."""
+        decision = self.controller.admit(chain, breaker=breaker, tenant=tenant)
         if not decision:
             return decision
         if not self.queue.push(chain, buf):
             TELEMETRY.add_admission("queue-full")
+            if tenant:
+                TELEMETRY.add_tenant_shed(tenant)
             return Rejected(
                 chain=chain, reason="queue-full",
                 verdict=decision.verdict, retry_after_s=0.01,
@@ -372,7 +383,7 @@ class AdmissionPipeline:
         # the flow is born only once the slice is really IN (a
         # queue-full shed must not leave a stale flow, still counting
         # queue-wait, riding the buf into a later retry)
-        flow = TELEMETRY.begin_flow(chain)
+        flow = TELEMETRY.begin_flow(chain, tenant)
         if flow is not None:
             flow.decision = "admit"
             flow.note_queue()

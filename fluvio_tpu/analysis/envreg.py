@@ -217,6 +217,13 @@ REGISTRY: Tuple[EnvFlag, ...] = (
        "telemetry/timeseries.py", "rolling time-series window count"),
     _f("FLUVIO_SLO_WINDOW_S", "float", "10", "seconds",
        "telemetry/timeseries.py", "rolling time-series window length"),
+    _f("FLUVIO_SOAK_SCENARIO", "spec", "nominal",
+       "name or key=value[,key=value...]",
+       "cli/soak.py",
+       "default soak scenario when the CLI gets no positional spec"),
+    _f("FLUVIO_SOAK_TENANT_CAP", "int", "128", "tenant labels",
+       "telemetry/registry.py",
+       "per-tenant label cardinality cap (overflow folds to _overflow)"),
     _f("FLUVIO_STRIPE_OVERLAP", "int", "128", "bytes (4-aligned)",
        "smartengine/tpu/stripes.py",
        "shared bytes between consecutive stripes"),

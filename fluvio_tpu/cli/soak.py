@@ -1,0 +1,164 @@
+"""`soak` subcommand — run one multi-tenant open-loop soak scenario
+in-process and gate on its scored verdict.
+
+The scenario spec is the positional argument (grammar in
+soak/scenario.py: ``name[:key=value,...]`` or bare overrides over
+``nominal``), defaulting from ``FLUVIO_SOAK_SCENARIO``. The run drives
+real traffic — an in-process SPU server over TCP for the ``broker``
+backend, the `AdmissionPipeline`/`FairQueue` front door for
+``pipeline`` — then scores ONLY the observability surfaces into the
+verdict document (soak/score.py).
+
+Exit code is the deploy-gate contract, symmetric with ``analyze`` /
+``health`` / ``lag``: rc 0 iff the verdict is ``pass``, rc 1 on
+``collapse`` or ``fail`` — so ``fluvio-tpu soak && promote`` refuses
+to advance a build that melts down, starves a tenant, or loses a
+record under the scenario's load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+
+def add_soak_parser(sub) -> None:
+    p = sub.add_parser(
+        "soak",
+        help="run a multi-tenant soak scenario and gate on its verdict",
+    )
+    p.add_argument(
+        "scenario",
+        nargs="?",
+        default=None,
+        help=(
+            "scenario spec: a built-in name, name:key=value overrides, "
+            "or bare key=value overrides over 'nominal' "
+            "(default: FLUVIO_SOAK_SCENARIO or 'nominal')"
+        ),
+    )
+    p.add_argument(
+        "--format",
+        choices=("table", "json"),
+        default="table",
+        help="output format (default: table)",
+    )
+    p.add_argument(
+        "--seed",
+        type=int,
+        help="override the scenario's schedule seed",
+    )
+    p.add_argument(
+        "--list",
+        action="store_true",
+        help="list the built-in scenarios and exit",
+    )
+    p.set_defaults(fn=soak)
+
+
+def render_verdict_table(doc: dict) -> str:
+    """Verdict document -> operator-facing table. Pure function so the
+    surface tests render without running a scenario."""
+    from fluvio_tpu.cli.metrics import _rows_to_table
+
+    sections = [
+        (
+            f"soak {doc.get('scenario', '?')}: "
+            f"verdict {doc.get('verdict', '?')} "
+            f"(p99_age {doc.get('p99_age_ms', 0)}ms, "
+            f"shed_ratio {doc.get('shed_ratio', 0)}, "
+            f"fairness {doc.get('fairness', 0)})"
+        )
+    ]
+    checks = doc.get("checks") or []
+    if checks:
+        sections.append(
+            _rows_to_table(
+                [
+                    (
+                        c.get("name", "?"),
+                        "ok" if c.get("ok") else "FAIL",
+                        c.get("detail", ""),
+                    )
+                    for c in checks
+                ],
+                header=("check", "status", "detail"),
+            )
+        )
+    rows = [
+        (
+            tenant,
+            e.get("offered", 0),
+            e.get("served", 0),
+            e.get("shed", 0),
+            e.get("held", 0),
+            e.get("ratio", "-"),
+            "-" if e.get("age_p99_ms") is None else e["age_p99_ms"],
+        )
+        for tenant, e in sorted((doc.get("tenants") or {}).items())
+    ]
+    if rows:
+        sections.append(
+            _rows_to_table(
+                rows,
+                header=(
+                    "tenant", "offered", "served", "shed", "held",
+                    "ratio", "age_p99_ms",
+                ),
+            )
+        )
+    return "\n\n".join(sections)
+
+
+async def soak(args) -> int:
+    from fluvio_tpu.cli.common import CliError
+    from fluvio_tpu.soak import (
+        SCENARIOS,
+        build_verdict,
+        parse_scenario,
+        run_broker,
+        run_pipeline,
+    )
+    from fluvio_tpu.telemetry import TELEMETRY
+    from fluvio_tpu.telemetry import lag as lag_mod
+
+    if args.list:
+        for name, sc in sorted(SCENARIOS.items()):
+            print(
+                f"{name}: backend={sc.backend} tenants={sc.tenants} "
+                f"streams={sc.streams} records={sc.records} "
+                f"skew={sc.skew} profile={sc.profile}"
+            )
+        return 0
+
+    spec = args.scenario or os.environ.get("FLUVIO_SOAK_SCENARIO") or ""
+    try:
+        sc = parse_scenario(spec)
+    except ValueError as e:
+        raise CliError(str(e)) from e
+    if args.seed is not None:
+        sc = dataclasses.replace(sc, seed=args.seed)
+    if not TELEMETRY.enabled:
+        raise CliError(
+            "soak needs telemetry capture on (FLUVIO_TELEMETRY=0 set?)"
+        )
+
+    # the run owns the process's telemetry so the scorer reads exactly
+    # this run (run_scenario does the same for library callers; the CLI
+    # is already inside an event loop so it awaits run_broker directly)
+    TELEMETRY.reset()
+    lag_mod.reset_engine()
+    if sc.backend == "pipeline":
+        run = run_pipeline(sc)
+    elif sc.backend == "broker":
+        run = await run_broker(sc)
+    else:
+        raise CliError(f"unknown soak backend {sc.backend!r}")
+
+    doc = build_verdict(sc, run)
+    if args.format == "json":
+        print(json.dumps(doc, indent=1))
+    else:
+        print(render_verdict_table(doc))
+    return int(doc["rc"])

@@ -1,0 +1,36 @@
+"""Multi-tenant open-loop soak harness: scenario grammar, seeded load
+generator over the real serving paths, and a scorer that turns the
+existing observability surfaces into a gated pass/collapse/fail
+verdict. See ``scenario.py`` / ``generator.py`` / ``score.py``."""
+
+from fluvio_tpu.soak.generator import (
+    build_schedule,
+    plan_topics,
+    run_broker,
+    run_pipeline,
+    run_scenario,
+)
+from fluvio_tpu.soak.scenario import SCENARIOS, Scenario, parse_scenario
+from fluvio_tpu.soak.score import (
+    build_verdict,
+    collect_observed,
+    jain,
+    tenant_of_key,
+    validate_verdict,
+)
+
+__all__ = [
+    "SCENARIOS",
+    "Scenario",
+    "build_schedule",
+    "build_verdict",
+    "collect_observed",
+    "jain",
+    "parse_scenario",
+    "plan_topics",
+    "run_broker",
+    "run_pipeline",
+    "run_scenario",
+    "tenant_of_key",
+    "validate_verdict",
+]

@@ -1,0 +1,502 @@
+"""Open-loop multi-tenant soak generator.
+
+Drives a scenario's worth of tenant traffic through one of two real
+serving paths and leaves every observation on the EXISTING telemetry
+surfaces (lag engine, admission counters, per-tenant accounting plane,
+flow ring) — the scorer (soak/score.py) never reads generator state.
+
+- **broker backend**: an in-process SPU server and real TCP clients.
+  Every tenant stream is a topic named ``{tenant}.{stream}``; producers
+  append per a seeded open-loop arrival schedule (Zipf-skewed across
+  tenants, flat/ramp/spike/step profiles), consumers run SmartModule
+  streams through the admission gate exactly as production does. Churn
+  disconnects seeded consumers mid-stream and resumes them from the
+  committed offset on a fresh connection; ``partition_groups`` +
+  ``fail_group`` rebalance device placement mid-run; ``faults`` arms
+  the FLUVIO_FAULTS chaos registry for the run.
+- **pipeline backend**: the `AdmissionPipeline` library front door with
+  `FairQueue` weighted round-robin — the fairness leg. The generator
+  plays the server role: arrivals append to per-stream offered logs
+  (the lag engine's ``leo`` side), dispatches book served counts and
+  commits, so the scorer's ledger closes over the same surfaces.
+
+Open-loop means arrival times come from the schedule, never from
+service feedback: when the path sheds, offered keeps growing — which
+is exactly what makes queueing collapse VISIBLE in the score instead
+of silently converting into generator backpressure (the closed-loop
+lie; cf. the coordinated-omission literature).
+
+Determinism: every schedule is a pure function of the scenario
+(seeded ``random.Random``); with ``rate=0`` the wall-clock gaps
+collapse and only the seeded ordering remains — the tier-1 smoke mode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import shutil
+import tempfile
+import time
+from typing import Dict, List, Tuple
+
+from fluvio_tpu.resilience import faults
+from fluvio_tpu.soak.scenario import Scenario
+from fluvio_tpu.soak.score import collect_observed
+from fluvio_tpu.telemetry import TELEMETRY
+from fluvio_tpu.telemetry import lag as lag_mod
+
+logger = logging.getLogger(__name__)
+
+#: pass-through corpus filter: every soak value contains ``keep`` so
+#: served record counts equal offered record counts and the scorer's
+#: exactly-once ledger closes without generator-side bookkeeping
+KEEP_FILTER_SM = b"""
+@smartmodule.filter(dsl=dsl.FilterProgram(
+    predicate=dsl.Contains(arg=dsl.Value(), literal=b"keep")))
+def fil(record):
+    return b"keep" in record.value
+"""
+
+#: every SLO rule except consumer_lag, off — soak scenarios shed on
+#: lag alone so the collapse/recovery story has one moving part
+_OTHER_RULES_OFF = (
+    "e2e_p99:off=1;spill_ratio:off=1;error_rate:off=1;"
+    "compile_budget:off=1;recompile_rate:off=1;queue_depth:off=1;"
+    "hbm_staged:off=1;record_age_p99:off=1"
+)
+
+
+def plan_topics(sc: Scenario) -> Dict[str, int]:
+    """{topic: offered records} — Zipf-scaled per tenant, each tenant's
+    streams named ``{tenant}.s{j}``."""
+    out: Dict[str, int] = {}
+    for tenant, w in sc.zipf_weights().items():
+        n = max(1, round(sc.records * w))
+        for j in range(sc.streams):
+            out[f"{tenant}.s{j}"] = n
+    return out
+
+
+def _profile_time(sc: Scenario, frac: float, rng: random.Random) -> float:
+    """Map an event's schedule fraction into [0, 1) virtual time per
+    the arrival profile (density follows the profile's rate shape)."""
+    if sc.profile == "ramp":
+        # rate grows linearly: CDF t^2 -> arrivals cluster late
+        t = frac ** 0.5
+    elif sc.profile == "spike":
+        # half the load lands in the middle tenth of the run
+        if rng.random() < 0.5:
+            t = 0.45 + frac * 0.1
+        else:
+            t = frac
+    elif sc.profile == "step":
+        # rate triples at the 3/4 mark
+        t = frac * 0.75 if frac < 0.5 else 0.75 + (frac - 0.5) * 0.5
+    else:  # flat
+        t = frac
+    # seeded jitter breaks ties without breaking determinism
+    return min(max(t + rng.uniform(-0.01, 0.01), 0.0), 0.999)
+
+
+def build_schedule(
+    sc: Scenario, topics: Dict[str, int], per_event: int = 2
+) -> List[Tuple[float, str, List[bytes]]]:
+    """Seeded open-loop production schedule: ``(virtual_t, topic,
+    values)`` events of up to ``per_event`` records, globally ordered
+    by virtual time. Small events mean many stored batches, so holds
+    and faults strike mid-stream, not between runs."""
+    rng = random.Random(sc.seed)
+    events: List[Tuple[float, str, List[bytes]]] = []
+    for topic, n in sorted(topics.items()):
+        for base in range(0, n, per_event):
+            values = [
+                b"keep-%s-%d" % (topic.encode(), i)
+                for i in range(base, min(base + per_event, n))
+            ]
+            frac = (base + 1) / max(n, 1)
+            events.append((_profile_time(sc, frac, rng), topic, values))
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# broker backend — the real serving path over TCP
+# ---------------------------------------------------------------------------
+
+
+def _keep_filter_invocation():
+    from fluvio_tpu.schema.smartmodule import (
+        SmartModuleInvocation,
+        SmartModuleInvocationKind,
+        SmartModuleInvocationWasm,
+    )
+
+    return SmartModuleInvocation(
+        wasm=SmartModuleInvocationWasm.adhoc(KEEP_FILTER_SM),
+        kind=SmartModuleInvocationKind.FILTER,
+    )
+
+
+async def _quiesce_lag(timeout_s: float = 10.0) -> bool:
+    """Wait until every tracked partition's joined lag reads zero (the
+    final consumer acks are fire-and-forget; scoring a quiesced run
+    before they land would misread in-flight acks as loss)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        eng = lag_mod.engine()
+        eng.sample()
+        lags, _, _ = TELEMETRY.lag_families()
+        if all(v <= 0 for v in lags.values()):
+            return True
+        await asyncio.sleep(0.01)
+    return False
+
+
+async def run_broker(sc: Scenario) -> dict:
+    """One broker-backend soak run; returns the run report (the
+    observations live on the telemetry surfaces)."""
+    from fluvio_tpu import admission as admission_pkg
+    from fluvio_tpu import partition as partition_pkg
+    from fluvio_tpu.admission import AdmissionController
+    from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset
+    from fluvio_tpu.spu import SpuConfig, SpuServer
+    from fluvio_tpu.storage.config import ReplicaConfig
+    from fluvio_tpu.telemetry import SloEngine, TimeSeries
+    from fluvio_tpu.telemetry.slo import parse_slo_spec
+
+    tmp = tempfile.mkdtemp(prefix="fluvio-soak-")
+    config = SpuConfig(
+        id=7001,
+        public_addr="127.0.0.1:0",
+        log_base_dir=tmp,
+        replication=ReplicaConfig(base_dir=tmp),
+    )
+    config.smart_engine.backend = "auto"
+    server = SpuServer(config)
+
+    if sc.admission:
+        lag_rule = (
+            f"consumer_lag:target={sc.lag_target}"
+            if sc.lag_target > 0
+            else "consumer_lag:off=1"
+        )
+        slo_eng = SloEngine(
+            timeseries=TimeSeries(window_s=1e-4, capacity=4),
+            rules=parse_slo_spec(f"{lag_rule};{_OTHER_RULES_OFF}"),
+        )
+        ctl = AdmissionController(
+            slo_engine=slo_eng, refresh_s=0.0, tokens=1e9, refill=1e9,
+            rng=random.Random(sc.seed),
+        )
+        admission_pkg.set_gate(ctl)
+    else:
+        admission_pkg.set_gate(None)
+
+    pgate = None
+    if sc.partition_groups > 0:
+        from fluvio_tpu.partition.runtime import BrokerPartitionGate
+
+        pgate = BrokerPartitionGate(sc.partition_groups)
+        partition_pkg.set_gate(pgate)
+    if sc.faults:
+        faults.FAULTS.load_env_spec(sc.faults)
+
+    topics = plan_topics(sc)
+    schedule = build_schedule(sc, topics)
+    run = {
+        "backend": "broker",
+        "offered": dict(topics),
+        "events": len(schedule),
+        "churns": 0,
+        "failovers": 0,
+        "hold_seen": False,
+        "quiesced": False,
+    }
+    rng = random.Random(sc.seed + 1)
+    churned = (
+        set(rng.sample(sorted(topics), min(sc.churn, len(topics))))
+        if sc.churn > 0
+        else set()
+    )
+    cfg = ConsumerConfig(
+        disable_continuous=True,
+        max_bytes=sc.max_bytes,
+        smartmodules=[_keep_filter_invocation()],
+    )
+    got: Dict[str, list] = {t: [] for t in topics}
+
+    try:
+        await server.start()
+        for topic in topics:
+            server.ctx.create_replica(topic, 0)
+        client = await Fluvio.connect(server.public_addr)
+        producers = {
+            t: await client.topic_producer(t) for t in sorted(topics)
+        }
+
+        # -- open-loop production per the seeded schedule ----------------
+        midpoint = len(schedule) // 2
+        prev_t = 0.0
+        for i, (vt, topic, values) in enumerate(schedule):
+            if sc.rate > 0 and vt > prev_t:
+                # paced mode: virtual [0,1) maps onto records/rate secs
+                await asyncio.sleep(
+                    (vt - prev_t) * (sc.records / sc.rate)
+                )
+            prev_t = vt
+            futs = [await producers[topic].send(None, v) for v in values]
+            await producers[topic].flush()
+            for f in futs:
+                await f.wait()
+            if pgate is not None and sc.fail_group >= 0 and i == midpoint:
+                pgate.fail_group(sc.fail_group)
+                run["failovers"] += 1
+        for p in producers.values():
+            await p.close()
+
+        # -- consumption: every stream through the real gated path -------
+        async def consume(topic: str) -> None:
+            consumer = await client.partition_consumer(topic, 0)
+            async for rec in consumer.stream(Offset.beginning(), cfg):
+                got[topic].append(rec)
+
+        async def consume_churned(topic: str) -> None:
+            # session 1: partial consume, then a REAL disconnect (the
+            # connection dies, the server-side stream task with it)
+            cut = max(1, topics[topic] // 2)
+            c1 = await Fluvio.connect(server.public_addr)
+            consumer = await c1.partition_consumer(topic, 0)
+            async for rec in consumer.stream(Offset.beginning(), cfg):
+                got[topic].append(rec)
+                if len(got[topic]) >= cut:
+                    break
+            await c1.close()
+            run["churns"] += 1
+            # session 2: reconnect and resume one past the last record
+            resume = got[topic][-1].offset + 1 if got[topic] else 0
+            c2 = await Fluvio.connect(server.public_addr)
+            consumer = await c2.partition_consumer(topic, 0)
+            async for rec in consumer.stream(Offset.absolute(resume), cfg):
+                got[topic].append(rec)
+            await c2.close()
+
+        if sc.stop_on_hold:
+            # overload mode: leave the backlog in place and wait for
+            # the gate to shed-HOLD a slice — then score IN that state
+            # (collapse must be visible, not drained away)
+            tasks = [
+                asyncio.ensure_future(consume(t)) for t in sorted(topics)
+            ]
+            deadline = time.monotonic() + sc.timeout_s
+            while time.monotonic() < deadline:
+                if (
+                    TELEMETRY.admission.get("breach-shed", 0) >= 1
+                    and TELEMETRY.gauge_value("held_slices") >= 1
+                ):
+                    run["hold_seen"] = True
+                    break
+                await asyncio.sleep(0.01)
+            lag_mod.engine().sample()  # the join the scorer will read
+            # capture the surfaces IN the held state: cancelling the
+            # consumer tasks below releases every hold (the disconnect
+            # path) and zeroes held_slices — the collapse evidence
+            # lives in this snapshot, not in post-teardown reads
+            run["observed"] = collect_observed()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        else:
+            tasks = [
+                consume_churned(t) if t in churned else consume(t)
+                for t in sorted(topics)
+            ]
+            await asyncio.wait_for(
+                asyncio.gather(*tasks), timeout=sc.timeout_s
+            )
+            run["quiesced"] = await _quiesce_lag()
+            # collect while the replica leaders are alive — the lag
+            # engine joins through weakrefs that die with the server
+            run["observed"] = collect_observed()
+
+        run["served_client"] = {t: len(v) for t, v in got.items()}
+        await client.close()
+        return run
+    finally:
+        admission_pkg.reset_gate()
+        if pgate is not None:
+            partition_pkg.reset_gate()
+        if sc.faults:
+            faults.FAULTS.clear()
+        await server.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# pipeline backend — AdmissionPipeline + FairQueue (the fairness leg)
+# ---------------------------------------------------------------------------
+
+
+class _OfferedLog:
+    """hw()/leo() stand-in the lag engine joins against: ``leo`` is the
+    open-loop offered-record count for one stream, growing with every
+    scheduled arrival whether or not admission lets it through."""
+
+    def __init__(self) -> None:
+        self._leo = 0
+
+    def append(self, n: int) -> None:
+        self._leo += n
+
+    def leo(self) -> int:
+        return self._leo
+
+    def hw(self) -> int:
+        return self._leo
+
+
+class _Buf:
+    """Minimal admission buffer: count + width + a flow slot."""
+
+    def __init__(self, count: int) -> None:
+        self.count = count
+        self.width = 64
+        self.t0 = time.perf_counter()
+        self._flow = None
+
+
+def run_pipeline(sc: Scenario) -> dict:
+    """One pipeline-backend soak run: seeded Zipf arrivals submitted
+    tick-by-tick into a bounded FairQueue, drained by WRR at
+    ``pump_per_tick`` — offered/served/shed all land on the lag engine
+    and the per-tenant accounting plane."""
+    from fluvio_tpu.admission import (
+        AdmissionController,
+        AdmissionPipeline,
+    )
+    from fluvio_tpu.admission.fairness import FairQueue
+    from fluvio_tpu.telemetry import SloEngine, TimeSeries
+    from fluvio_tpu.telemetry.slo import parse_slo_spec
+
+    weights = sc.zipf_weights()
+    keys: Dict[str, str] = {}  # key -> tenant
+    logs: Dict[str, _OfferedLog] = {}
+    for tenant in weights:
+        for j in range(sc.streams):
+            key = f"soak@{tenant}.s{j}/0"
+            keys[key] = tenant
+            logs[key] = _OfferedLog()
+
+    slo_eng = SloEngine(
+        timeseries=TimeSeries(window_s=1e-4, capacity=4),
+        rules=parse_slo_spec(f"consumer_lag:off=1;{_OTHER_RULES_OFF}"),
+    )
+    ctl = AdmissionController(
+        slo_engine=slo_eng, refresh_s=3600.0, tokens=1e9, refill=1e9,
+        rng=random.Random(sc.seed),
+    )
+    served_cum: Dict[str, int] = {}
+
+    def dispatch(flush):
+        buf = flush.buffer
+        n = int(getattr(buf, "count", 0))
+        key = flush.chain
+        tenant = keys.get(key, "")
+        served_cum[key] = served_cum.get(key, 0) + n
+        age_s = max(time.perf_counter() - buf.t0, 0.0)
+        lag_mod.note_commit(key, served_cum[key])
+        lag_mod.note_serve(key, n, age_s)
+        TELEMETRY.add_tenant_served(tenant, n)
+        TELEMETRY.add_tenant_age(tenant, age_s)
+        return n
+
+    pipe = AdmissionPipeline(
+        dispatch=dispatch,
+        controller=ctl,
+        queue=FairQueue(max_depth=sc.queue_depth),
+    )
+    for key, tenant in keys.items():
+        weight = 1.0 if sc.wrr else weights[tenant]
+        # solo dispatch: the fairness leg measures the QUEUE, and a
+        # shape-bucket batcher between WRR and dispatch would blur
+        # per-stream service order
+        pipe.register_chain(key, weight=weight, coalesce=False)
+        lag_mod.engine().track(key, logs[key])
+
+    # arrivals: per-stream record totals -> 4-record submissions mapped
+    # onto 16 virtual ticks by the profile (same schedule machinery as
+    # the broker leg, reusing topic names as stream labels)
+    topics = {k.split("@", 1)[1].rsplit("/", 1)[0]: n
+              for k, n in (
+                  (key, max(1, round(sc.records * weights[tenant])))
+                  for key, tenant in keys.items()
+              )}
+    schedule = build_schedule(sc, topics, per_event=4)
+    by_tick: Dict[int, List[Tuple[str, int]]] = {}
+    for vt, topic, values in schedule:
+        by_tick.setdefault(int(vt * 16), []).append((topic, len(values)))
+
+    run = {
+        "backend": "pipeline",
+        "offered": dict(topics),
+        "events": len(schedule),
+        "ticks": len(by_tick),
+        "dropped": 0,
+    }
+    key_of = {t: k for k, t in (
+        (key, key.split("@", 1)[1].rsplit("/", 1)[0]) for key in keys
+    )}
+    for tick in sorted(by_tick):
+        for topic, n in by_tick[tick]:
+            key = key_of[topic]
+            logs[key].append(n)  # offered, admitted or not
+            d = pipe.submit(key, _Buf(n), tenant=keys[key])
+            if not d:
+                run["dropped"] += n  # open loop: a shed is a drop
+        pipe.pump(sc.pump_per_tick)
+    pipe.drain()
+    lag_mod.engine().sample()
+    run["served"] = dict(served_cum)
+    # open-loop drops stay on the ledger as backlog (lag > 0): a run
+    # that shed is scored in bounds mode, not exact-equality mode
+    run["quiesced"] = run["dropped"] == 0
+    # the offered logs are local: collect before their weakrefs die
+    run["observed"] = collect_observed()
+    return run
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def run_scenario(sc: Scenario, reset: bool = True) -> dict:
+    """Run one scenario to completion and return its run report. The
+    run OWNS the process's telemetry: by default it resets the registry
+    and the lag engine first so the scorer reads exactly this run."""
+    if not TELEMETRY.enabled:
+        raise ValueError(
+            "soak needs telemetry capture on (FLUVIO_TELEMETRY=0 set?)"
+        )
+    if reset:
+        TELEMETRY.reset()
+        lag_mod.reset_engine()
+    if sc.backend == "pipeline":
+        return run_pipeline(sc)
+    if sc.backend != "broker":
+        raise ValueError(f"unknown soak backend {sc.backend!r}")
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        loop = None
+    if loop is not None:
+        raise RuntimeError(
+            "run_scenario called inside a running event loop; "
+            "await run_broker(sc) instead"
+        )
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(run_broker(sc))
+    finally:
+        loop.close()

@@ -1,0 +1,186 @@
+"""Soak scenario grammar: the "millions of users" workload as a spec.
+
+A scenario is the full description of one multi-tenant open-loop run —
+how many tenants, how skewed their traffic is, the arrival profile,
+the churn and fault schedule, which backend carries it, and the
+thresholds the scorer judges the run against. Scenarios parse from a
+compact spec string (the ``fluvio-tpu soak`` positional argument and
+the ``FLUVIO_SOAK_SCENARIO`` default)::
+
+    nominal                      # a built-in, as-is
+    overload:records=40          # a built-in with overrides
+    tenants=8,skew=1.0,seed=3    # bare overrides over ``nominal``
+
+Grammar: ``name[:key=value[,key=value...]]`` — the name must be a
+built-in; bare ``key=value`` lists overlay ``nominal``. Values coerce
+to the field's declared type (int/float/bool/str); unknown keys are a
+``ValueError`` (the CLI turns it into a usage error, never a traceback).
+
+Tenant identity is carried by topic names: the generator names every
+topic ``{tenant}.{stream}`` and the broker's accounting plane labels
+served/shed/held counts by the prefix (``telemetry.registry.
+tenant_label``) — no protocol change anywhere.
+
+Two backends:
+
+- ``broker`` — the real serving path: an in-process SPU server, real
+  TCP clients, SmartModule consume streams, the admission gate and the
+  lag engine exactly as production wires them.
+- ``pipeline`` — the library front door (`AdmissionPipeline` +
+  `FairQueue` weighted round-robin): the fairness/starvation leg,
+  where WRR floors are the mechanism under test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict
+
+
+@dataclass
+class Scenario:
+    """One soak run's full configuration + scoring thresholds."""
+
+    name: str = "nominal"
+    #: ``broker`` (real SPU server over TCP) | ``pipeline`` (the
+    #: AdmissionPipeline/FairQueue library path)
+    backend: str = "broker"
+    tenants: int = 3
+    #: streams (topics) per tenant
+    streams: int = 2
+    #: records offered to the HEAVIEST tenant's each stream; lighter
+    #: tenants scale down by their Zipf weight
+    records: int = 6
+    #: Zipf exponent over tenant ranks (0 = uniform; 1.0 with 4
+    #: tenants = 4:1 heaviest:lightest)
+    skew: float = 0.0
+    #: arrival-rate shape over the run: flat | ramp | spike | step
+    profile: str = "flat"
+    seed: int = 17
+    #: open-loop pacing in records/s per stream; 0 = as-fast-as-
+    #: scheduled (the tier-1 smoke mode — ordering is still the seeded
+    #: schedule, only the wall-clock gaps collapse)
+    rate: float = 0.0
+    #: consumer disconnect/reconnect cycles spread over seeded streams
+    #: (each resumes from its committed offset — the failover leg)
+    churn: int = 0
+    #: consumer_lag SLO target; 0 leaves the lag rule off (nominal)
+    lag_target: int = 0
+    #: consume slice size; small values force many slices per stream
+    #: so holds strike mid-stream (the overload recipe)
+    max_bytes: int = 16 << 20
+    #: arm the admission gate (broker) / controller (pipeline)
+    admission: bool = True
+    #: WRR floors: equal fair-queue weights per stream (pipeline leg);
+    #: False weights streams by their offered share instead
+    wrr: bool = True
+    #: pipeline leg: bounded fair-queue depth (overflow = queue-full
+    #: shed) and slices pumped per virtual tick
+    queue_depth: int = 64
+    pump_per_tick: int = 64
+    #: broker leg: arm FLUVIO_PARTITIONS-style placement with this
+    #: many device groups (0 = off)...
+    partition_groups: int = 0
+    #: ...and fail this group at the production midpoint (-1 = never)
+    fail_group: int = -1
+    #: FLUVIO_FAULTS-grammar chaos spec armed for the run ("" = none)
+    faults: str = ""
+    #: overload mode: stop consuming once a slice is shed-HELD and
+    #: score the run in that state (collapse must be visible)
+    stop_on_hold: bool = False
+    #: scoring thresholds
+    min_fairness: float = 0.8
+    collapse_ratio: float = 0.5
+    starvation_floor: float = 0.25
+    #: wall-clock guard for the whole run (seconds)
+    timeout_s: float = 120.0
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def zipf_weights(self) -> Dict[str, float]:
+        """{tenant name: weight}, rank-ordered ``t00`` heaviest."""
+        return {
+            f"t{i:02d}": 1.0 / float(i + 1) ** self.skew
+            for i in range(self.tenants)
+        }
+
+
+#: built-in scenario library. The three smoke members are the tier-1
+#: acceptance set: ``nominal`` passes (rc 0), ``overload`` collapses
+#: (rc 1), ``fairness`` holds Jain >= 0.8 under 4:1 skew with WRR
+#: floors. The ``soak`` / ``spike`` members are the full slow runs.
+SCENARIOS: Dict[str, Scenario] = {
+    "nominal": Scenario(
+        name="nominal", backend="broker", tenants=3, streams=2,
+        records=6, skew=0.5, churn=1,
+    ),
+    "overload": Scenario(
+        name="overload", backend="broker", tenants=2, streams=1,
+        records=20, lag_target=4, max_bytes=64, stop_on_hold=True,
+        collapse_ratio=0.95,
+    ),
+    "fairness": Scenario(
+        name="fairness", backend="pipeline", tenants=4, streams=1,
+        records=24, skew=1.0, queue_depth=16, pump_per_tick=8,
+    ),
+    "soak": Scenario(
+        name="soak", backend="broker", tenants=12, streams=4,
+        records=64, skew=1.0, churn=6, rate=200.0, profile="ramp",
+        timeout_s=600.0,
+    ),
+    "spike": Scenario(
+        name="spike", backend="broker", tenants=8, streams=3,
+        records=48, skew=0.8, profile="spike", lag_target=64,
+        max_bytes=512, timeout_s=600.0,
+    ),
+}
+
+_BOOL_TRUE = ("1", "true", "yes", "on")
+_BOOL_FALSE = ("0", "false", "no", "off")
+
+
+def _coerce(field: dataclasses.Field, raw: str):
+    t = field.type
+    if t in (bool, "bool"):
+        low = raw.strip().lower()
+        if low in _BOOL_TRUE:
+            return True
+        if low in _BOOL_FALSE:
+            return False
+        raise ValueError(f"{field.name} wants a boolean, got {raw!r}")
+    if t in (int, "int"):
+        return int(raw)
+    if t in (float, "float"):
+        return float(raw)
+    return raw
+
+
+def parse_scenario(spec: str) -> Scenario:
+    """Spec string -> Scenario (see module doc for the grammar)."""
+    spec = (spec or "").strip()
+    if not spec:
+        spec = "nominal"
+    name, sep, overrides = spec.partition(":")
+    if not sep and "=" in name:
+        # bare key=value list: overlay the nominal baseline
+        name, overrides = "nominal", spec
+    base = SCENARIOS.get(name)
+    if base is None:
+        raise ValueError(
+            f"unknown soak scenario {name!r} "
+            f"(one of {', '.join(sorted(SCENARIOS))})"
+        )
+    fields = {f.name: f for f in dataclasses.fields(Scenario)}
+    kwargs: Dict = {}
+    for part in overrides.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, eq, raw = part.partition("=")
+        key = key.strip()
+        if not eq or key not in fields or key == "name":
+            raise ValueError(f"bad soak scenario field {part!r}")
+        kwargs[key] = _coerce(fields[key], raw.strip())
+    return dataclasses.replace(base, **kwargs)

@@ -1,0 +1,336 @@
+"""Soak scenario scoring: observability surfaces -> a gated verdict.
+
+The scorer is deliberately blind to the generator: everything it
+judges comes off surfaces any operator could read mid-incident — the
+lag engine's per-partition join (``lag_snapshot``), the admission
+reason counters, the per-tenant accounting plane
+(``tenant_families``), and the held-slices gauge. If the verdict can't
+be computed from those, the observability layer is what failed, and
+that IS the test.
+
+The checks:
+
+- **exactly-once accounting** — per ``chain@topic/partition`` key the
+  offered side is the replica's ``leo`` (streams start at offset 0).
+  The exactly-once surface is the COMMIT ledger: ``lag == 0`` after
+  quiesce means every offered record was consumed and acked by
+  position, and a position cannot double-count. ``served_records``
+  proves delivery (``served >= offered``) and must equal offered
+  exactly unless the run churned — a disconnect legitimately re-serves
+  records pushed but never consumed (at-least-once transport under
+  exactly-once commit; the redelivered tail is reported, not hidden).
+  A run scored mid-collapse demands only the no-loss / no-over-serve
+  bounds (in-flight acks make equality unfair there).
+- **queueing collapse** — offered vs served divergence
+  (``served/offered`` under the scenario threshold), or a slice
+  shed-HELD at scoring time with the backlog still open. Open-loop
+  arrivals make this visible; a closed-loop generator would hide it as
+  its own slowdown.
+- **fairness** — Jain's index over per-tenant goodput RATIOS
+  (served/offered), not raw served: under a 4:1 Zipf skew every
+  tenant fully served is perfectly fair (J = 1.0) even though raw
+  throughputs differ 4:1.
+- **starvation** — a tenant with offered work, a goodput ratio under
+  the floor, and shed/held evidence that admission (not the tenant)
+  did it.
+
+``build_verdict`` returns the machine-readable verdict document; rc 0
+iff the verdict is ``pass`` — symmetric with ``analyze``/``health``/
+``lag`` as a deploy gate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from fluvio_tpu.soak.scenario import Scenario
+from fluvio_tpu.telemetry import TELEMETRY
+from fluvio_tpu.telemetry import lag as lag_mod
+from fluvio_tpu.telemetry.registry import tenant_label
+
+#: admission reasons that count as sheds in the shed ratio (every
+#: decline the controller can emit except the degraded-path marker)
+SHED_REASONS = (
+    "breach-shed", "warn-shed", "queue-full", "no-tokens", "cold-chain",
+)
+
+
+def jain(values) -> float:
+    """Jain's fairness index: (sum x)^2 / (n * sum x^2) in (0, 1]."""
+    vals = list(values)
+    if not vals:
+        return 1.0
+    s = float(sum(vals))
+    s2 = float(sum(v * v for v in vals))
+    if s2 <= 0.0:
+        return 1.0
+    return (s * s) / (len(vals) * s2)
+
+
+def tenant_of_key(key: str) -> str:
+    """``chain@topic/partition`` -> tenant (the topic-name prefix)."""
+    topic = key.split("@", 1)[1] if "@" in key else key
+    topic = topic.rsplit("/", 1)[0]
+    return tenant_label(topic)
+
+
+def collect_observed() -> dict:
+    """One read of every surface the scorer consumes. Callers collect
+    AT the scoring moment (mid-hold for overload runs, post-quiesce for
+    nominal ones) — the surfaces are live, not a recording."""
+    snap = TELEMETRY.snapshot()
+    counters = snap.get("counters") or {}
+    served, shed, held, ages = TELEMETRY.tenant_families()
+    return {
+        "lag": lag_mod.lag_snapshot(),
+        "admission": dict(counters.get("admission") or {}),
+        "tenants": {
+            "served": served,
+            "shed": shed,
+            "held": held,
+            "age_p99_ms": {
+                k: round(h.percentile(99) * 1000, 3)
+                for k, h in ages.items()
+                if h.count
+            },
+        },
+        "held_now": TELEMETRY.gauge_value("held_slices"),
+        "quarantined": int(counters.get("quarantined") or 0),
+        "flows_total": int(snap.get("flows_total") or 0),
+    }
+
+
+def build_verdict(sc: Scenario, run: dict) -> dict:
+    """Score one finished run's observations against the scenario's
+    thresholds; see the module doc for each check's meaning."""
+    obs = run.get("observed") or collect_observed()
+    parts: Dict[str, dict] = obs["lag"].get("partitions") or {}
+    quarantined = int(obs.get("quarantined", 0))
+    quiesced = bool(run.get("quiesced", not sc.stop_on_hold))
+
+    # disconnects/failovers/faults may legitimately re-serve records the
+    # client never consumed before the cut; only then may served exceed
+    # offered (the commit ledger still closes exactly once)
+    churned_run = bool(
+        run.get("churns") or run.get("failovers") or sc.faults
+    )
+    by_key: Dict[str, dict] = {}
+    offered_t: Dict[str, int] = {}
+    lag_t: Dict[str, int] = {}
+    for key, entry in parts.items():
+        offered = entry.get("leo", entry.get("hw"))
+        if offered is None:
+            continue  # untracked leader: no offered side to close over
+        served = int(entry.get("served_records", 0))
+        lag = int(entry.get("lag", 0))
+        if quiesced and quarantined == 0:
+            ok = (
+                lag == 0
+                and served >= offered
+                and (churned_run or served == offered)
+            )
+        else:
+            # mid-collapse (or with quarantined records): no record
+            # lost, and none over-served absent a disconnect
+            ok = served + lag + quarantined >= offered and (
+                churned_run or served <= offered
+            )
+        by_key[key] = {
+            "offered": int(offered), "served": served, "lag": lag,
+            "ok": ok,
+        }
+        tenant = tenant_of_key(key)
+        offered_t[tenant] = offered_t.get(tenant, 0) + int(offered)
+        lag_t[tenant] = lag_t.get(tenant, 0) + lag
+
+    acct = obs["tenants"]
+    served_t: Dict[str, int] = dict(acct.get("served") or {})
+    shed_t: Dict[str, int] = dict(acct.get("shed") or {})
+    held_t: Dict[str, int] = dict(acct.get("held") or {})
+
+    total_offered = sum(offered_t.values())
+    total_served = sum(e["served"] for e in by_key.values())
+    total_lag = sum(e["lag"] for e in by_key.values())
+    accounting_ok = all(e["ok"] for e in by_key.values()) and bool(by_key)
+    # the accounting plane must agree with the per-key lag families —
+    # the tenant labels are a RELABELING of served records, not a
+    # second counter that can drift
+    plane_served = sum(served_t.values())
+    plane_consistent = plane_served == total_served
+    accounting_ok = accounting_ok and plane_consistent
+
+    tenants_doc: Dict[str, dict] = {}
+    ratios: List[float] = []
+    starved: List[str] = []
+    for tenant in sorted(set(offered_t) | set(served_t)):
+        if tenant == "_overflow":
+            continue  # the cardinality-cap fold has no offered side
+        offered = offered_t.get(tenant, 0)
+        served = served_t.get(tenant, 0)
+        ratio = min(served / offered, 1.0) if offered > 0 else 1.0
+        tenants_doc[tenant] = {
+            "offered": offered,
+            "served": served,
+            "shed": shed_t.get(tenant, 0),
+            "held": held_t.get(tenant, 0),
+            "ratio": round(ratio, 4),
+            "age_p99_ms": acct["age_p99_ms"].get(tenant),
+        }
+        if offered > 0:
+            ratios.append(ratio)
+            if ratio < sc.starvation_floor and (
+                shed_t.get(tenant, 0) > 0 or held_t.get(tenant, 0) > 0
+            ):
+                starved.append(tenant)
+
+    fairness = round(jain(ratios), 4)
+    admission = obs.get("admission") or {}
+    sheds = sum(admission.get(r, 0) for r in SHED_REASONS)
+    admits = admission.get("admit", 0)
+    shed_ratio = round(sheds / max(admits + sheds, 1), 4)
+    p99_age_ms = max(
+        [e.get("age_p99_ms", 0.0) or 0.0 for e in parts.values()],
+        default=0.0,
+    )
+
+    served_ratio = (  # clamp: redelivery must not mask a collapse
+        min(total_served / total_offered, 1.0)
+        if total_offered > 0
+        else 1.0
+    )
+    held_now = float(obs.get("held_now", 0))
+    collapsed = served_ratio < sc.collapse_ratio or (
+        held_now > 0 and served_ratio < 1.0
+    )
+
+    checks = [
+        {
+            "name": "exactly_once_accounting",
+            "ok": accounting_ok,
+            "detail": (
+                f"offered={total_offered} served={total_served} "
+                f"lag={total_lag} quarantined={quarantined} "
+                f"plane={plane_served} "
+                f"redelivered={max(total_served - total_offered, 0)} "
+                f"mode={'exact' if quiesced else 'bounds'}"
+            ),
+        },
+        {
+            "name": "no_queueing_collapse",
+            "ok": not collapsed,
+            "detail": (
+                f"served_ratio={served_ratio:.3f} "
+                f"threshold={sc.collapse_ratio} held_now={held_now:g}"
+            ),
+        },
+        {
+            "name": "fairness",
+            "ok": fairness >= sc.min_fairness,
+            "detail": f"jain={fairness} floor={sc.min_fairness}",
+        },
+        {
+            "name": "no_starvation",
+            "ok": not starved,
+            "detail": (
+                f"floor={sc.starvation_floor} starved={starved or '-'}"
+            ),
+        },
+    ]
+    if collapsed:
+        verdict = "collapse"
+    elif all(c["ok"] for c in checks):
+        verdict = "pass"
+    else:
+        verdict = "fail"
+
+    return {
+        "scenario": sc.name,
+        "spec": sc.to_dict(),
+        "verdict": verdict,
+        "rc": 0 if verdict == "pass" else 1,
+        "p99_age_ms": round(float(p99_age_ms), 3),
+        "shed_ratio": shed_ratio,
+        "fairness": fairness,
+        "offered": total_offered,
+        "served": total_served,
+        "collapse": {
+            "detected": collapsed,
+            "served_ratio": round(served_ratio, 4),
+            "threshold": sc.collapse_ratio,
+            "held_now": held_now,
+        },
+        "accounting": {
+            "ok": accounting_ok,
+            "mode": "exact" if quiesced else "bounds",
+            "offered": total_offered,
+            "served": total_served,
+            "lag": total_lag,
+            "quarantined": quarantined,
+            "plane_served": plane_served,
+            "redelivered": max(total_served - total_offered, 0),
+            "by_key": by_key,
+        },
+        "tenants": tenants_doc,
+        "starvation": {
+            "floor": sc.starvation_floor,
+            "starved": starved,
+        },
+        "slo": obs["lag"].get("verdict", "ok"),
+        "checks": checks,
+        "run": {
+            k: v for k, v in run.items() if k != "observed"
+        },
+    }
+
+
+# -- verdict-document schema (the ``soak --json`` round-trip contract) -------
+
+#: top-level field -> required type(s); the CLI json output must
+#: round-trip through json and validate against exactly this
+VERDICT_SCHEMA: Dict[str, tuple] = {
+    "scenario": (str,),
+    "spec": (dict,),
+    "verdict": (str,),
+    "rc": (int,),
+    "p99_age_ms": (int, float),
+    "shed_ratio": (int, float),
+    "fairness": (int, float),
+    "offered": (int,),
+    "served": (int,),
+    "collapse": (dict,),
+    "accounting": (dict,),
+    "tenants": (dict,),
+    "starvation": (dict,),
+    "slo": (str,),
+    "checks": (list,),
+    "run": (dict,),
+}
+
+VERDICT_VALUES = ("pass", "collapse", "fail")
+
+
+def validate_verdict(doc: dict) -> List[str]:
+    """Schema check for a verdict document; returns the violations
+    (empty = valid). Used by the CLI round-trip test and any consumer
+    that gates on the document (the autoscaling acceptance gate)."""
+    errors: List[str] = []
+    for field, types in VERDICT_SCHEMA.items():
+        if field not in doc:
+            errors.append(f"missing field {field!r}")
+        elif not isinstance(doc[field], types) or isinstance(
+            doc[field], bool
+        ):
+            errors.append(
+                f"field {field!r} has type {type(doc[field]).__name__}"
+            )
+    if not errors:
+        if doc["verdict"] not in VERDICT_VALUES:
+            errors.append(f"verdict {doc['verdict']!r} not in vocabulary")
+        if doc["rc"] not in (0, 1):
+            errors.append(f"rc {doc['rc']!r} not 0|1")
+        if (doc["rc"] == 0) != (doc["verdict"] == "pass"):
+            errors.append("rc must be 0 iff verdict is pass")
+        for c in doc["checks"]:
+            if not {"name", "ok", "detail"} <= set(c):
+                errors.append(f"check missing fields: {c}")
+    return errors

@@ -68,6 +68,7 @@ from fluvio_tpu.smartengine.engine import EngineError, SmartModuleChainInitError
 from fluvio_tpu.smartengine.metering import SmartModuleFuelError
 from fluvio_tpu.telemetry import TELEMETRY
 from fluvio_tpu.telemetry import lag as lag_mod
+from fluvio_tpu.telemetry.registry import tenant_label
 from fluvio_tpu.transport.service import FluvioService
 from fluvio_tpu.transport.sink import ExclusiveSink, FluvioSink
 from fluvio_tpu.transport.socket import FluvioSocket, SocketClosed
@@ -493,6 +494,10 @@ class StreamFetchHandler:
         # streams (matching the admission/SLO key), stream@topic/partition
         # for plain consumes
         self._lag_key = f"stream@{req.topic}/{req.partition}"
+        # tenant identity (ISSUE-17 soak plane): the topic-name prefix
+        # before the first dot — every served/shed/held count and
+        # record-age observation this stream books is tenant-labeled
+        self._tenant = tenant_label(req.topic)
 
     async def run(self) -> None:
         try:
@@ -504,10 +509,11 @@ class StreamFetchHandler:
                 "stream fetch failed (%s-%s)", self.req.topic, self.req.partition
             )
         finally:
-            if self._hold_t0 is not None:
-                # stream died mid-hold: the gauge must not leak
-                self._hold_t0 = None
-                TELEMETRY.gauge_add("held_slices", -1)
+            # stream died mid-hold: release through the same path as a
+            # re-admit so the gauge drops AND the hold duration is
+            # booked (the bare gauge decrement used to lose the
+            # admission_hold_seconds observation on disconnect)
+            self._release_hold()
 
     def _note_hold(self) -> None:
         """First shed of a held slice: stamp the hold + raise the gauge
@@ -515,6 +521,7 @@ class StreamFetchHandler:
         if self._hold_t0 is None:
             self._hold_t0 = time.monotonic()
             TELEMETRY.gauge_add("held_slices", 1)
+            TELEMETRY.add_tenant_held(self._tenant)
 
     def _release_hold(self, flow=None) -> None:
         """A held slice was re-admitted: book the hold duration (the
@@ -602,13 +609,16 @@ class StreamFetchHandler:
                         # the admission decision — and survives the
                         # hold-retry loop, so held time is on its record
                         if flow is None:
-                            flow = TELEMETRY.begin_flow(self._lag_key)
+                            flow = TELEMETRY.begin_flow(
+                                self._lag_key, self._tenant
+                            )
                         # admission front door: a health/credit shed
                         # HOLDS the slice (offsets untouched — nothing
                         # lost, nothing duplicated); breaker-open
                         # proceeds, the per-record path serves it
                         rej = admission_check(
-                            chain, topic=req.topic, partition=req.partition
+                            chain, topic=req.topic, partition=req.partition,
+                            tenant=self._tenant,
                         )
                         if rej is not None and rej.reason != "breaker-open":
                             if flow is not None:
@@ -675,14 +685,17 @@ class StreamFetchHandler:
             shed = None
             if planned < leader.read_bound(req.isolation):
                 if held_flow is None:
-                    held_flow = TELEMETRY.begin_flow(self._lag_key)
+                    held_flow = TELEMETRY.begin_flow(
+                        self._lag_key, self._tenant
+                    )
                 # admission front door for the speculative read: a shed
                 # skips THIS slice's intake (the in-flight one still
                 # finishes below) and, when nothing is in flight,
                 # sleeps out the backpressure hint — offsets never
                 # advance past a shed slice, so the retry re-reads it
                 shed = admission_check(
-                    chain, topic=req.topic, partition=req.partition
+                    chain, topic=req.topic, partition=req.partition,
+                    tenant=self._tenant,
                 )
                 if shed is not None and shed.reason == "breaker-open":
                     # per-record path serves breaker-open; the flow
@@ -820,13 +833,13 @@ class StreamFetchHandler:
             # streaming lag: served-record rate + ONE end-to-end
             # record-age observation per pushed slice (append wall-time
             # from the first output batch's header -> now)
-            lag_mod.note_serve(
-                self._lag_key,
-                result.records.total_records(),
-                lag_mod.serve_age_s(
-                    result.records.batches[0].header.first_timestamp
-                ),
+            served = result.records.total_records()
+            age_s = lag_mod.serve_age_s(
+                result.records.batches[0].header.first_timestamp
             )
+            lag_mod.note_serve(self._lag_key, served, age_s)
+            TELEMETRY.add_tenant_served(self._tenant, served)
+            TELEMETRY.add_tenant_age(self._tenant, age_s)
         return result.next_offset
 
     async def _wait_for_ack(self, target: int, end_wait: asyncio.Future) -> None:

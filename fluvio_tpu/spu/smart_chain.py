@@ -481,7 +481,7 @@ def admission_chain_sig(chain, topic=None, partition=None) -> str:
     return f"{sig}@{topic or 't'}/{partition}"
 
 
-def admission_check(chain, topic=None, partition=None):
+def admission_check(chain, topic=None, partition=None, tenant=""):
     """The broker front door: one admission decision for one read slice.
 
     Returns None when admitted (or admission is disabled), else the
@@ -497,6 +497,12 @@ def admission_check(chain, topic=None, partition=None):
     constructs a dispatched `PendingSlice` — the
     ``inflight_queue_depth`` gauge must not move for it (regression-
     pinned in tests/test_admission.py).
+
+    ``tenant`` attributes real sheds (not breaker-open, which the
+    caller serves anyway) to the per-tenant accounting plane. The
+    attribution happens HERE, not inside the gate: ``set_gate()``
+    installs duck-typed controllers whose ``admit(chain, cost,
+    breaker)`` contract predates tenancy and must keep working.
     """
     ctl = _admission_gate()
     if ctl is None:
@@ -505,7 +511,11 @@ def admission_check(chain, topic=None, partition=None):
         admission_chain_sig(chain, topic, partition),
         breaker=getattr(chain, "breaker", None),
     )
-    return None if decision else decision
+    if decision:
+        return None
+    if tenant and decision.reason != "breaker-open":
+        TELEMETRY.add_tenant_shed(tenant)
+    return decision
 
 
 def admission_note_warm(chain, buckets) -> None:

@@ -50,14 +50,17 @@ class SliceFlow:
     path = "slice"
 
     __slots__ = (
-        "flow_id", "chain", "t0", "t_end", "records", "phases",
+        "flow_id", "chain", "tenant", "t0", "t_end", "records", "phases",
         "decision", "holds", "cause", "sources", "dispatch_t",
         "_q_t0", "_b_t0",
     )
 
-    def __init__(self, flow_id: int, chain: str = "") -> None:
+    def __init__(self, flow_id: int, chain: str = "", tenant: str = "") -> None:
         self.flow_id = flow_id
         self.chain = chain
+        #: tenant label (topic-name prefix) — the soak scorer joins
+        #: flow-ring records against the per-tenant counter families
+        self.tenant = tenant
         self.t0 = time.perf_counter()
         self.t_end: Optional[float] = None
         self.records = 0
@@ -137,6 +140,8 @@ class SliceFlow:
         }
         if self.chain:
             d["chain"] = self.chain
+        if self.tenant:
+            d["tenant"] = self.tenant
         if self.decision:
             d["decision"] = self.decision
         if self.holds:

@@ -109,6 +109,10 @@ def render_prometheus(
         consumer_lag = dict(t.consumer_lag)
         served_records = dict(t.served_records)
         record_age = {k: h.copy() for k, h in t.record_age.items()}
+        tenant_served = dict(t.tenant_served)
+        tenant_shed = dict(t.tenant_shed)
+        tenant_held = dict(t.tenant_held)
+        tenant_age = {k: h.copy() for k, h in t.tenant_age.items()}
     spans_dropped = t.spans.dropped
 
     _histogram(
@@ -334,6 +338,40 @@ def render_prometheus(
             "End-to-end record age (append wall-time -> served) per "
             "chain@topic/partition.",
             [({"key": k}, h) for k, h in sorted(record_age.items())],
+        )
+
+    # -- per-tenant accounting plane (ISSUE-17) ------------------------------
+    w.header(
+        f"{_PREFIX}_tenant_served_records_total",
+        "Records served per tenant label (cardinality-capped; overflow "
+        "folds into _overflow).",
+        "counter",
+    )
+    for tenant, v in sorted(tenant_served.items()):
+        w.sample(
+            f"{_PREFIX}_tenant_served_records_total", {"tenant": tenant}, v
+        )
+    w.header(
+        f"{_PREFIX}_tenant_shed_total",
+        "Admission shed decisions per tenant label.",
+        "counter",
+    )
+    for tenant, v in sorted(tenant_shed.items()):
+        w.sample(f"{_PREFIX}_tenant_shed_total", {"tenant": tenant}, v)
+    w.header(
+        f"{_PREFIX}_tenant_held_total",
+        "Shed-hold cycles entered per tenant label.",
+        "counter",
+    )
+    for tenant, v in sorted(tenant_held.items()):
+        w.sample(f"{_PREFIX}_tenant_held_total", {"tenant": tenant}, v)
+    if tenant_age:
+        _histogram(
+            w,
+            f"{_PREFIX}_tenant_record_age_seconds",
+            "End-to-end record age (append wall-time -> served) per "
+            "tenant label.",
+            [({"tenant": k}, h) for k, h in sorted(tenant_age.items())],
         )
 
     # -- gauges --------------------------------------------------------------

@@ -61,6 +61,16 @@ COMPILE_STORM_N = int(env_int("FLUVIO_COMPILE_STORM_N"))
 COMPILE_STORM_WINDOW_S = float(env_float("FLUVIO_COMPILE_STORM_WINDOW_S"))
 
 
+def tenant_label(topic: str) -> str:
+    """Tenant identity carried by the topic name: the soak generator
+    names topics ``{tenant}.{stream}``, so the prefix before the first
+    dot IS the tenant — no protocol change, and single-segment topics
+    stay their own (degenerate) tenant."""
+    if not topic:
+        return ""
+    return topic.split(".", 1)[0]
+
+
 class PipelineTelemetry:
     def __init__(self, ring_capacity: int = SPAN_RING_CAPACITY) -> None:
         self.enabled = env_bool("FLUVIO_TELEMETRY")
@@ -168,6 +178,18 @@ class PipelineTelemetry:
         self.consumer_lag: Dict[str, float] = {}
         self.served_records: Dict[str, int] = {}
         self.record_age: Dict[str, LatencyHistogram] = {}
+        # per-tenant accounting plane (ISSUE-17): served/shed/held
+        # counters and record-age histograms keyed by tenant label (the
+        # topic-name prefix). Label cardinality is HARD-capped — a
+        # million-tenant soak run folds everyone past the cap into ONE
+        # "_overflow" bucket instead of growing these dicts unboundedly
+        # (LRU eviction would silently restart the hottest tenant's
+        # counters, so overflow-fold is the honest bound here).
+        self.tenant_cap = int(env_int("FLUVIO_SOAK_TENANT_CAP"))
+        self.tenant_served: Dict[str, int] = {}
+        self.tenant_shed: Dict[str, int] = {}
+        self.tenant_held: Dict[str, int] = {}
+        self.tenant_age: Dict[str, LatencyHistogram] = {}
         # pull-join hook: telemetry/lag.py installs its sampler here so
         # the time-series tick (and the Prometheus scrape) re-joins
         # committed offsets against replica high watermarks at the
@@ -233,7 +255,9 @@ class PipelineTelemetry:
 
     # -- slice flows (per-slice causal tracing, ISSUE-15) --------------------
 
-    def begin_flow(self, chain: str = "") -> Optional[SliceFlow]:
+    def begin_flow(
+        self, chain: str = "", tenant: str = ""
+    ) -> Optional[SliceFlow]:
         """A new slice's flow record, or None when capture/flow tracing
         is off (every caller guards on that — the zero-cost seam)."""
         if not (self.enabled and self.flow_trace):
@@ -241,7 +265,7 @@ class PipelineTelemetry:
         with self._lock:
             self._flow_seq += 1
             fid = self._flow_seq
-        return SliceFlow(fid, chain)
+        return SliceFlow(fid, chain, tenant)
 
     def end_flow(self, flow: Optional[SliceFlow], records: int = 0) -> None:
         """Close a slice flow: record its lifecycle phases into the
@@ -337,6 +361,61 @@ class PipelineTelemetry:
                 dict(self.consumer_lag),
                 dict(self.served_records),
                 {k: h.copy() for k, h in self.record_age.items()},
+            )
+
+    # -- per-tenant accounting (ISSUE-17 soak plane) --------------------------
+
+    def _tenant_key(self, d: dict, tenant: str) -> str:
+        """Resolve the bounded label for ``tenant`` in family ``d``
+        (caller holds the lock): known tenants and tenants under the cap
+        keep their own label; everyone else folds into "_overflow"."""
+        if tenant in d or len(d) < self.tenant_cap:
+            return tenant
+        return "_overflow"
+
+    def add_tenant_served(self, tenant: str, records: int) -> None:
+        if not self.enabled or not tenant or records <= 0:
+            return
+        with self._lock:
+            k = self._tenant_key(self.tenant_served, tenant)
+            self.tenant_served[k] = self.tenant_served.get(k, 0) + records
+
+    def add_tenant_shed(self, tenant: str) -> None:
+        if not self.enabled or not tenant:
+            return
+        with self._lock:
+            k = self._tenant_key(self.tenant_shed, tenant)
+            self.tenant_shed[k] = self.tenant_shed.get(k, 0) + 1
+
+    def add_tenant_held(self, tenant: str) -> None:
+        if not self.enabled or not tenant:
+            return
+        with self._lock:
+            k = self._tenant_key(self.tenant_held, tenant)
+            self.tenant_held[k] = self.tenant_held.get(k, 0) + 1
+
+    def add_tenant_age(self, tenant: str, seconds: float) -> None:
+        """One served-slice record-age observation attributed to a
+        tenant (one per SLICE, never per record — same cadence as
+        `add_record_age`)."""
+        if not self.enabled or not tenant:
+            return
+        with self._lock:
+            k = self._tenant_key(self.tenant_age, tenant)
+            h = self.tenant_age.get(k)
+            if h is None:
+                h = self.tenant_age.setdefault(k, LatencyHistogram())
+            h.record(max(seconds, 0.0))
+
+    def tenant_families(self):
+        """(served, shed, held, age copies) under ONE lock hold — the
+        soak scorer and the Prometheus export read all four coherently."""
+        with self._lock:
+            return (
+                dict(self.tenant_served),
+                dict(self.tenant_shed),
+                dict(self.tenant_held),
+                {k: h.copy() for k, h in self.tenant_age.items()},
             )
 
     def refresh_lag(self) -> None:
@@ -605,6 +684,16 @@ class PipelineTelemetry:
                 "record_age": {
                     k: h.copy() for k, h in self.record_age.items()
                 },
+                # per-tenant accounting plane (soak scorer + SLO layer
+                # window these like the lag families above)
+                "tenants": {
+                    "served": dict(self.tenant_served),
+                    "shed": dict(self.tenant_shed),
+                    "held": dict(self.tenant_held),
+                    "age": {
+                        k: h.copy() for k, h in self.tenant_age.items()
+                    },
+                },
             }
 
     def path_records(self) -> Dict[str, int]:
@@ -684,6 +773,16 @@ class PipelineTelemetry:
                         if h.count
                     },
                 },
+                "tenants": {
+                    "served": dict(self.tenant_served),
+                    "shed": dict(self.tenant_shed),
+                    "held": dict(self.tenant_held),
+                    "age": {
+                        k: h.to_dict()
+                        for k, h in self.tenant_age.items()
+                        if h.count
+                    },
+                },
             } | self._ring_stats()
 
     def _ring_stats(self) -> dict:
@@ -747,6 +846,10 @@ class PipelineTelemetry:
             self.consumer_lag = {}
             self.served_records = {}
             self.record_age = {}
+            self.tenant_served = {}
+            self.tenant_shed = {}
+            self.tenant_held = {}
+            self.tenant_age = {}
             self._flow_seq = 0
             # lag_sampler survives reset on purpose: the bench resets
             # between configs and the lag engine's tracked leaders must

@@ -787,6 +787,75 @@ def test_dfa_line_key_rides_compact_line():
     assert "dfa" not in json.loads(json.dumps(b._compact_line(out2)))
 
 
+def test_soak_line_key_rides_compact_line():
+    """ISSUE-17: a tiny ``soak:{p99_age,shed_ratio}`` key rides the
+    compact line when the soak family ran (the nominal scenario's
+    steady-state health); full per-scenario verdict documents stay in
+    BENCH_DETAIL.json only."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = {"2_filter_map": dict(GOOD)}
+    results["soak"] = {
+        "scenarios": {
+            "nominal": {"verdict": "pass", "rc": 0, "expected_rc": 0,
+                        "p99_age_ms": 3.2, "shed_ratio": 0.0,
+                        "fairness": 1.0,
+                        "checks": {"exactly_once_accounting": True}},
+            "overload": {"verdict": "collapse", "rc": 1, "expected_rc": 1,
+                         "p99_age_ms": 0.0, "shed_ratio": 0.6,
+                         "fairness": 1.0,
+                         "checks": {"no_queueing_collapse": False}},
+        },
+        "soak": {"p99_age": 3.2, "shed_ratio": 0.0, "ok": 2, "of": 2},
+    }
+    out, rc = b._build_output(results)
+    assert rc == 0
+    # the aux section never becomes the headline
+    assert out["value"] == 1000
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["soak"] == {"p99_age": 3.2, "shed_ratio": 0.0}
+    # the bulky per-scenario verdicts never reach the line
+    assert "scenarios" not in json.dumps(line)
+    # without a soak block the key stays off entirely
+    out2, _ = b._build_output({"2_filter_map": dict(GOOD)})
+    assert "soak" not in json.loads(json.dumps(b._compact_line(out2)))
+
+
+def test_soak_key_fits_contract_and_trims_before_lag():
+    """The full-matrix line with the soak key stays ≤1500 chars and the
+    blowup trim ladder drops ``soak`` BEFORE ``lag`` (and therefore
+    before ``part``/``link``, the sentinel's contract field)."""
+    import json
+    import re
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = _full_results()
+    results["soak"] = {
+        "scenarios": {
+            name: {"verdict": "pass", "rc": 0, "expected_rc": 0,
+                   "p99_age_ms": 4.1, "shed_ratio": 0.02, "fairness": 0.97,
+                   "checks": {"exactly_once_accounting": True,
+                              "no_queueing_collapse": True,
+                              "fairness": True, "no_starvation": True}}
+            for name in ("nominal", "overload", "fairness")
+        },
+        "soak": {"p99_age": 4.1, "shed_ratio": 0.02, "ok": 3, "of": 3},
+    }
+    out, _ = b._build_output(results)
+    line = json.dumps(b._compact_line(out))
+    assert len(line) <= 1500, f"compact line is {len(line)} chars"
+    parsed = json.loads(line)
+    assert parsed["soak"] == {"p99_age": 4.1, "shed_ratio": 0.02}
+    src = open(_BENCH_PATH).read()
+    ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
+    assert ladder.index('"soak"') < ladder.index('"lag"')
+    assert ladder.index('"soak"') < ladder.index('"part"')
+    assert ladder.index('"soak"') < ladder.index('"link"')
+
+
 def test_dfa_key_fits_contract_and_trims_before_link():
     """The full-matrix line with the dfa key stays ≤1500 chars and the
     blowup trim ladder drops ``dfa`` BEFORE ``lag``/``part``/``link``

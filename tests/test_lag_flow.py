@@ -394,6 +394,115 @@ class TestLagKeyedShedding:
             for k in TELEMETRY.slo_breaches
         ), TELEMETRY.slo_breaches
 
+    def test_two_tenants_coalesce_while_third_sheds_on_breach(self):
+        """ISSUE-17 chaos pin: tenants A and B ride ONE cached chain
+        and their slices COALESCE into a single batcher flush, while
+        tenant C — same chain, hot partition in consumer_lag breach —
+        is shed with tenant attribution. Once the hot backlog drains C
+        serves too; the commit ledger closes per key (exactly-once)
+        and every served slice's flow chain renders connected."""
+        from fluvio_tpu.admission import AdmissionPipeline
+
+        clk = {"t": 1000.0}
+        ctl, eng = self._controller(clk)
+        chain = _filter_chain()
+        ex = chain.tpu_chain
+        sig = ex._chain_sig
+        shared, hot = f"{sig}@shared/0", f"{sig}@hot/0"
+
+        # keep strong refs: the engine tracks leaders by weakref
+        shared_leader, hot_leader = FakeLeader(8), FakeLeader(10_000)
+        leng = lag_mod.engine()
+        leng.track(shared, shared_leader)
+        leng.track(hot, hot_leader)
+        leng.note_commit(shared, 0)
+        leng.note_commit(hot, 10)  # residual backlog: lag 9_990 >> 100
+        eng.timeseries.force_tick()
+        clk["t"] += 1.0
+
+        committed = {shared: 0, hot: 10}
+
+        def dispatch(flush):
+            # the serving side of the ledger: process the coalesced
+            # buffer, ack its positions, attribute per-tenant goodput
+            # through the flow records the slices rode in on
+            out = ex.process_buffer(flush.buffer)
+            n = int(flush.buffer.count)
+            committed[flush.chain] += n
+            lag_mod.note_commit(flush.chain, committed[flush.chain])
+            lag_mod.note_serve(flush.chain, n, 0.001)
+            for buf in flush.items:
+                fl = getattr(buf, "_flow", None)
+                if fl is not None and fl.tenant:
+                    TELEMETRY.add_tenant_served(fl.tenant, int(buf.count))
+            return out
+
+        pipe = AdmissionPipeline(dispatch=dispatch, controller=ctl)
+        pipe.register_chain(shared)
+        pipe.register_chain(hot)
+
+        # tenants A and B: admitted onto the same chain key
+        da = pipe.submit(shared, _buf(4, "keep-a"), tenant="ta")
+        db = pipe.submit(shared, _buf(4, "keep-b"), tenant="tb")
+        assert da.admitted and db.admitted
+        # tenant C: same cached chain, hot partition — breach-shed,
+        # and the shed lands on C's tenant counter
+        dc = pipe.submit(hot, _buf(4, "keep-c"), tenant="tc")
+        assert not dc and dc.reason == "breach-shed"
+        _, shed_t, _, _ = TELEMETRY.tenant_families()
+        assert shed_t.get("tc") == 1, shed_t
+
+        pipe.pump()
+        flushes = pipe.batcher.flush_all()
+        assert len(flushes) == 1 and len(flushes[0].items) == 2, (
+            "tenant A and B slices must coalesce into ONE flush"
+        )
+        snap1 = lag_mod.lag_snapshot()["partitions"]
+        assert snap1[shared]["lag"] == 0
+        assert snap1[shared]["served_records"] == 8  # == offered (leo)
+
+        # the backlog drains out-of-band down to a 4-record tail; the
+        # next verdict join reads lag 4 (under target) and C re-admits
+        leng.note_commit(hot, 9_996)
+        committed[hot] = 9_996
+        clk["t"] += 1.0
+        dc = pipe.submit(hot, _buf(4, "keep-c"), tenant="tc")
+        assert dc.admitted, dc
+        pipe.pump()
+        flushes = pipe.batcher.flush_all()
+        assert len(flushes) == 1
+
+        # exactly-once on the commit ledger: both keys fully acked by
+        # position, and C's served tail closes the hot backlog
+        parts = lag_mod.lag_snapshot()["partitions"]
+        assert parts[shared]["lag"] == 0
+        assert parts[hot]["lag"] == 0
+        assert parts[hot]["served_records"] == 4
+        served_t, shed_t, _, _ = TELEMETRY.tenant_families()
+        assert served_t == {"ta": 4, "tb": 4, "tc": 4}, served_t
+        assert shed_t == {"tc": 1}, shed_t
+        adm = TELEMETRY.admission
+        assert adm.get("admit") == 3 and adm.get("breach-shed") == 1, adm
+
+        # every served slice's flow chain is connected in the doc, the
+        # coalesced pair names both sources, and tenants ride the flows
+        flows = TELEMETRY.flows.recent()
+        assert len(flows) == 3
+        doc = render_trace()
+        by_tenant = {}
+        for fl in flows:
+            _assert_connected(doc, fl.flow_id)
+            by_tenant[fl.tenant] = fl
+        assert set(by_tenant) == {"ta", "tb", "tc"}
+        assert by_tenant["ta"].sources == 2
+        assert by_tenant["tb"].sources == 2
+        assert by_tenant["tc"].sources == 1
+        # the breach landed on the slo-breach counter under C's key
+        assert any(
+            k.startswith(f"{hot}/consumer_lag")
+            for k in TELEMETRY.slo_breaches
+        ), TELEMETRY.slo_breaches
+
     def test_zero_cost_when_telemetry_off(self, monkeypatch):
         """The acceptance tripwire: with FLUVIO_TELEMETRY=0 the flow
         and lag seams do NOTHING — no flow objects, no ring pushes, no
@@ -663,6 +772,128 @@ class TestBrokerLagLoop:
         assert TELEMETRY.admission.get("breach-shed", 0) == 0, (
             TELEMETRY.admission
         )
+
+    def test_disconnect_while_held_releases_and_books_the_hold(
+        self, tmp_path
+    ):
+        """ISSUE-17 regression pin (live server): the client
+        disconnects WHILE its slice is shed-held. The stream handler's
+        exit path must release the hold through the same path as a
+        re-admit — ``held_slices`` returns to 0 (no gauge leak) AND
+        the held duration lands on ``admission_hold_seconds`` (the
+        bare gauge decrement used to lose the observation), with the
+        tenant held counter keeping the attribution."""
+        from fluvio_tpu import admission as admission_pkg
+        from fluvio_tpu.admission import AdmissionController
+        from fluvio_tpu.client import ConsumerConfig, Fluvio, Offset
+        from fluvio_tpu.schema.smartmodule import (
+            SmartModuleInvocation,
+            SmartModuleInvocationKind,
+            SmartModuleInvocationWasm,
+        )
+        from fluvio_tpu.spu import SpuConfig, SpuServer
+        from fluvio_tpu.storage.config import ReplicaConfig
+
+        loop = asyncio.new_event_loop()
+        config = SpuConfig(
+            id=5002,
+            public_addr="127.0.0.1:0",
+            log_base_dir=str(tmp_path),
+            replication=ReplicaConfig(base_dir=str(tmp_path)),
+        )
+        config.smart_engine.backend = "auto"
+        server = SpuServer(config)
+        slo_eng = SloEngine(
+            timeseries=TimeSeries(window_s=1e-4, capacity=4),
+            rules=parse_slo_spec(
+                "consumer_lag:target=4;e2e_p99:off=1;spill_ratio:off=1;"
+                "error_rate:off=1;compile_budget:off=1;recompile_rate:off=1;"
+                "queue_depth:off=1;hbm_staged:off=1;record_age_p99:off=1"
+            ),
+        )
+        ctl = AdmissionController(
+            slo_engine=slo_eng, refresh_s=0.0, tokens=1e9, refill=1e9
+        )
+        admission_pkg.set_gate(ctl)
+        values = [b"keep-%d" % i for i in range(20)]
+
+        async def run():
+            await server.start()
+            # tenant = topic-name prefix: the held attribution below
+            # must land on "acme"
+            server.ctx.create_replica("acme.orders", 0)
+            client = await Fluvio.connect(server.public_addr)
+            producer = await client.topic_producer("acme.orders")
+            for i in range(0, len(values), 2):
+                futs = [
+                    await producer.send(None, v) for v in values[i:i + 2]
+                ]
+                await producer.flush()
+                for f in futs:
+                    await f.wait()
+            await producer.close()
+
+            cfg = ConsumerConfig(
+                disable_continuous=True,
+                max_bytes=64,  # many slices: the hold strikes mid-stream
+                smartmodules=[
+                    SmartModuleInvocation(
+                        wasm=SmartModuleInvocationWasm.adhoc(FILTER_SM),
+                        kind=SmartModuleInvocationKind.FILTER,
+                    )
+                ],
+            )
+            consumer = await client.partition_consumer("acme.orders", 0)
+
+            async def consume():
+                async for _ in consumer.stream(Offset.beginning(), cfg):
+                    pass
+
+            task = asyncio.ensure_future(consume())
+            for _ in range(3000):
+                if (
+                    TELEMETRY.admission.get("breach-shed", 0) >= 1
+                    and TELEMETRY.gauge_value("held_slices") >= 1
+                ):
+                    break
+                await asyncio.sleep(0.01)
+            assert TELEMETRY.admission.get("breach-shed", 0) >= 1, (
+                TELEMETRY.admission
+            )
+            assert TELEMETRY.gauge_value("held_slices") >= 1
+
+            # the generator-driven disconnect: the client goes away
+            # while the server still holds the shed slice
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+            await client.close()
+
+            # the handler notices the dead connection on its next
+            # retry tick and must release the hold on its way out
+            for _ in range(3000):
+                if TELEMETRY.gauge_value("held_slices") == 0:
+                    break
+                await asyncio.sleep(0.01)
+
+        try:
+            loop.run_until_complete(asyncio.wait_for(run(), 120))
+        finally:
+            admission_pkg.reset_gate()
+            loop.run_until_complete(server.stop())
+            loop.close()
+        # no leak: the gauge came back without a drain or a re-admit
+        assert TELEMETRY.gauge_value("held_slices") == 0
+        # and the hold DURATION was booked on the way out — the exit
+        # path must go through the same release as a re-admit, not a
+        # bare gauge decrement that loses the observation
+        snap = TELEMETRY.snapshot()
+        hold = snap["slices"].get("hold")
+        assert hold is not None and hold["count"] >= 1, snap["slices"]
+        _, _, held_t, _ = TELEMETRY.tenant_families()
+        assert held_t.get("acme", 0) >= 1, held_t
 
 
 # ---------------------------------------------------------------------------

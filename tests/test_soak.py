@@ -1,0 +1,360 @@
+"""Multi-tenant soak harness tests (ISSUE-17).
+
+The smoke members are the tier-1 acceptance set, deterministic on CPU:
+
+- ``nominal`` quiesces and passes (rc 0) with the exactly-once ledger
+  closed over the lag engine's offered/served/committed join;
+- ``overload`` is detected as queueing collapse (rc 1), scored IN the
+  shed-held state;
+- ``fairness`` holds Jain >= 0.8 under a 4:1 Zipf skew with WRR floors
+  armed, and the shed variant's queue-full drops land on the
+  per-tenant accounting plane deterministically;
+- the chaos leg drives shed + retry + churn + partition failover
+  through the real broker path and the ledger still closes.
+
+Full-size scenarios (``soak``, ``spike``) are ``slow``-marked: tier-1
+runs with ``-m 'not slow'``.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from fluvio_tpu.soak import (
+    SCENARIOS,
+    Scenario,
+    build_verdict,
+    jain,
+    parse_scenario,
+    run_scenario,
+    tenant_of_key,
+    validate_verdict,
+)
+from fluvio_tpu.telemetry import TELEMETRY
+from fluvio_tpu.telemetry import lag as lag_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    TELEMETRY.reset()
+    lag_mod.reset_engine()
+    yield
+    TELEMETRY.reset()
+    lag_mod.reset_engine()
+
+
+def _check(doc: dict, name: str) -> dict:
+    return next(c for c in doc["checks"] if c["name"] == name)
+
+
+# ---------------------------------------------------------------------------
+# scenario grammar
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioGrammar:
+    def test_builtins_parse_as_themselves(self):
+        for name, sc in SCENARIOS.items():
+            assert parse_scenario(name) == sc
+
+    def test_empty_spec_is_nominal(self):
+        assert parse_scenario("") == SCENARIOS["nominal"]
+        assert parse_scenario(None) == SCENARIOS["nominal"]
+
+    def test_colon_overrides(self):
+        sc = parse_scenario("overload:records=40,timeout_s=9.5")
+        assert sc.name == "overload"
+        assert sc.records == 40
+        assert sc.timeout_s == 9.5
+        assert sc.stop_on_hold is SCENARIOS["overload"].stop_on_hold
+
+    def test_bare_overrides_overlay_nominal(self):
+        sc = parse_scenario("tenants=8,skew=1.0,seed=3")
+        assert (sc.tenants, sc.skew, sc.seed) == (8, 1.0, 3)
+        assert sc.name == "nominal"
+
+    def test_bool_coercion(self):
+        assert parse_scenario("wrr=off").wrr is False
+        assert parse_scenario("stop_on_hold=true").stop_on_hold is True
+        with pytest.raises(ValueError):
+            parse_scenario("wrr=maybe")
+
+    def test_unknown_scenario_rejected(self):
+        with pytest.raises(ValueError, match="unknown soak scenario"):
+            parse_scenario("bogus")
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="bad soak scenario field"):
+            parse_scenario("nominal:warp=9")
+
+    def test_name_not_overridable(self):
+        with pytest.raises(ValueError):
+            parse_scenario("nominal:name=other")
+
+    def test_zipf_weights_skew(self):
+        w = Scenario(tenants=4, skew=1.0).zipf_weights()
+        assert w["t00"] / w["t03"] == pytest.approx(4.0)
+        flat = Scenario(tenants=4, skew=0.0).zipf_weights()
+        assert set(flat.values()) == {1.0}
+
+
+# ---------------------------------------------------------------------------
+# scorer primitives
+# ---------------------------------------------------------------------------
+
+
+class TestScorerPrimitives:
+    def test_jain(self):
+        assert jain([5, 5, 5, 5]) == pytest.approx(1.0)
+        assert jain([1, 0, 0, 0]) == pytest.approx(0.25)
+        assert jain([]) == 1.0
+        assert jain([0, 0]) == 1.0
+        # 4:1 skew over RAW throughput is unfair; over ratios it isn't
+        assert jain([4, 1]) < 0.8 < jain([1.0, 1.0])
+
+    def test_tenant_of_key(self):
+        assert tenant_of_key("sig123@t03.s1/0") == "t03"
+        assert tenant_of_key("stream@acme.events/2") == "acme"
+        assert tenant_of_key("plain-topic/0") == "plain-topic"
+
+    def test_verdict_schema_negatives(self):
+        sc = parse_scenario("fairness")
+        doc = build_verdict(sc, run_scenario(sc))
+        assert validate_verdict(doc) == []
+        missing = {k: v for k, v in doc.items() if k != "fairness"}
+        assert any("fairness" in e for e in validate_verdict(missing))
+        bad = dict(doc, verdict="maybe")
+        assert any("vocabulary" in e for e in validate_verdict(bad))
+        flipped = dict(doc, rc=1 - doc["rc"])
+        assert any("rc must be 0 iff" in e for e in validate_verdict(flipped))
+
+
+# ---------------------------------------------------------------------------
+# tenant label cardinality (the bounded accounting plane)
+# ---------------------------------------------------------------------------
+
+
+class TestTenantCardinality:
+    def test_overflow_fold_bounds_label_count(self, monkeypatch):
+        monkeypatch.setattr(TELEMETRY, "tenant_cap", 2)
+        for i in range(10):
+            TELEMETRY.add_tenant_served(f"t{i:02d}", 1)
+        served, _, _, _ = TELEMETRY.tenant_families()
+        # two real labels + ONE overflow bucket; nothing dropped
+        assert set(served) == {"t00", "t01", "_overflow"}
+        assert sum(served.values()) == 10
+        assert served["_overflow"] == 8
+
+    def test_known_tenant_keeps_label_past_cap(self, monkeypatch):
+        monkeypatch.setattr(TELEMETRY, "tenant_cap", 2)
+        TELEMETRY.add_tenant_served("t00", 1)
+        TELEMETRY.add_tenant_served("t01", 1)
+        TELEMETRY.add_tenant_served("t99", 1)  # folds
+        TELEMETRY.add_tenant_served("t00", 5)  # existing label sticks
+        served, _, _, _ = TELEMETRY.tenant_families()
+        assert served["t00"] == 6
+        assert served["_overflow"] == 1
+
+    def test_shed_and_age_families_fold_too(self, monkeypatch):
+        monkeypatch.setattr(TELEMETRY, "tenant_cap", 1)
+        for i in range(3):
+            TELEMETRY.add_tenant_shed(f"t{i}")
+            TELEMETRY.add_tenant_age(f"t{i}", 0.01)
+        _, shed, _, ages = TELEMETRY.tenant_families()
+        assert set(shed) == {"t0", "_overflow"}
+        assert shed["_overflow"] == 2
+        assert set(ages) == {"t0", "_overflow"}
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 smoke scenarios (deterministic, CPU, fast)
+# ---------------------------------------------------------------------------
+
+
+class TestFairnessScenario:
+    def test_wrr_holds_jain_under_zipf_skew(self):
+        sc = parse_scenario("fairness")
+        assert sc.skew == 1.0 and sc.tenants == 4  # 4:1 Zipf
+        doc = build_verdict(sc, run_scenario(sc))
+        assert doc["rc"] == 0 and doc["verdict"] == "pass"
+        assert doc["fairness"] >= 0.8
+        assert len(doc["tenants"]) == 4
+        assert all(e["ratio"] <= 1.0 for e in doc["tenants"].values())
+        assert _check(doc, "exactly_once_accounting")["ok"]
+
+    def test_deterministic_queue_full_sheds_hit_tenant_plane(self):
+        spec = "fairness:profile=spike,queue_depth=1,pump_per_tick=1"
+        sc = parse_scenario(spec)
+        runs = []
+        for _ in range(2):
+            TELEMETRY.reset()
+            lag_mod.reset_engine()
+            run = run_scenario(sc)
+            runs.append(
+                (
+                    run["dropped"],
+                    run["observed"]["admission"],
+                    run["observed"]["tenants"]["shed"],
+                )
+            )
+        # seeded schedule + synchronous pipeline = bit-identical runs
+        assert runs[0] == runs[1]
+        dropped, admission, shed_plane = runs[0]
+        assert dropped > 0
+        assert admission.get("queue-full", 0) > 0
+        # every queue-full shed is tenant-attributed on the plane
+        assert sum(shed_plane.values()) == admission["queue-full"]
+        doc = build_verdict(sc, run_scenario(sc))
+        # dropped records stay on the ledger as backlog: bounds mode
+        assert doc["accounting"]["mode"] == "bounds"
+        assert doc["accounting"]["ok"]
+        assert doc["rc"] == 0  # shed but fair and far from collapse
+
+
+class TestNominalBroker:
+    def test_nominal_passes_exactly_once(self):
+        sc = parse_scenario("nominal")
+        run = run_scenario(sc)
+        doc = build_verdict(sc, run)
+        assert doc["rc"] == 0 and doc["verdict"] == "pass"
+        assert run["quiesced"] is True
+        assert run["churns"] == 1  # the churn leg really disconnected
+        acct = doc["accounting"]
+        assert acct["ok"] and acct["mode"] == "exact"
+        assert acct["lag"] == 0
+        # the client consumed every offered record exactly once, per
+        # topic, across the disconnect/resume
+        assert run["served_client"] == run["offered"]
+        # the accounting plane agrees with the lag families
+        assert acct["plane_served"] == acct["served"]
+
+    def test_verdict_round_trips_through_json(self):
+        sc = parse_scenario("nominal")
+        doc = build_verdict(sc, run_scenario(sc))
+        reloaded = json.loads(json.dumps(doc))
+        assert validate_verdict(reloaded) == []
+        assert reloaded == doc
+
+
+class TestOverloadBroker:
+    def test_overload_detected_as_queueing_collapse(self):
+        sc = parse_scenario("overload")
+        run = run_scenario(sc)
+        doc = build_verdict(sc, run)
+        assert doc["verdict"] == "collapse" and doc["rc"] == 1
+        assert run["hold_seen"] is True
+        collapse = doc["collapse"]
+        assert collapse["detected"]
+        assert collapse["held_now"] >= 1  # scored IN the held state
+        assert collapse["served_ratio"] < sc.collapse_ratio
+        # mid-collapse the ledger still closes as bounds: nothing lost
+        acct = doc["accounting"]
+        assert acct["ok"] and acct["mode"] == "bounds"
+        assert acct["served"] + acct["lag"] >= acct["offered"]
+        assert run["observed"]["admission"].get("breach-shed", 0) >= 1
+        assert doc["shed_ratio"] > 0
+
+
+class TestChaosBroker:
+    def test_exactly_once_across_shed_retry_churn_failover(self):
+        # warn-band lag target: sheds are probabilistic-with-retry, so
+        # the stream recovers and drains (seed 3 is known to shed);
+        # churn forces a real disconnect/resume and fail_group a
+        # partition-placement failover mid-production
+        sc = parse_scenario(
+            "nominal:tenants=2,streams=1,records=16,lag_target=18,"
+            "max_bytes=64,churn=1,partition_groups=2,fail_group=0,"
+            "timeout_s=60,seed=3"
+        )
+        run = run_scenario(sc)
+        doc = build_verdict(sc, run)
+        assert doc["rc"] == 0 and doc["verdict"] == "pass"
+        assert run["churns"] == 1
+        assert run["failovers"] == 1
+        assert run["quiesced"] is True
+        acct = doc["accounting"]
+        assert acct["ok"] and acct["mode"] == "exact"
+        assert acct["lag"] == 0
+        assert run["served_client"] == run["offered"]
+        # any sheds that fired are tenant-attributed on the plane
+        adm = run["observed"]["admission"]
+        sheds = adm.get("warn-shed", 0) + adm.get("breach-shed", 0)
+        shed_plane = run["observed"]["tenants"]["shed"]
+        assert sum(shed_plane.values()) == sheds
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+class TestSoakCli:
+    def test_json_verdict_round_trips_schema(self, capsys):
+        from fluvio_tpu.cli import main
+
+        rc = main(["soak", "fairness", "--format", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert validate_verdict(doc) == []
+        assert rc == doc["rc"] == 0
+
+    def test_overload_exits_nonzero(self, capsys):
+        from fluvio_tpu.cli import main
+
+        rc = main(["soak", "overload:timeout_s=30"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "collapse" in out
+        assert "no_queueing_collapse" in out and "FAIL" in out
+
+    def test_bad_spec_is_usage_error(self, capsys):
+        from fluvio_tpu.cli import main
+
+        assert main(["soak", "not-a-scenario"]) == 1
+        assert "unknown soak scenario" in capsys.readouterr().err
+
+    def test_env_default_spec(self, capsys, monkeypatch):
+        from fluvio_tpu.cli import main
+
+        monkeypatch.setenv("FLUVIO_SOAK_SCENARIO", "fairness")
+        rc = main(["soak", "--format", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["scenario"] == "fairness"
+
+    def test_list_names_builtins(self, capsys):
+        from fluvio_tpu.cli import main
+
+        assert main(["soak", "--list"]) == 0
+        out = capsys.readouterr().out
+        for name in SCENARIOS:
+            assert name in out
+
+    def test_table_renders_without_a_run(self):
+        from fluvio_tpu.cli.soak import render_verdict_table
+
+        sc = parse_scenario("fairness")
+        doc = build_verdict(sc, run_scenario(sc))
+        table = render_verdict_table(doc)
+        assert "verdict pass" in table
+        assert "t00" in table and "fairness" in table
+
+
+# ---------------------------------------------------------------------------
+# full scenarios (slow: excluded from tier-1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestFullScenarios:
+    def test_soak_full(self):
+        sc = parse_scenario("soak")
+        doc = build_verdict(sc, run_scenario(sc))
+        assert validate_verdict(doc) == []
+        assert doc["rc"] == 0
+
+    def test_spike_full(self):
+        sc = dataclasses.replace(parse_scenario("spike"), timeout_s=300.0)
+        doc = build_verdict(sc, run_scenario(sc))
+        assert validate_verdict(doc) == []
+        assert doc["verdict"] in ("pass", "collapse", "fail")

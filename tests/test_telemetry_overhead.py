@@ -587,6 +587,99 @@ def test_flow_lag_seams_zero_cost_when_telemetry_off(monkeypatch):
         lag_module.reset_engine()
 
 
+def test_soak_accounting_armed_overhead_under_gate():
+    """ISSUE-17 CI satellite: the per-tenant accounting plane armed —
+    one tenant-attributed admission decision, tenant-labeled flow, and
+    served/age booking per slice around the REAL dispatch path — must
+    stay inside the same <2% rps gate. Tenant accounting is a couple
+    of capped-dict bumps per SLICE, never per record."""
+    from fluvio_tpu.admission import AdmissionController
+    from fluvio_tpu.telemetry import SloEngine, TimeSeries
+
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    buf = _corpus_buf()
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+
+    ctl = AdmissionController(
+        slo_engine=SloEngine(timeseries=TimeSeries(window_s=1.0, capacity=8)),
+        refresh_s=1.0,
+        tokens=1e9,
+        refill=1e9,
+    )
+    sig = executor._chain_sig
+    ctl.admit(sig, tenant="acme")  # resolve the first evaluation
+
+    def _measure_soak():
+        times = {"bare": [], "armed": []}
+        for _ in range(PASSES_PER_ARM):
+            for arm in ("bare", "armed"):
+                t0 = time.perf_counter()
+                for _i in range(BATCHES_PER_PASS):
+                    if arm == "armed":
+                        d = ctl.admit(sig, tenant="acme")
+                        assert d.admitted
+                        f = TELEMETRY.begin_flow(sig, tenant="acme")
+                        f.mark_dispatch()
+                        executor.process_buffer(buf)
+                        TELEMETRY.add_tenant_served("acme", N_RECORDS)
+                        TELEMETRY.add_tenant_age("acme", 0.001)
+                        TELEMETRY.end_flow(f, records=N_RECORDS)
+                    else:
+                        executor.process_buffer(buf)
+                times[arm].append(
+                    (time.perf_counter() - t0) / BATCHES_PER_PASS
+                )
+        return min(times["bare"]), min(times["armed"])
+
+    for attempt in range(5):
+        bare_s, armed_s = _measure_soak()
+        overhead = max(armed_s - bare_s, 0.0)
+        if overhead <= bare_s * GATE or overhead < 500e-6:
+            break
+    else:
+        raise AssertionError(
+            f"tenant accounting cost {overhead*1e6:.0f}us/slice on a "
+            f"{bare_s*1e3:.2f}ms batch — exceeds the {GATE:.0%} gate "
+            f"after 5 measurement rounds"
+        )
+    rps_bare = N_RECORDS / bare_s
+    rps_armed = N_RECORDS / armed_s
+    assert rps_armed >= rps_bare * (1 - GATE) or overhead < 500e-6
+
+
+def test_tenant_seams_zero_cost_when_telemetry_off(monkeypatch):
+    """ISSUE-17 CI satellite, the strict half: with FLUVIO_TELEMETRY=0
+    every tenant seam — served/shed/held counters, age histograms, the
+    cardinality-cap fold, the tenant-labeled flow — is ZERO work.
+    Every ``add_tenant_*`` routes through the cap resolver once it
+    does real work, so one tripwire there covers the whole family."""
+    TELEMETRY.reset()
+    prior = TELEMETRY.enabled
+    TELEMETRY.enabled = False
+    try:
+
+        def tripwire(*a, **k):
+            raise AssertionError("tenant seam touched with telemetry off")
+
+        monkeypatch.setattr(TELEMETRY, "_tenant_key", tripwire)
+        TELEMETRY.add_tenant_served("acme", 64)
+        TELEMETRY.add_tenant_shed("acme")
+        TELEMETRY.add_tenant_held("acme")
+        TELEMETRY.add_tenant_age("acme", 0.5)
+        assert TELEMETRY.begin_flow("c", tenant="acme") is None
+        served, shed, held, ages = TELEMETRY.tenant_families()
+        assert served == {} and shed == {} and held == {} and ages == {}
+        snap = TELEMETRY.snapshot()
+        assert snap["tenants"] == {
+            "served": {}, "shed": {}, "held": {}, "age": {},
+        }
+    finally:
+        TELEMETRY.enabled = prior
+        TELEMETRY.reset()
+
+
 def test_telemetry_disabled_skips_span_capture_entirely():
     """The off switch must mean OFF: no spans, no histogram writes."""
     chain = _headline_chain()
