@@ -142,6 +142,13 @@ class AdmissionController:
         # with require_warm shed "cold-chain" until note_warm fires
         self._require_warm: Dict[str, bool] = {}
         self._warmed: Dict[str, set] = {}
+        # migration grace: topic/partition -> deadline. A partition the
+        # rebalancer just moved carries a breach verdict EARNED ON THE
+        # OLD GROUP; the grace window lets it re-admit on the new group
+        # so the backlog can drain (the verdict cache recovers instead
+        # of pinning the partition shed forever — the control loop's
+        # admission half)
+        self._migrated: Dict[str, float] = {}
         # per-chain compile timestamps: the PR-5 storm thresholds
         # (FLUVIO_COMPILE_STORM_N / _WINDOW_S) applied per chain — the
         # fairness trip signal
@@ -207,6 +214,36 @@ class AdmissionController:
             v2 = self._engine_verdict
         return v1 if rank.get(v1, 0) >= rank.get(v2, 0) else v2
 
+    # -- migration grace (rebalancer recovery seam) --------------------------
+
+    def note_migrated(self, partition: str, grace_s: float = 10.0) -> None:
+        """A ``topic/partition`` just migrated to a new device group:
+        clear its cached verdicts (they were earned on the OLD group)
+        and grant a grace window during which lag breach/warn verdicts
+        do not shed it — serving must resume for the backlog to drain,
+        which is what clears the breach for real. Token buckets still
+        apply, so grace is not an admission bypass."""
+        now = self.clock()
+        with self._lock:
+            self._migrated.pop(partition, None)
+            self._migrated[partition] = now + max(grace_s, 0.0)
+            while len(self._migrated) > 128:
+                self._migrated.pop(next(iter(self._migrated)))
+            for chain in list(self._verdicts):
+                if "@" in chain and chain.split("@", 1)[1] == partition:
+                    self._verdicts[chain] = "ok"
+
+    def _in_migration_grace(self, chain: str, now: float) -> bool:
+        part = chain.split("@", 1)[1] if "@" in chain else chain
+        with self._lock:
+            deadline = self._migrated.get(part)
+            if deadline is None:
+                return False
+            if now >= deadline:
+                del self._migrated[part]
+                return False
+            return True
+
     # -- storm attribution (the fairness trip signal) ------------------------
 
     def note_compiles(self, chain: str, n: int) -> bool:
@@ -251,6 +288,10 @@ class AdmissionController:
             return self._shed(chain, "cold-chain", "ok", tenant)
         self._refresh_verdicts(now)
         verdict = self.chain_verdict(chain)
+        if verdict in ("breach", "warn") and self._in_migration_grace(
+            chain, now
+        ):
+            verdict = "ok"
         if verdict == "breach":
             return self._shed(chain, "breach-shed", verdict, tenant)
         if verdict == "warn" and self.rng.random() < self.warn_shed:

@@ -188,6 +188,25 @@ REGISTRY: Tuple[EnvFlag, ...] = (
     _f("FLUVIO_PARTITION_RULES", "spec", "", "pattern=N|hash|spread;...",
        "partition/placement.py",
        "partition -> device-group placement rules"),
+    _f("FLUVIO_REBALANCE", "bool01", "1", "1|0|off",
+       ("partition/rebalancer.py", "soak/generator.py"),
+       "arm the lag-driven elastic partition rebalancer daemon"),
+    _f("FLUVIO_REBALANCE_BURN", "float", "1.0", "records/s",
+       "partition/rebalancer.py",
+       "required lag drain rate; a backlogged partition not draining "
+       "this fast counts as hot"),
+    _f("FLUVIO_REBALANCE_COOLDOWN_S", "float", "5", "seconds",
+       "partition/rebalancer.py",
+       "per-partition refractory window between voluntary moves"),
+    _f("FLUVIO_REBALANCE_HYSTERESIS", "float", "4", "records",
+       "partition/rebalancer.py",
+       "absolute-lag floor below which a partition never migrates"),
+    _f("FLUVIO_REBALANCE_INTERVAL_S", "float", "0.25", "seconds",
+       "partition/rebalancer.py",
+       "rebalancer daemon tick period (burn-rate sampling cadence)"),
+    _f("FLUVIO_REBALANCE_MAX_MOVES", "int", "2", "moves",
+       "partition/rebalancer.py",
+       "voluntary-move budget per tick (max concurrent migrations)"),
     _f("FLUVIO_RESULT_COMPACT", "mode", "auto", "auto|1|0",
        "smartengine/tpu/executor.py",
        "device-side result compaction (flat packed payload, auto: on)"),

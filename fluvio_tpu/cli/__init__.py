@@ -2,7 +2,7 @@
 
 Run as ``python -m fluvio_tpu.cli <command>``. Commands: produce, consume,
 topic, partition, smartmodule, tableformat, spu, profile, cluster, run,
-metrics, trace, analyze, health, lag, soak, warmup, version.
+metrics, trace, analyze, health, lag, rebalance, soak, warmup, version.
 """
 
 from __future__ import annotations
@@ -24,6 +24,7 @@ def build_parser() -> argparse.ArgumentParser:
     from fluvio_tpu.cli import lag as lag_cmd
     from fluvio_tpu.cli import metrics as metrics_cmd
     from fluvio_tpu.cli import produce as produce_cmd
+    from fluvio_tpu.cli import rebalance as rebalance_cmd
     from fluvio_tpu.cli import soak as soak_cmd
     from fluvio_tpu.cli import trace as trace_cmd
     from fluvio_tpu.cli import warmup as warmup_cmd
@@ -52,6 +53,7 @@ def build_parser() -> argparse.ArgumentParser:
         analyze_cmd.add_analyze_parser,
         health_cmd.add_health_parser,
         lag_cmd.add_lag_parser,
+        rebalance_cmd.add_rebalance_parser,
         soak_cmd.add_soak_parser,
         warmup_cmd.add_warmup_parser,
     ):

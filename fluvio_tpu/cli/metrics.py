@@ -131,6 +131,8 @@ def render_metrics_table(data: dict) -> str:
         )
     for key, n in sorted((counters.get("slo_breaches") or {}).items()):
         rows.append((f"slo_breach[{key}]", _fmt_count(n)))
+    for reason, n in sorted((counters.get("rebalance_moves") or {}).items()):
+        rows.append((f"rebalance[{reason}]", _fmt_count(n)))
     breaker = counters.get("breaker") or {}
     rows.append(
         ("breaker_short_circuits",

@@ -1,0 +1,155 @@
+"""`rebalance` subcommand — the elastic partition rebalancer's view.
+
+``fluvio-tpu rebalance --status`` renders the lag-driven rebalancer's
+control-loop document (partition/rebalancer.py): per-partition lag and
+burn-rate as the daemon sees them, the current placement, the
+moves-by-reason counters and the migration-duration histogram, plus
+the last few move records (success AND rollback). ``--local`` reads
+the in-process daemon (soak/bench single-process runs and tests);
+without it the document is reduced from the monitoring socket's full
+telemetry snapshot — counters survive the daemon, the live control
+view does not.
+
+Exit code is symmetric with ``fluvio-tpu health`` / ``lag``: 0 when no
+migration has rolled back, 1 when any rollback is on the books — so
+``fluvio-tpu rebalance --status && promote`` refuses to advance past a
+failed (rolled-back) migration without an operator look.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def add_rebalance_parser(sub) -> None:
+    p = sub.add_parser(
+        "rebalance",
+        help="elastic partition rebalancer status (moves, lag, burn)",
+    )
+    p.add_argument(
+        "--status",
+        action="store_true",
+        help="render the rebalancer status document (the only mode)",
+    )
+    p.add_argument(
+        "--path",
+        help="monitoring unix-socket path (default: FLUVIO_METRIC_SPU)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("table", "json"),
+        default="table",
+        help="output format (default: table)",
+    )
+    p.add_argument(
+        "--local",
+        action="store_true",
+        help="read the in-process rebalancer instead of a socket",
+    )
+    p.set_defaults(fn=rebalance)
+
+
+def render_rebalance_table(doc: dict) -> str:
+    """Status document -> operator-facing table. Pure function so the
+    surface tests render without a socket or a daemon."""
+    from fluvio_tpu.cli.metrics import _rows_to_table
+
+    moves = doc.get("moves") or {}
+    rollbacks = doc.get("rollbacks", 0)
+    head = (
+        f"rebalancer: {'armed' if doc.get('enabled') else 'off'}  "
+        f"ticks={doc.get('ticks', 0)}  moves={doc.get('moves_total', 0)}  "
+        f"rollbacks={rollbacks}"
+    )
+    sections = [head]
+    parts = doc.get("partitions") or {}
+    if parts:
+        rows = [
+            (
+                key,
+                "-" if entry.get("group") is None else entry["group"],
+                entry.get("lag", 0.0),
+                entry.get("burn", 0.0),
+                entry.get("cooldown_s", 0.0),
+            )
+            for key, entry in sorted(parts.items())
+        ]
+        sections.append(
+            _rows_to_table(
+                rows,
+                header=("partition", "group", "lag", "burn", "cooldown_s"),
+            )
+        )
+    if moves:
+        sections.append(
+            _rows_to_table(
+                sorted(moves.items()),
+                header=("reason", "moves"),
+            )
+        )
+    recent = doc.get("recent") or []
+    if recent:
+        rows = [
+            (
+                m.get("key", "-"),
+                "-" if m.get("from") is None else m["from"],
+                m.get("to", "-"),
+                m.get("reason", "-"),
+                "ok" if m.get("ok") else "ROLLBACK",
+                m.get("replayed", 0),
+                round(m.get("seconds", 0.0), 3),
+            )
+            for m in recent[-8:]
+        ]
+        sections.append(
+            _rows_to_table(
+                rows,
+                header=(
+                    "partition", "from", "to", "reason", "outcome",
+                    "replayed", "seconds",
+                ),
+            )
+        )
+    if not parts and not moves and not recent:
+        sections.append("no rebalance activity (no moves on the books)")
+    return "\n\n".join(sections)
+
+
+def _doc_from_snapshot(snap: dict) -> dict:
+    """Reduce the full telemetry snapshot (socket ``json`` mode) to the
+    status shape — the counters plane only; the live control view
+    (lag/burn per partition) needs ``--local``."""
+    from fluvio_tpu.partition.rebalancer import rebalance_enabled
+
+    tel = snap.get("telemetry") or snap
+    reb = tel.get("rebalance") or {}
+    moves = dict(reb.get("moves") or {})
+    return {
+        "enabled": rebalance_enabled(),
+        "ticks": 0,
+        "moves_total": sum(moves.values()),
+        "rollbacks": moves.get("rollback", 0),
+        "partitions": {},
+        "moves": moves,
+        "migration_seconds": reb.get("migration_seconds") or {},
+        "recent": [],
+    }
+
+
+async def _read_doc(args) -> dict:
+    if args.local:
+        from fluvio_tpu.partition.rebalancer import rebalance_status
+
+        return rebalance_status()
+    from fluvio_tpu.spu.monitoring import read_metrics
+
+    return _doc_from_snapshot(await read_metrics(args.path))
+
+
+async def rebalance(args) -> int:
+    doc = await _read_doc(args)
+    if args.format == "json":
+        print(json.dumps(doc, indent=1))
+    else:
+        print(render_rebalance_table(doc))
+    return 1 if doc.get("rollbacks", 0) else 0

@@ -41,6 +41,10 @@ _LAZY = {
     "CarryReplica": "fluvio_tpu.partition.failover",
     "FailoverCoordinator": "fluvio_tpu.partition.failover",
     "chain_from_spec": "fluvio_tpu.partition.failover",
+    "PartitionRebalancer": "fluvio_tpu.partition.rebalancer",
+    "RebalanceConfig": "fluvio_tpu.partition.rebalancer",
+    "rebalance_enabled": "fluvio_tpu.partition.rebalancer",
+    "rebalance_status": "fluvio_tpu.partition.rebalancer",
 }
 
 __all__ = sorted(_LAZY) + ["gate", "set_gate", "reset_gate"]

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import base64
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -160,6 +161,8 @@ class FailoverCoordinator:
         self.logs: Dict[str, _PartitionLog] = {}
         self.served: Dict[str, list] = {}
         self.promotions = 0
+        self.migrations = 0
+        self.migrations_failed = 0
         self.leader = self._build_runtime()
 
     def _build_runtime(self) -> PartitionRuntime:
@@ -245,6 +248,98 @@ class FailoverCoordinator:
                 # accounting lands it in served ∪ quarantined
                 out = runtime.process_chain(self.topic, partition, slab)
                 self._commit(key, partition, nxt, out.successes)
+
+    # -- voluntary migration -------------------------------------------------
+
+    def migrate_partition(
+        self,
+        partition: int,
+        group: int,
+        reason: str = "lag",
+        clock=None,
+    ) -> dict:
+        """Demote-the-leader migration of ONE partition onto ``group``.
+
+        The voluntary mirror of :meth:`promote`, scoped to a single
+        partition: rewind the partition to its last COMMITTED replica
+        snapshot (a controlled leader death — un-committed in-memory
+        progress is discarded, exactly as a real death would), move the
+        assignment (the vacated group stays schedulable), then replay
+        the un-acked log suffix through the full recovery ladder on the
+        NEW group. Chaos-safe by construction: every un-acked record
+        lands exactly once in served ∪ dead-letter, same as promotion.
+
+        A replay failure ROLLS BACK: the partition returns to its old
+        group seeded with the newest committed snapshot (which includes
+        any records the partial replay already committed — commits are
+        monotonic and never undone), and the still-un-acked suffix
+        stays in the follower log, replayable by the next promotion or
+        migration attempt. Exactly-once accounting is intact either
+        way; ``ok`` reports which way it went.
+        """
+        now = clock or time.monotonic
+        t0 = now()
+        key = partition_key(self.topic, partition)
+        old_group = self.leader.plan.assignments.get(key)
+        committed, carries, inst = self.replica.latest(key)
+        plog = self.logs.get(key) or _PartitionLog()
+        if not self.leader.move_partition(self.topic, partition, group):
+            return {
+                "ok": True, "moved": False, "from": old_group,
+                "to": group, "replayed": 0, "seconds": 0.0,
+            }
+        if carries is not None:
+            self.leader.seed_partition(
+                self.topic, partition, carries, inst_state=inst
+            )
+        replayed = 0
+        try:
+            for base, nxt, slab in plog.unacked(committed):
+                out = self.leader.process_chain(self.topic, partition, slab)
+                self._commit(key, partition, nxt, out.successes)
+                replayed += 1
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            # roll back onto the old group with the NEWEST committed
+            # snapshot (partial-replay commits are monotonic and stay)
+            committed2, carries2, inst2 = self.replica.latest(key)
+            self.leader.move_partition(self.topic, partition, old_group)
+            if carries2 is not None:
+                self.leader.seed_partition(
+                    self.topic, partition, carries2, inst_state=inst2
+                )
+            self.migrations_failed += 1
+            seconds = max(now() - t0, 0.0)
+            logger.warning(
+                "migration of %s -> group %d failed (%s: %s); rolled back",
+                key, group, type(e).__name__, e,
+            )
+            self._note_move(key, old_group, group, reason, seconds, ok=False)
+            return {
+                "ok": False, "moved": False, "from": old_group,
+                "to": group, "replayed": replayed, "seconds": seconds,
+                "error": f"{type(e).__name__}: {e}",
+            }
+        self.migrations += 1
+        seconds = max(now() - t0, 0.0)
+        self._note_move(key, old_group, group, reason, seconds, ok=True)
+        return {
+            "ok": True, "moved": True, "from": old_group, "to": group,
+            "replayed": replayed, "seconds": seconds,
+        }
+
+    @staticmethod
+    def _note_move(key, src, dst, reason, seconds, ok) -> None:
+        from fluvio_tpu.telemetry import TELEMETRY
+
+        if not TELEMETRY.enabled:
+            return
+        TELEMETRY.add_rebalance_move(
+            reason if ok else "rollback",
+            f"{key}:{src}->{dst}",
+        )
+        TELEMETRY.add_migration_seconds(seconds)
 
     # -- accounting ----------------------------------------------------------
 

@@ -155,6 +155,7 @@ class PlacementPlan:
     rules: Tuple[PlacementRule, ...] = DEFAULT_RULES
     failed: frozenset = frozenset()
     rebalances: int = 0
+    moves: int = 0
 
     def group_of(self, topic: str, partition: int) -> int:
         key = partition_key(topic, partition)
@@ -192,7 +193,65 @@ class PlacementPlan:
             rules=self.rules,
             failed=self.failed,
             rebalances=self.rebalances,
+            moves=self.moves,
         )
+
+    def move_partition(self, key: str, group: int) -> "PlacementPlan":
+        """Voluntarily move ONE partition onto ``group``.
+
+        Distinct from :meth:`rebalance`: the vacated group stays
+        schedulable (``failed`` is untouched) — a rebalancer draining a
+        hot partition off a healthy group must be able to route new
+        partitions back onto it later. Moving onto a failed or
+        out-of-range group is a caller bug and raises.
+        """
+        if key not in self.assignments:
+            raise KeyError(f"partition {key!r} is not in the placement plan")
+        if not 0 <= group < self.n_groups:
+            raise ValueError(
+                f"move target group {group} outside mesh of {self.n_groups}"
+            )
+        if group in self.failed:
+            raise ValueError(f"move target group {group} has failed")
+        if self.assignments[key] == group:
+            return self  # already there: a no-op move is not a move
+        assignments = dict(self.assignments)
+        assignments[key] = group
+        return PlacementPlan(
+            n_groups=self.n_groups,
+            assignments=assignments,
+            rules=self.rules,
+            failed=self.failed,
+            rebalances=self.rebalances,
+            moves=self.moves + 1,
+        )
+
+    def split_group(self, group: int, target: int) -> "PlacementPlan":
+        """Split a folded group's load: move half its partitions (every
+        second key in sorted order — deterministic, so every control
+        plane replica computes the same split) onto ``target``. Both
+        groups stay schedulable."""
+        if group == target:
+            raise ValueError("split target must differ from the source")
+        keys = sorted(
+            k for k, g in self.assignments.items() if g == group
+        )
+        plan = self
+        for key in keys[1::2]:
+            plan = plan.move_partition(key, target)
+        return plan
+
+    def merge_groups(self, src: int, dst: int) -> "PlacementPlan":
+        """Fold every partition of ``src`` onto ``dst`` (voluntary —
+        ``src`` stays live, unlike :meth:`rebalance`'s failure path)."""
+        if src == dst:
+            raise ValueError("merge source must differ from destination")
+        plan = self
+        for key in sorted(
+            k for k, g in self.assignments.items() if g == src
+        ):
+            plan = plan.move_partition(key, dst)
+        return plan
 
     def rebalance(self, failed_group: int) -> "PlacementPlan":
         """Reassign a failed group's partitions onto the survivors.
@@ -221,6 +280,7 @@ class PlacementPlan:
             rules=self.rules,
             failed=failed,
             rebalances=self.rebalances + 1,
+            moves=self.moves,
         )
 
     def rows(self) -> List[Tuple[str, int]]:
@@ -233,6 +293,7 @@ class PlacementPlan:
             "assignments": dict(sorted(self.assignments.items())),
             "failed": sorted(self.failed),
             "rebalances": self.rebalances,
+            "moves": self.moves,
         }
 
 

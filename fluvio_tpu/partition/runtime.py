@@ -218,6 +218,11 @@ class PartitionRuntime:
         with self._lock:
             return self._plan.rebalances
 
+    @property
+    def moves(self) -> int:
+        with self._lock:
+            return self._plan.moves
+
     def partitions(self) -> List[str]:
         with self._lock:
             return sorted(self._states)
@@ -263,6 +268,28 @@ class PartitionRuntime:
             "device group %d failed: rebalanced %d partitions", group, moved
         )
         return moved
+
+    def move_partition(self, topic: str, partition: int, group: int) -> bool:
+        """Voluntary single-partition move (the rebalancer's actuator).
+
+        Unlike :meth:`fail_group` the vacated group stays schedulable.
+        Carries migrate lazily — the next ``_swap_in`` device_puts them
+        onto the new group's device, so the move itself touches no
+        device state and is safe from a control thread. Returns whether
+        the assignment actually changed.
+        """
+        key = partition_key(topic, partition)
+        with self._lock:
+            plan = self._plan
+            if key not in plan.assignments:
+                plan = plan.with_partitions([key])
+            new_plan = plan.move_partition(key, group)
+            changed = new_plan is not plan
+            self._plan = new_plan
+            st = self._states.get(key)
+            if st is not None and changed:
+                st.group = group
+        return changed
 
     # -- carry bank ----------------------------------------------------------
 
@@ -568,6 +595,20 @@ class BrokerPartitionGate:
     def fail_group(self, group: int) -> None:
         with self._lock:
             self._plan = self._plan.rebalance(group)
+
+    def move_partition(self, topic: str, partition: int, group: int) -> bool:
+        """Voluntary move (rebalancer actuator): reroute the stream's
+        dispatch device starting from its next slice. The source group
+        stays schedulable. Returns whether the assignment changed."""
+        key = partition_key(topic, partition)
+        with self._lock:
+            plan = self._plan
+            if key not in plan.assignments:
+                plan = plan.with_partitions([key])
+            new_plan = plan.move_partition(key, group)
+            changed = new_plan is not plan
+            self._plan = new_plan
+        return changed
 
     def scope(self, topic: str, partition: int, executor):
         """Context manager: partitioned identity + group device for one

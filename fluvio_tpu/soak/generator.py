@@ -196,12 +196,51 @@ async def run_broker(sc: Scenario) -> dict:
 
     pgate = None
     if sc.partition_groups > 0:
+        from fluvio_tpu.partition.placement import parse_placement_rules
         from fluvio_tpu.partition.runtime import BrokerPartitionGate
 
-        pgate = BrokerPartitionGate(sc.partition_groups)
+        rules = (
+            parse_placement_rules(f".*={sc.pin_group}")
+            if sc.pin_group >= 0
+            else None
+        )
+        pgate = BrokerPartitionGate(sc.partition_groups, rules=rules)
         partition_pkg.set_gate(pgate)
     if sc.faults:
         faults.FAULTS.load_env_spec(sc.faults)
+
+    # the rebalancer daemon: the scenario asks for it AND the master
+    # switch arms it — the skew scenario's verdict flips on exactly
+    # this (collapse with the daemon off, pass with it on)
+    reb = None
+    reb_stop = None
+    reb_thread = None
+    if pgate is not None and sc.rebalance:
+        from fluvio_tpu.partition import rebalancer as reb_mod
+
+        if reb_mod.rebalance_enabled():
+            import threading
+
+            ctl_ref = admission_pkg.gate() if sc.admission else None
+
+            def _mover(key: str, group: int, reason: str) -> bool:
+                topic, _, pstr = key.rpartition("/")
+                moved = pgate.move_partition(topic, int(pstr), group)
+                if moved and ctl_ref is not None:
+                    # the verdict cache recovers on the NEW group: the
+                    # held slice's next retry re-admits and the backlog
+                    # drains — the admission half of the control loop
+                    ctl_ref.note_migrated(key, grace_s=30.0)
+                return moved
+
+            reb = reb_mod.PartitionRebalancer(lambda: pgate.plan, _mover)
+            reb_mod.set_active(reb)
+            reb_stop = threading.Event()
+            reb_thread = threading.Thread(
+                target=reb.run, args=(reb_stop,),
+                name="soak-rebalancer", daemon=True,
+            )
+            reb_thread.start()
 
     topics = plan_topics(sc)
     schedule = build_schedule(sc, topics)
@@ -309,21 +348,53 @@ async def run_broker(sc: Scenario) -> dict:
             await asyncio.gather(*tasks, return_exceptions=True)
         else:
             tasks = [
-                consume_churned(t) if t in churned else consume(t)
+                asyncio.ensure_future(
+                    consume_churned(t) if t in churned else consume(t)
+                )
                 for t in sorted(topics)
             ]
-            await asyncio.wait_for(
-                asyncio.gather(*tasks), timeout=sc.timeout_s
+            done, pending = await asyncio.wait(
+                tasks, timeout=sc.timeout_s
             )
-            run["quiesced"] = await _quiesce_lag()
-            # collect while the replica leaders are alive — the lag
-            # engine joins through weakrefs that die with the server
-            run["observed"] = collect_observed()
+            for t in done:
+                t.result()  # a real consumer error is a harness bug
+            if pending:
+                # stuck mid-hold at the deadline (a shed-held backlog
+                # nothing drained — the un-rebalanced skew outcome):
+                # score IN the held state, exactly like stop_on_hold —
+                # cancelling first would release the holds and hide
+                # the collapse evidence
+                run["hold_seen"] = (
+                    TELEMETRY.gauge_value("held_slices") >= 1
+                )
+                lag_mod.engine().sample()
+                run["observed"] = collect_observed()
+                for t in pending:
+                    t.cancel()
+                await asyncio.gather(*pending, return_exceptions=True)
+            else:
+                run["quiesced"] = await _quiesce_lag()
+                # collect while the replica leaders are alive — the lag
+                # engine joins through weakrefs that die with the server
+                run["observed"] = collect_observed()
 
         run["served_client"] = {t: len(v) for t, v in got.items()}
+        if reb is not None:
+            run["rebalance"] = {
+                "moves": reb.moves_total,
+                "ticks": reb.ticks,
+                "rollbacks": reb.rollbacks,
+            }
         await client.close()
         return run
     finally:
+        if reb_stop is not None:
+            reb_stop.set()
+            reb_thread.join(timeout=5.0)
+        if reb is not None:
+            from fluvio_tpu.partition import rebalancer as reb_mod
+
+            reb_mod.set_active(None)
         admission_pkg.reset_gate()
         if pgate is not None:
             partition_pkg.reset_gate()

@@ -84,6 +84,14 @@ class Scenario:
     partition_groups: int = 0
     #: ...and fail this group at the production midpoint (-1 = never)
     fail_group: int = -1
+    #: pin EVERY partition onto this group (".*=N" placement rule) —
+    #: the skewed-hot-group setup the rebalancer is scored against
+    #: (-1 = normal rule/env placement)
+    pin_group: int = -1
+    #: arm the lag-driven rebalancer daemon for the run (still subject
+    #: to the FLUVIO_REBALANCE master switch — the scoring gate flips
+    #: this scenario from collapse to pass)
+    rebalance: bool = False
     #: FLUVIO_FAULTS-grammar chaos spec armed for the run ("" = none)
     faults: str = ""
     #: overload mode: stop consuming once a slice is shed-HELD and
@@ -107,10 +115,13 @@ class Scenario:
         }
 
 
-#: built-in scenario library. The three smoke members are the tier-1
+#: built-in scenario library. The smoke members are the tier-1
 #: acceptance set: ``nominal`` passes (rc 0), ``overload`` collapses
 #: (rc 1), ``fairness`` holds Jain >= 0.8 under 4:1 skew with WRR
-#: floors. The ``soak`` / ``spike`` members are the full slow runs.
+#: floors, and ``skew`` (one pinned-hot device group) collapses with
+#: ``FLUVIO_REBALANCE=0`` but PASSES with the rebalancer daemon armed
+#: — the elastic-rebalancer scoring gate. The ``soak`` / ``spike``
+#: members are the full slow runs.
 SCENARIOS: Dict[str, Scenario] = {
     "nominal": Scenario(
         name="nominal", backend="broker", tenants=3, streams=2,
@@ -124,6 +135,12 @@ SCENARIOS: Dict[str, Scenario] = {
     "fairness": Scenario(
         name="fairness", backend="pipeline", tenants=4, streams=1,
         records=24, skew=1.0, queue_depth=16, pump_per_tick=8,
+    ),
+    "skew": Scenario(
+        name="skew", backend="broker", tenants=3, streams=1,
+        records=18, skew=1.0, lag_target=4, max_bytes=64,
+        partition_groups=3, pin_group=0, rebalance=True,
+        collapse_ratio=0.9, timeout_s=60.0,
     ),
     "soak": Scenario(
         name="soak", backend="broker", tenants=12, streams=4,

@@ -113,6 +113,8 @@ def render_prometheus(
         tenant_shed = dict(t.tenant_shed)
         tenant_held = dict(t.tenant_held)
         tenant_age = {k: h.copy() for k, h in t.tenant_age.items()}
+        rebalance_moves = dict(t.rebalance_moves)
+        migration_hist = t.migration_hist.copy()
     spans_dropped = t.spans.dropped
 
     _histogram(
@@ -372,6 +374,23 @@ def render_prometheus(
             "End-to-end record age (append wall-time -> served) per "
             "tenant label.",
             [({"tenant": k}, h) for k, h in sorted(tenant_age.items())],
+        )
+
+    # -- elastic rebalancer (ISSUE-18) ---------------------------------------
+    w.header(
+        f"{_PREFIX}_rebalance_moves_total",
+        "Voluntary partition migrations by reason (lag | split | merge | "
+        "manual | rollback).",
+        "counter",
+    )
+    for reason, v in sorted(rebalance_moves.items()):
+        w.sample(f"{_PREFIX}_rebalance_moves_total", {"reason": reason}, v)
+    if migration_hist.count:
+        _histogram(
+            w,
+            f"{_PREFIX}_migration_seconds",
+            "Drain + replay duration of one voluntary partition migration.",
+            [({}, migration_hist)],
         )
 
     # -- gauges --------------------------------------------------------------

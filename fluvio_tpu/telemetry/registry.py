@@ -190,6 +190,12 @@ class PipelineTelemetry:
         self.tenant_shed: Dict[str, int] = {}
         self.tenant_held: Dict[str, int] = {}
         self.tenant_age: Dict[str, LatencyHistogram] = {}
+        # rebalance/migration plane (ISSUE-18): voluntary partition
+        # moves by reason (lag burn, split, merge, rollback) + the
+        # migration-duration histogram — the rebalancer daemon's
+        # observable output, read by prom/CLI/bench
+        self.rebalance_moves: Dict[str, int] = {}
+        self.migration_hist = LatencyHistogram()
         # pull-join hook: telemetry/lag.py installs its sampler here so
         # the time-series tick (and the Prometheus scrape) re-joins
         # committed offsets against replica high watermarks at the
@@ -514,6 +520,31 @@ class PipelineTelemetry:
         with self._lock:
             self.admission[reason] = self.admission.get(reason, 0) + 1
 
+    def add_rebalance_move(self, reason: str, detail: str = "") -> None:
+        """One voluntary partition migration outcome (reason ∈ the
+        rebalancer's vocabulary: lag/split/merge/manual/rollback).
+        Counts always-on like admission; the flight-recorder instant
+        event (gated with capture) lands the move on the Perfetto
+        timeline next to the slice flows it unblocks."""
+        with self._lock:
+            self.rebalance_moves[reason] = (
+                self.rebalance_moves.get(reason, 0) + 1
+            )
+        self._event("rebalance", detail or reason)
+
+    def add_migration_seconds(self, seconds: float) -> None:
+        """One migration's drain+replay duration (seconds)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.migration_hist.record(max(seconds, 0.0))
+
+    def rebalance_families(self):
+        """(moves-by-reason, migration histogram copy) under ONE lock
+        hold — the CLI status table and bench read both coherently."""
+        with self._lock:
+            return dict(self.rebalance_moves), self.migration_hist.copy()
+
     def record_breaker(self, name: str, state: str, transition: bool = True) -> None:
         if transition:
             self._event("breaker", f"{name}->{state}")
@@ -673,6 +704,7 @@ class PipelineTelemetry:
                         "recompile-storm", 0
                     ),
                     "breaker_short_circuits": self.breaker_short_circuits,
+                    "rebalance_moves": sum(self.rebalance_moves.values()),
                 },
                 "gauges": dict(self.gauges),
                 # streaming-lag families: point-in-time lag per
@@ -694,6 +726,7 @@ class PipelineTelemetry:
                         k: h.copy() for k, h in self.tenant_age.items()
                     },
                 },
+                "migration_hist": self.migration_hist.copy(),
             }
 
     def path_records(self) -> Dict[str, int]:
@@ -736,6 +769,7 @@ class PipelineTelemetry:
                     ),
                     "slo_breaches": dict(self.slo_breaches),
                     "admission": dict(self.admission),
+                    "rebalance_moves": dict(self.rebalance_moves),
                     "breaker": {
                         "states": dict(self.breaker_states),
                         "transitions": dict(self.breaker_transitions),
@@ -782,6 +816,10 @@ class PipelineTelemetry:
                         for k, h in self.tenant_age.items()
                         if h.count
                     },
+                },
+                "rebalance": {
+                    "moves": dict(self.rebalance_moves),
+                    "migration_seconds": self.migration_hist.to_dict(),
                 },
             } | self._ring_stats()
 
@@ -850,6 +888,8 @@ class PipelineTelemetry:
             self.tenant_shed = {}
             self.tenant_held = {}
             self.tenant_age = {}
+            self.rebalance_moves = {}
+            self.migration_hist = LatencyHistogram()
             self._flow_seq = 0
             # lag_sampler survives reset on purpose: the bench resets
             # between configs and the lag engine's tracked leaders must

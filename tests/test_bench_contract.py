@@ -880,3 +880,58 @@ def test_dfa_key_fits_contract_and_trims_before_link():
     ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
     assert ladder.index('"dfa"') < ladder.index('"lag"')
     assert ladder.index('"dfa"') < ladder.index('"link"')
+
+
+def test_rebal_line_key_rides_compact_line():
+    """ISSUE-18: a tiny ``rebal:{moves,drain_s}`` key rides the compact
+    line when any config armed the rebalancer daemon; the full move
+    records (src/dst groups, rollbacks) stay in BENCH_DETAIL.json."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    cfg = dict(GOOD)
+    cfg["rebalance"] = {
+        "moves": 1, "rollbacks": 0, "from": 0, "to": 1, "drain_s": 0.421,
+    }
+    out, rc = b._build_output({"9_partitioned": cfg})
+    assert rc == 0
+    assert out["configs"]["9_partitioned"]["rebalance"]["from"] == 0
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["rebal"] == {"moves": 1, "drain_s": 0.421}
+    # the bulky detail never reaches the line
+    assert "rebalance" not in line["configs"].get("9_partitioned", {})
+    # without a daemon-armed config the key stays off entirely
+    out2, _ = b._build_output({"2_filter_map": dict(GOOD)})
+    assert "rebal" not in json.loads(json.dumps(b._compact_line(out2)))
+
+
+def test_rebal_key_fits_contract_and_trims_before_part():
+    """The full-matrix line with the rebal key stays ≤1500 chars and
+    the blowup trim ladder drops ``rebal`` BEFORE ``part`` (and
+    therefore before ``link``, the sentinel's contract field)."""
+    import json
+    import re
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = _full_results()
+    results["9_partitioned"] = dict(GOOD)
+    results["9_partitioned"]["part"] = {
+        "n": 4, "groups": 2, "rebal": 1, "moves": 1, "exact": True,
+        "offsets": {f"bench/{i}": 4999 for i in range(4)},
+        "plan": {f"bench/{i}": i % 2 for i in range(4)},
+    }
+    results["9_partitioned"]["rebalance"] = {
+        "moves": 1, "rollbacks": 0, "from": 0, "to": 1, "drain_s": 0.421,
+    }
+    out, _ = b._build_output(results)
+    line = json.dumps(b._compact_line(out))
+    assert len(line) <= 1500, f"compact line is {len(line)} chars"
+    parsed = json.loads(line)
+    assert parsed["rebal"] == {"moves": 1, "drain_s": 0.421}
+    assert parsed["part"] == {"n": 4, "rebal": 1}
+    src = open(_BENCH_PATH).read()
+    ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
+    assert ladder.index('"rebal"') < ladder.index('"part"')
+    assert ladder.index('"rebal"') < ladder.index('"link"')

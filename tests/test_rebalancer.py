@@ -1,0 +1,795 @@
+"""Lag-driven elastic partition rebalancer (ISSUE-18).
+
+The closed control loop: lag burn-rates in (the PR-15 observability
+surfaces), voluntary partition moves out (the PR-13 placement plans),
+with the demote-the-leader migration primitive riding the exactly-once
+replay ladder. The suite pins:
+
+- the voluntary-move plan primitives never touch ``failed``;
+- deterministic control-loop decisions under an injected clock —
+  hysteresis floor, required-drain-rate hotness, nowhere-colder guard,
+  per-tick move budget, cooldown flap suppression;
+- chaos matrix: ``FLUVIO_FAULTS`` at every leader seam around a
+  mid-stream migration keeps every record exactly once in served ∪
+  dead-letter with carries bit-equal to a run that never migrated;
+- a failed migration ROLLS BACK with exactly-once intact;
+- the admission grace seam (``note_migrated``) un-wedges shed-held
+  backlogs after a move;
+- the ``skew`` soak scenario collapses with ``FLUVIO_REBALANCE=0`` and
+  passes with the daemon armed (the scoring gate);
+- observability: telemetry families, snapshot/prom/CLI surfaces, the
+  ``partition.rebalancer`` lock in the static vocabulary.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from fluvio_tpu.partition.failover import FailoverCoordinator
+from fluvio_tpu.partition.placement import (
+    parse_placement_rules,
+    partition_key,
+    plan_placement,
+)
+from fluvio_tpu.partition.rebalancer import (
+    MOVE_REASONS,
+    PartitionRebalancer,
+    RebalanceConfig,
+    partition_of,
+    rebalance_enabled,
+    rebalance_status,
+    set_active,
+)
+from fluvio_tpu.resilience import faults
+from fluvio_tpu.telemetry import TELEMETRY
+from fluvio_tpu.telemetry import lag as lag_mod
+
+CHAIN_SPEC = [
+    {"name": "regex-filter", "kind": "filter", "params": {"regex": "fluvio"}},
+    {
+        "name": "aggregate-field",
+        "kind": "aggregate",
+        "params": {"field": "n", "combine": "add"},
+    },
+]
+
+LEADER_POINTS = ("stage", "h2d", "dispatch", "device", "fetch")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    TELEMETRY.reset()
+    prior = TELEMETRY.enabled
+    TELEMETRY.enabled = True
+    lag_mod.reset_engine()
+    faults.FAULTS.clear()
+    set_active(None)
+    yield
+    faults.FAULTS.clear()
+    set_active(None)
+    lag_mod.reset_engine()
+    TELEMETRY.enabled = prior
+    TELEMETRY.reset()
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+class PlanBox:
+    """A mutable plan holder exposing the (plan_view, mover) pair the
+    daemon wires to — the pure-control-plane stand-in for a gate."""
+
+    def __init__(self, plan):
+        self.plan = plan
+        self.calls = []
+
+    def view(self):
+        return self.plan
+
+    def mover(self, key: str, group: int, reason: str) -> bool:
+        self.calls.append((key, group, reason))
+        if key not in self.plan.assignments:
+            # real movers (PartitionRuntime/BrokerPartitionGate) register
+            # lazily via with_partitions before acting
+            self.plan = self.plan.with_partitions([key])
+        new = self.plan.move_partition(key, group)
+        changed = new is not self.plan
+        self.plan = new
+        return changed
+
+
+def _plan(keys, n_groups=2, pin=None):
+    rules = parse_placement_rules(f".*={pin}") if pin is not None else ()
+    return plan_placement(rules, keys, n_groups)
+
+
+# ---------------------------------------------------------------------------
+# PlacementPlan voluntary-move primitives (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanPrimitives:
+    def test_move_partition_leaves_failed_untouched(self):
+        plan = _plan(["t/0", "t/1"], n_groups=3, pin=0)
+        moved = plan.move_partition("t/0", 2)
+        assert moved.assignments["t/0"] == 2
+        assert moved.assignments["t/1"] == 0
+        assert moved.failed == frozenset()
+        assert moved.moves == 1 and plan.moves == 0
+        # the vacated group stays schedulable for NEW partitions
+        assert 0 in moved.live_groups()
+
+    def test_move_is_a_noop_when_already_there(self):
+        plan = _plan(["t/0"], pin=1)
+        assert plan.move_partition("t/0", 1) is plan
+        assert plan.moves == 0
+
+    def test_move_rejects_bad_targets(self):
+        plan = _plan(["t/0", "t/1"], n_groups=3, pin=0).rebalance(2)
+        with pytest.raises(KeyError):
+            plan.move_partition("t/9", 1)
+        with pytest.raises(ValueError):
+            plan.move_partition("t/0", 3)
+        with pytest.raises(ValueError):
+            plan.move_partition("t/0", 2)  # failed group
+
+    def test_split_group_moves_alternating_keys(self):
+        plan = _plan([f"t/{i}" for i in range(4)], pin=0)
+        split = plan.split_group(0, 1)
+        assert [split.assignments[f"t/{i}"] for i in range(4)] == [0, 1, 0, 1]
+        assert split.moves == 2
+        assert split.failed == frozenset()
+
+    def test_merge_groups_folds_src_onto_dst_src_stays_live(self):
+        plan = _plan([f"t/{i}" for i in range(4)], pin=0).split_group(0, 1)
+        merged = plan.merge_groups(1, 0)
+        assert set(merged.assignments.values()) == {0}
+        assert 1 in merged.live_groups()  # unlike rebalance()
+        with pytest.raises(ValueError):
+            merged.merge_groups(0, 0)
+
+    def test_moves_counter_survives_serialization_and_extension(self):
+        plan = _plan(["t/0", "t/1"], pin=0).move_partition("t/0", 1)
+        assert plan.to_dict()["moves"] == 1
+        extended = plan.with_partitions(["t/2"])
+        assert extended.moves == 1
+        failed = plan.rebalance(0)
+        assert failed.moves == 1 and failed.rebalances == 1
+
+
+# ---------------------------------------------------------------------------
+# control-loop decisions (deterministic under the injected clock)
+# ---------------------------------------------------------------------------
+
+
+def _reb(box, lags, cfg=None, clock=None):
+    return PartitionRebalancer(
+        box.view,
+        box.mover,
+        config=cfg
+        or RebalanceConfig(
+            interval_s=0.0, burn=1.0, cooldown_s=5.0, max_moves=2,
+            hysteresis=4.0,
+        ),
+        clock=clock or FakeClock(),
+        lag_reader=lambda: dict(lags),
+    )
+
+
+class TestControlLoop:
+    def test_stalled_hot_partition_moves_to_coldest_group(self):
+        box = PlanBox(_plan(["t/0", "t/1"], n_groups=3, pin=0))
+        lags = {"t/0": 50.0, "t/1": 1.0}
+        clk = FakeClock()
+        reb = _reb(box, lags, clock=clk)
+        assert reb.tick() == []  # first sighting only seeds the baseline
+        clk.advance(1.0)
+        moves = reb.tick()  # stalled (burn 0) above the floor: hot
+        assert len(moves) == 1
+        assert moves[0]["key"] == "t/0" and moves[0]["reason"] == "lag"
+        assert box.plan.assignments["t/0"] in (1, 2)
+        assert reb.moves_total == 1
+
+    def test_growing_lag_is_hot_draining_lag_is_left_alone(self):
+        box = PlanBox(_plan(["t/0"], n_groups=2, pin=0))
+        lags = {"t/0": 40.0}
+        clk = FakeClock()
+        reb = _reb(box, lags, clock=clk)
+        reb.tick()
+        # draining at 10 rec/s >= the required 1 rec/s: healthy
+        lags["t/0"] = 30.0
+        clk.advance(1.0)
+        assert reb.tick() == []
+        # now it grows again: hot
+        lags["t/0"] = 45.0
+        clk.advance(1.0)
+        moves = reb.tick()
+        assert len(moves) == 1 and box.plan.assignments["t/0"] == 1
+
+    def test_hysteresis_floor_suppresses_micro_lag(self):
+        box = PlanBox(_plan(["t/0"], n_groups=2, pin=0))
+        lags = {"t/0": 3.0}  # below the 4-record floor
+        clk = FakeClock()
+        reb = _reb(box, lags, clock=clk)
+        reb.tick()
+        clk.advance(1.0)
+        assert reb.tick() == []
+        assert reb.moves_total == 0
+
+    def test_nowhere_colder_guard(self):
+        # both groups carry the same heat: moving only spreads it
+        box = PlanBox(_plan(["t/0", "t/1"], n_groups=2, pin=0))
+        box.plan = box.plan.move_partition("t/1", 1)
+        lags = {"t/0": 20.0, "t/1": 20.0}
+        clk = FakeClock()
+        reb = _reb(box, lags, clock=clk)
+        reb.tick()
+        clk.advance(1.0)
+        assert reb.tick() == []
+
+    def test_move_budget_bounds_each_tick(self):
+        box = PlanBox(_plan([f"t/{i}" for i in range(4)], n_groups=4, pin=0))
+        lags = {f"t/{i}": 100.0 for i in range(4)}
+        clk = FakeClock()
+        reb = _reb(box, lags, clock=clk)
+        reb.tick()
+        clk.advance(1.0)
+        assert len(reb.tick()) == 2  # max_moves, not all four
+
+    def test_flap_suppression_cooldown_bounds_oscillating_load(self):
+        """An oscillating hot partition produces at most one move per
+        cooldown window — 50 ticks over 5 s of clock with a 5 s
+        cooldown means at most 2 moves (t=0 and t=5)."""
+        box = PlanBox(_plan(["t/0"], n_groups=2, pin=0))
+        lags = {"t/0": 100.0}
+        clk = FakeClock()
+        reb = _reb(box, lags, clock=clk)
+        for i in range(51):
+            lags["t/0"] = 100.0 if i % 2 else 90.0  # oscillate, stay hot
+            reb.tick()
+            clk.advance(0.1)
+        assert 1 <= reb.moves_total <= 2, reb.moves_total
+
+    def test_held_from_birth_partition_is_visible_via_plan_rules(self):
+        """A stream shed-held since its FIRST slice never entered the
+        lazy plan; the daemon must resolve it through the plan rules at
+        tick time instead of skipping it."""
+        box = PlanBox(_plan(["t/0"], n_groups=2, pin=0))
+        lags = {"t/0": 10.0, "t/1": 50.0}  # t/1 unknown to the plan
+        clk = FakeClock()
+        reb = _reb(box, lags, clock=clk)
+        reb.tick()
+        clk.advance(1.0)
+        moves = reb.tick()
+        assert any(m["key"] == "t/1" for m in moves)
+
+    def test_broken_mover_books_rollback_and_daemon_survives(self):
+        box = PlanBox(_plan(["t/0"], n_groups=2, pin=0))
+
+        def boom(key, group, reason):
+            raise RuntimeError("actuator on fire")
+
+        clk = FakeClock()
+        reb = PartitionRebalancer(
+            box.view, boom,
+            config=RebalanceConfig(cooldown_s=5.0),
+            clock=clk,
+            lag_reader=lambda: {"t/0": 50.0},
+        )
+        reb.tick()
+        clk.advance(1.0)
+        assert reb.tick() == []  # the failed move is not a move
+        assert reb.rollbacks == 1 and reb.moves_total == 0
+        assert "actuator" in reb.status()["recent"][-1]["error"]
+
+    def test_split_reason_when_fold_burns_past_budget(self):
+        # one group owns every partition, the other is empty; more hot
+        # keys than the budget -> the surplus splits onto the idle fold
+        box = PlanBox(_plan([f"t/{i}" for i in range(4)], n_groups=2, pin=0))
+        lags = {f"t/{i}": 100.0 for i in range(4)}
+        clk = FakeClock()
+        cfg = RebalanceConfig(cooldown_s=0.0, max_moves=4, hysteresis=4.0)
+        reb = _reb(box, lags, cfg=cfg, clock=clk)
+        reb.tick()
+        clk.advance(1.0)
+        moves = reb.tick()
+        assert moves and set(box.plan.assignments.values()) == {0, 1}
+        reasons = {m["reason"] for m in moves}
+        assert reasons <= set(MOVE_REASONS)
+
+    def test_explicit_split_and_merge(self):
+        box = PlanBox(_plan([f"t/{i}" for i in range(4)], n_groups=2, pin=0))
+        reb = _reb(box, {}, clock=FakeClock())
+        split_moves = reb.split(0, 1)
+        assert [m["reason"] for m in split_moves] == ["split", "split"]
+        assert sorted(set(box.plan.assignments.values())) == [0, 1]
+        merge_moves = reb.merge(1, 0)
+        assert all(m["reason"] == "merge" for m in merge_moves)
+        assert set(box.plan.assignments.values()) == {0}
+        assert reb.moves_total == 4
+
+    def test_single_live_group_never_moves(self):
+        box = PlanBox(_plan(["t/0"], n_groups=1, pin=0))
+        reb = _reb(box, {"t/0": 100.0}, clock=FakeClock())
+        reb.tick()
+        assert reb.tick() == []
+
+    def test_partition_of_strips_chain_identity(self):
+        assert partition_of("sig123@t00.s0/0") == "t00.s0/0"
+        assert partition_of("t00.s0/0") == "t00.s0/0"
+
+    def test_config_from_env_and_master_switch(self):
+        env = {
+            "FLUVIO_REBALANCE": "0",
+            "FLUVIO_REBALANCE_BURN": "2.5",
+            "FLUVIO_REBALANCE_COOLDOWN_S": "9",
+            "FLUVIO_REBALANCE_MAX_MOVES": "0",
+            "FLUVIO_REBALANCE_HYSTERESIS": "8",
+            "FLUVIO_REBALANCE_INTERVAL_S": "0.5",
+        }
+        assert rebalance_enabled(env) is False
+        assert rebalance_enabled({}) is True  # armed by default
+        cfg = RebalanceConfig.from_env(env)
+        assert cfg.burn == 2.5 and cfg.cooldown_s == 9.0
+        assert cfg.max_moves == 1  # floor of 1
+        assert cfg.hysteresis == 8.0 and cfg.interval_s == 0.5
+
+    def test_status_document_shape(self):
+        box = PlanBox(_plan(["t/0"], n_groups=2, pin=0))
+        clk = FakeClock()
+        reb = _reb(box, {"t/0": 50.0}, clock=clk)
+        reb.tick()
+        clk.advance(1.0)
+        reb.tick()
+        doc = json.loads(json.dumps(reb.status()))
+        assert doc["enabled"] and doc["ticks"] == 2
+        assert doc["moves_total"] == 1
+        assert doc["partitions"]["t/0"]["lag"] == 50.0
+        assert doc["config"]["hysteresis"] == 4.0
+        assert doc["moves"].get("lag") == 1
+        assert doc["recent"][-1]["key"] == "t/0"
+        # the process-global handle serves the same document
+        set_active(reb)
+        assert rebalance_status()["moves_total"] == 1
+        set_active(None)
+        fallback = rebalance_status()
+        assert fallback["partitions"] == {}
+        assert fallback["moves"].get("lag") == 1  # counters survive
+
+
+# ---------------------------------------------------------------------------
+# demote-the-leader migration: chaos matrix + rollback (tentpole pins)
+# ---------------------------------------------------------------------------
+
+
+def _slab(vals, base=0):
+    from fluvio_tpu.protocol.record import Record
+    from fluvio_tpu.smartmodule.types import SmartModuleInput
+
+    return SmartModuleInput.from_records(
+        [
+            Record(value=json.dumps({"n": v, "name": f"fluvio-{v}"}).encode())
+            for v in vals
+        ],
+        base_offset=base,
+        base_timestamp=0,
+    )
+
+
+def _stream():
+    return [
+        (0, _slab([1, 2])),
+        (1, _slab([5])),
+        (0, _slab([3])),
+        (1, _slab([7, 8])),
+        (0, _slab([4, 6])),
+        (1, _slab([9])),
+    ]
+
+
+EXTRA = ([10, 11], [12])  # un-acked suffix slabs appended behind serving
+
+
+class TestMigrationExactness:
+    """Every run serves stream[:3], syncs EXTRA into partition 0's
+    follower log un-acked (replication runs ahead of serving), migrates
+    partition 0 to the other group — replaying EXTRA on the NEW group —
+    then serves stream[3:]. The reference run does the same with no
+    faults; chaos variants must end bit-identical."""
+
+    def _run(self, migrate=True):
+        coord = FailoverCoordinator(CHAIN_SPEC, n_groups=2)
+        stream = _stream()
+        coord.run(stream[:3])
+        key = partition_key("t", 0)
+        committed = coord.leader.offsets.committed(key)
+        base = max(committed, 0)
+        for vals in EXTRA:
+            coord.logs[key].append(base, base + len(vals), _slab(vals))
+            base += len(vals)
+        res = None
+        if migrate:
+            src = coord.leader.plan.assignments[key]
+            dst = next(
+                g for g in coord.leader.plan.live_groups() if g != src
+            )
+            res = coord.migrate_partition(0, dst, reason="lag")
+        else:
+            coord.promote()  # serve EXTRA via plain promotion replay
+        coord.run(stream[3:])
+        return coord, res
+
+    def test_migration_replays_unacked_suffix_exactly_once(self):
+        clean, _ = self._run(migrate=False)
+        coord, res = self._run(migrate=True)
+        assert res["ok"] and res["moved"]
+        assert res["replayed"] == len(EXTRA)
+        assert coord.migrations == 1 and coord.promotions == 0
+        for p in (0, 1):
+            assert coord.final_carries(p) == clean.final_carries(p)
+            assert sorted(coord.served_values(p)) == sorted(
+                clean.served_values(p)
+            )
+        # committed offsets advanced over every input exactly once
+        assert (
+            coord.leader.offsets.snapshot()
+            == clean.leader.offsets.snapshot()
+        )
+
+    @pytest.mark.parametrize("point", LEADER_POINTS)
+    @pytest.mark.parametrize("nth", (1, 2))
+    def test_chaos_matrix_mid_migration_is_exactly_once(self, point, nth):
+        """Arm a deterministic fault just before the migration: it
+        fires either inside the migration's replay ladder (absorbed or
+        rolled back) or on the post-migration stream (leader death ->
+        promotion). Every outcome must leave served ∪ dead-letter
+        exactly-once and carries bit-equal to the no-fault run."""
+        clean, _ = self._run(migrate=True)
+        faults.FAULTS.clear()
+        coord = FailoverCoordinator(CHAIN_SPEC, n_groups=2)
+        stream = _stream()
+        coord.run(stream[:3])
+        key = partition_key("t", 0)
+        committed = coord.leader.offsets.committed(key)
+        base = max(committed, 0)
+        for vals in EXTRA:
+            coord.logs[key].append(base, base + len(vals), _slab(vals))
+            base += len(vals)
+        faults.FAULTS.inject(point, first=nth, exc="deterministic")
+        src = coord.leader.plan.assignments[key]
+        dst = next(g for g in coord.leader.plan.live_groups() if g != src)
+        res = coord.migrate_partition(0, dst, reason="lag")
+        if not res["ok"]:
+            # rolled back: the suffix is still replayable — the next
+            # promotion serves it (the documented recovery path)
+            faults.FAULTS.clear()
+            coord.promote()
+        coord.run(stream[3:])
+        faults.FAULTS.clear()
+        rule = faults.FAULTS.rule(point)
+        for p in (0, 1):
+            assert coord.final_carries(p) == clean.final_carries(p), (
+                f"partition {p} carries diverged after {point}:first={nth} "
+                f"(migration ok={res['ok']})"
+            )
+            assert sorted(coord.served_values(p)) == sorted(
+                clean.served_values(p)
+            ), f"partition {p} served set diverged at {point}:first={nth}"
+        assert (
+            coord.leader.offsets.snapshot()
+            == clean.leader.offsets.snapshot()
+        )
+
+    def test_failed_migration_rolls_back_exactly_once(self):
+        clean, _ = self._run(migrate=False)
+        coord = FailoverCoordinator(CHAIN_SPEC, n_groups=2)
+        stream = _stream()
+        coord.run(stream[:3])
+        key = partition_key("t", 0)
+        committed = coord.leader.offsets.committed(key)
+        base = max(committed, 0)
+        for vals in EXTRA:
+            coord.logs[key].append(base, base + len(vals), _slab(vals))
+            base += len(vals)
+        src = coord.leader.plan.assignments[key]
+        dst = next(g for g in coord.leader.plan.live_groups() if g != src)
+
+        def _lava(topic, partition, slab):
+            raise RuntimeError("new group is lava")
+
+        coord.leader.process_chain = _lava  # instance shadow
+        res = coord.migrate_partition(0, dst, reason="lag")
+        del coord.leader.process_chain
+        assert res["ok"] is False and res["moved"] is False
+        assert "lava" in res["error"]
+        assert coord.migrations_failed == 1 and coord.migrations == 0
+        # rolled back onto the old group, suffix still in the log
+        assert coord.leader.plan.assignments[key] == src
+        assert len(coord.logs[key].unacked(committed)) == len(EXTRA)
+        # the rollback is on the telemetry books
+        moves, _ = TELEMETRY.rebalance_families()
+        assert moves.get("rollback", 0) >= 1
+        # recovery: the next promotion replays the suffix — the final
+        # state is indistinguishable from a run that never migrated
+        coord.promote()
+        coord.run(stream[3:])
+        for p in (0, 1):
+            assert coord.final_carries(p) == clean.final_carries(p)
+            assert sorted(coord.served_values(p)) == sorted(
+                clean.served_values(p)
+            )
+
+    def test_partial_replay_rollback_keeps_committed_prefix(self):
+        """A replay that commits slab 1 then dies on slab 2 rolls back
+        seeded with the NEWEST snapshot: the committed prefix stays
+        committed (monotonic), only the un-served tail remains in the
+        log — nothing replays twice."""
+        coord = FailoverCoordinator(CHAIN_SPEC, n_groups=2)
+        stream = _stream()
+        coord.run(stream[:3])
+        key = partition_key("t", 0)
+        committed0 = coord.leader.offsets.committed(key)
+        base = max(committed0, 0)
+        for vals in EXTRA:
+            coord.logs[key].append(base, base + len(vals), _slab(vals))
+            base += len(vals)
+        real = coord.leader.process_chain
+        calls = []
+
+        def _second_fails(topic, partition, slab):
+            calls.append(1)
+            if len(calls) >= 2:
+                raise RuntimeError("died mid-replay")
+            return real(topic, partition, slab)
+
+        coord.leader.process_chain = _second_fails
+        src = coord.leader.plan.assignments[key]
+        dst = next(g for g in coord.leader.plan.live_groups() if g != src)
+        res = coord.migrate_partition(0, dst)
+        del coord.leader.process_chain
+        assert res["ok"] is False and res["replayed"] == 1
+        # the first EXTRA slab committed and LEFT the un-acked window
+        committed1 = coord.leader.offsets.committed(key)
+        assert committed1 == committed0 + len(EXTRA[0])
+        assert len(coord.logs[key].unacked(committed1)) == 1
+        served_before = len(coord.served_values(0))
+        coord.promote()  # replays only the tail
+        assert len(coord.served_values(0)) == served_before + len(EXTRA[1])
+
+    def test_migration_to_same_group_is_a_noop(self):
+        coord = FailoverCoordinator(CHAIN_SPEC, n_groups=2)
+        coord.run(_stream()[:2])
+        key = partition_key("t", 0)
+        src = coord.leader.plan.assignments[key]
+        res = coord.migrate_partition(0, src)
+        assert res["ok"] and not res["moved"] and res["replayed"] == 0
+        assert coord.migrations == 0
+
+
+# ---------------------------------------------------------------------------
+# admission grace seam (the shed-hold deadlock breaker)
+# ---------------------------------------------------------------------------
+
+
+class TestMigrationGrace:
+    def _controller(self, clk):
+        import random
+
+        from fluvio_tpu.admission.controller import AdmissionController
+
+        class _Slo:
+            def __init__(self):
+                self.doc = {"enabled": True, "chains": {}}
+
+            def evaluate(self, tick: bool = True):
+                return self.doc
+
+        slo = _Slo()
+        ctl = AdmissionController(
+            slo_engine=slo, clock=clk, rng=random.Random(7),
+            refresh_s=1.0, tokens=1e9, refill=1e9,
+        )
+        return ctl, slo
+
+    def test_grace_window_unwedges_breach_shed(self):
+        clk = FakeClock()
+        ctl, slo = self._controller(clk)
+        chain = "sig@t00.s0/0"
+        other = "sig@t01.s0/0"
+        slo.doc = {
+            "enabled": True,
+            "chains": {
+                chain: {"verdict": "breach", "rules": {}},
+                other: {"verdict": "breach", "rules": {}},
+            },
+        }
+        d = ctl.admit(chain)
+        assert not d and d.reason == "breach-shed"
+        # the migration grace downgrades the breach: serving resumes so
+        # the backlog can actually drain on the new group
+        ctl.note_migrated("t00.s0/0", grace_s=10.0)
+        assert ctl.admit(chain).admitted
+        # an unrelated breached partition stays shed — the grace is
+        # scoped to the migrated partition, not a global bypass
+        assert not ctl.admit(other)
+        # grace expires: the verdict bites again
+        clk.advance(11.0)
+        d = ctl.admit(chain)
+        assert not d and d.reason == "breach-shed"
+
+    def test_grace_is_not_a_token_bypass(self):
+        import random
+
+        from fluvio_tpu.admission.controller import AdmissionController
+
+        clk = FakeClock()
+        ctl = AdmissionController(
+            slo_engine=type(
+                "S", (), {"evaluate": lambda self, tick=True: {
+                    "enabled": True, "chains": {}}}
+            )(),
+            clock=clk, rng=random.Random(7), refresh_s=1.0,
+            tokens=1.0, refill=0.0,
+        )
+        ctl.note_migrated("t00.s0/0", grace_s=30.0)
+        assert ctl.admit("sig@t00.s0/0").admitted
+        d = ctl.admit("sig@t00.s0/0")  # bucket empty: still shed
+        assert not d and d.reason == "no-tokens"
+
+
+# ---------------------------------------------------------------------------
+# the skew soak scoring gate (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestSkewScenarioGate:
+    def test_skew_collapses_with_rebalancer_off(self, monkeypatch):
+        from fluvio_tpu.soak import (
+            build_verdict, parse_scenario, run_scenario, validate_verdict,
+        )
+
+        monkeypatch.setenv("FLUVIO_REBALANCE", "0")
+        sc = parse_scenario("skew:timeout_s=5")
+        run = run_scenario(sc)
+        doc = build_verdict(sc, run)
+        assert validate_verdict(doc) == []
+        assert doc["verdict"] == "collapse" and doc["rc"] == 1
+        assert doc["collapse"]["held_now"] >= 1
+        assert "rebalance" not in run  # the daemon never armed
+
+    def test_skew_passes_with_daemon_armed(self, monkeypatch):
+        from fluvio_tpu.soak import (
+            build_verdict, parse_scenario, run_scenario, validate_verdict,
+        )
+
+        monkeypatch.setenv("FLUVIO_REBALANCE", "1")
+        sc = parse_scenario("skew")
+        run = run_scenario(sc)
+        doc = build_verdict(sc, run)
+        assert validate_verdict(doc) == []
+        assert doc["verdict"] == "pass" and doc["rc"] == 0, doc
+        # the daemon really moved something off the pinned-hot group
+        assert run["rebalance"]["moves"] >= 1
+        assert run["rebalance"]["rollbacks"] == 0
+        # exactly-once across the migration: the ledger closes exact
+        acct = doc["accounting"]
+        assert acct["ok"]
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces (satellite 4)
+# ---------------------------------------------------------------------------
+
+
+class TestObservability:
+    def test_rebalance_families_snapshot_and_reset(self):
+        TELEMETRY.add_rebalance_move("lag", "t/0:0->1")
+        TELEMETRY.add_rebalance_move("lag", "t/1:0->1")
+        TELEMETRY.add_rebalance_move("rollback", "t/2:1->0")
+        TELEMETRY.add_migration_seconds(0.25)
+        moves, hist = TELEMETRY.rebalance_families()
+        assert moves == {"lag": 2, "rollback": 1}
+        assert hist.count == 1
+        snap = TELEMETRY.snapshot()
+        assert snap["counters"]["rebalance_moves"] == moves
+        assert snap["rebalance"]["moves"] == moves
+        assert snap["rebalance"]["migration_seconds"]["count"] == 1
+        ts = TELEMETRY.timeseries_sample()
+        assert ts["counters"]["rebalance_moves"] == 3
+        assert ts["migration_hist"].count == 1
+        TELEMETRY.reset()
+        moves, hist = TELEMETRY.rebalance_families()
+        assert moves == {} and hist.count == 0
+
+    def test_counter_is_always_on_histogram_is_gated(self):
+        TELEMETRY.enabled = False
+        TELEMETRY.add_rebalance_move("manual", "t/0:0->1")
+        TELEMETRY.add_migration_seconds(1.0)
+        moves, hist = TELEMETRY.rebalance_families()
+        assert moves == {"manual": 1}  # counters always book
+        assert hist.count == 0  # histograms follow the capture switch
+
+    def test_rebalance_instant_event_lands_in_flight_recorder(self):
+        TELEMETRY.add_rebalance_move("lag", "t/0:0->1")
+        evts = [
+            e for e in TELEMETRY.events_json() if e.get("kind") == "rebalance"
+        ]
+        assert evts and evts[-1]["detail"] == "t/0:0->1"
+
+    def test_prometheus_export_carries_both_families(self):
+        from fluvio_tpu.telemetry.prometheus import render_prometheus
+
+        TELEMETRY.add_rebalance_move("lag", "t/0:0->1")
+        TELEMETRY.add_migration_seconds(0.12)
+        text = render_prometheus()
+        assert 'fluvio_tpu_rebalance_moves_total{reason="lag"} 1' in text
+        assert "fluvio_tpu_migration_seconds_count 1" in text
+        assert "fluvio_tpu_migration_seconds_sum" in text
+
+    def test_metrics_cli_table_carries_rebalance_rows(self):
+        from fluvio_tpu.cli.metrics import render_metrics_table
+
+        TELEMETRY.add_rebalance_move("lag", "t/0:0->1")
+        table = render_metrics_table({"telemetry": TELEMETRY.snapshot()})
+        assert "rebalance[lag]" in table
+
+    def test_rebalance_cli_table_and_rc(self):
+        from fluvio_tpu.cli.rebalance import render_rebalance_table
+
+        box = PlanBox(_plan(["t/0"], n_groups=2, pin=0))
+        clk = FakeClock()
+        reb = _reb(box, {"t/0": 50.0}, clock=clk)
+        reb.tick()
+        clk.advance(1.0)
+        reb.tick()
+        doc = reb.status()
+        table = render_rebalance_table(doc)
+        assert "rebalancer: armed" in table and "t/0" in table
+        assert "moves=1" in table
+        empty = render_rebalance_table(
+            {"enabled": False, "ticks": 0, "moves_total": 0,
+             "rollbacks": 0, "partitions": {}, "moves": {}, "recent": []}
+        )
+        assert "no rebalance activity" in empty
+
+    def test_rebalance_cli_rc_symmetric_with_health(self):
+        from fluvio_tpu.cli import main
+
+        box = PlanBox(_plan(["t/0"], n_groups=2, pin=0))
+        reb = _reb(box, {}, clock=FakeClock())
+        set_active(reb)
+        assert main(["rebalance", "--status", "--local"]) == 0
+        reb.rollbacks = 1
+        assert main(
+            ["rebalance", "--status", "--local", "--format", "json"]
+        ) == 1
+
+    def test_rebalancer_lock_in_static_vocabulary(self):
+        import fluvio_tpu.partition.rebalancer  # noqa: F401 — registration
+        from fluvio_tpu.analysis import analyze_concurrency
+
+        names = set(analyze_concurrency().locks)
+        assert "partition.rebalancer" in names
+
+    def test_rebalance_flags_registered(self):
+        from fluvio_tpu.analysis.envreg import REGISTRY
+
+        names = {f.name for f in REGISTRY}
+        assert {
+            "FLUVIO_REBALANCE",
+            "FLUVIO_REBALANCE_BURN",
+            "FLUVIO_REBALANCE_COOLDOWN_S",
+            "FLUVIO_REBALANCE_HYSTERESIS",
+            "FLUVIO_REBALANCE_INTERVAL_S",
+            "FLUVIO_REBALANCE_MAX_MOVES",
+        } <= names
