@@ -106,6 +106,23 @@ def gen_ints(n: int):
     return [str(nums[i]).encode() for i in range(n)]
 
 
+def gen_keyed_ints(n: int):
+    """``"<key> <value>"`` two-int records for the keyed windowed
+    family (config #12): 64 keys, values 0..999."""
+    rng = np.random.default_rng(13)
+    keys = rng.integers(0, 64, size=n)
+    vals = rng.integers(0, 1000, size=n)
+    return [f"{keys[i]} {vals[i]}".encode() for i in range(n)]
+
+
+def _ts_event_time(n: int):
+    """Monotonic event-time ms for the windowed family: 4 ms spacing
+    -> 250 records per 1000 ms window. The seed corpus's cyclic
+    ``% 60_000`` timestamps wrap every minute, which a watermark
+    engine correctly reads as ~100% late data — useless for windows."""
+    return np.arange(n, dtype=np.int64) * 4
+
+
 def gen_json_300b(n: int):
     """~300-byte records: spans exceed 255 so the D2H descriptors ride
     the uint16 narrowing tier instead of uint8."""
@@ -152,10 +169,14 @@ CONFIGS = {
         "specs": [("array-map-json", None)],
         "corpus": gen_arrays,
     },
+    # windowed family (ISSUE-19): device-resident window state with
+    # delta-only emission. #5 keeps the classic windowed-sum chain as
+    # its A arm (the d2h-wall baseline the delta engine must cut).
     "5_windowed": {
         "specs": [("windowed-sum", {"kind": "sum_int", "window_ms": "1000"})],
         "corpus": gen_ints,
-        "ts": lambda n: (np.arange(n, dtype=np.int64) * 7919) % 60_000,
+        "ts": _ts_event_time,
+        "windowed": {"kind": "sum_int", "window_ms": 1000, "classic": True},
     },
     # narrowing-tier sweep (VERDICT r3 weak #8): 300 B records push span
     # descriptors onto the uint16 tier; 70 KiB records exceed the narrow
@@ -216,6 +237,29 @@ CONFIGS = {
         ],
         "corpus": gen_fat_70k,
         "divisor": 1024,
+    },
+    # windowed family, engine-only members (ISSUE-19): sliding (#11,
+    # fanout 4) and per-key segmented state over "k v" records (#12).
+    # No classic chain can express their semantics, so their d2h
+    # evidence is the hardware-independent delta-vs-full byte ratio;
+    # both pin bit-equality against the host reference at EVERY batch
+    # boundary. `emit`/`batch_records` size the bounded emit slice so
+    # a batch's event-time span never overflows it (overflow degrades
+    # to a resync, which the exactness pin would reject).
+    "11_windowed_sliding": {
+        "specs": [("windowed-sum", {"kind": "sum_int", "window_ms": "1000"})],
+        "corpus": gen_ints,
+        "ts": _ts_event_time,
+        "divisor": 2,
+        "windowed": {"kind": "sum_int", "window_ms": 1000, "slide_ms": 250},
+    },
+    "12_windowed_keyed": {
+        "specs": [("windowed-sum", {"kind": "sum_int", "window_ms": "1000"})],
+        "corpus": gen_keyed_ints,
+        "ts": _ts_event_time,
+        "divisor": 2,
+        "windowed": {"kind": "sum_int", "window_ms": 1000, "keyed": True,
+                     "emit": 4096, "batch_records": 8192},
     },
 }
 
@@ -753,9 +797,229 @@ def _run_partitioned_config(
     return result
 
 
+def _run_windowed_config(
+    name: str, cfg: dict, n: int, smoke: bool, deadline=None
+) -> dict:
+    """Windowed-family driver (ISSUE-19): the delta-only windowed-state
+    engine measured against host truth at every batch boundary.
+
+    Two arms. The **classic arm** (``windowed.classic``, config #5
+    only) runs the pre-existing ship-every-record windowed-sum chain
+    through `_run_config` — its serial-pass ``phases.phase_ms.d2h`` is
+    the downlink wall the delta engine must cut >=3x. The **delta arm**
+    streams the same corpus through `WindowedRuntime` in batches: the
+    window bank never leaves the device, only closed windows + changed
+    accumulators cross down (`WindowDelta`), folded into a
+    `MaterializedView` and pinned bit-equal against
+    `HostWindowReference` — table AND device carry — after EVERY batch.
+    Engine-only members (sliding/keyed) have no classic chain for their
+    semantics; their d2h evidence is the hardware-independent
+    delta-vs-full byte ratio."""
+    from fluvio_tpu.telemetry import TELEMETRY
+    from fluvio_tpu.windows import (
+        HostWindowReference,
+        MaterializedView,
+        WindowSpec,
+        WindowedRuntime,
+    )
+    from fluvio_tpu.windows.spec import KIND_TO_OP, delta_enabled
+
+    w = cfg["windowed"]
+    spec = WindowSpec(
+        window_ms=int(w["window_ms"]),
+        slide_ms=int(w.get("slide_ms", 0)),
+        op=KIND_TO_OP[str(w.get("kind", "sum_int"))],
+        keyed=bool(w.get("keyed", False)),
+        emit_capacity=int(w.get("emit", 0)),
+        delta_only=delta_enabled(),
+    )
+
+    result = None
+    if w.get("classic"):
+        result = _run_config(name, cfg, n, smoke, deadline, headline=False)
+    divisor = cfg.get("divisor", 1)
+    if divisor > 1:
+        n = max(n // divisor, 1024)
+
+    log(f"[{name}] delta arm: {spec.describe()} over {n} records")
+    values = cfg["corpus"](n)
+    ts = cfg["ts"](n)
+
+    preflight = result.get("preflight") if result else None
+    if preflight is None:
+        try:
+            from fluvio_tpu.analysis import preflight_for_specs
+
+            preflight = preflight_for_specs(
+                cfg["specs"], max(len(v) for v in values)
+            )
+            log(
+                "  preflight: predicted window variant "
+                f"{preflight.get('window_variant', 'off')}"
+            )
+        except Exception as e:  # noqa: BLE001 — analysis must never cost a run
+            log(f"  preflight analysis failed: {type(e).__name__}: {e}")
+
+    per = int(w.get("batch_records", 16384))
+    if smoke:
+        # smoke still wants several inter-batch carry boundaries
+        per = min(per, max(n // 6, 512))
+    # even split: a runt tail batch would land in a smaller padded-rows
+    # shape bucket and pay a full fresh compile for 2 records
+    n_batches = max(1, -(-n // per))
+    per = -(-n // n_batches)
+    slices = [(a, min(a + per, n)) for a in range(0, n, per)]
+
+    ref = HostWindowReference(spec)
+    view = MaterializedView(spec)
+    rt = WindowedRuntime(spec)
+    ct0 = TELEMETRY.compile_totals()
+    pt0 = TELEMETRY.phase_totals()
+    wc0 = TELEMETRY.window_counts()
+    bt = []  # per-batch device-arm seconds
+    ref_wall = 0.0  # host-truth fold seconds (the python baseline)
+    rows_kind = 0  # deltas that shipped as delta rows (vs resync)
+    pt_warm = None  # phase totals AFTER the compile-paying first batch
+    for a, b in slices:
+        buf = _pack(values[a:b], ts[a:b])
+        t0 = time.time()
+        delta = rt.process_buffer(buf)
+        bt.append(time.time() - t0)
+        if pt_warm is None:
+            pt_warm = TELEMETRY.phase_totals()
+        view.apply_delta(delta)
+        rows_kind += delta.kind == "rows"
+        # host truth over the same records at the same absolute event
+        # time (_pack stamps base_timestamp=1_000_000). The corpora are
+        # pure ASCII ints, so int() matches the kernel's leading-int
+        # parse exactly.
+        t0 = time.time()
+        if spec.keyed:
+            recs = []
+            for r, t in zip(values[a:b], ts[a:b]):
+                k, v = r.split(b" ", 1)
+                recs.append((int(k), int(v), int(t) + 1_000_000))
+        else:
+            recs = [
+                (0, int(r), int(t) + 1_000_000)
+                for r, t in zip(values[a:b], ts[a:b])
+            ]
+        ref.process_batch(recs)
+        ref_wall += time.time() - t0
+        # the exactness pins: device carry bit-equal after EVERY batch;
+        # the materialized view's full table under delta-only emission
+        assert rt.bank.snapshot() == ref.bank_entries(), (
+            f"{name}: device carry diverged from host at record {b}"
+        )
+    if spec.delta_only:
+        assert view.table() == ref.table(), (
+            f"{name}: materialized view diverged from host reference"
+        )
+
+    wc1 = TELEMETRY.window_counts()
+    kinds = {
+        k: v - wc0[1].get(k, 0)
+        for k, v in wc1[1].items()
+        if v - wc0[1].get(k, 0)
+    }
+    delta_bytes = wc1[2] - wc0[2]
+    full_bytes = wc1[3] - wc0[3]
+    pt1 = TELEMETRY.phase_totals()
+
+    def _d2h_ms(since):
+        return round(
+            (pt1.get("d2h", (0, 0.0))[1] - since.get("d2h", (0, 0.0))[1])
+            * 1000,
+            2,
+        )
+
+    d2h_ms = _d2h_ms(pt0)
+    # warm d2h: the classic arm's phase split comes from a warm serial
+    # pass, so the apples-to-apples delta-arm number excludes the first
+    # batch's one-time slice-bucket compile
+    warm_records = n - (slices[0][1] - slices[0][0])
+    d2h_warm_ms = _d2h_ms(pt_warm) if len(slices) > 1 else d2h_ms
+    # first batch pays the window-kernel compiles (attributed below);
+    # steady-state throughput is the warm batches' median
+    warm = bt[1:] or bt
+    rps = per / statistics.median(warm)
+    base_rps = n / ref_wall if ref_wall else 0.0
+    log(
+        f"  delta arm: {rps:,.0f} records/s warm "
+        f"({len(slices)} batches, first {bt[0]*1000:.0f}ms), "
+        f"delta {delta_bytes/1e6:.3f}MB vs full {full_bytes/1e6:.3f}MB"
+    )
+
+    win = {
+        "mode": spec.mode,
+        "keys": len({k for (k, _s) in ref.table()}),
+        "batches": len(slices),
+        "closed": wc1[0] - wc0[0],
+        "late": kinds.get("late", 0),
+        "deltas": {k: v for k, v in kinds.items() if k != "late"},
+        "delta_mb": round(delta_bytes / 1e6, 3),
+        "full_mb": round(full_bytes / 1e6, 3),
+        # the hardware-independent acceptance signal: what fraction of
+        # the classic per-record emission's bytes the deltas shipped
+        "delta_ratio": (
+            round(delta_bytes / full_bytes, 4) if full_bytes else None
+        ),
+        "d2h_ms_delta": d2h_ms,
+        "d2h_ms_delta_warm": d2h_warm_ms,
+        "rps_delta": round(rps),
+        "state_bytes": rt.bank.state_bytes(),
+        "exact": True,  # the asserts above did not fire
+    }
+    observed = "win-delta" if rows_kind >= len(slices) / 2 else "win-full"
+    if result is not None:
+        classic_d2h = (result.get("phases") or {}).get("phase_ms", {}).get(
+            "d2h"
+        )
+        if classic_d2h:
+            # warm-for-warm: the classic phases ride a warm serial pass
+            # over n records; scale it to the delta arm's warm record
+            # count before comparing
+            classic_warm = classic_d2h * warm_records / n
+            win["d2h_ms_classic"] = classic_d2h
+            win["d2h_cut"] = round(
+                classic_warm / max(d2h_warm_ms, 0.01), 1
+            )
+            log(
+                f"  d2h: classic {classic_d2h}ms -> delta warm "
+                f"{d2h_warm_ms}ms ({win['d2h_cut']}x)"
+            )
+    else:
+        result = {
+            "records_per_sec": round(rps),
+            "pass_ms": [round(t * 1000) for t in bt],
+            "first_call_s": round(bt[0], 2),
+            "baseline_records_per_sec": round(base_rps),
+            "vs_baseline": round(rps / base_rps, 2) if base_rps else None,
+            "compile": _compile_delta(ct0, TELEMETRY.compile_totals()),
+            "path": "windowed",
+            "path_records": {"windowed": n},
+        }
+    result["win"] = win
+    if preflight is not None:
+        # windowed agreement: predicted emission variant vs the one the
+        # deltas actually shipped under; a classic arm's path agreement
+        # (when judgeable) must hold too
+        path_agree = preflight.get("agree")
+        win_agree = preflight.get("window_variant", "off") == observed
+        preflight["window_actual"] = observed
+        preflight["agree"] = (
+            win_agree if path_agree is None else (path_agree and win_agree)
+        )
+        preflight.setdefault("actual", observed)
+        result["preflight"] = preflight
+    return result
+
+
 def run_config(name: str, cfg: dict, n: int, smoke: bool, deadline=None) -> dict:
     if cfg.get("partitions"):
         return _run_partitioned_config(name, cfg, n, smoke, deadline)
+    if cfg.get("windowed"):
+        return _run_windowed_config(name, cfg, n, smoke, deadline)
     headline = name == "2_filter_map"
     # wide300 re-checks a raw verdict at its own far-better ratio — but
     # only with enough budget left for its re-check to actually run;
@@ -848,7 +1112,6 @@ def _run_config(
         slo_eng = slo_mod.SloEngine(timeseries=TimeSeries(
             window_s=3600.0, capacity=2
         ))
-        slo_eng.timeseries.force_tick()
     except Exception as e:  # noqa: BLE001 — SLO must never cost a run
         log(f"  slo engine unavailable: {type(e).__name__}: {e}")
 
@@ -884,6 +1147,13 @@ def _run_config(
             log(f"  admission warmup: {adm_warm}")
     except Exception as e:  # noqa: BLE001 — admission must never cost a run
         log(f"  admission warmup failed: {type(e).__name__}: {e}")
+    if slo_eng is not None:
+        # the verdict window opens HERE, after verify/build/warmup: the
+        # counters the time-series samples are suite-cumulative, so a
+        # tick taken before those steps let their compiles land in
+        # every config's window and flagged configs that compiled
+        # nothing themselves
+        slo_eng.timeseries.force_tick()
     try:
         (out, times, first_call, link_mb, phases, path_info, compile_info,
          link_info) = bench_tpu(chain, buf, runs, passes, deadline)
@@ -1578,6 +1848,31 @@ def _dfa_counts(configs: dict):
     return {"classes": top.get("classes"), "states": top.get("states")}
 
 
+def _win_counts(configs: dict):
+    """Windowed-family evidence for the compact line's tiny ``win``
+    key: worst (largest) delta-vs-full downlink ratio + most distinct
+    keys across the family. None when no windowed config ran. Full
+    per-config blocks (d2h A/B, per-kind delta rows, exactness,
+    state bytes) stay in BENCH_DETAIL.json only (the ≤1500-char
+    contract)."""
+    blocks = [
+        c["win"]
+        for c in configs.values()
+        if isinstance(c, dict) and isinstance(c.get("win"), dict)
+    ]
+    if not blocks:
+        return None
+    ratios = [
+        b["delta_ratio"]
+        for b in blocks
+        if isinstance(b.get("delta_ratio"), (int, float))
+    ]
+    return {
+        "delta_ratio": max(ratios) if ratios else None,
+        "keys": max(int(b.get("keys", 0)) for b in blocks),
+    }
+
+
 def _slo_verdict(configs: dict):
     """Worst per-config SLO verdict across the suite — the compact
     line's tiny ``slo`` key; full per-config blocks (targets, observed
@@ -1700,6 +1995,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         df = _dfa_counts(out["configs"])
         if df:
             compact["dfa"] = df
+        wn = _win_counts(out["configs"])
+        if wn:
+            compact["win"] = wn
     if "cpu_fallback" in out:
         inner = out["cpu_fallback"]
         compact["cpu_fallback"] = {
@@ -1712,9 +2010,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "dfa", "soak", "lag", "rebal", "part",
-        "adm", "slo", "preflight", "down", "compile", "phases", "error",
-        "xla_cache", "link",
+        "configs", "cpu_fallback", "dfa", "win", "soak", "lag", "rebal",
+        "part", "adm", "slo", "preflight", "down", "compile", "phases",
+        "error", "xla_cache", "link",
     ):
         if len(json.dumps(compact)) <= limit:
             break

@@ -112,12 +112,17 @@ def analyze_chain(
     from fluvio_tpu.analysis.jaxpr_lint import (
         dfa_table_reports,
         trace_chain_entry_points,
+        window_specs_for_programs,
+        window_update_reports,
     )
     from fluvio_tpu.analysis.spec import resolved_programs
     from fluvio_tpu.smartengine.tpu.executor import TpuChainExecutor
 
     programs, _ = resolved_programs(entries)
     report.jaxprs.extend(dfa_table_reports(programs))
+    report.jaxprs.extend(
+        window_update_reports(window_specs_for_programs(programs), rows=rows)
+    )
     executor = TpuChainExecutor.try_build(list(entries))
     if executor is not None:
         trace_widths = [
@@ -151,6 +156,8 @@ def preflight_for_specs(
         "link_variant": pred.link_variant,
         "down_variant": pred.down_variant,
     }
+    if pred.window_variant != "off":
+        out["window_variant"] = pred.window_variant
     if pred.spill_reasons:
         out["spill_reasons"] = list(pred.spill_reasons)
     if pred.declines:

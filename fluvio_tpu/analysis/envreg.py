@@ -295,6 +295,21 @@ REGISTRY: Tuple[EnvFlag, ...] = (
        "admission/warmup.py", "AOT warmup row-bucket probe override"),
     _f("FLUVIO_WARMUP_WIDTHS", "spec", "", "comma-separated widths",
        "admission/warmup.py", "AOT warmup width probe override"),
+    _f("FLUVIO_WINDOW_CAPACITY", "int", "1024", "entries",
+       "windows/spec.py",
+       "device window-state bank slots (open (key, window) entries)"),
+    _f("FLUVIO_WINDOW_DELTA", "bool01", "1", "1|0|off",
+       "windows/spec.py",
+       "delta-only window emission (0: full-state every batch, the "
+       "debugging escape hatch / preflight win-full variant)"),
+    _f("FLUVIO_WINDOW_EMIT", "int", "1024", "rows",
+       "windows/spec.py",
+       "per-batch delta emit columns (overflow degrades to one "
+       "full-state resync delta, never silent loss)"),
+    _f("FLUVIO_WINDOW_LATENESS_MS", "int", "0", "ms",
+       "windows/spec.py",
+       "allowed event-time lateness before a window closes; later "
+       "records are counted late and dropped"),
 )
 
 BY_NAME: Dict[str, EnvFlag] = {f.name: f for f in REGISTRY}

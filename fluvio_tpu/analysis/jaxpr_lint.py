@@ -425,6 +425,44 @@ def dfa_table_reports(programs) -> List[JaxprReport]:
     return reports
 
 
+def window_specs_for_programs(programs) -> list:
+    """`WindowSpec`s implied by a chain's windowed aggregates (tumbling,
+    from the canned kind + window_ms; the sliding/keyed family members
+    are authored as explicit specs and traced via
+    `window_update_reports` directly)."""
+    from fluvio_tpu.smartmodule import dsl
+    from fluvio_tpu.windows.spec import KIND_TO_OP, WindowSpec
+
+    specs = []
+    for prog in programs or []:
+        if (
+            isinstance(prog, dsl.AggregateProgram)
+            and prog.window_ms
+            and prog.kind in KIND_TO_OP
+        ):
+            specs.append(WindowSpec.from_params(prog.kind, prog.window_ms))
+    return specs
+
+
+def window_update_reports(
+    specs, rows: int = 8, width: int = 32
+) -> List[JaxprReport]:
+    """Abstract-trace the windowed-state update jit for each
+    `WindowSpec` — one AOT-warmup work-list entry per (geometry, shape
+    bucket), same contract as the chain entry points (the compile
+    telemetry instruments these jits under kind="window")."""
+    from fluvio_tpu.windows.kernels import trace_update
+
+    return [
+        _trace_report(
+            "window",
+            f"{spec.describe()} rows={rows}x{width}",
+            lambda s=spec: trace_update(s, rows=rows, width=width),
+        )
+        for spec in specs
+    ]
+
+
 def _dfa_compose_reports(executor, buf) -> List[JaxprReport]:
     """Trace the fused DFA block-compose kernel at each distinct table
     bucket the chain would run it for (mirrors the chooser inside

@@ -97,6 +97,12 @@ class PathPrediction:
     # variant; per-batch ratio losses ship packed with `glz-enc-ratio`
     # on the decline counter.
     down_variant: str = "down-raw"
+    # predicted windowed-state emission form for chains with a windowed
+    # aggregate: "off" (no windowed stage) | "win-delta" (delta-only
+    # downlink, the default) | "win-full" (FLUVIO_WINDOW_DELTA=0 full
+    # state every batch). Differentially pinned against the runtime's
+    # window_deltas counters.
+    window_variant: str = "off"
 
     def to_dict(self) -> dict:
         return {
@@ -108,6 +114,7 @@ class PathPrediction:
             "causes": list(self.causes),
             "link_variant": self.link_variant,
             "down_variant": self.down_variant,
+            "window_variant": self.window_variant,
         }
 
 
@@ -180,7 +187,17 @@ def resolve_gates() -> dict:
         "result_compact": _executor().effective_result_compact(),
         "result_compress": _executor().effective_result_compress(),
         "glz_enc_pallas": pallas_kernels.glz_enc_pallas_active(),
+        # windowed-state gate: delta-only emission vs full-state every
+        # batch (FLUVIO_WINDOW_DELTA), mirrored for the window_variant
+        # arm of the prediction
+        "window_delta": _window_delta_enabled(),
     }
+
+
+def _window_delta_enabled() -> bool:
+    from fluvio_tpu.windows.spec import delta_enabled
+
+    return delta_enabled()
 
 
 def _executor():
@@ -847,6 +864,19 @@ def predict_link_variant(gates: dict, path: str, sharded: bool) -> str:
     return "glz-pallas" if gates.get("glz_pallas") else "glz-gather"
 
 
+def predict_window_variant(programs, gates: dict) -> str:
+    """Which emission form a windowed aggregate ships its state in —
+    the mirror of `windows.spec.delta_enabled` applied to chains that
+    actually carry a windowed stage. "off" when nothing is windowed."""
+    windowed = any(
+        isinstance(p, dsl.AggregateProgram) and getattr(p, "window_ms", 0)
+        for p in programs
+    )
+    if not windowed:
+        return "off"
+    return "win-delta" if gates.get("window_delta") else "win-full"
+
+
 def analyze_entries(
     entries,
     widths: Optional[Sequence[int]] = None,
@@ -911,6 +941,7 @@ def analyze_entries(
             has_fanout, sharded=sharded,
         )
         pred.link_variant = predict_link_variant(gates, pred.path, sharded)
+        pred.window_variant = predict_window_variant(programs, gates)
         pred.down_variant = predict_down_variant(
             gates, pred.path, down_profile(programs), sharded,
             striped_span=any(

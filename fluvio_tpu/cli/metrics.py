@@ -133,6 +133,15 @@ def render_metrics_table(data: dict) -> str:
         rows.append((f"slo_breach[{key}]", _fmt_count(n)))
     for reason, n in sorted((counters.get("rebalance_moves") or {}).items()):
         rows.append((f"rebalance[{reason}]", _fmt_count(n)))
+    windows = tel.get("windows") or {}
+    if windows.get("closed") or windows.get("deltas"):
+        rows.append(("windows_closed", _fmt_count(windows.get("closed", 0))))
+        for kind, n in sorted((windows.get("deltas") or {}).items()):
+            rows.append((f"window_delta[{kind}]", _fmt_count(n)))
+        full = windows.get("full_bytes", 0)
+        if full:
+            ratio = windows.get("delta_bytes", 0) / full
+            rows.append(("window_downlink_ratio", f"{ratio:.3f}"))
     breaker = counters.get("breaker") or {}
     rows.append(
         ("breaker_short_circuits",

@@ -115,6 +115,9 @@ def render_prometheus(
         tenant_age = {k: h.copy() for k, h in t.tenant_age.items()}
         rebalance_moves = dict(t.rebalance_moves)
         migration_hist = t.migration_hist.copy()
+        windows_closed = t.windows_closed
+        window_deltas = dict(t.window_deltas)
+        window_bytes = (t.window_delta_bytes, t.window_full_bytes)
     spans_dropped = t.spans.dropped
 
     _histogram(
@@ -391,6 +394,32 @@ def render_prometheus(
             f"{_PREFIX}_migration_seconds",
             "Drain + replay duration of one voluntary partition migration.",
             [({}, migration_hist)],
+        )
+
+    # -- windowed state (ISSUE-19) -------------------------------------------
+    w.header(
+        f"{_PREFIX}_windows_closed_total",
+        "Windows whose close watermark passed (final value emitted).",
+        "counter",
+    )
+    w.sample(f"{_PREFIX}_windows_closed_total", {}, windows_closed)
+    w.header(
+        f"{_PREFIX}_window_deltas_total",
+        "Window delta rows by kind (upsert | close | resync | late — "
+        "late rows are dropped, not shipped).",
+        "counter",
+    )
+    for kind, v in sorted(window_deltas.items()):
+        w.sample(f"{_PREFIX}_window_deltas_total", {"kind": kind}, v)
+    w.header(
+        f"{_PREFIX}_window_downlink_bytes_total",
+        "Windowed downlink bytes: delta actually shipped vs the "
+        "full-state counterfactual (their ratio is the d2h win).",
+        "counter",
+    )
+    for form, v in zip(("delta", "full"), window_bytes):
+        w.sample(
+            f"{_PREFIX}_window_downlink_bytes_total", {"form": form}, v
         )
 
     # -- gauges --------------------------------------------------------------

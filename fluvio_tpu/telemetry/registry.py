@@ -196,6 +196,14 @@ class PipelineTelemetry:
         # observable output, read by prom/CLI/bench
         self.rebalance_moves: Dict[str, int] = {}
         self.migration_hist = LatencyHistogram()
+        # windowed-state plane (ISSUE-19): delta-only emission
+        # accounting — windows closed, delta rows by kind
+        # (upsert/close/resync/late), and the delta-vs-full downlink
+        # byte split whose ratio is the d2h-win evidence
+        self.windows_closed = 0
+        self.window_deltas: Dict[str, int] = {}
+        self.window_delta_bytes = 0
+        self.window_full_bytes = 0
         # pull-join hook: telemetry/lag.py installs its sampler here so
         # the time-series tick (and the Prometheus scrape) re-joins
         # committed offsets against replica high watermarks at the
@@ -545,6 +553,45 @@ class PipelineTelemetry:
         with self._lock:
             return dict(self.rebalance_moves), self.migration_hist.copy()
 
+    def add_windows_closed(self, n: int) -> None:
+        """``n`` windows crossed the close watermark this batch.
+        Always-on like admission: close counts are exactness evidence
+        (the pins diff them around runs), not observability sugar."""
+        if n <= 0:
+            return
+        with self._lock:
+            self.windows_closed += n
+
+    def add_window_delta(self, kind: str, rows: int) -> None:
+        """Delta rows shipped down by kind (upsert/close/resync/late —
+        late counts dropped rows, which never ship but must stay
+        observable for the exactness story)."""
+        if rows <= 0:
+            return
+        with self._lock:
+            self.window_deltas[kind] = (
+                self.window_deltas.get(kind, 0) + rows
+            )
+
+    def add_window_downlink(self, delta_bytes: int, full_bytes: int) -> None:
+        """One windowed batch's downlink split: bytes the delta
+        actually shipped vs what full-state per-record emission would
+        have — numerator and denominator of the delta ratio."""
+        with self._lock:
+            self.window_delta_bytes += delta_bytes
+            self.window_full_bytes += full_bytes
+
+    def window_counts(self):
+        """(closed, deltas-by-kind, delta_bytes, full_bytes) under ONE
+        lock hold — bench and CLI read the family coherently."""
+        with self._lock:
+            return (
+                self.windows_closed,
+                dict(self.window_deltas),
+                self.window_delta_bytes,
+                self.window_full_bytes,
+            )
+
     def record_breaker(self, name: str, state: str, transition: bool = True) -> None:
         if transition:
             self._event("breaker", f"{name}->{state}")
@@ -821,6 +868,12 @@ class PipelineTelemetry:
                     "moves": dict(self.rebalance_moves),
                     "migration_seconds": self.migration_hist.to_dict(),
                 },
+                "windows": {
+                    "closed": self.windows_closed,
+                    "deltas": dict(self.window_deltas),
+                    "delta_bytes": self.window_delta_bytes,
+                    "full_bytes": self.window_full_bytes,
+                },
             } | self._ring_stats()
 
     def _ring_stats(self) -> dict:
@@ -890,6 +943,10 @@ class PipelineTelemetry:
             self.tenant_age = {}
             self.rebalance_moves = {}
             self.migration_hist = LatencyHistogram()
+            self.windows_closed = 0
+            self.window_deltas = {}
+            self.window_delta_bytes = 0
+            self.window_full_bytes = 0
             self._flow_seq = 0
             # lag_sampler survives reset on purpose: the bench resets
             # between configs and the lag engine's tracked leaders must

@@ -1,0 +1,53 @@
+"""Device-resident windowed state with delta-only emission.
+
+The windowed-workload family's engine: tumbling/sliding windows and
+per-key segmented state computed by one fused device kernel, with the
+inter-batch carry (the state bank: (id, acc, count) rows + watermark)
+HBM-resident across batches and only the per-batch DELTA — closed
+windows and touched entries — crossing the link down. Broker-side,
+`MaterializedView` folds deltas into a queryable table; full-state
+images ship only on attach/seed/migration (CarryReplica ladder).
+
+- `spec`      — WindowSpec geometry + env-gated capacities
+- `kernels`   — the fused jitted update/merge programs
+- `state`     — WindowStateBank (the device carry) + shard merge
+- `engine`    — WindowedRuntime / PartitionedWindowRuntime drivers
+- `views`     — MaterializedView (the broker read surface)
+- `reference` — host-truth oracle for exactness pins
+"""
+
+import jax
+
+# composite ids / accumulators / timestamps are int64 end-to-end; the
+# bank cannot even initialize under 32-bit jax (same package-level pin
+# as smartengine.tpu)
+jax.config.update("jax_enable_x64", True)
+
+from fluvio_tpu.windows.engine import (  # noqa: E402
+    PartitionedWindowRuntime,
+    WindowDelta,
+    WindowedRuntime,
+)
+from fluvio_tpu.windows.kernels import WindowJits
+from fluvio_tpu.windows.reference import HostWindowReference
+from fluvio_tpu.windows.spec import (
+    WindowCapacityError,
+    WindowSpec,
+    delta_enabled,
+)
+from fluvio_tpu.windows.state import WindowStateBank, merge_banks
+from fluvio_tpu.windows.views import MaterializedView  # noqa: E402
+
+__all__ = [
+    "HostWindowReference",
+    "MaterializedView",
+    "PartitionedWindowRuntime",
+    "WindowCapacityError",
+    "WindowDelta",
+    "WindowJits",
+    "WindowSpec",
+    "WindowStateBank",
+    "WindowedRuntime",
+    "delta_enabled",
+    "merge_banks",
+]

@@ -1,0 +1,372 @@
+"""Jitted update kernels for the windowed-state engine.
+
+The per-batch update is ONE fused device program: window assignment
+(tumbling or sliding replication), optional per-key segmentation, a
+sort-based segmented merge of the batch's contributions into the
+HBM-resident state bank, watermark advance, window closing, and
+delta-row compaction — everything up to (but not including) the tiny
+delta D2H. The inter-batch carry is the bank itself: ``capacity``
+(id, acc, count) rows plus one watermark scalar, the same constant-size
+inter-chunk state shape as the partition carry bank (SSM chunked-scan
+argument), never re-uploaded between batches.
+
+Merge strategy: concat (bank entries ++ replicated batch rows), one
+argsort over the composite int64 segment id (key * KEY_STRIDE +
+window_index; empties sort last), segment heads where the id changes,
+then the SAME `segmented_scan` primitives the aggregate engine uses —
+bit-exact for the integer monoids, and associative, which is what makes
+the bank mergeable across striped/sharded ingest (``merge`` below is
+the shard-combine).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+from fluvio_tpu.windows.spec import EMPTY_ID, INT64_MIN, KEY_STRIDE, WindowSpec
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# Keyed record parsing
+# ---------------------------------------------------------------------------
+
+
+def parse_two_ints(values, lengths) -> Tuple:
+    """Per-record ``"<key> <value>"`` parse: the leading ASCII int and
+    the int after the first space (0 when absent). Reuses the engine's
+    `parse_int` scan twice over a shifted view instead of growing a
+    second two-field state machine."""
+    import jax.numpy as jnp
+
+    from fluvio_tpu.smartengine.tpu.kernels import parse_int
+
+    n, width = values.shape
+    lengths = lengths.astype(jnp.int32)
+    first = parse_int(values, lengths)
+    col = jnp.arange(width, dtype=jnp.int32)
+    is_sp = (values == 32) & (col[None, :] < lengths[:, None])
+    has_sp = jnp.any(is_sp, axis=1)
+    sp = jnp.argmax(is_sp, axis=1).astype(jnp.int32)
+    idx = jnp.clip(sp[:, None] + 1 + col[None, :], 0, width - 1)
+    shifted = jnp.take_along_axis(values, idx, axis=1)
+    rest = jnp.where(has_sp, lengths - sp - 1, 0)
+    second = parse_int(shifted, rest)
+    return first, second
+
+
+# ---------------------------------------------------------------------------
+# Segmented merge (the bank combine)
+# ---------------------------------------------------------------------------
+
+
+def _segment_merge(ids, accs, cnts, touched, op: str):
+    """Combine rows sharing a composite id: one argsort + segmented
+    scans; returns (n_entries, entry columns, live mask), entries
+    compacted to the front with empty slots re-marked EMPTY_ID."""
+    import jax.numpy as jnp
+
+    from fluvio_tpu.smartengine.tpu.kernels import compact_rows, segmented_scan
+
+    m = ids.shape[0]
+    order = jnp.argsort(ids)
+    sid = jnp.take(ids, order)
+    sacc = jnp.take(accs, order)
+    scnt = jnp.take(cnts, order)
+    stb = jnp.take(touched, order)
+    change = sid[1:] != sid[:-1]
+    head = jnp.concatenate([jnp.ones((1,), bool), change])
+    tail = jnp.concatenate([change, jnp.ones((1,), bool)])
+    acc_run = segmented_scan(sacc, head, op)
+    cnt_run = segmented_scan(scnt, head, "add")
+    tb_run = segmented_scan(stb, head, "add")
+    is_entry = tail & (sid != EMPTY_ID)
+    n_entries, (e_ids, e_accs, e_cnts, e_tb) = compact_rows(
+        is_entry, sid, acc_run, cnt_run, tb_run
+    )
+    # compact_rows zero-fills dropped slots; a zero id is a REAL
+    # composite id (key 0, window 0), so dead slots must be re-marked
+    live = jnp.arange(m, dtype=jnp.int32) < n_entries
+    e_ids = jnp.where(live, e_ids, EMPTY_ID)
+    return n_entries, e_ids, e_accs, e_cnts, e_tb, live
+
+
+def _update_core(
+    window_ms: int,
+    slide_ms: int,
+    fanout: int,
+    lateness_ms: int,
+    op: str,
+    neutral: int,
+    capacity: int,
+    emit_cap: int,
+    delta_only: bool,
+    bank_ids,
+    bank_accs,
+    bank_cnts,
+    watermark,
+    contribs,
+    keys,
+    ts,
+    valid,
+):
+    """One batch's full window-state transition. Pure function of
+    (bank, batch): the bank inputs are NOT donated, so a faulted batch
+    retries against the identical carry — exactness under chaos comes
+    for free instead of from an undo path."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from fluvio_tpu.smartengine.tpu.kernels import compact_rows
+
+    n = contribs.shape[0]
+    # -- window assignment (sliding replicates each record over the
+    # fanout window phases; tumbling is fanout == 1) -------------------------
+    base_idx = jnp.where(valid, ts // slide_ms, 0)
+    j = jnp.arange(fanout, dtype=jnp.int64)
+    win_idx = base_idx[:, None] - j[None, :]
+    rep_valid = valid[:, None] & (win_idx >= 0)
+    win_end = win_idx * slide_ms + window_ms
+    # late vs the PRE-batch watermark: the window already closed in an
+    # earlier batch, so folding this row in would re-open it — count
+    # and drop instead (the host reference applies the same rule)
+    late = rep_valid & (win_end + lateness_ms <= watermark)
+    rep_valid = rep_valid & ~late
+    ids = jnp.where(
+        rep_valid, keys[:, None] * KEY_STRIDE + win_idx, EMPTY_ID
+    )
+    rep_acc = jnp.where(rep_valid, contribs[:, None], neutral)
+    rep_cnt = rep_valid.astype(jnp.int64)
+    # -- merge into the bank -------------------------------------------------
+    all_ids = jnp.concatenate([bank_ids, ids.reshape(-1)])
+    all_accs = jnp.concatenate([bank_accs, rep_acc.reshape(-1)])
+    all_cnts = jnp.concatenate([bank_cnts, rep_cnt.reshape(-1)])
+    all_tb = jnp.concatenate(
+        [
+            jnp.zeros((capacity,), dtype=jnp.int64),
+            rep_valid.reshape(-1).astype(jnp.int64),
+        ]
+    )
+    n_entries, e_ids, e_accs, e_cnts, e_tb, live = _segment_merge(
+        all_ids, all_accs, all_cnts, all_tb, op
+    )
+    # -- watermark + closing -------------------------------------------------
+    batch_max = jnp.max(
+        jnp.where(valid, ts, jnp.int64(INT64_MIN + 1)), initial=INT64_MIN + 1
+    )
+    new_wm = jnp.maximum(watermark, batch_max)
+    e_win_idx = jnp.where(live, e_ids % KEY_STRIDE, 0)
+    e_win_end = e_win_idx * slide_ms + window_ms
+    closed = live & (e_win_end + lateness_ms <= new_wm)
+    open_m = live & ~closed
+    # -- delta emission: closed windows always ship; open entries ship
+    # only when this batch touched them (delta_only off = full state)
+    if delta_only:
+        emit_m = closed | (open_m & (e_tb > 0))
+    else:
+        emit_m = live
+    n_emit, (m_ids, m_accs, m_cnts, m_closed) = compact_rows(
+        emit_m, e_ids, e_accs, e_cnts, closed.astype(jnp.int32)
+    )
+    # -- new bank: open entries only, compacted to capacity ------------------
+    n_open, (o_ids, o_accs, o_cnts, _o_tb) = compact_rows(
+        open_m, e_ids, e_accs, e_cnts, e_tb
+    )
+    slot = jnp.arange(capacity, dtype=jnp.int32)
+    in_bank = slot < n_open
+    nb_ids = jnp.where(in_bank, lax.slice(o_ids, (0,), (capacity,)), EMPTY_ID)
+    nb_accs = jnp.where(
+        in_bank, lax.slice(o_accs, (0,), (capacity,)), jnp.int64(neutral)
+    )
+    nb_cnts = jnp.where(
+        in_bank, lax.slice(o_cnts, (0,), (capacity,)), jnp.int64(0)
+    )
+    # -- bounded emit columns + scalar header --------------------------------
+    e_slice = min(emit_cap, m_ids.shape[0])
+    em_ids = lax.slice(m_ids, (0,), (e_slice,))
+    em_accs = lax.slice(m_accs, (0,), (e_slice,))
+    em_cnts = lax.slice(m_cnts, (0,), (e_slice,))
+    em_closed = lax.slice(m_closed, (0,), (e_slice,))
+    header = jnp.stack(
+        [
+            n_emit.astype(jnp.int64),
+            n_open.astype(jnp.int64),
+            jnp.sum(closed).astype(jnp.int64),
+            jnp.sum(late).astype(jnp.int64),
+            new_wm,
+            (n_open > capacity).astype(jnp.int64),
+            (n_emit > e_slice).astype(jnp.int64),
+        ]
+    )
+    return (
+        header,
+        nb_ids,
+        nb_accs,
+        nb_cnts,
+        em_ids,
+        em_accs,
+        em_cnts,
+        em_closed,
+    )
+
+
+def _merge_core(op: str, neutral: int, capacity: int, a, b):
+    """Associative bank combine for striped/sharded ingest: two banks'
+    entries merge into one (watermark = max). No closing and no
+    emission here — those happen at the next `update` against the
+    merged bank, so split ingest stays bit-equal to serial ingest."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from fluvio_tpu.smartengine.tpu.kernels import compact_rows
+
+    a_ids, a_accs, a_cnts, a_wm = a
+    b_ids, b_accs, b_cnts, b_wm = b
+    ids = jnp.concatenate([a_ids, b_ids])
+    accs = jnp.concatenate([a_accs, b_accs])
+    cnts = jnp.concatenate([a_cnts, b_cnts])
+    tb = jnp.zeros_like(cnts)
+    _n, e_ids, e_accs, e_cnts, _tb, live = _segment_merge(
+        ids, accs, cnts, tb, op
+    )
+    n_open, (o_ids, o_accs, o_cnts, _o) = compact_rows(
+        live, e_ids, e_accs, e_cnts, e_cnts
+    )
+    slot = jnp.arange(capacity, dtype=jnp.int32)
+    in_bank = slot < n_open
+    nb_ids = jnp.where(in_bank, lax.slice(o_ids, (0,), (capacity,)), EMPTY_ID)
+    nb_accs = jnp.where(
+        in_bank, lax.slice(o_accs, (0,), (capacity,)), jnp.int64(neutral)
+    )
+    nb_cnts = jnp.where(
+        in_bank, lax.slice(o_cnts, (0,), (capacity,)), jnp.int64(0)
+    )
+    header = jnp.stack(
+        [
+            n_open.astype(jnp.int64),
+            jnp.maximum(a_wm, b_wm),
+            (n_open > capacity).astype(jnp.int64),
+        ]
+    )
+    return header, nb_ids, nb_accs, nb_cnts
+
+
+# ---------------------------------------------------------------------------
+# Jit construction (instrumented like the executor's chain jits)
+# ---------------------------------------------------------------------------
+
+
+def _spec_statics(spec: WindowSpec) -> tuple:
+    return (
+        spec.window_ms,
+        spec.slide_ms,
+        spec.fanout,
+        spec.lateness_ms,
+        spec.op,
+        spec.neutral,
+        spec.capacity,
+        spec.emit_capacity,
+        spec.delta_only,
+    )
+
+
+def _from_values(statics, keyed, bank_ids, bank_accs, bank_cnts, watermark,
+                 values, lengths, ts, valid):
+    import jax.numpy as jnp
+
+    from fluvio_tpu.smartengine.tpu.kernels import parse_int
+
+    if keyed:
+        keys, contribs = parse_two_ints(values, lengths)
+    else:
+        keys = jnp.zeros(values.shape[:1], dtype=jnp.int64)
+        contribs = parse_int(values, lengths)
+    return _update_core(
+        *statics, bank_ids, bank_accs, bank_cnts, watermark,
+        contribs, keys, ts, valid,
+    )
+
+
+def _from_arrays(statics, bank_ids, bank_accs, bank_cnts, watermark,
+                 contribs, keys, ts, valid):
+    return _update_core(
+        *statics, bank_ids, bank_accs, bank_cnts, watermark,
+        contribs, keys, ts, valid,
+    )
+
+
+class WindowJits:
+    """The compiled surface for one `WindowSpec`: the value-parsing
+    update (single-device RecordBuffer path), the pre-parsed-array
+    update (the seam striped/sharded split-backs feed), and the bank
+    merge (the shard combine). Shared across engines of the same spec
+    so partitioned runtimes compile once, and instrumented like every
+    other engine entry point so compiles land on the telemetry ladder
+    and the jaxpr-lint AOT work list."""
+
+    def __init__(self, spec: WindowSpec):
+        import jax
+
+        from fluvio_tpu.telemetry.compiles import instrument_jit
+
+        self.spec = spec
+        statics = _spec_statics(spec)
+        sig = spec.describe()
+
+        def describe_values(*args, **kwargs):
+            return f"{sig} rows={args[4].shape[0]}x{args[4].shape[1]}"
+
+        def describe_arrays(*args, **kwargs):
+            return f"{sig} rows={args[4].shape[0]}"
+
+        self.update_values = instrument_jit(
+            jax.jit(
+                functools.partial(_from_values, statics, spec.keyed)
+            ),
+            "window",
+            describe_values,
+        )
+        self.update_arrays = instrument_jit(
+            jax.jit(functools.partial(_from_arrays, statics)),
+            "window",
+            describe_arrays,
+        )
+        self.merge = instrument_jit(
+            jax.jit(
+                functools.partial(
+                    _merge_core, spec.op, spec.neutral, spec.capacity
+                )
+            ),
+            "window",
+            lambda *a, **k: f"{sig} merge",
+        )
+
+
+def trace_update(spec: WindowSpec, rows: int = 8, width: int = 32):
+    """Abstract-trace the windowed update for the jaxpr lint / AOT
+    work list (mirrors `jaxpr_lint.scan_function` call shape)."""
+    import jax.numpy as jnp
+
+    from fluvio_tpu.analysis.jaxpr_lint import scan_function
+
+    statics = _spec_statics(spec)
+    k = spec.capacity
+    return scan_function(
+        functools.partial(_from_values, statics, spec.keyed),
+        jnp.full((k,), EMPTY_ID, dtype=jnp.int64),
+        jnp.full((k,), spec.neutral, dtype=jnp.int64),
+        jnp.zeros((k,), dtype=jnp.int64),
+        jnp.int64(INT64_MIN + 1),
+        jnp.asarray(np.zeros((rows, width), dtype=np.uint8)),
+        jnp.zeros((rows,), dtype=jnp.int32),
+        jnp.zeros((rows,), dtype=jnp.int64),
+        jnp.ones((rows,), dtype=bool),
+    )

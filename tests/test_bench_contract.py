@@ -935,3 +935,69 @@ def test_rebal_key_fits_contract_and_trims_before_part():
     ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
     assert ladder.index('"rebal"') < ladder.index('"part"')
     assert ladder.index('"rebal"') < ladder.index('"link"')
+
+
+def test_win_line_key_rides_compact_line():
+    """ISSUE-19: a tiny ``win:{delta_ratio,keys}`` key rides the compact
+    line when any windowed config ran — the WORST (largest) delta-vs-full
+    downlink ratio and the widest key space across the family; the full
+    per-config block (d2h A/B, per-kind delta rows, exactness, state
+    bytes) stays in BENCH_DETAIL.json only."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = {}
+    for name, ratio, keys in (
+        ("5_windowed", 0.0111, 1), ("12_windowed_keyed", 0.31, 64),
+    ):
+        cfg = dict(GOOD)
+        cfg["win"] = {
+            "mode": "tumbling", "keys": keys, "batches": 6, "closed": 74,
+            "late": 0, "deltas": {"close": 74, "upsert": 12},
+            "delta_mb": 0.004, "full_mb": 0.35, "delta_ratio": ratio,
+            "d2h_ms_delta": 3.4, "d2h_ms_delta_warm": 3.4,
+            "rps_delta": 812000, "state_bytes": 56, "exact": True,
+        }
+        results[name] = cfg
+    out, rc = b._build_output(results)
+    assert rc == 0
+    assert out["configs"]["5_windowed"]["win"]["exact"] is True
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["win"] == {"delta_ratio": 0.31, "keys": 64}
+    # the bulky per-config block never reaches the line
+    assert "win" not in line["configs"].get("5_windowed", {})
+    # without a windowed config the key stays off entirely
+    out2, _ = b._build_output({"2_filter_map": dict(GOOD)})
+    assert "win" not in json.loads(json.dumps(b._compact_line(out2)))
+
+
+def test_win_key_fits_contract_and_trims_after_dfa_before_soak():
+    """The full-matrix line with the win key stays ≤1500 chars and the
+    blowup trim ladder drops ``win`` AFTER ``dfa`` but BEFORE ``soak``
+    (and therefore before ``lag``/``part``/``link``, the sentinel's
+    contract field)."""
+    import json
+    import re
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = _full_results()
+    results["5_windowed"] = _full_config(512000, 2.1, "windowed")
+    results["5_windowed"]["win"] = {
+        "mode": "sliding+keyed", "keys": 64, "batches": 6, "closed": 260,
+        "late": 3, "deltas": {"close": 260, "upsert": 1800, "resync": 0},
+        "delta_mb": 0.061, "full_mb": 0.35, "delta_ratio": 0.1741,
+        "d2h_ms_delta": 4.9, "d2h_ms_delta_warm": 4.2, "rps_delta": 488000,
+        "state_bytes": 1544, "exact": True, "d2h_cut": 6.0,
+    }
+    out, _ = b._build_output(results)
+    line = json.dumps(b._compact_line(out))
+    assert len(line) <= 1500, f"compact line is {len(line)} chars"
+    parsed = json.loads(line)
+    assert parsed["win"] == {"delta_ratio": 0.1741, "keys": 64}
+    src = open(_BENCH_PATH).read()
+    ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
+    assert ladder.index('"dfa"') < ladder.index('"win"')
+    assert ladder.index('"win"') < ladder.index('"soak"')
+    assert ladder.index('"win"') < ladder.index('"link"')

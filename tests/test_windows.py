@@ -1,0 +1,533 @@
+"""Windowed-state engine exactness: device carry vs the host oracle.
+
+Every test pins the SAME two surfaces the bench pins: the bank snapshot
+(the device carry, bit-for-bit) after every batch, and the broker-side
+`MaterializedView.table()` folded from the delta stream against
+`HostWindowReference.table()`. The chaos matrix re-runs those pins with
+faults armed at each engine seam; the failover tests ride the
+CarryReplica ladder and pin exactly-once delta serving.
+"""
+
+import numpy as np
+import pytest
+
+from fluvio_tpu.partition.failover import CarryReplica
+from fluvio_tpu.resilience import faults
+from fluvio_tpu.windows import (
+    HostWindowReference,
+    MaterializedView,
+    PartitionedWindowRuntime,
+    WindowCapacityError,
+    WindowJits,
+    WindowSpec,
+    WindowedRuntime,
+    merge_banks,
+)
+
+# tiny geometries keep every shape in the smallest jit buckets
+FOREVER = 10**9  # lateness that never closes a window
+
+# specs are hashable by design so every distinct geometry compiles its
+# kernels exactly ONCE across the whole module — the same shared-jits
+# discipline PartitionedWindowRuntime uses per broker
+_JITS = {}
+
+
+def _spec(window_ms=100, slide_ms=0, op="add", keyed=False, lateness_ms=0,
+          capacity=512, emit_capacity=256, delta_only=True):
+    """Fully pinned spec: no env-resolved capacities, so tests stay
+    hermetic under any FLUVIO_WINDOW* ambient config."""
+    return WindowSpec(
+        window_ms=window_ms, slide_ms=slide_ms, op=op, keyed=keyed,
+        lateness_ms=lateness_ms, capacity=capacity,
+        emit_capacity=emit_capacity, delta_only=delta_only,
+    )
+
+
+def _jits(spec):
+    jits = _JITS.get(spec)
+    if jits is None:
+        jits = _JITS[spec] = WindowJits(spec)
+    return jits
+
+
+def _runtime(spec):
+    return WindowedRuntime(spec, jits=_jits(spec))
+
+
+def _partitioned(spec, replica=None):
+    return PartitionedWindowRuntime(spec, replica=replica, jits=_jits(spec))
+
+
+def _cols(batch):
+    keys = np.array([k for k, _, _ in batch], dtype=np.int64)
+    contribs = np.array([c for _, c, _ in batch], dtype=np.int64)
+    ts = np.array([t for _, _, t in batch], dtype=np.int64)
+    return contribs, keys, ts
+
+
+def _drive(rt, view, ref, batches):
+    """Feed (key, contrib, ts) batches through engine + oracle, pinning
+    the carry and the per-batch header counts after every batch."""
+    for batch in batches:
+        delta = rt.ingest_arrays(*_cols(batch))
+        view.apply_delta(delta)
+        pins = ref.process_batch(batch)
+        assert delta.n_closed == pins["closed"]
+        assert delta.n_late == pins["late"]
+        assert delta.watermark == pins["watermark"]
+        assert rt.bank.snapshot() == ref.bank_entries()
+
+
+def _gen_batches(rng, n_batches, per, n_keys, step, regress=0):
+    """Mostly-monotonic event time with optional backwards jitter (the
+    late-record source); contribs include negatives so sum-vs-max bugs
+    can't cancel out."""
+    t = 0
+    batches = []
+    for _ in range(n_batches):
+        batch = []
+        for _ in range(per):
+            t += int(rng.integers(0, step))
+            ts = max(t - int(rng.integers(0, regress + 1)), 0)
+            batch.append(
+                (int(rng.integers(0, n_keys)),
+                 int(rng.integers(-50, 100)), ts)
+            )
+        batches.append(batch)
+    return batches
+
+
+def _pack(values, ts):
+    """Raw records -> RecordBuffer (the process_buffer seam); absolute
+    event time rides timestamp_deltas with base_timestamp unset."""
+    from fluvio_tpu.smartengine.tpu.buffer import RecordBuffer, bucket_width
+
+    n = len(values)
+    width = bucket_width(max(len(v) for v in values))
+    rows = 8
+    while rows < n:
+        rows *= 2
+    arr = np.zeros((rows, width), dtype=np.uint8)
+    lengths = np.zeros(rows, dtype=np.int32)
+    for i, v in enumerate(values):
+        arr[i, : len(v)] = np.frombuffer(v, dtype=np.uint8)
+        lengths[i] = len(v)
+    tcol = np.zeros(rows, dtype=np.int64)
+    tcol[:n] = np.asarray(ts, dtype=np.int64)
+    return RecordBuffer.from_arrays(
+        arr, lengths, count=n, timestamp_deltas=tcol
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.FAULTS.clear()
+    yield
+    faults.FAULTS.clear()
+
+
+class TestExactness:
+    def test_tumbling_multi_batch(self):
+        spec = _spec()
+        rng = np.random.default_rng(7)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        _drive(rt, view, ref, _gen_batches(rng, 5, 40, 1, step=12))
+        assert view.table() == ref.table()
+        assert view.close_events == len(ref.closed)
+        assert view.duplicate_closes == 0
+
+    def test_sliding_multi_batch(self):
+        spec = _spec(window_ms=100, slide_ms=25)
+        rng = np.random.default_rng(11)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        _drive(rt, view, ref, _gen_batches(rng, 4, 32, 1, step=10))
+        assert view.table() == ref.table()
+
+    def test_keyed_multi_batch(self):
+        spec = _spec(keyed=True)
+        rng = np.random.default_rng(13)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        _drive(rt, view, ref, _gen_batches(rng, 4, 48, 8, step=6))
+        assert view.table() == ref.table()
+
+    @pytest.mark.parametrize("op", ["max", "min"])
+    def test_minmax_monoids(self, op):
+        spec = _spec(op=op)
+        rng = np.random.default_rng(17)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        _drive(rt, view, ref, _gen_batches(rng, 3, 24, 1, step=15))
+        assert view.table() == ref.table()
+
+    def test_late_records_drop_not_fold(self):
+        # batch 2 carries records behind the watermark: the closed
+        # window's total must NOT change, and both sides count the drop
+        spec = _spec(lateness_ms=0)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        b0 = [(0, 5, 10), (0, 7, 40)]
+        b1 = [(0, 1, 250)]  # wm 250 closes [0, 100)
+        b2 = [(0, 100, 20), (0, 3, 260)]  # ts=20 is late now
+        _drive(rt, view, ref, [b0, b1, b2])
+        assert ref.late == 1
+        assert view.table()[(0, 0)] == (12, 2, "closed")
+        assert view.table() == ref.table()
+
+    def test_buffer_parse_path_unkeyed(self):
+        # the RecordBuffer value-parse entry (what the bench drives)
+        spec = _spec()
+        rng = np.random.default_rng(19)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        t = 0
+        for _ in range(3):
+            vals = [int(rng.integers(0, 1000)) for _ in range(24)]
+            ts = [(t := t + int(rng.integers(0, 9))) for _ in vals]
+            delta = rt.process_buffer(
+                _pack([str(v).encode() for v in vals], ts)
+            )
+            view.apply_delta(delta)
+            ref.process_batch([(0, v, s) for v, s in zip(vals, ts)])
+            assert rt.bank.snapshot() == ref.bank_entries()
+        assert view.table() == ref.table()
+
+    def test_buffer_parse_path_keyed(self):
+        # "<key> <value>" records through the fused two-int parse
+        spec = _spec(keyed=True)
+        rng = np.random.default_rng(23)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        t = 0
+        for _ in range(3):
+            recs = [
+                (int(rng.integers(0, 8)), int(rng.integers(0, 1000)))
+                for _ in range(24)
+            ]
+            ts = [(t := t + int(rng.integers(0, 9))) for _ in recs]
+            delta = rt.process_buffer(
+                _pack([f"{k} {v}".encode() for k, v in recs], ts)
+            )
+            view.apply_delta(delta)
+            ref.process_batch(
+                [(k, v, s) for (k, v), s in zip(recs, ts)]
+            )
+            assert rt.bank.snapshot() == ref.bank_entries()
+        assert view.table() == ref.table()
+
+    def test_delta_smaller_than_full_state(self):
+        spec = _spec()
+        rt = _runtime(spec)
+        batch = [(0, i, i * 3) for i in range(64)]
+        delta = rt.ingest_arrays(*_cols(batch))
+        assert delta.kind == "rows"
+        assert delta.delta_bytes < delta.full_bytes
+        assert delta.row_count() >= delta.n_closed
+
+
+class TestSlidingOverlapFuzz:
+    @pytest.mark.parametrize(
+        "window_ms,slide_ms,seed",
+        [(120, 40, 101), (100, 20, 202), (90, 30, 303)],
+    )
+    def test_fuzz_vs_host_reference(self, window_ms, slide_ms, seed):
+        # random keys, jittered event time WITH regressions: every
+        # record fans out to window_ms/slide_ms overlapping windows and
+        # some arrive late — the full assignment/close/late matrix
+        spec = _spec(window_ms=window_ms, slide_ms=slide_ms, keyed=True,
+                     lateness_ms=slide_ms)
+        rng = np.random.default_rng(seed)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        _drive(
+            rt, view, ref,
+            _gen_batches(
+                rng, 4, 40, 4, step=8,
+                regress=window_ms + 4 * slide_ms,
+            ),
+        )
+        assert view.table() == ref.table()
+        assert ref.late > 0, "fuzz must exercise the late path"
+
+
+class TestMergeBanks:
+    def test_shard_merge_associative_and_serial_equal(self):
+        # split ingest + merge == one-stream ingest, under both
+        # association orders (the striped/sharded combine contract)
+        spec = _spec(keyed=True, lateness_ms=FOREVER)
+        jits = _jits(spec)
+        rng = np.random.default_rng(29)
+        records = [
+            b for batch in _gen_batches(rng, 3, 30, 6, step=7)
+            for b in batch
+        ]
+        parts = [records[0::3], records[1::3], records[2::3]]
+        shards = []
+        for part in parts:
+            rt = WindowedRuntime(spec, jits=jits)
+            rt.ingest_arrays(*_cols(part))
+            shards.append(rt.bank)
+        serial = WindowedRuntime(spec, jits=jits)
+        serial.ingest_arrays(*_cols(records))
+        left = merge_banks(jits, merge_banks(jits, shards[0], shards[1]),
+                           shards[2])
+        right = merge_banks(jits, shards[0],
+                            merge_banks(jits, shards[1], shards[2]))
+        assert left.snapshot() == serial.bank.snapshot()
+        assert right.snapshot() == serial.bank.snapshot()
+
+
+class TestChaosMatrix:
+    POINTS = ("stage", "dispatch", "device", "fetch")
+
+    @pytest.mark.parametrize("point", POINTS)
+    def test_transient_fault_retries_bit_equal(self, point):
+        # transient fault mid-stream: the engine retries ONCE against
+        # the untouched carry, and the results stay bit-equal to an
+        # un-faulted host fold
+        spec = _spec()
+        rng = np.random.default_rng(31)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        batches = _gen_batches(rng, 3, 20, 1, step=10)
+        t = 0
+        for i, batch in enumerate(batches):
+            if i == 1:
+                faults.FAULTS.inject(point, first=1)
+            vals = [str(c).encode() for _, c, _ in batch]
+            ts = [s for _, _, s in batch]
+            delta = rt.process_buffer(_pack(vals, ts))
+            view.apply_delta(delta)
+            ref.process_batch(batch)
+            assert rt.bank.snapshot() == ref.bank_entries()
+        assert view.table() == ref.table()
+
+    @pytest.mark.parametrize("point", POINTS)
+    def test_deterministic_fault_leaves_carry_valid(self, point):
+        # a non-transient fault raises (no blind retry) BEFORE the bank
+        # commits: the previous carry survives and replaying the same
+        # buffer afterwards lands the exact result
+        spec = _spec()
+        rng = np.random.default_rng(37)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        b0, b1 = _gen_batches(rng, 2, 20, 1, step=10)
+        view.apply_delta(rt.process_buffer(
+            _pack([str(c).encode() for _, c, _ in b0],
+                  [s for _, _, s in b0])
+        ))
+        ref.process_batch(b0)
+        before = rt.bank.snapshot()
+        faults.FAULTS.inject(
+            point, first=1,
+            exc=faults.InjectedFault(point, transient=False),
+        )
+        buf = _pack([str(c).encode() for _, c, _ in b1],
+                    [s for _, _, s in b1])
+        with pytest.raises(faults.InjectedFault):
+            rt.process_buffer(buf)
+        assert rt.bank.snapshot() == before, "faulted batch must not commit"
+        faults.FAULTS.clear()
+        view.apply_delta(rt.process_buffer(buf))
+        ref.process_batch(b1)
+        assert rt.bank.snapshot() == ref.bank_entries()
+        assert view.table() == ref.table()
+
+    def test_env_grammar_arms_window_seams(self, monkeypatch):
+        # the FLUVIO_FAULTS env spec drives the same seams (chaos runs
+        # arm brokers without code changes)
+        monkeypatch.setenv("FLUVIO_FAULTS", "device:first=1")
+        faults._load_from_env()
+        spec = _spec()
+        rt, ref = _runtime(spec), HostWindowReference(spec)
+        batch = [(0, 5, 10), (0, 7, 40)]
+        rt.process_buffer(
+            _pack([b"5", b"7"], [10, 40])
+        )  # transient by default: retried internally
+        ref.process_batch(batch)
+        assert rt.bank.snapshot() == ref.bank_entries()
+
+
+class TestFailoverAndMigration:
+    def _batches(self):
+        rng = np.random.default_rng(41)
+        return _gen_batches(rng, 3, 20, 4, step=12)
+
+    def test_seed_restores_bit_equal_bank(self):
+        spec = _spec(keyed=True)
+        replica = CarryReplica()
+        a = _partitioned(spec, replica=replica)
+        ref = HostWindowReference(spec)
+        batches = self._batches()
+        for batch in batches[:2]:
+            vals = [f"{k} {c}".encode() for k, c, _ in batch]
+            a.process_buffer("t", 0, _pack(vals, [s for _, _, s in batch]))
+            ref.process_batch(batch)
+        # promotion: a fresh runtime (standby broker) seeds from the
+        # replica's last committed snapshot
+        b = _partitioned(spec, replica=replica)
+        offset = b.seed("t", 0)
+        assert offset == sum(len(x) for x in batches[:2])
+        assert b.snapshot("t", 0) == a.snapshot("t", 0)
+        assert b.snapshot("t", 0) == ref.bank_entries()
+
+    def test_exactly_once_served_deltas_across_failover(self):
+        # the replay ladder re-serves the last batch's delta after
+        # promotion; the view folds it idempotently (no double counts,
+        # duplicate closes observable) and the stream continues exact
+        spec = _spec()
+        replica = CarryReplica()
+        a = _partitioned(spec, replica=replica)
+        view, ref = MaterializedView(spec), HostWindowReference(spec)
+        b0 = [(0, 5, 10), (0, 7, 40)]
+        b1 = [(0, 2, 250), (0, 9, 260)]  # closes [0, 100)
+        b2 = [(0, 4, 470), (0, 6, 480)]
+        deltas = []
+        for batch in (b0, b1):
+            vals = [str(c).encode() for _, c, _ in batch]
+            d = a.process_buffer("t", 0,
+                                 _pack(vals, [s for _, _, s in batch]))
+            deltas.append(d)
+            view.apply_delta(d)
+            ref.process_batch(batch)
+        assert deltas[1].n_closed == 1
+        assert deltas[0].offset == 0 and deltas[1].offset == 2
+        b = _partitioned(spec, replica=replica)
+        offset = b.seed("t", 0)
+        assert offset == 4
+        # failover replay: batch 1's delta arrives AGAIN
+        table_before = view.table()
+        view.apply_delta(deltas[1])
+        assert view.table() == table_before, "replay must not double-count"
+        assert view.duplicate_closes == 1
+        # new leader resumes from the committed offset
+        d2 = b.process_buffer(
+            "t", 0, _pack([b"4", b"6"], [470, 480])
+        )
+        assert d2.offset == offset
+        view.apply_delta(d2)
+        ref.process_batch(b2)
+        assert b.snapshot("t", 0) == ref.bank_entries()
+        assert view.table() == ref.table()
+
+    def test_migration_mid_window_bit_equal(self):
+        # move the partition to another device BETWEEN batches with
+        # windows still open: the carry re-places with no host round
+        # trip and the stream stays bit-equal to the oracle
+        import jax
+
+        devices = jax.devices()
+        if len(devices) < 2:
+            pytest.skip("needs the multi-device CPU mesh")
+        spec = _spec(keyed=True)
+        prt = _partitioned(spec, replica=CarryReplica())
+        view, ref = MaterializedView(spec), HostWindowReference(spec)
+        batches = self._batches()
+        for i, batch in enumerate(batches):
+            if i == 1:
+                prt.migrate("t", 0, devices[1])
+                assert prt.runtime("t", 0).bank.device is devices[1]
+            vals = [f"{k} {c}".encode() for k, c, _ in batch]
+            d = prt.process_buffer(
+                "t", 0, _pack(vals, [s for _, _, s in batch])
+            )
+            view.apply_delta(d)
+            ref.process_batch(batch)
+            assert prt.snapshot("t", 0) == ref.bank_entries()
+        assert view.table() == ref.table()
+
+    def test_consumer_attach_resync(self):
+        # a consumer attaching mid-stream full-resyncs the OPEN table,
+        # then follows deltas; open-window state converges exactly
+        spec = _spec(lateness_ms=FOREVER)
+        rt, ref = _runtime(spec), HostWindowReference(spec)
+        rng = np.random.default_rng(43)
+        batches = _gen_batches(rng, 3, 16, 1, step=9)
+        rt.ingest_arrays(*_cols(batches[0]))
+        ref.process_batch(batches[0])
+        late_view = MaterializedView(spec)
+        late_view.resync(*rt.resync_rows())
+        for batch in batches[1:]:
+            late_view.apply_delta(rt.ingest_arrays(*_cols(batch)))
+            ref.process_batch(batch)
+        assert late_view.table() == ref.table()
+        assert late_view.resyncs == 1
+
+
+class TestOverflowPaths:
+    def test_emit_overflow_falls_back_to_resync(self):
+        # more changed rows than the emit columns: the delta degrades to
+        # a full-state image (correct, just not delta-sized) and the
+        # view replaces its open table from it
+        spec = _spec(emit_capacity=8, lateness_ms=FOREVER)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        batch = [(0, i, i * 100) for i in range(40)]  # 40 open windows
+        delta = rt.ingest_arrays(*_cols(batch))
+        view.apply_delta(delta)
+        ref.process_batch(batch)
+        assert delta.kind == "resync"
+        assert view.resyncs == 1
+        assert rt.bank.snapshot() == ref.bank_entries()
+        assert view.table() == ref.table()
+
+    def test_delta_disabled_ships_full_state(self):
+        # the FLUVIO_WINDOW_DELTA=0 escape hatch: every batch ships the
+        # full bank image and the view still converges
+        spec = _spec(delta_only=False, lateness_ms=FOREVER)
+        rng = np.random.default_rng(47)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        for batch in _gen_batches(rng, 3, 16, 1, step=9):
+            delta = rt.ingest_arrays(*_cols(batch))
+            assert delta.kind == "resync"
+            assert delta.delta_bytes >= 0
+            view.apply_delta(delta)
+            ref.process_batch(batch)
+        assert view.table() == ref.table()
+
+    def test_bank_capacity_error_before_commit(self):
+        spec = _spec(capacity=4, emit_capacity=8, lateness_ms=FOREVER)
+        rt = _runtime(spec)
+        rt.ingest_arrays(*_cols([(0, 1, 0), (0, 2, 150)]))
+        before = rt.bank.snapshot()
+        wide = [(0, i, i * 100) for i in range(10)]
+        with pytest.raises(WindowCapacityError):
+            rt.ingest_arrays(*_cols(wide))
+        assert rt.bank.snapshot() == before, "overflow must not commit"
+
+    def test_restore_rejects_oversized_snapshot(self):
+        big = _spec(capacity=64, lateness_ms=FOREVER)
+        rt = _runtime(big)
+        rt.ingest_arrays(*_cols([(0, i, i * 100) for i in range(20)]))
+        entries, wm = rt.bank.snapshot()
+        small = _runtime(_spec(capacity=8))
+        with pytest.raises(WindowCapacityError):
+            small.bank.restore(entries, wm)
