@@ -911,10 +911,11 @@ def _run_windowed_config(
         assert rt.bank.snapshot() == ref.bank_entries(), (
             f"{name}: device carry diverged from host at record {b}"
         )
-    if spec.delta_only:
-        assert view.table() == ref.table(), (
-            f"{name}: materialized view diverged from host reference"
-        )
+    # full-table pin holds on BOTH emission variants: resync deltas
+    # carry the batch's closes, so FLUVIO_WINDOW_DELTA=0 converges too
+    assert view.table() == ref.table(), (
+        f"{name}: materialized view diverged from host reference"
+    )
 
     wc1 = TELEMETRY.window_counts()
     kinds = {

@@ -563,9 +563,9 @@ class PipelineTelemetry:
             self.windows_closed += n
 
     def add_window_delta(self, kind: str, rows: int) -> None:
-        """Delta rows shipped down by kind (upsert/close/resync/late —
-        late counts dropped rows, which never ship but must stay
-        observable for the exactness story)."""
+        """Delta rows shipped down by kind (upsert/close/resync/late/
+        invalid — late and invalid count dropped rows, which never ship
+        but must stay observable for the exactness story)."""
         if rows <= 0:
             return
         with self._lock:

@@ -6,7 +6,9 @@ only thing that crosses the link down is the per-batch DELTA — closed
 windows plus the (key, window) entries this batch touched — as packed
 int columns riding the same down-* accounting the executor's packed
 fetch uses. A full-state image ships only on consumer attach, failover
-seed/migration (CarryReplica), and the emit-capacity overflow resync.
+seed/migration (CarryReplica), and the emit-capacity overflow resync —
+and an overflow resync still carries the batch's closed rows (their
+final aggregates were evicted from the bank), never dropping closes.
 
 Fault discipline matches the executor: `faults.maybe_fire` at the
 stage/dispatch/device/fetch seams, transient faults retried ONCE
@@ -52,6 +54,9 @@ class WindowDelta:
     delta_bytes: int
     full_bytes: int
     records: int
+    # rows whose key fell outside the composite-id packing range
+    # [0, KEY_STRIDE): dropped (never folded), counted for observability
+    n_invalid: int = 0
     # filled by PartitionedWindowRuntime so replayed deltas can be
     # deduped by the serving ladder
     partition: Optional[Tuple[str, int]] = None
@@ -161,14 +166,13 @@ class WindowedRuntime:
         faults.maybe_fire("device")
         (header, nb_ids, nb_accs, nb_cnts,
          em_ids, em_accs, em_cnts, em_closed) = outs
-        # first blocking sync: the scalar header (7 i64 = 56 bytes)
+        # first blocking sync: the scalar header (8 i64 = 64 bytes)
         h = jax.device_get(header)
         if span is not None:
             span.mark_device_ready()
         faults.maybe_fire("fetch")
-        n_emit, n_open, n_closed, n_late, new_wm, bank_ovf, emit_ovf = (
-            int(x) for x in h
-        )
+        (n_emit, n_open, n_closed, n_late, new_wm, bank_ovf, emit_ovf,
+         n_invalid) = (int(x) for x in h)
         if bank_ovf:
             # the merged open set no longer fits the device bank: loud
             # failure BEFORE committing, so the carry stays valid
@@ -177,19 +181,61 @@ class WindowedRuntime:
                 f"{n_open} open windows exceed bank capacity "
                 f"{self.spec.capacity} (raise FLUVIO_WINDOW_CAPACITY)"
             )
+        emit_cols = int(em_ids.shape[0])
+        resync = emit_ovf or not self.spec.delta_only
+        if resync and n_closed > emit_cols:
+            # the batch closed more windows than the emit columns hold:
+            # their final aggregates exist ONLY there (a close evicts
+            # the entry from the bank), so they cannot be delivered —
+            # loud failure BEFORE committing, like the bank-capacity
+            # path, instead of silently losing close events
+            TELEMETRY.add_decline("window-capacity")
+            raise WindowCapacityError(
+                f"{n_closed} windows closed in one batch exceed emit "
+                f"capacity {emit_cols} (raise FLUVIO_WINDOW_EMIT)"
+            )
         self.bank.commit(
             nb_ids, nb_accs, nb_cnts, header[4], n_open, new_wm
         )
-        if emit_ovf or not self.spec.delta_only:
+        if resync:
             # more changed rows than the emit columns hold — or the
-            # FLUVIO_WINDOW_DELTA=0 escape hatch: ship ONE full-state
-            # image instead of delta rows (correct, just not
-            # delta-sized); the view replaces its open table from it
+            # FLUVIO_WINDOW_DELTA=0 escape hatch: ship the batch's
+            # CLOSED rows (the compacted emit prefix — the kernel packs
+            # closes first, and the guard above pinned n_closed within
+            # the columns) plus ONE full open-state image (correct,
+            # just not delta-sized); the view folds the closes and
+            # replaces its open table from the image
+            t_ph = time.perf_counter()
+            if n_closed:
+                fetch_rows = 8
+                while fetch_rows < n_closed:
+                    fetch_rows *= 2
+                fetch_rows = min(fetch_rows, emit_cols)
+                cl_ids, cl_accs, cl_cnts = (
+                    np.asarray(a)[:n_closed]
+                    for a in jax.device_get(
+                        (em_ids[:fetch_rows], em_accs[:fetch_rows],
+                         em_cnts[:fetch_rows])
+                    )
+                )
+                closed_bytes = fetch_rows * ENTRY_BYTES
+            else:
+                cl_ids = cl_accs = cl_cnts = np.zeros((0,), dtype=np.int64)
+                closed_bytes = 0
             rows = self.bank.full_rows()
-            ids, accs, cnts = rows[:, 0], rows[:, 1], rows[:, 2]
-            closed = np.zeros((rows.shape[0],), dtype=np.int32)
+            if span is not None:
+                span.add("d2h", time.perf_counter() - t_ph)
+            ids = np.concatenate([cl_ids, rows[:, 0]])
+            accs = np.concatenate([cl_accs, rows[:, 1]])
+            cnts = np.concatenate([cl_cnts, rows[:, 2]])
+            closed = np.zeros((ids.shape[0],), dtype=np.int32)
+            closed[:n_closed] = 1
             kind = "rows-resync"
-            delta_bytes = rows.shape[0] * ENTRY_BYTES + DELTA_FRAME_BYTES
+            delta_bytes = (
+                closed_bytes
+                + rows.shape[0] * ENTRY_BYTES
+                + DELTA_FRAME_BYTES
+            )
         else:
             # bucketed emit fetch: slice lengths quantize to powers of
             # two (the executor's bucketed-jit discipline) so XLA
@@ -226,9 +272,12 @@ class WindowedRuntime:
             if upserts:
                 TELEMETRY.add_window_delta("upsert", upserts)
         else:
-            TELEMETRY.add_window_delta("resync", int(ids.shape[0]))
+            # closes riding the resync are already counted under "close"
+            TELEMETRY.add_window_delta("resync", int(ids.shape[0]) - n_closed)
         if n_late:
             TELEMETRY.add_window_delta("late", n_late)
+        if n_invalid:
+            TELEMETRY.add_window_delta("invalid", n_invalid)
         TELEMETRY.add_window_downlink(delta_bytes, full_bytes)
         TELEMETRY.gauge_set("window_state_bytes", self.bank.state_bytes())
         TELEMETRY.add_link_variant("down-packed")
@@ -246,6 +295,7 @@ class WindowedRuntime:
             delta_bytes=delta_bytes,
             full_bytes=full_bytes,
             records=count,
+            n_invalid=n_invalid,
         )
 
     # -- attach / resync -----------------------------------------------------
@@ -254,6 +304,25 @@ class WindowedRuntime:
         """Full-state image for a consumer attach: (rows, watermark)
         for `MaterializedView.resync`."""
         return self.bank.full_rows(), self.bank.watermark
+
+
+def _fold_open(mirror: Dict[int, Tuple[int, int]], delta: WindowDelta
+               ) -> None:
+    """Fold one delta into a host open-table mirror (the open-side of
+    `MaterializedView.apply_delta`): upserts overwrite, closes evict, a
+    resync replaces the table from its open rows. Because every open
+    bank entry shipped in the batch that last touched it, the mirror
+    tracks the device bank's live entries exactly — which is what lets
+    the replica publish ride rows the batch ALREADY fetched instead of
+    a per-batch full-bank device_get."""
+    if delta.kind == "resync":
+        mirror.clear()
+    for i, a, c, cl in zip(delta.ids, delta.accs, delta.counts,
+                           delta.closed):
+        if cl:
+            mirror.pop(int(i), None)
+        else:
+            mirror[int(i)] = (int(a), int(c))
 
 
 class PartitionedWindowRuntime:
@@ -270,6 +339,9 @@ class PartitionedWindowRuntime:
         self.replica = replica
         self._runtimes: Dict[Tuple[str, int], WindowedRuntime] = {}
         self._offsets: Dict[Tuple[str, int], int] = {}
+        # host mirror of each bank's open entries, folded from served
+        # deltas — the replica-publish source (no extra D2H per batch)
+        self._mirrors: Dict[Tuple[str, int], Dict[int, Tuple[int, int]]] = {}
 
     @staticmethod
     def _replica_key(topic: str, partition: int) -> str:
@@ -296,12 +368,17 @@ class PartitionedWindowRuntime:
         delta.offset = offset
         self._offsets[key] = offset + delta.records
         if self.replica is not None:
-            entries, wm = rt.bank.snapshot()
+            # the publish derives from the delta the batch already
+            # fetched: the mirror IS the bank's live entry set (sorted
+            # by id, the bank's compaction order), so promotion seeds
+            # bit-equal without re-shipping the full bank every batch
+            mirror = self._mirrors.setdefault(key, {})
+            _fold_open(mirror, delta)
             self.replica.publish(
                 self._replica_key(topic, partition),
                 self._offsets[key],
-                entries,
-                inst_state=[("wm", wm)],
+                [(i,) + mirror[i] for i in sorted(mirror)],
+                inst_state=[("wm", delta.watermark)],
             )
         return delta
 
@@ -324,6 +401,9 @@ class PartitionedWindowRuntime:
         rt = self.runtime(topic, partition, device=device)
         rt.bank.restore(list(carries or ()), int(wm))
         self._offsets[(topic, partition)] = int(offset)
+        self._mirrors[(topic, partition)] = {
+            int(i): (int(a), int(c)) for i, a, c in (carries or ())
+        }
         return int(offset)
 
     def migrate(self, topic: str, partition: int, device) -> None:

@@ -128,6 +128,14 @@ def _update_core(
     from fluvio_tpu.smartengine.tpu.kernels import compact_rows
 
     n = contribs.shape[0]
+    # composite-id packing only holds for keys in [0, KEY_STRIDE): an
+    # out-of-range key would silently alias into another key's window-id
+    # space (or overflow int64). Such rows are invalid — counted in the
+    # header and dropped entirely (no fold, no watermark advance), the
+    # same drop-not-corrupt rule as late rows; reference.py mirrors it.
+    key_ok = (keys >= 0) & (keys < KEY_STRIDE)
+    invalid = valid & ~key_ok
+    valid = valid & key_ok
     # -- window assignment (sliding replicates each record over the
     # fanout window phases; tumbling is fanout == 1) -------------------------
     base_idx = jnp.where(valid, ts // slide_ms, 0)
@@ -168,13 +176,23 @@ def _update_core(
     closed = live & (e_win_end + lateness_ms <= new_wm)
     open_m = live & ~closed
     # -- delta emission: closed windows always ship; open entries ship
-    # only when this batch touched them (delta_only off = full state)
-    if delta_only:
-        emit_m = closed | (open_m & (e_tb > 0))
-    else:
-        emit_m = live
+    # only when this batch touched them (delta_only off = full state).
+    # Closed rows compact FIRST (the two-block concat keeps them ahead
+    # of the open upserts): a close evicts its entry from the bank, so
+    # the emit-overflow resync path must still be able to fetch the
+    # batch's closes as a bounded prefix of the emit columns — open
+    # rows it can recover from the bank image, final aggregates of
+    # closed windows live nowhere else.
+    emit_open = (open_m & (e_tb > 0)) if delta_only else open_m
+    m = e_ids.shape[0]
     n_emit, (m_ids, m_accs, m_cnts, m_closed) = compact_rows(
-        emit_m, e_ids, e_accs, e_cnts, closed.astype(jnp.int32)
+        jnp.concatenate([closed, emit_open]),
+        jnp.concatenate([e_ids, e_ids]),
+        jnp.concatenate([e_accs, e_accs]),
+        jnp.concatenate([e_cnts, e_cnts]),
+        jnp.concatenate(
+            [jnp.ones((m,), dtype=jnp.int32), jnp.zeros((m,), dtype=jnp.int32)]
+        ),
     )
     # -- new bank: open entries only, compacted to capacity ------------------
     n_open, (o_ids, o_accs, o_cnts, _o_tb) = compact_rows(
@@ -204,6 +222,7 @@ def _update_core(
             new_wm,
             (n_open > capacity).astype(jnp.int64),
             (n_emit > e_slice).astype(jnp.int64),
+            jnp.sum(invalid).astype(jnp.int64),
         ]
     )
     return (

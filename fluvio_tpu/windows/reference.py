@@ -25,6 +25,7 @@ class HostWindowReference:
         self.closed: Dict[int, Tuple[int, int]] = {}
         self.watermark = INT64_MIN + 1  # matches the bank's seed
         self.late = 0
+        self.invalid = 0  # keys outside the composite-id packing range
 
     def _fold(self, composite: int, contrib: int) -> None:
         acc, cnt = self.open.get(composite, (self.spec.neutral, 0))
@@ -42,12 +43,19 @@ class HostWindowReference:
     ) -> Dict[str, int]:
         """Fold one batch of ``(key, contrib, ts)`` rows (key 0 for
         unkeyed streams). Returns the batch's counts for pinning the
-        engine header: {closed, late, watermark}."""
+        engine header: {closed, late, invalid, watermark}."""
         spec = self.spec
         pre_wm = self.watermark
         batch_max = INT64_MIN + 1
         late = 0
+        invalid = 0
         for key, contrib, ts in records:
+            if key < 0 or key >= KEY_STRIDE:
+                # kernel rule: a key outside [0, KEY_STRIDE) would alias
+                # in the composite-id packing — dropped entirely, not
+                # even advancing the watermark
+                invalid += 1
+                continue
             batch_max = max(batch_max, ts)
             base_idx = ts // spec.slide_ms
             for j in range(spec.fanout):
@@ -69,7 +77,13 @@ class HostWindowReference:
                 n_closed += 1
         self.watermark = new_wm
         self.late += late
-        return {"closed": n_closed, "late": late, "watermark": new_wm}
+        self.invalid += invalid
+        return {
+            "closed": n_closed,
+            "late": late,
+            "invalid": invalid,
+            "watermark": new_wm,
+        }
 
     # -- pin surfaces --------------------------------------------------------
 

@@ -41,29 +41,40 @@ class MaterializedView:
 
     def apply_delta(self, delta) -> None:
         """Fold one batch's `WindowDelta` (engine.py). Resync deltas
-        REPLACE the open table (they are the full bank image); row
-        deltas upsert/close incrementally."""
+        REPLACE the open table from their open rows — and still fold
+        their closed rows (the batch's closes ride the resync as a
+        prefix; their final aggregates left the bank when they closed);
+        row deltas upsert/close incrementally."""
         if delta.kind == "resync":
             self.resyncs += 1
-            self.open = {
-                int(i): (int(a), int(c))
-                for i, a, c in zip(delta.ids, delta.accs, delta.counts)
-            }
+            fresh = {}
+            for i, a, c, cl in zip(
+                delta.ids, delta.accs, delta.counts, delta.closed
+            ):
+                i = int(i)
+                if cl:
+                    self._close(i, int(a), int(c))
+                else:
+                    fresh[i] = (int(a), int(c))
+            self.open = fresh
         else:
             for i, a, c, cl in zip(
                 delta.ids, delta.accs, delta.counts, delta.closed
             ):
                 i = int(i)
                 if cl:
-                    if i in self.closed:
-                        self.duplicate_closes += 1
-                    else:
-                        self.close_events += 1
-                    self.closed[i] = (int(a), int(c))
+                    self._close(i, int(a), int(c))
                     self.open.pop(i, None)
                 else:
                     self.open[i] = (int(a), int(c))
         self.watermark = int(delta.watermark)
+
+    def _close(self, i: int, acc: int, cnt: int) -> None:
+        if i in self.closed:
+            self.duplicate_closes += 1
+        else:
+            self.close_events += 1
+        self.closed[i] = (acc, cnt)
 
     def resync(self, rows, watermark: int) -> None:
         """Full-state resync (consumer attach / failover seed): replace

@@ -75,6 +75,7 @@ def _drive(rt, view, ref, batches):
         pins = ref.process_batch(batch)
         assert delta.n_closed == pins["closed"]
         assert delta.n_late == pins["late"]
+        assert delta.n_invalid == pins["invalid"]
         assert delta.watermark == pins["watermark"]
         assert rt.bank.snapshot() == ref.bank_entries()
 
@@ -231,6 +232,35 @@ class TestExactness:
             )
             assert rt.bank.snapshot() == ref.bank_entries()
         assert view.table() == ref.table()
+
+    def test_out_of_range_keys_drop_and_count(self):
+        # composite-id packing holds for keys in [0, 2^31) only: an
+        # out-of-range key would alias into another key's window-id
+        # space, so the kernel drops such rows (no fold, no watermark
+        # advance) and counts them; the host reference mirrors the rule
+        from fluvio_tpu.windows.spec import KEY_STRIDE
+
+        spec = _spec(keyed=True)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        batch = [
+            (1, 5, 10),
+            (KEY_STRIDE, 7, 20),       # aliases key 0 if folded
+            (-3, 9, 30),               # negative composite id
+            (KEY_STRIDE * 4, 11, 40),  # would overflow into key 4
+            (2, 6, 50),
+            (1 << 40, 13, 999),        # max-ts row is invalid: wm stays 50
+        ]
+        delta = rt.ingest_arrays(*_cols(batch))
+        view.apply_delta(delta)
+        pins = ref.process_batch(batch)
+        assert delta.n_invalid == 4 == pins["invalid"]
+        assert delta.watermark == 50 == pins["watermark"]
+        assert rt.bank.snapshot() == ref.bank_entries()
+        assert view.table() == ref.table()
+        assert {k for (k, _s) in view.table()} == {1, 2}
 
     def test_delta_smaller_than_full_state(self):
         spec = _spec()
@@ -512,6 +542,68 @@ class TestOverflowPaths:
             view.apply_delta(delta)
             ref.process_batch(batch)
         assert view.table() == ref.table()
+
+    def test_emit_overflow_with_closes_ships_final_aggregates(self):
+        # windows CLOSE in the same batch that overflows the emit
+        # columns: their final aggregates were evicted from the bank, so
+        # the resync must deliver them too (they ride as the emit-column
+        # prefix) — the view's closed table still matches host truth
+        spec = _spec(emit_capacity=32, lateness_ms=2000)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        # 40 windows touched (> emit capacity), wm 3900 closes the
+        # first 19 of them in the SAME batch
+        b0 = [(0, i + 1, i * 100) for i in range(40)]
+        b1 = [(0, 5, 4000)]  # follow-on delta over the resynced view
+        deltas = []
+        for batch in (b0, b1):
+            delta = rt.ingest_arrays(*_cols(batch))
+            deltas.append(delta)
+            view.apply_delta(delta)
+            pins = ref.process_batch(batch)
+            assert delta.n_closed == pins["closed"]
+            assert rt.bank.snapshot() == ref.bank_entries()
+        assert deltas[0].kind == "resync"
+        assert deltas[0].n_closed > 0, "overflow batch must close windows"
+        assert view.resyncs == 1
+        assert view.close_events == len(ref.closed)
+        assert view.table() == ref.table()
+
+    def test_delta_disabled_closes_still_delivered(self):
+        # FLUVIO_WINDOW_DELTA=0 with windows closing along the way: the
+        # per-batch full-state images carry each batch's closes, so the
+        # view's closed table converges exactly (not just the open set)
+        spec = _spec(delta_only=False, lateness_ms=0)
+        rng = np.random.default_rng(53)
+        rt, view, ref = (
+            _runtime(spec), MaterializedView(spec),
+            HostWindowReference(spec),
+        )
+        for batch in _gen_batches(rng, 4, 16, 1, step=14):
+            delta = rt.ingest_arrays(*_cols(batch))
+            assert delta.kind == "resync"
+            view.apply_delta(delta)
+            ref.process_batch(batch)
+            assert rt.bank.snapshot() == ref.bank_entries()
+        assert ref.closed, "must exercise closes on the full-state path"
+        assert view.close_events == len(ref.closed)
+        assert view.duplicate_closes == 0
+        assert view.table() == ref.table()
+
+    def test_closed_overflow_raises_before_commit(self):
+        # more closes in ONE batch than the emit columns hold: the
+        # closes cannot be delivered, so the engine fails loud BEFORE
+        # committing (like the bank-capacity path) and the carry stays
+        spec = _spec(emit_capacity=8, lateness_ms=0)
+        rt = _runtime(spec)
+        rt.ingest_arrays(*_cols([(0, 1, 0)]))
+        before = rt.bank.snapshot()
+        wide = [(0, i, i * 100) for i in range(40)]  # closes 39 windows
+        with pytest.raises(WindowCapacityError, match="emit"):
+            rt.ingest_arrays(*_cols(wide))
+        assert rt.bank.snapshot() == before, "overflow must not commit"
 
     def test_bank_capacity_error_before_commit(self):
         spec = _spec(capacity=4, emit_capacity=8, lateness_ms=FOREVER)
