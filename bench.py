@@ -1017,6 +1017,42 @@ def _run_windowed_config(
 
 
 def run_config(name: str, cfg: dict, n: int, smoke: bool, deadline=None) -> dict:
+    # per-config device-memory attribution: restart the ledger's
+    # config watermark so the mem block charges peak bytes to THIS
+    # config, then attach the block to whatever the run produced
+    _mem_reset_peak()
+    result = _dispatch_config(name, cfg, n, smoke, deadline)
+    _attach_memory_block(result)
+    return result
+
+
+def _mem_reset_peak() -> None:
+    try:
+        from fluvio_tpu.telemetry import memory as memory_mod
+
+        eng = memory_mod.peek()
+        if eng is not None:
+            eng.reset_peak()
+    except Exception:  # noqa: BLE001 — accounting must never cost a run
+        pass
+
+
+def _attach_memory_block(result) -> None:
+    """Per-config ``memory`` block for BENCH_DETAIL.json (the compact
+    line's tiny ``mem`` key summarizes across configs)."""
+    try:
+        from fluvio_tpu.telemetry import memory as memory_mod
+
+        blk = memory_mod.bench_block()
+        if blk and isinstance(result, dict) and "skipped" not in result:
+            result["memory"] = blk
+    except Exception:  # noqa: BLE001 — accounting must never cost a run
+        pass
+
+
+def _dispatch_config(
+    name: str, cfg: dict, n: int, smoke: bool, deadline=None
+) -> dict:
     if cfg.get("partitions"):
         return _run_partitioned_config(name, cfg, n, smoke, deadline)
     if cfg.get("windowed"):
@@ -1874,6 +1910,37 @@ def _win_counts(configs: dict):
     }
 
 
+def _mem_counts(configs: dict):
+    """Device-memory evidence for the compact line's tiny ``mem`` key:
+    worst per-config ledger peak + the owner classes that ever held
+    bytes across the family (plus the leak count when non-zero). Full
+    per-config blocks (per-owner bytes, reconcile doc) stay in
+    BENCH_DETAIL.json only (the ≤1500-char contract)."""
+    blocks = [
+        c["memory"]
+        for c in configs.values()
+        if isinstance(c, dict) and isinstance(c.get("memory"), dict)
+    ]
+    if not blocks:
+        return None
+    peaks = [
+        b["peak_mb"]
+        for b in blocks
+        if isinstance(b.get("peak_mb"), (int, float))
+    ]
+    owners = sorted({
+        o for b in blocks for o in (b.get("owners") or {})
+    })
+    out = {
+        "peak_mb": max(peaks) if peaks else None,
+        "owners": owners,
+    }
+    leaks = sum(int(b.get("leaks", 0) or 0) for b in blocks)
+    if leaks:
+        out["leaks"] = leaks
+    return out
+
+
 def _slo_verdict(configs: dict):
     """Worst per-config SLO verdict across the suite — the compact
     line's tiny ``slo`` key; full per-config blocks (targets, observed
@@ -1999,6 +2066,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
         wn = _win_counts(out["configs"])
         if wn:
             compact["win"] = wn
+        mm = _mem_counts(out["configs"])
+        if mm:
+            compact["mem"] = mm
     if "cpu_fallback" in out:
         inner = out["cpu_fallback"]
         compact["cpu_fallback"] = {
@@ -2011,9 +2081,9 @@ def _compact_line(out: dict, limit: int = COMPACT_LINE_LIMIT) -> dict:
     # reads, and it is emitted unconditionally by contract — the bulky
     # sections go first
     for drop in (
-        "configs", "cpu_fallback", "dfa", "win", "soak", "lag", "rebal",
-        "part", "adm", "slo", "preflight", "down", "compile", "phases",
-        "error", "xla_cache", "link",
+        "configs", "cpu_fallback", "dfa", "win", "mem", "soak", "lag",
+        "rebal", "part", "adm", "slo", "preflight", "down", "compile",
+        "phases", "error", "xla_cache", "link",
     ):
         if len(json.dumps(compact)) <= limit:
             break

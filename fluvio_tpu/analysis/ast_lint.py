@@ -72,6 +72,7 @@ ALLOWED_TELEMETRY_SEAMS = {
     "add_sharded_compress", "add_slo_breach", "add_admission",
     "add_windows_closed", "add_window_delta", "add_window_downlink",
     "gauge_add", "gauge_set",
+    "mem_acquire", "mem_release",
 }
 
 _WHERE_FUNCS = {"where", "select"}

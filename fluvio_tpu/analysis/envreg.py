@@ -180,6 +180,16 @@ REGISTRY: Tuple[EnvFlag, ...] = (
     _f("FLUVIO_LOCKWATCH", "mode", "0", "0|1|record|assert",
        "analysis/lockwatch.py",
        "runtime lock-order watchdog (assert: raise on new edges)"),
+    _f("FLUVIO_MEM_BUDGET", "int", "0", "bytes (0 = no budget)",
+       ("telemetry/memory.py", "telemetry/slo.py"),
+       "device-memory ledger ceiling: arms the hbm_headroom SLO rule "
+       "(admission sheds before the allocator fails)"),
+    _f("FLUVIO_MEM_LEAK_TTL_S", "float", "120", "seconds",
+       "telemetry/memory.py",
+       "ledger entries unreleased past this age flag as mem-leaks"),
+    _f("FLUVIO_MEM_SAMPLE_S", "float", "10", "seconds",
+       "telemetry/memory.py",
+       "min interval between ledger leak-scan/reconcile passes"),
     _f("FLUVIO_METRIC_SPU", "path", "/tmp/fluvio-spu.sock", "socket path",
        "spu/monitoring.py", "SPU monitoring unix-socket location"),
     _f("FLUVIO_PARTITIONS", "int", None, "group count (unset/0 = off)",

@@ -2,7 +2,8 @@
 
 Run as ``python -m fluvio_tpu.cli <command>``. Commands: produce, consume,
 topic, partition, smartmodule, tableformat, spu, profile, cluster, run,
-metrics, trace, analyze, health, lag, rebalance, soak, warmup, version.
+metrics, trace, analyze, health, lag, memory, rebalance, soak, warmup,
+version.
 """
 
 from __future__ import annotations
@@ -22,6 +23,7 @@ def build_parser() -> argparse.ArgumentParser:
     from fluvio_tpu.cli import health as health_cmd
     from fluvio_tpu.cli import hub as hub_cmd
     from fluvio_tpu.cli import lag as lag_cmd
+    from fluvio_tpu.cli import memory as memory_cmd
     from fluvio_tpu.cli import metrics as metrics_cmd
     from fluvio_tpu.cli import produce as produce_cmd
     from fluvio_tpu.cli import rebalance as rebalance_cmd
@@ -53,6 +55,7 @@ def build_parser() -> argparse.ArgumentParser:
         analyze_cmd.add_analyze_parser,
         health_cmd.add_health_parser,
         lag_cmd.add_lag_parser,
+        memory_cmd.add_memory_parser,
         rebalance_cmd.add_rebalance_parser,
         soak_cmd.add_soak_parser,
         warmup_cmd.add_warmup_parser,

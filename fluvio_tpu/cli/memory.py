@@ -1,0 +1,156 @@
+"""`memory` subcommand — the device-memory ledger per owner class.
+
+Reads the monitoring socket's ``memory`` mode (the per-owner HBM
+ledger, telemetry/memory.py) and renders it as a table or JSON. Exit
+code is the deploy-gate contract, symmetric with ``fluvio-tpu
+health``/``lag``: 0 when the ledger is clean, 1 when any owner has a
+flagged leak or the ``hbm_headroom`` budget is in ``breach`` — so
+``fluvio-tpu memory && promote`` refuses to advance a rollout that is
+leaking device memory or running out of headroom.
+
+``--watch N`` re-reads and re-renders every N seconds (rc reflects the
+LAST document). ``--local`` evaluates the in-process ledger instead of
+connecting to a socket (bench-style single-process runs and tests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+
+def add_memory_parser(sub) -> None:
+    p = sub.add_parser(
+        "memory",
+        help="device-memory ledger: HBM bytes per owner, leaks, headroom",
+    )
+    p.add_argument(
+        "--path",
+        help="monitoring unix-socket path (default: FLUVIO_METRIC_SPU)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("table", "json"),
+        default="table",
+        help="output format (default: table)",
+    )
+    p.add_argument(
+        "--local",
+        action="store_true",
+        help="evaluate the in-process memory ledger instead of a socket",
+    )
+    p.add_argument(
+        "--watch",
+        type=float,
+        metavar="SECONDS",
+        help="re-read and re-render every SECONDS until interrupted",
+    )
+    p.set_defaults(fn=memory)
+
+
+def _fmt_mb(nbytes) -> str:
+    try:
+        nbytes = int(nbytes)
+    except (TypeError, ValueError):
+        return "-"
+    if nbytes >= 1_000_000:
+        return f"{nbytes / 1e6:.2f}MB"
+    if nbytes >= 1_000:
+        return f"{nbytes / 1e3:.1f}kB"
+    return str(nbytes)
+
+
+def render_memory_table(doc: dict) -> str:
+    """Memory document -> operator-facing table. Pure function so the
+    surface tests render without a socket."""
+    from fluvio_tpu.cli.metrics import _rows_to_table
+
+    if not doc.get("enabled", False):
+        return (
+            "telemetry capture is off (FLUVIO_TELEMETRY=0): no memory data"
+        )
+    budget = doc.get("budget_bytes") or 0
+    sections = [
+        f"memory verdict: {doc.get('verdict', 'ok')}"
+        + (f"  (budget {_fmt_mb(budget)})" if budget else "  (no budget)")
+    ]
+    leaks = doc.get("leaks") or {}
+    rows = []
+    for owner, entry in sorted((doc.get("owners") or {}).items()):
+        rows.append(
+            (
+                owner,
+                _fmt_mb(entry.get("bytes", 0)),
+                entry.get("entries", 0),
+                leaks.get(owner, 0),
+            )
+        )
+    if rows:
+        sections.append(
+            _rows_to_table(
+                rows, header=("owner", "bytes", "entries", "leaks")
+            )
+        )
+    sections.append(
+        f"total: {_fmt_mb(doc.get('total_bytes', 0))}"
+        f"  peak: {_fmt_mb(doc.get('peak_bytes', 0))}"
+        f"  leaks: {doc.get('leaks_total', 0)}"
+    )
+    leaked = doc.get("leaked") or []
+    if leaked:
+        sections.append(
+            _rows_to_table(
+                [
+                    (
+                        e.get("owner", "-"),
+                        e.get("key", "-"),
+                        _fmt_mb(e.get("bytes", 0)),
+                        f"{e.get('age_s', 0):.1f}s",
+                    )
+                    for e in leaked
+                ],
+                header=("leaked_owner", "key", "bytes", "age"),
+            )
+        )
+    recon = doc.get("reconcile") or {}
+    if "backend_bytes" in recon:
+        sections.append(
+            f"backend: {_fmt_mb(recon['backend_bytes'])}"
+            f"  unaccounted: {_fmt_mb(recon.get('unaccounted_bytes', 0))}"
+        )
+    return "\n\n".join(sections)
+
+
+def memory_rc(doc: dict) -> int:
+    """The deploy-gate bit: 1 on budget breach OR any flagged leak."""
+    if doc.get("verdict") == "breach":
+        return 1
+    if doc.get("leaks_total", 0):
+        return 1
+    return 0
+
+
+async def _read_doc(args) -> dict:
+    if args.local:
+        from fluvio_tpu.telemetry.memory import memory_snapshot
+
+        return memory_snapshot()
+    from fluvio_tpu.spu.monitoring import read_memory
+
+    return await read_memory(args.path)
+
+
+async def memory(args) -> int:
+    while True:
+        doc = await _read_doc(args)
+        if args.format == "json":
+            print(json.dumps(doc, indent=1))
+        else:
+            print(render_memory_table(doc))
+        if not args.watch:
+            break
+        try:
+            await asyncio.sleep(max(args.watch, 0.1))
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            break
+    return memory_rc(doc)

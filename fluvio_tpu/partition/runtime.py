@@ -327,6 +327,23 @@ class PartitionRuntime:
         st.device_carries = ex._device_carries
         st.host_carries = list(ex.carries)
         st.batches += 1
+        # device-memory ledger: this partition's aggregate carry bank
+        # is HBM-resident between dispatches. Re-acquire on the same
+        # key is a resize, so per-batch capture stays balanced; a
+        # persistent owner, so quiesce drains do not expect zero.
+        if TELEMETRY.enabled:
+            carries = st.device_carries
+            if carries is None:
+                TELEMETRY.mem_release(("carry", st.key))
+            else:
+                # the carry is a pytree of tiny arrays, not one buffer
+                nbytes = sum(
+                    int(getattr(leaf, "nbytes", 0) or 0)
+                    for leaf in jax.tree_util.tree_leaves(carries)
+                )
+                TELEMETRY.mem_acquire(
+                    "carry_bank", ("carry", st.key), nbytes
+                )
 
     def _swap_out(self, prev: tuple) -> None:
         ex = self._executor
@@ -361,6 +378,10 @@ class PartitionRuntime:
         st = self._state(partition_key(topic, partition))
         st.device_carries = None
         st.carry_device = None
+        # the promoted follower holds only the host snapshot — the old
+        # device-resident bank (if any) is garbage now; retire its
+        # ledger booking with it
+        TELEMETRY.mem_release(("carry", st.key))
         st.host_carries = [tuple(c) for c in host_carries]
         if inst_state is not None:
             st.inst_state = [tuple(s) for s in inst_state]

@@ -1461,22 +1461,33 @@ class TpuChainExecutor:
 
     # -- device-memory / in-flight gauges ------------------------------------
 
-    def _gauge_track(self, handle, nbytes: int) -> None:
+    def _gauge_track(self, handle, nbytes: int, glz_nbytes: int = 0) -> None:
         """A dispatch went up: its staged link bytes are HBM-resident
-        until the fetch (or discard) releases them."""
+        until the fetch (or discard) releases them. Booked in the
+        device-memory ledger under a typed owner — ``shard_staging``
+        on the sharded path, else ``staged_batch``, with compressed
+        token bytes split out under ``glz_tokens`` — and the old
+        ``hbm_staged_bytes`` gauge republishes from the ledger as an
+        alias, so finish/discard/dead-letter imbalance cannot drift
+        the gauge from the balance the ledger proves."""
         if not TELEMETRY.enabled:
             return
+        owner = "shard_staging" if self._sharded is not None else "staged_batch"
+        glz_nbytes = min(max(glz_nbytes, 0), nbytes)
         self._handle_gauge[id(handle)] = nbytes
-        TELEMETRY.gauge_add("hbm_staged_bytes", nbytes)
+        TELEMETRY.mem_acquire(owner, ("batch", id(handle)), nbytes - glz_nbytes)
+        if glz_nbytes:
+            TELEMETRY.mem_acquire("glz_tokens", ("glz", id(handle)), glz_nbytes)
         TELEMETRY.gauge_add("live_batch_handles", 1)
 
     def _gauge_release(self, handle) -> None:
         """Idempotent: finish and discard may both see a handle on the
-        recovery ladders — only the first release moves the gauges."""
+        recovery ladders — only the first release moves the ledger."""
         nbytes = self._handle_gauge.pop(id(handle), None)
         if nbytes is None:
             return
-        TELEMETRY.gauge_add("hbm_staged_bytes", -nbytes)
+        TELEMETRY.mem_release(("batch", id(handle)))
+        TELEMETRY.mem_release(("glz", id(handle)))
         TELEMETRY.gauge_add("live_batch_handles", -1)
 
     def _dispatch(
@@ -1645,6 +1656,9 @@ class TpuChainExecutor:
             span.add("dispatch", time.perf_counter() - t_ph)
         self._glz_last = bool(glz_bytes)
         self._glz_last_variant = glz_variant if glz_bytes else None
+        # ledger attribution: how many of THIS dispatch's flat-link
+        # bytes were compressed token arrays (glz_tokens owner)
+        self._glz_last_h2d = flat_h2d if glz_bytes else 0
         self._enc_last = enc_now if enc_now != "off" else None
         # link-variant attribution (always-on counter, like declines):
         # which form THIS batch's flat actually crossed the link in
@@ -2984,7 +2998,11 @@ class TpuChainExecutor:
         spec["enc_variant"] = getattr(self, "_enc_last", None)
         spec["epoch"] = self._heal_epoch
         handle = (prev_carries, header, packed, spec)
-        self._gauge_track(handle, self.h2d_bytes_total - h0)
+        self._gauge_track(
+            handle,
+            self.h2d_bytes_total - h0,
+            glz_nbytes=getattr(self, "_glz_last_h2d", 0),
+        )
         return handle
 
     def dispatch_buffers(self, bufs: List[RecordBuffer]) -> List[tuple]:

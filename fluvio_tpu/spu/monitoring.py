@@ -18,7 +18,10 @@ Protocol: the client MAY send one mode line before reading:
   admission controller's input; see telemetry/slo.py),
 - ``lag``   → the streaming lag document: per-chain@topic/partition
   consumer lag / record age joined against the replica high
-  watermarks, plus the lag-rule SLO verdicts (telemetry/lag.py).
+  watermarks, plus the lag-rule SLO verdicts (telemetry/lag.py),
+- ``memory``→ the device-memory ledger document: per-owner HBM bytes,
+  the peak watermark, leak-detector state, and the hbm_headroom
+  budget verdict (telemetry/memory.py).
 
 A client that sends nothing still gets JSON after a short grace wait,
 so pre-existing scrapers keep working unchanged. One document per
@@ -86,6 +89,10 @@ class MonitoringServer:
             from fluvio_tpu.telemetry.lag import lag_snapshot
 
             return (json.dumps(lag_snapshot(), indent=1) + "\n").encode()
+        if mode == "memory":
+            from fluvio_tpu.telemetry.memory import memory_snapshot
+
+            return (json.dumps(memory_snapshot(), indent=1) + "\n").encode()
         return json.dumps(self.ctx.metrics.to_dict(), indent=2).encode()
 
     async def _handle(
@@ -104,7 +111,8 @@ class MonitoringServer:
                 )
                 requested = line.decode("ascii", "replace").strip().lower()
                 if requested in (
-                    "prom", "spans", "trace", "health", "lag", "json"
+                    "prom", "spans", "trace", "health", "lag",
+                    "memory", "json",
                 ):
                     mode = requested
             except (asyncio.TimeoutError, ValueError):
@@ -189,3 +197,9 @@ async def read_lag(path: Optional[str] = None) -> dict:
     """Fetch the streaming lag document (per-chain@topic/partition
     consumer lag / record age + lag-rule SLO verdicts)."""
     return json.loads(await _read_mode(path, "lag"))
+
+
+async def read_memory(path: Optional[str] = None) -> dict:
+    """Fetch the device-memory ledger document (per-owner HBM bytes,
+    peak watermark, leak state, hbm_headroom verdict)."""
+    return json.loads(await _read_mode(path, "memory"))

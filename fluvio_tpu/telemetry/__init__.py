@@ -27,7 +27,11 @@ per-record work:
                 multi-window burn-rate logic into per-chain
                 ok|warn|breach verdicts; breaches emit flight-recorder
                 instant events and (``FLUVIO_SLO_PROFILE``) bounded
-                jax.profiler captures.
+                jax.profiler captures,
+- `memory`    — the per-owner device-memory ledger: typed
+                acquire/release handles on every HBM allocation seam,
+                TTL leak detection, backend reconciliation, and the
+                ``hbm_headroom`` budget feeding admission shedding.
 
 Always-on contract: one monotonic clock pair per phase per batch, no
 per-record work; ``FLUVIO_TELEMETRY=0`` disables span/histogram capture
@@ -55,6 +59,10 @@ from fluvio_tpu.telemetry.trace import (
 )
 from fluvio_tpu.telemetry.timeseries import TimeSeries, WindowDelta
 from fluvio_tpu.telemetry.slo import SloEngine, health_snapshot
+from fluvio_tpu.telemetry.memory import (
+    MemoryLedger,
+    memory_snapshot,
+)
 
 # continuous flight recorder: arm the file sink when FLUVIO_TRACE names
 # a path (no-op otherwise; bounded + rotated, see telemetry/trace.py)
@@ -82,4 +90,6 @@ __all__ = [
     "WindowDelta",
     "SloEngine",
     "health_snapshot",
+    "MemoryLedger",
+    "memory_snapshot",
 ]

@@ -69,9 +69,12 @@ def render_prometheus(
     t = telemetry if telemetry is not None else TELEMETRY
     w = _Writer()
 
-    # pull-join the consumer-lag gauges at the scrape edge (outside the
-    # registry lock; one attribute check when nothing is tracked)
+    # pull-join the consumer-lag + device-memory gauges at the scrape
+    # edge (outside the registry lock; one attribute check each when
+    # nothing is tracked — and the memory pull runs the leak scan, so
+    # scraping keeps the TTL detector honest while nothing dispatches)
     t.refresh_lag()
+    t.refresh_memory()
     with t._lock:
         batch_series = [
             ({"path": path}, h.copy()) for path, h in t.batch_latency.items()
@@ -118,7 +121,14 @@ def render_prometheus(
         windows_closed = t.windows_closed
         window_deltas = dict(t.window_deltas)
         window_bytes = (t.window_delta_bytes, t.window_full_bytes)
+        memory_leaks = dict(t.memory_leaks)
     spans_dropped = t.spans.dropped
+    # per-owner ledger bytes read OUTSIDE the registry lock (the
+    # ledger has its own lock; peek() never creates one for a scrape)
+    from fluvio_tpu.telemetry import memory as memory_mod
+
+    _mem_eng = memory_mod.peek()
+    memory_owners = _mem_eng.owner_bytes() if _mem_eng is not None else {}
 
     _histogram(
         w,
@@ -422,10 +432,41 @@ def render_prometheus(
             f"{_PREFIX}_window_downlink_bytes_total", {"form": form}, v
         )
 
+    # -- device-memory ledger ------------------------------------------------
+    # per-owner family: the flat device_memory_bytes gauge is the sum
+    # of these samples (rendered HERE, labeled, instead of through the
+    # generic gauge loop below)
+    w.header(
+        f"{_PREFIX}_device_memory_bytes",
+        "Device-memory ledger bytes by owner class "
+        "(staged_batch | carry_bank | window_bank | emit_buffer | "
+        "glz_tokens | shard_staging | compile_cache).",
+        "gauge",
+    )
+    for owner, v in sorted(memory_owners.items()):
+        w.sample(f"{_PREFIX}_device_memory_bytes", {"owner": owner}, v)
+    w.header(
+        f"{_PREFIX}_device_memory_peak_bytes",
+        "High watermark of the device-memory ledger total.",
+        "gauge",
+    )
+    w.sample(
+        f"{_PREFIX}_device_memory_peak_bytes", {},
+        gauges.get("device_memory_peak_bytes", 0),
+    )
+    w.header(
+        f"{_PREFIX}_memory_leaks_total",
+        "Ledger entries unreleased past FLUVIO_MEM_LEAK_TTL_S, by owner.",
+        "counter",
+    )
+    for owner, n in sorted(memory_leaks.items()):
+        w.sample(f"{_PREFIX}_memory_leaks_total", {"owner": owner}, n)
+
     # -- gauges --------------------------------------------------------------
     for name, help_text in (
         ("hbm_staged_bytes",
-         "Device-memory bytes currently staged by in-flight batches."),
+         "Device-memory bytes currently staged by in-flight batches "
+         "(ledger alias: staged_batch + glz_tokens + shard_staging)."),
         ("live_batch_handles",
          "Dispatched batches whose results have not been fetched."),
         ("inflight_queue_depth",
@@ -445,6 +486,8 @@ def render_prometheus(
         "hbm_staged_bytes", "live_batch_handles",
         "inflight_queue_depth", "deadletter_entries",
         "admission_queue_depth", "warmed_buckets", "held_slices",
+        # rendered above as the labeled/peak ledger families
+        "device_memory_bytes", "device_memory_peak_bytes",
     }):
         w.header(f"{_PREFIX}_{name}", "Engine gauge.", "gauge")
         w.sample(f"{_PREFIX}_{name}", {}, gauges[name])

@@ -204,11 +204,21 @@ class PipelineTelemetry:
         self.window_deltas: Dict[str, int] = {}
         self.window_delta_bytes = 0
         self.window_full_bytes = 0
+        # device-memory plane (ISSUE-20): leak-detector counter by
+        # owner class. The ledger itself lives in telemetry/memory.py;
+        # this counter is always-on like the window close counts (a
+        # leak that happened while capture was off is still a leak).
+        self.memory_leaks: Dict[str, int] = {}
         # pull-join hook: telemetry/lag.py installs its sampler here so
         # the time-series tick (and the Prometheus scrape) re-joins
         # committed offsets against replica high watermarks at the
         # sampling edge — lag keeps moving while serving is fully shed
         self.lag_sampler = None
+        # pull-join hook: telemetry/memory.py installs the ledger's
+        # leak-scan/reconcile sampler here (same contract as
+        # lag_sampler — the scrape edge keeps the leak TTL honest
+        # while nothing is dispatching)
+        self.mem_sampler = None
         # optional flight-recorder sink (telemetry/trace.py installs it
         # from FLUVIO_TRACE): completed spans and instant events stream
         # into it as they happen
@@ -444,6 +454,42 @@ class PipelineTelemetry:
         except Exception:  # noqa: BLE001 — scrape surfaces must stay live
             pass
 
+    def refresh_memory(self) -> None:
+        """Pull the device-memory ledger's sampler (leak scan +
+        backend reconciliation + gauge republish). Same contract as
+        :meth:`refresh_lag`: one attribute check when no ledger exists,
+        never raises into a scrape."""
+        sampler = self.mem_sampler
+        if sampler is None or not self.enabled:
+            return
+        try:
+            sampler()
+        except Exception:  # noqa: BLE001 — scrape surfaces must stay live
+            pass
+
+    # -- device-memory ledger seams ------------------------------------------
+
+    def mem_acquire(self, owner: str, key, nbytes: int) -> None:
+        """Book device bytes under ``owner`` in the memory ledger. One
+        ``enabled`` check when capture is off — the hot allocation
+        seams (stage/dispatch/swap-in) call this unconditionally."""
+        if not self.enabled or nbytes <= 0:
+            return
+        from fluvio_tpu.telemetry import memory as memory_mod
+
+        memory_mod.engine().acquire(owner, key, nbytes)
+
+    def mem_release(self, key) -> None:
+        """Retire a ledger booking. Idempotent at the ledger; gated
+        here so disabled capture costs one attribute check."""
+        if not self.enabled:
+            return
+        from fluvio_tpu.telemetry import memory as memory_mod
+
+        eng = memory_mod.peek()
+        if eng is not None:
+            eng.release(key)
+
     # -- instant events (flight recorder) ------------------------------------
 
     def _event(self, kind: str, detail: str = "") -> None:
@@ -519,6 +565,20 @@ class PipelineTelemetry:
         with self._lock:
             self.slo_breaches[key] = self.slo_breaches.get(key, 0) + 1
         self._event("slo-breach", detail or key)
+
+    def add_memory_leak(self, owner: str, detail: str = "") -> None:
+        """One device-memory ledger entry aged past its leak TTL with
+        no release. Counter is always-on (a leak is a leak); the
+        flight-recorder instant lands the leak on the Perfetto
+        timeline next to the spans that stranded it."""
+        with self._lock:
+            self.memory_leaks[owner] = self.memory_leaks.get(owner, 0) + 1
+        self._event("mem-leak", detail or owner)
+
+    def memory_leak_counts(self) -> Dict[str, int]:
+        """{owner: leaks} — the memory CLI's rc gate reads this."""
+        with self._lock:
+            return dict(self.memory_leaks)
 
     def add_admission(self, reason: str) -> None:
         """One admission-controller decision: ``admit`` or a shed/flush
@@ -787,7 +847,7 @@ class PipelineTelemetry:
         (monitoring JSON, Prometheus text, CLI table) — they must not
         drift apart, so they all start here."""
         with self._lock:
-            return {
+            doc = {
                 "enabled": self.enabled,
                 "batches": {
                     path: dict(h.to_dict(), records=self.batch_records.get(path, 0))
@@ -874,7 +934,33 @@ class PipelineTelemetry:
                     "delta_bytes": self.window_delta_bytes,
                     "full_bytes": self.window_full_bytes,
                 },
-            } | self._ring_stats()
+            }
+            leaks = dict(self.memory_leaks)
+        # ledger section joins OUTSIDE the registry lock: the ledger
+        # has its own lock (telemetry.memory) and the registry lock is
+        # not re-entrant — holding both here would pin a lock order
+        # the acquire seams then have to honor forever
+        return doc | self._memory_stats(leaks) | self._ring_stats()
+
+    def _memory_stats(self, leaks: Dict[str, int]) -> dict:
+        """Device-memory ledger section — peek() never creates an
+        engine just for a snapshot."""
+        from fluvio_tpu.telemetry import memory as memory_mod
+
+        eng = memory_mod.peek()
+        if eng is None:
+            return {"memory": {"owners": {}, "total_bytes": 0,
+                               "peak_bytes": 0, "leaks": leaks}}
+        return {
+            "memory": {
+                "owners": {
+                    o: b for o, b in eng.owner_bytes().items() if b
+                },
+                "total_bytes": eng.total_bytes(),
+                "peak_bytes": eng.peak_bytes(),
+                "leaks": leaks,
+            }
+        }
 
     def _ring_stats(self) -> dict:
         """Span/event/flow ring bookkeeping, each triple read under ONE
@@ -947,10 +1033,12 @@ class PipelineTelemetry:
             self.window_deltas = {}
             self.window_delta_bytes = 0
             self.window_full_bytes = 0
+            self.memory_leaks = {}
             self._flow_seq = 0
-            # lag_sampler survives reset on purpose: the bench resets
-            # between configs and the lag engine's tracked leaders must
-            # keep re-joining; tests drop it via lag.reset_engine()
+            # lag_sampler survives reset on purpose (and mem_sampler
+            # with it, same rationale): the bench resets between
+            # configs and the engines must keep sampling; tests drop
+            # them via lag.reset_engine() / memory.reset_engine()
         self.spans = SpanRing(self.spans.capacity)
         self.events = EventRing(self.events.capacity)
         self.flows = FlowRing(self.flows.capacity)

@@ -111,6 +111,11 @@ DEFAULT_RULES: Tuple[SloRule, ...] = (
     # admission controller sheds it) without touching its siblings
     SloRule("consumer_lag", 65536.0, "records", per_chain=True),
     SloRule("record_age_p99", 60.0, "s", per_chain=True, latency=True),
+    # device-memory headroom (ISSUE-20): the ledger total against the
+    # FLUVIO_MEM_BUDGET ceiling. Disabled until a budget is set —
+    # rules_from_env arms it with target=budget so a runaway window
+    # bank sheds admission BEFORE the allocator fails
+    SloRule("hbm_headroom", 4e9, "bytes", enabled=False),
 )
 
 
@@ -155,15 +160,36 @@ def parse_slo_spec(
     return tuple(rules.values())
 
 
+def _apply_mem_budget(
+    rules: Tuple[SloRule, ...], env: Optional[dict]
+) -> Tuple[SloRule, ...]:
+    """Arm ``hbm_headroom`` with ``FLUVIO_MEM_BUDGET`` as its target
+    when a budget is set. A FLUVIO_SLO entry for the rule wins — the
+    explicit spec is the operator overriding the ambient budget."""
+    from fluvio_tpu.analysis.envreg import env_int
+
+    budget = env_int("FLUVIO_MEM_BUDGET", env) or 0
+    if budget <= 0:
+        return rules
+    return tuple(
+        replace(r, target=float(budget), enabled=True)
+        if r.name == "hbm_headroom"
+        else r
+        for r in rules
+    )
+
+
 def rules_from_env(env: Optional[dict] = None) -> Tuple[SloRule, ...]:
     spec = (env or os.environ).get(SLO_ENV, "")
+    explicit = spec and "hbm_headroom" in spec
     if not spec:
-        return DEFAULT_RULES
+        return _apply_mem_budget(DEFAULT_RULES, env)
     try:
-        return parse_slo_spec(spec)
+        rules = parse_slo_spec(spec)
     except ValueError as e:
         logger.error("ignoring malformed %s=%r: %s", SLO_ENV, spec, e)
-        return DEFAULT_RULES
+        return _apply_mem_budget(DEFAULT_RULES, env)
+    return rules if explicit else _apply_mem_budget(rules, env)
 
 
 def _observe(rule: SloRule, delta: WindowDelta) -> Dict[str, float]:
@@ -185,10 +211,13 @@ def _observe(rule: SloRule, delta: WindowDelta) -> Dict[str, float]:
             key: h.percentile(99)
             for key, h in delta.record_age_hists().items()
         }
-    if rule.name in ("queue_depth", "hbm_staged"):
+    if rule.name in ("queue_depth", "hbm_staged", "hbm_headroom"):
         gauge = {
             "queue_depth": "inflight_queue_depth",
             "hbm_staged": "hbm_staged_bytes",
+            # the full ledger total (all owners), not just staging —
+            # headroom is a property of the whole device
+            "hbm_headroom": "device_memory_bytes",
         }[rule.name]
         return {ENGINE_CHAIN: float(delta.gauges.get(gauge, 0.0))}
     counters = delta.counters()

@@ -229,10 +229,11 @@ class TimeSeries:
         One truthiness check when telemetry capture is off."""
         if not self.telemetry.enabled:
             return 0
-        # pull-join the lag gauges OUTSIDE the ring lock (the sampler
-        # takes the lag-engine + registry locks): one attribute check
-        # when nothing is tracked
+        # pull-join the lag + memory gauges OUTSIDE the ring lock (the
+        # samplers take their engine + registry locks): one attribute
+        # check each when nothing is tracked
         self.telemetry.refresh_lag()
+        self.telemetry.refresh_memory()
         now = self.clock()
         with self._lock:
             if not self._ring:
@@ -280,6 +281,7 @@ class TimeSeries:
         if not self.telemetry.enabled:
             return
         self.telemetry.refresh_lag()
+        self.telemetry.refresh_memory()
         with self._lock:
             sample = self.telemetry.timeseries_sample()
             if self._ring and sample.get("generation", 0) != (

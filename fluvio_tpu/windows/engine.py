@@ -211,13 +211,22 @@ class WindowedRuntime:
                 while fetch_rows < n_closed:
                     fetch_rows *= 2
                 fetch_rows = min(fetch_rows, emit_cols)
-                cl_ids, cl_accs, cl_cnts = (
-                    np.asarray(a)[:n_closed]
-                    for a in jax.device_get(
-                        (em_ids[:fetch_rows], em_accs[:fetch_rows],
-                         em_cnts[:fetch_rows])
-                    )
+                # emit-buffer ledger window: the sliced device rows are
+                # live HBM until the host copy below materializes
+                TELEMETRY.mem_acquire(
+                    "emit_buffer", ("emit", id(self)),
+                    fetch_rows * ENTRY_BYTES,
                 )
+                try:
+                    cl_ids, cl_accs, cl_cnts = (
+                        np.asarray(a)[:n_closed]
+                        for a in jax.device_get(
+                            (em_ids[:fetch_rows], em_accs[:fetch_rows],
+                             em_cnts[:fetch_rows])
+                        )
+                    )
+                finally:
+                    TELEMETRY.mem_release(("emit", id(self)))
                 closed_bytes = fetch_rows * ENTRY_BYTES
             else:
                 cl_ids = cl_accs = cl_cnts = np.zeros((0,), dtype=np.int64)
@@ -247,10 +256,18 @@ class WindowedRuntime:
                 fetch_rows *= 2
             fetch_rows = min(fetch_rows, self.spec.emit_capacity)
             t_ph = time.perf_counter()
-            ids, accs, cnts, closed = jax.device_get(
-                (em_ids[:fetch_rows], em_accs[:fetch_rows],
-                 em_cnts[:fetch_rows], em_closed[:fetch_rows])
+            # emit-buffer ledger window: 3 i64 + 1 i32 columns per
+            # bucket row stay device-live until this copy lands
+            TELEMETRY.mem_acquire(
+                "emit_buffer", ("emit", id(self)), fetch_rows * 28
             )
+            try:
+                ids, accs, cnts, closed = jax.device_get(
+                    (em_ids[:fetch_rows], em_accs[:fetch_rows],
+                     em_cnts[:fetch_rows], em_closed[:fetch_rows])
+                )
+            finally:
+                TELEMETRY.mem_release(("emit", id(self)))
             if span is not None:
                 span.add("d2h", time.perf_counter() - t_ph)
             ids = np.asarray(ids)[:n_emit]
@@ -279,7 +296,9 @@ class WindowedRuntime:
         if n_invalid:
             TELEMETRY.add_window_delta("invalid", n_invalid)
         TELEMETRY.add_window_downlink(delta_bytes, full_bytes)
-        TELEMETRY.gauge_set("window_state_bytes", self.bank.state_bytes())
+        # window_state_bytes now republishes from the device-memory
+        # ledger's window_bank owner — booked (always-on) inside
+        # bank.commit above, gauge publication still capture-gated
         TELEMETRY.add_link_variant("down-packed")
         TELEMETRY.end_batch(span, records=count)
         return WindowDelta(

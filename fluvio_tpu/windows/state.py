@@ -62,10 +62,20 @@ class WindowStateBank:
         self.ids, self.accs, self.counts, self.wm = ids, accs, counts, wm
         self.occupancy = int(occupancy)
         self.watermark = int(watermark)
+        self._note_ledger()
 
     def state_bytes(self) -> int:
         """Live device bytes (the `window_state_bytes` gauge)."""
         return self.occupancy * ENTRY_BYTES + 8
+
+    def _note_ledger(self) -> None:
+        # window_bank device-memory booking is ALWAYS-ON (state size
+        # is exactness evidence, like the delta byte counters); the
+        # window_state_bytes gauge republishes from the ledger, still
+        # gated on capture being enabled
+        from fluvio_tpu.telemetry import memory as memory_mod
+
+        memory_mod.note_window_bank(id(self), self.state_bytes())
 
     # -- failover / migration (CarryReplica tuple format) --------------------
 
@@ -116,6 +126,7 @@ class WindowStateBank:
         self.ids, self.accs, self.counts, self.wm = arrs
         self.occupancy = len(entries)
         self.watermark = int(watermark)
+        self._note_ledger()
 
     def to_device(self, device) -> None:
         """Lazy carry re-placement (the partition runtime's migration

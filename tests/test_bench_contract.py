@@ -1001,3 +1001,72 @@ def test_win_key_fits_contract_and_trims_after_dfa_before_soak():
     assert ladder.index('"dfa"') < ladder.index('"win"')
     assert ladder.index('"win"') < ladder.index('"soak"')
     assert ladder.index('"win"') < ladder.index('"link"')
+
+
+def test_mem_line_key_rides_compact_line():
+    """ISSUE-20: a tiny ``mem:{peak_mb,owners}`` key rides the compact
+    line when any config booked device memory — the WORST per-config
+    ledger peak and the owner classes that held bytes across the
+    family (plus ``leaks`` when non-zero); the full per-config block
+    (per-owner bytes, reconcile doc) stays in BENCH_DETAIL.json."""
+    import json
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = {}
+    for name, peak, owners in (
+        ("2_filter_map", 0.131, {"staged_batch": 98304}),
+        ("5_windowed", 1.204, {"window_bank": 2888, "emit_buffer": 448}),
+    ):
+        cfg = dict(GOOD)
+        cfg["memory"] = {"peak_mb": peak, "owners": owners}
+        results[name] = cfg
+    out, rc = b._build_output(results)
+    assert rc == 0
+    assert out["configs"]["5_windowed"]["memory"]["peak_mb"] == 1.204
+    line = json.loads(json.dumps(b._compact_line(out)))
+    assert line["mem"] == {
+        "peak_mb": 1.204,
+        "owners": ["emit_buffer", "staged_batch", "window_bank"],
+    }
+    # the bulky per-config block never reaches the line
+    assert "memory" not in line["configs"].get("5_windowed", {})
+    # a leaking run carries the count on the line
+    results["5_windowed"]["memory"]["leaks"] = 2
+    out2, _ = b._build_output(results)
+    assert json.loads(
+        json.dumps(b._compact_line(out2))
+    )["mem"]["leaks"] == 2
+    # without any booked config the key stays off entirely
+    out3, _ = b._build_output({"2_filter_map": dict(GOOD)})
+    assert "mem" not in json.loads(json.dumps(b._compact_line(out3)))
+
+
+def test_mem_key_fits_contract_and_trims_after_win_before_soak():
+    """The full-matrix line with the mem key stays ≤1500 chars and the
+    blowup trim ladder drops ``mem`` AFTER ``win`` but BEFORE ``soak``
+    (and therefore before ``lag``/``part``/``link``, the sentinel's
+    contract field)."""
+    import json
+    import re
+
+    b = _bench()
+    b._BACKEND_MODE = "tpu"
+    results = _full_results()
+    for name, cfg in results.items():
+        cfg["memory"] = {
+            "peak_mb": 0.262,
+            "owners": {"staged_batch": 131072, "glz_tokens": 4096},
+        }
+    out, _ = b._build_output(results)
+    line = json.dumps(b._compact_line(out))
+    assert len(line) <= 1500, f"compact line is {len(line)} chars"
+    parsed = json.loads(line)
+    assert parsed["mem"] == {
+        "peak_mb": 0.262, "owners": ["glz_tokens", "staged_batch"],
+    }
+    src = open(_BENCH_PATH).read()
+    ladder = re.search(r"for drop in \(([^)]*)\)", src, re.S).group(1)
+    assert ladder.index('"win"') < ladder.index('"mem"')
+    assert ladder.index('"mem"') < ladder.index('"soak"')
+    assert ladder.index('"mem"') < ladder.index('"link"')

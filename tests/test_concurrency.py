@@ -67,9 +67,17 @@ def test_package_has_no_concurrency_errors():
 def test_static_graph_is_acyclic_and_canonically_named():
     edges = static_lock_graph()
     assert find_cycle(edges) is None
-    # the one real nested acquisition today: the registry snapshot
-    # reads ring counters (spans_total/dropped) under the registry lock
-    assert ("telemetry.registry", "telemetry.ring") in edges
+    # the registry snapshot no longer nests ring/memory reads under the
+    # registry lock (ISSUE-20 moved them outside to keep the memory
+    # ledger ordering flat), so telemetry.registry -> telemetry.ring is
+    # gone; the surviving nested acquisitions are the rebalancer tick
+    # booking telemetry and the timeseries tick publishing gauges
+    assert ("telemetry.registry", "telemetry.ring") not in edges
+    assert ("partition.rebalancer", "telemetry.registry") in edges
+    assert ("telemetry.timeseries", "telemetry.registry") in edges
+    # the memory ledger publishes gauges OUTSIDE its own lock by design:
+    # no telemetry.memory -> telemetry.registry edge may ever appear
+    assert all(src != "telemetry.memory" for src, _ in edges)
 
 
 # ---------------------------------------------------------------------------

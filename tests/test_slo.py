@@ -183,8 +183,11 @@ class TestGrammar:
         assert names == {
             "e2e_p99", "spill_ratio", "error_rate", "compile_budget",
             "recompile_rate", "queue_depth", "hbm_staged",
-            "consumer_lag", "record_age_p99",
+            "consumer_lag", "record_age_p99", "hbm_headroom",
         }
+        # hbm_headroom stays dormant until FLUVIO_MEM_BUDGET arms it
+        by_name = {r.name: r for r in DEFAULT_RULES}
+        assert not by_name["hbm_headroom"].enabled
 
     def test_target_and_warn_overrides(self):
         rules = {

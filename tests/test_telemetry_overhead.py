@@ -876,3 +876,104 @@ def test_window_seams_zero_cost_when_telemetry_off():
     finally:
         TELEMETRY.enabled = prior
         TELEMETRY.reset()
+
+
+def test_memory_ledger_armed_overhead_under_gate():
+    """ISSUE-20 CI satellite: the device-memory ledger armed — one
+    acquire/release pair per batch on top of the seams the executor
+    already books — must stay inside the same <2% rps gate. A ledger
+    move is one dict write under one short lock plus four gauge sets,
+    per BATCH, never per record."""
+    from fluvio_tpu.telemetry import memory as memory_mod
+
+    memory_mod.reset_engine()
+    chain = _headline_chain()
+    executor = chain.tpu_chain
+    buf = _corpus_buf()
+    for out in executor.process_stream(iter([buf] * 2)):
+        pass
+    ledger = memory_mod.engine()
+
+    def _measure_ledger():
+        times = {"bare": [], "armed": []}
+        for _ in range(PASSES_PER_ARM):
+            for arm in ("bare", "armed"):
+                t0 = time.perf_counter()
+                for i in range(BATCHES_PER_PASS):
+                    if arm == "armed":
+                        ledger.acquire("compile_cache", ("gate", i), 4096)
+                        executor.process_buffer(buf)
+                        ledger.release(("gate", i))
+                    else:
+                        executor.process_buffer(buf)
+                times[arm].append(
+                    (time.perf_counter() - t0) / BATCHES_PER_PASS
+                )
+        return min(times["bare"]), min(times["armed"])
+
+    try:
+        for attempt in range(5):
+            bare_s, armed_s = _measure_ledger()
+            overhead = max(armed_s - bare_s, 0.0)
+            if overhead <= bare_s * GATE or overhead < 500e-6:
+                break
+        else:
+            raise AssertionError(
+                f"ledger booking cost {overhead*1e6:.0f}us/batch on a "
+                f"{bare_s*1e3:.2f}ms batch — exceeds the {GATE:.0%} gate "
+                f"after 5 measurement rounds"
+            )
+        rps_bare = N_RECORDS / bare_s
+        rps_armed = N_RECORDS / armed_s
+        assert rps_armed >= rps_bare * (1 - GATE) or overhead < 500e-6
+    finally:
+        memory_mod.reset_engine()
+        TELEMETRY.reset()
+
+
+def test_memory_seams_zero_cost_when_telemetry_off(monkeypatch):
+    """ISSUE-20 CI satellite, the strict half: with FLUVIO_TELEMETRY=0
+    the ledger seams are ONE enabled-check — tripwires on the ledger
+    entry points prove no acquire, no release, no sampler install, and
+    no gauge moves through a full pipelined pass plus direct seam
+    calls. (The ``window_bank`` owner is the documented exception: the
+    windowed engine books state bytes always-on as exactness evidence —
+    this pass rides the NON-windowed executor path.)"""
+    from fluvio_tpu.telemetry import memory as memory_mod
+
+    memory_mod.reset_engine()
+    TELEMETRY.reset()
+    prior = TELEMETRY.enabled
+    TELEMETRY.enabled = False
+    try:
+
+        def tripwire(*a, **k):
+            raise AssertionError("memory seam touched with telemetry off")
+
+        monkeypatch.setattr(memory_mod.MemoryLedger, "acquire", tripwire)
+        monkeypatch.setattr(memory_mod.MemoryLedger, "release", tripwire)
+        monkeypatch.setattr(memory_mod.MemoryLedger, "sample", tripwire)
+
+        # direct seam calls: all gated to a single enabled check
+        TELEMETRY.mem_acquire("staged_batch", ("b", 1), 4096)
+        TELEMETRY.mem_release(("b", 1))
+        TELEMETRY.refresh_memory()
+        assert TELEMETRY.mem_sampler is None
+
+        chain = _headline_chain()
+        buf = _corpus_buf()
+        for out in chain.tpu_chain.process_stream(iter([buf] * 2)):
+            pass
+        # nothing minted a ledger, and the snapshot's memory section
+        # reads honest zeros
+        assert memory_mod.peek() is None
+        snap = TELEMETRY.snapshot()
+        assert snap["memory"] == {
+            "owners": {}, "total_bytes": 0, "peak_bytes": 0, "leaks": {},
+        }
+        assert "device_memory_bytes" not in snap["gauges"]
+        assert "hbm_staged_bytes" not in snap["gauges"]
+    finally:
+        TELEMETRY.enabled = prior
+        TELEMETRY.reset()
+        memory_mod.reset_engine()

@@ -123,9 +123,19 @@ def _pack(values, ts):
 
 @pytest.fixture(autouse=True)
 def _clean_faults():
+    from fluvio_tpu.telemetry import memory as memory_mod
+
     faults.FAULTS.clear()
+    memory_mod.reset_engine()
     yield
     faults.FAULTS.clear()
+    # ISSUE-20 standing invariant: whatever each test did — faults,
+    # failover, capacity errors — the transient device-memory owners
+    # (emit fetch buffers above all) must have drained at quiesce
+    eng = memory_mod.peek()
+    if eng is not None:
+        eng.assert_drained()
+    memory_mod.reset_engine()
 
 
 class TestExactness:
